@@ -1,0 +1,36 @@
+"""ACCL-TPU: a TPU-native collective communication framework.
+
+A ground-up rebuild of the capabilities of the reference ACCL (an MPI-like
+collective offload library for network-attached FPGAs) for TPUs:
+
+- the same driver API (`ACCL`, buffers, communicators, async requests,
+  eager/rendezvous protocols, on-path reduction, wire compression);
+- a native C++ collective engine + CPU dataplane emulator, so everything
+  is testable without TPU hardware (reference test ladder rung 1);
+- a JAX/XLA backend lowering every collective to HLO collectives over the
+  ICI mesh, and Pallas kernels for ring collectives / reduction /
+  compression lanes;
+- an SPMD parallelism layer (data/tensor/pipeline/expert/sequence
+  parallelism, ring attention) built on those collectives.
+"""
+
+from .accl import ACCL, GLOBAL_COMM  # noqa: F401
+from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig  # noqa: F401
+from .buffer import BaseBuffer, DummyBuffer  # noqa: F401
+from .communicator import Communicator, Rank  # noqa: F401
+from .constants import (  # noqa: F401
+    ACCLError,
+    CCLOCall,
+    CfgFunc,
+    CompressionFlags,
+    DataType,
+    ErrorCode,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    TAG_ANY,
+)
+from .request import Request  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,608 @@
+"""The ACCL driver: public collective API and call marshaling.
+
+Equivalent of the reference `ACCL::ACCL` host driver class
+(driver/xrt/include/accl/accl.hpp:46-1148, driver/xrt/src/accl.cpp):
+every collective builds one 15-word call descriptor, syncs operand
+buffers to the device, submits asynchronously through the request queue,
+and on completion syncs results back and checks the engine retcode.
+
+The collective *algorithms* do not live here — exactly as in the
+reference, where the host only marshals a descriptor and the
+device-resident engine decomposes it (SURVEY §1).  Here the engine is
+either the native C++ emulator (backends/emu.py) or the JAX/XLA/Pallas
+TPU engine (backends/tpu.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
+from .backends.base import CCLODevice
+from .buffer import BaseBuffer, DummyBuffer
+from .communicator import Communicator, Rank
+from .constants import (
+    ACCLError,
+    CCLOCall,
+    CfgFunc,
+    CompressionFlags,
+    DataType,
+    DEFAULT_EAGER_RX_BUFS,
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    TAG_ANY,
+)
+from .request import Request, RequestQueue
+
+GLOBAL_COMM = 0  # id of the world communicator, like the reference's comm 0
+
+
+class ACCL:
+    """One rank's handle on the collective engine.
+
+    Usage mirrors the reference driver: construct with a backend device,
+    call :meth:`initialize` with the rank table, then issue collectives.
+    """
+
+    def __init__(self, device: CCLODevice):
+        self._device = device
+        self._queue = RequestQueue()
+        self._communicators: list[Communicator] = []
+        self._arith_ids: dict[tuple[DataType, DataType], int] = {}
+        self._initialized = False
+        self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
+        self.max_rendezvous_size = DEFAULT_MAX_RENDEZVOUS_SIZE
+        self._last_request: Optional[Request] = None
+
+    # ------------------------------------------------------------------
+    # bring-up (reference: accl.cpp:1082-1130 initialize)
+    # ------------------------------------------------------------------
+    def initialize(
+        self,
+        ranks: Sequence[Rank],
+        local_rank: int,
+        n_egr_rx_bufs: int = DEFAULT_EAGER_RX_BUFS,
+        egr_rx_buf_size: int = DEFAULT_EAGER_RX_BUF_SIZE,
+        # NB: the reference *driver* defaults the eager threshold to the rx
+        # buffer size (1 KB, accl.hpp:103-105), overriding the engine's
+        # 32 KB default (ccl_offload_control.c:27-28).
+        max_eager_size: Optional[int] = None,
+        max_rendezvous_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE,
+        timeout: int = 1_000_000,
+    ) -> None:
+        """Full bring-up sequence (reference order, accl.cpp:1082-1130):
+        soft reset, eager rx buffer pool, rendezvous spare buffers,
+        communicator, arithmetic configs, tuning, thresholds, enable."""
+        if self._initialized:
+            raise ACCLError("ACCL already initialized")
+
+        # 1. soft reset (reference: accl.cpp:57-69 soft_reset)
+        self._config_call(CfgFunc.reset_periph)
+
+        # 2. eager rx buffers + rendezvous spares live inside the backend
+        #    engine (reference writes a table into exchange memory,
+        #    accl.cpp:1147-1212; our backends own their pools).
+        self._device.setup_rx_buffers(n_egr_rx_bufs, egr_rx_buf_size)
+
+        # 3. communicator (reference: accl.cpp:1435-1443)
+        comm = Communicator(list(ranks), local_rank, comm_id=GLOBAL_COMM)
+        self._device.upload_communicator(comm)
+        self._communicators = [comm]
+
+        # 4. arithmetic configs (reference: accl.cpp:1132-1141)
+        for key, cfg in DEFAULT_ARITH_CONFIG.items():
+            self._arith_ids[key] = self._device.upload_arithconfig(cfg)
+
+        # 5. timeout + protocol thresholds (reference: accl.cpp:1112-1120)
+        self._config_call(CfgFunc.set_timeout, value=timeout)
+        if max_eager_size is None:
+            max_eager_size = egr_rx_buf_size
+        self.set_max_eager_msg_size(max_eager_size)
+        self.set_max_rendezvous_msg_size(max_rendezvous_size)
+
+        # 6. enable transport engines (reference: accl.cpp:1122-1125)
+        self._config_call(CfgFunc.enable_pkt)
+        self._initialized = True
+
+    # ------------------------------------------------------------------
+    # properties / config
+    # ------------------------------------------------------------------
+    @property
+    def device(self) -> CCLODevice:
+        return self._device
+
+    @property
+    def comm(self) -> Communicator:
+        return self._communicators[GLOBAL_COMM]
+
+    @property
+    def rank(self) -> int:
+        return self.comm.local_rank
+
+    @property
+    def size(self) -> int:
+        return self.comm.size
+
+    def communicator(self, comm_id: int) -> Communicator:
+        return self._communicators[comm_id]
+
+    def create_communicator(self, indices: Sequence[int]) -> int:
+        """Create a sub-communicator from global-rank indices; returns its
+        id (reference: accl.cpp:971-978)."""
+        new_id = len(self._communicators)
+        sub = self.comm.split(indices, new_id)
+        self._device.upload_communicator(sub)
+        self._communicators.append(sub)
+        return new_id
+
+    def set_max_eager_msg_size(self, nbytes: int) -> None:
+        """Runtime eager↔rendezvous threshold (reference:
+        accl.cpp:1415-1423; validated ≥ rx buffer size by the engine,
+        ccl_offload_control.c:2432-2441)."""
+        self._config_call(CfgFunc.set_max_eager_msg_size, value=nbytes)
+        self.max_eager_size = nbytes
+
+    def set_max_rendezvous_msg_size(self, nbytes: int) -> None:
+        self._config_call(CfgFunc.set_max_rendezvous_msg_size, value=nbytes)
+        self.max_rendezvous_size = nbytes
+
+    def set_timeout(self, timeout: int) -> None:
+        self._config_call(CfgFunc.set_timeout, value=timeout)
+
+    def get_duration(self, request: Optional[Request] = None) -> float:
+        """Duration in ns of a completed call, from the engine's
+        performance counter (reference: accl.cpp:1387 get_duration;
+        simdevice.cpp:123 cycle→ns scaling)."""
+        req = request or self._last_request
+        return req.duration_ns if req else 0.0
+
+    # ------------------------------------------------------------------
+    # buffers
+    # ------------------------------------------------------------------
+    def create_buffer(self, length: int, dtype=np.float32) -> BaseBuffer:
+        """Allocate a paired host+device buffer
+        (reference: accl.hpp:774-1004 create_buffer<T> family)."""
+        return self._device.create_buffer(length, np.dtype(dtype))
+
+    def create_buffer_like(self, data: np.ndarray) -> BaseBuffer:
+        buf = self.create_buffer(int(np.asarray(data).size), np.asarray(data).dtype)
+        buf.host[:] = np.asarray(data).reshape(-1)
+        return buf
+
+    # ------------------------------------------------------------------
+    # collectives — each mirrors one reference entry point in accl.cpp
+    # ------------------------------------------------------------------
+    def send(
+        self,
+        srcbuf: BaseBuffer,
+        count: int,
+        dst: int,
+        tag: int = TAG_ANY,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Point-to-point send (reference: accl.cpp:138)."""
+        call = self._build(
+            Operation.send, count, comm_id, root_src_dst=dst, tag=tag,
+            op0=srcbuf, stream_flags=stream_flags, compress_dtype=compress_dtype,
+        )
+        return self._execute(call, sync_in=[] if from_fpga else [(srcbuf, count)],
+                             sync_out=[], run_async=run_async, desc=f"send(dst={dst})")
+
+    def recv(
+        self,
+        dstbuf: BaseBuffer,
+        count: int,
+        src: int,
+        tag: int = TAG_ANY,
+        comm_id: int = GLOBAL_COMM,
+        to_fpga: bool = False,
+        stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Point-to-point receive (reference: accl.cpp:252)."""
+        call = self._build(
+            Operation.recv, count, comm_id, root_src_dst=src, tag=tag,
+            res=dstbuf, stream_flags=stream_flags, compress_dtype=compress_dtype,
+        )
+        return self._execute(call, sync_in=[],
+                             sync_out=[] if to_fpga else [(dstbuf, count)],
+                             run_async=run_async, desc=f"recv(src={src})")
+
+    def stream_put(
+        self,
+        srcbuf: BaseBuffer,
+        count: int,
+        dst: int,
+        stream_id: int,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        run_async: bool = False,
+    ):
+        """Send into a remote compute stream: the payload is routed to
+        stream `stream_id` on the destination instead of a memory buffer
+        (reference: accl.cpp:191-250 stream_put; remote routing by header
+        strm field, udp_depacketizer.cpp:136-147)."""
+        if stream_id < 9:
+            raise ACCLError("stream ids < 9 are reserved")  # reference: accl.cpp:197
+        call = self._build(
+            Operation.send, count, comm_id, root_src_dst=dst, tag=stream_id,
+            op0=srcbuf, stream_flags=StreamFlags.RES_STREAM,
+        )
+        return self._execute(call, sync_in=[] if from_fpga else [(srcbuf, count)],
+                             sync_out=[], run_async=run_async,
+                             desc=f"stream_put(dst={dst}, strm={stream_id})")
+
+    def copy(
+        self,
+        srcbuf: BaseBuffer,
+        dstbuf: BaseBuffer,
+        count: int,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        run_async: bool = False,
+    ):
+        """Local device-side copy (reference: accl.cpp:310)."""
+        call = self._build(Operation.copy, count, GLOBAL_COMM, op0=srcbuf, res=dstbuf)
+        return self._execute(call, sync_in=[] if from_fpga else [(srcbuf, count)],
+                             sync_out=[] if to_fpga else [(dstbuf, count)],
+                             run_async=run_async, desc="copy")
+
+    def combine(
+        self,
+        count: int,
+        function: ReduceFunction,
+        op0: BaseBuffer,
+        op1: BaseBuffer,
+        res: BaseBuffer,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        run_async: bool = False,
+    ):
+        """Local elementwise reduction of two device buffers
+        (reference: accl.cpp:378)."""
+        call = self._build(
+            Operation.combine, count, GLOBAL_COMM, function=int(function),
+            op0=op0, op1=op1, res=res,
+        )
+        sync_in = [] if from_fpga else [(op0, count), (op1, count)]
+        return self._execute(call, sync_in=sync_in,
+                             sync_out=[] if to_fpga else [(res, count)],
+                             run_async=run_async, desc=f"combine({function.name})")
+
+    def bcast(
+        self,
+        buf: BaseBuffer,
+        count: int,
+        root: int,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Broadcast from root (reference: accl.cpp:418)."""
+        comm = self._communicators[comm_id]
+        is_root = comm.local_rank == root
+        call = self._build(
+            Operation.bcast, count, comm_id, root_src_dst=root,
+            op0=buf if is_root else None, res=None if is_root else buf,
+            compress_dtype=compress_dtype,
+        )
+        sync_in = [(buf, count)] if (is_root and not from_fpga) else []
+        sync_out = [(buf, count)] if (not is_root and not to_fpga) else []
+        return self._execute(call, sync_in=sync_in, sync_out=sync_out,
+                             run_async=run_async, desc=f"bcast(root={root})")
+
+    def scatter(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        root: int,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Scatter `count` elements to each rank from root
+        (reference: accl.cpp:464)."""
+        comm = self._communicators[comm_id]
+        is_root = comm.local_rank == root
+        call = self._build(
+            Operation.scatter, count, comm_id, root_src_dst=root,
+            op0=sendbuf if is_root else None, res=recvbuf,
+            compress_dtype=compress_dtype,
+        )
+        sync_in = [(sendbuf, count * comm.size)] if (is_root and not from_fpga) else []
+        sync_out = [] if to_fpga else [(recvbuf, count)]
+        return self._execute(call, sync_in=sync_in, sync_out=sync_out,
+                             run_async=run_async, desc=f"scatter(root={root})")
+
+    def gather(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        root: int,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Gather `count` elements from each rank at root
+        (reference: accl.cpp:513)."""
+        comm = self._communicators[comm_id]
+        is_root = comm.local_rank == root
+        call = self._build(
+            Operation.gather, count, comm_id, root_src_dst=root,
+            op0=sendbuf, res=recvbuf if is_root else None,
+            compress_dtype=compress_dtype,
+        )
+        sync_in = [] if from_fpga else [(sendbuf, count)]
+        sync_out = [(recvbuf, count * comm.size)] if (is_root and not to_fpga) else []
+        return self._execute(call, sync_in=sync_in, sync_out=sync_out,
+                             run_async=run_async, desc=f"gather(root={root})")
+
+    def allgather(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """All-gather (reference: accl.cpp:571)."""
+        comm = self._communicators[comm_id]
+        call = self._build(
+            Operation.allgather, count, comm_id,
+            op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
+        )
+        return self._execute(call,
+                             sync_in=[] if from_fpga else [(sendbuf, count)],
+                             sync_out=[] if to_fpga else [(recvbuf, count * comm.size)],
+                             run_async=run_async, desc="allgather")
+
+    def reduce(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        root: int,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Rooted reduction (reference: accl.cpp:627-794, 4 overloads)."""
+        comm = self._communicators[comm_id]
+        is_root = comm.local_rank == root
+        call = self._build(
+            Operation.reduce, count, comm_id, root_src_dst=root,
+            function=int(function), op0=sendbuf,
+            res=recvbuf if is_root else None, compress_dtype=compress_dtype,
+        )
+        sync_out = [(recvbuf, count)] if (is_root and not to_fpga) else []
+        return self._execute(call, sync_in=[] if from_fpga else [(sendbuf, count)],
+                             sync_out=sync_out, run_async=run_async,
+                             desc=f"reduce(root={root},{function.name})")
+
+    def allreduce(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """All-reduce (reference: accl.cpp:796)."""
+        call = self._build(
+            Operation.allreduce, count, comm_id, function=int(function),
+            op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
+        )
+        return self._execute(call, sync_in=[] if from_fpga else [(sendbuf, count)],
+                             sync_out=[] if to_fpga else [(recvbuf, count)],
+                             run_async=run_async, desc=f"allreduce({function.name})")
+
+    def reduce_scatter(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        function: ReduceFunction = ReduceFunction.SUM,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        compress_dtype: Optional[DataType] = None,
+        run_async: bool = False,
+    ):
+        """Reduce-scatter: each rank ends with `count` reduced elements
+        (reference: accl.cpp:844)."""
+        comm = self._communicators[comm_id]
+        call = self._build(
+            Operation.reduce_scatter, count, comm_id, function=int(function),
+            op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
+        )
+        return self._execute(call,
+                             sync_in=[] if from_fpga else [(sendbuf, count * comm.size)],
+                             sync_out=[] if to_fpga else [(recvbuf, count)],
+                             run_async=run_async, desc=f"reduce_scatter({function.name})")
+
+    def alltoall(
+        self,
+        sendbuf: BaseBuffer,
+        recvbuf: BaseBuffer,
+        count: int,
+        comm_id: int = GLOBAL_COMM,
+        from_fpga: bool = False,
+        to_fpga: bool = False,
+        run_async: bool = False,
+    ):
+        """All-to-all personalized exchange (reference: accl.cpp:892)."""
+        comm = self._communicators[comm_id]
+        call = self._build(Operation.alltoall, count, comm_id,
+                           op0=sendbuf, res=recvbuf)
+        return self._execute(call,
+                             sync_in=[] if from_fpga else [(sendbuf, count * comm.size)],
+                             sync_out=[] if to_fpga else [(recvbuf, count * comm.size)],
+                             run_async=run_async, desc="alltoall")
+
+    def barrier(self, comm_id: int = GLOBAL_COMM, run_async: bool = False):
+        """Barrier over the communicator (reference: accl.cpp:947)."""
+        call = self._build(Operation.barrier, 0, comm_id)
+        return self._execute(call, sync_in=[], sync_out=[],
+                             run_async=run_async, desc="barrier")
+
+    def nop(self, run_async: bool = False):
+        call = self._build(Operation.nop, 0, GLOBAL_COMM)
+        return self._execute(call, sync_in=[], sync_out=[],
+                             run_async=run_async, desc="nop")
+
+    # ------------------------------------------------------------------
+    # marshaling (reference: accl.cpp:1252-1372 prepare_call)
+    # ------------------------------------------------------------------
+    def _build(
+        self,
+        scenario: Operation,
+        count: int,
+        comm_id: int,
+        root_src_dst: int = 0,
+        function: int = 0,
+        tag: int = TAG_ANY,
+        op0: Optional[BaseBuffer] = None,
+        op1: Optional[BaseBuffer] = None,
+        res: Optional[BaseBuffer] = None,
+        stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+        compress_dtype: Optional[DataType] = None,
+    ) -> CCLOCall:
+        """Build a call descriptor: select the arithmetic config from the
+        (uncompressed, compressed) dtype pair, derive compression flags,
+        substitute dummies for absent operands — the same responsibilities
+        as the reference prepare_call (accl.cpp:1252-1372)."""
+        dummy = DummyBuffer()
+        op0 = op0 if op0 is not None else dummy
+        op1 = op1 if op1 is not None else dummy
+        res = res if res is not None else dummy
+
+        # dtype consistency across present operands (accl.cpp:1262-1296)
+        dtypes = {b.data_type for b in (op0, op1, res) if not b.is_dummy}
+        if len(dtypes) > 1:
+            raise ACCLError(f"mismatched buffer dtypes in call: {dtypes}")
+        dtype = dtypes.pop() if dtypes else DataType.float32
+
+        compression = CompressionFlags.NO_COMPRESSION
+        if compress_dtype is not None and compress_dtype != dtype:
+            pair = (dtype, compress_dtype)
+            if pair not in self._arith_ids:
+                raise ACCLError(f"no arithmetic config for dtype pair {pair}")
+            arithcfg = self._arith_ids[pair]
+            # Only on-the-wire compression is requested at the API level;
+            # per-operand COMPRESSED flags are derived by the engine per
+            # collective step (flag algebra, e.g. fw :1408-1411).
+            compression = CompressionFlags.ETH_COMPRESSED
+        else:
+            pair = (dtype, dtype)
+            if pair not in self._arith_ids and scenario not in (
+                Operation.config, Operation.nop, Operation.barrier,
+            ):
+                raise ACCLError(f"unsupported dtype {dtype!r}")
+            arithcfg = self._arith_ids.get(pair, 0)
+
+        return CCLOCall(
+            scenario=scenario,
+            count=count,
+            comm=comm_id,
+            root_src_dst=root_src_dst,
+            function=function,
+            tag=tag,
+            arithcfg=arithcfg,
+            compression_flags=compression,
+            stream_flags=stream_flags,
+            host_flags=HostFlags.NO_HOST,
+            addr_0=op0.address,
+            addr_1=op1.address,
+            addr_2=res.address,
+        )
+
+    def _config_call(self, func: CfgFunc, value: int = 0) -> None:
+        """Issue an Operation.config descriptor
+        (reference: accl.cpp call_config / cfgFunc dispatch fw :2413-2459)."""
+        call = CCLOCall(scenario=Operation.config, count=value, function=int(func))
+        req = Request(f"config({func.name})")
+        self._queue.submit(req, lambda r: self._device.start(call, r))
+        if not req.wait(timeout=30.0):
+            raise ACCLError(f"config({func.name}) timed out")
+        req.check()
+
+    def _execute(
+        self,
+        call: CCLOCall,
+        sync_in: list,
+        sync_out: list,
+        run_async: bool,
+        desc: str,
+    ):
+        """Submit one call: sync inputs, start async, and either return the
+        request handle or wait + sync outputs + check retcode
+        (reference: call_async/call_sync accl.cpp:1395-1413)."""
+        for buf, count in sync_in:
+            if not buf.is_dummy:
+                buf.slice(0, min(count, buf.length)).sync_to_device()
+
+        req = Request(desc)
+
+        def finish(r: Request) -> None:
+            if r.retcode == 0:
+                for buf, count in sync_out:
+                    if not buf.is_dummy:
+                        buf.slice(0, min(count, buf.length)).sync_from_device()
+
+        req.on_complete = finish
+        self._queue.submit(req, lambda r: self._device.start(call, r))
+        self._last_request = req
+        if run_async:
+            return req
+        if not req.wait(timeout=60.0):
+            raise ACCLError(f"{desc} timed out waiting for engine completion")
+        req.check()
+        return req
+
+    # ------------------------------------------------------------------
+    # observability (reference: accl.cpp:980-1064 dump utilities)
+    # ------------------------------------------------------------------
+    def dump_communicator(self, comm_id: int = GLOBAL_COMM) -> str:
+        return self._communicators[comm_id].dump()
+
+    def dump_rx_buffers(self) -> str:
+        dump = getattr(self._device, "dump_rx_buffers", None)
+        return dump() if dump else "<backend has no rx buffer table>"
+
+    def deinit(self) -> None:
+        self._device.close()
+
+    def __enter__(self) -> "ACCL":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.deinit()

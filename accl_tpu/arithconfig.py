@@ -1,0 +1,128 @@
+"""Arithmetic/compression routing configuration.
+
+The reference routes every operand through datapath lanes selected by an
+"arithmetic configuration": element widths of the uncompressed and
+compressed representations, their ratio, and TDEST routing ids for the
+compressor, decompressor and arithmetic units
+(reference: driver/xrt/include/accl/arithconfig.hpp:32-119).
+
+In the TPU build the same structure selects which emulator arithmetic
+lane / Pallas kernel handles a dtype pair, and whether wire payloads are
+sent compressed.  The table is uploaded to the native engine at
+`ACCL.initialize()` time, exactly as `write_arithconfig` serializes it to
+exchange memory in the reference (driver/xrt/src/common.cpp:50-73).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .constants import DATA_TYPE_SIZE, DataType
+
+
+@dataclass(frozen=True)
+class ArithConfig:
+    """Datapath routing metadata for one (uncompressed, compressed) pair.
+
+    Mirrors the field set of the reference ArithConfig
+    (arithconfig.hpp:32-100): element widths, elems-per-word ratio,
+    routing ids for compressor / decompressor / arithmetic function, and
+    whether arithmetic runs on the compressed representation.
+    """
+
+    uncompressed_elem_bits: int
+    compressed_elem_bits: int
+    elem_ratio_log: int  # log2(uncompressed/compressed width ratio)
+    compressor_tdest: int
+    decompressor_tdest: int
+    arith_is_compressed: bool
+    arith_tdest: tuple[int, ...]  # per ReduceFunction (SUM, MAX)
+
+    @property
+    def compression_ratio(self) -> int:
+        return 1 << self.elem_ratio_log
+
+    def to_words(self) -> list[int]:
+        """Serialize for upload into the engine's config region
+        (reference: common.cpp:50-73)."""
+        words = [
+            self.uncompressed_elem_bits,
+            self.compressed_elem_bits,
+            self.elem_ratio_log,
+            self.compressor_tdest,
+            self.decompressor_tdest,
+            int(self.arith_is_compressed),
+            len(self.arith_tdest),
+        ]
+        words.extend(self.arith_tdest)
+        return words
+
+
+# Arithmetic lane ids of the emulator/Pallas reduce unit.  One lane per
+# (dtype, function) pair, equivalent to the 10 TDEST-selected functions of
+# the reference reduce_ops plugin (kernels/plugins/reduce_ops/reduce_ops.cpp:31-107).
+ARITH_LANE = {
+    (DataType.float32, "sum"): 0,
+    (DataType.float32, "max"): 1,
+    (DataType.float64, "sum"): 2,
+    (DataType.float64, "max"): 3,
+    (DataType.int32, "sum"): 4,
+    (DataType.int32, "max"): 5,
+    (DataType.int64, "sum"): 6,
+    (DataType.int64, "max"): 7,
+    (DataType.float16, "sum"): 8,
+    (DataType.float16, "max"): 9,
+}
+
+# Compression lane ids (reference hp_compression plugin: TDEST 0=compress
+# fp32->fp16, 1=decompress; hp_compression.cpp:70-144).
+COMPRESS_F32_F16 = 0
+DECOMPRESS_F16_F32 = 1
+
+
+def _cfg(u: DataType, c: DataType, arith_compressed: bool = False) -> ArithConfig:
+    ubits = DATA_TYPE_SIZE[u]
+    cbits = DATA_TYPE_SIZE[c]
+    ratio_log = max(0, (ubits // max(cbits, 1)).bit_length() - 1)
+    arith_dtype = c if arith_compressed else u
+    return ArithConfig(
+        uncompressed_elem_bits=ubits,
+        compressed_elem_bits=cbits,
+        elem_ratio_log=ratio_log,
+        compressor_tdest=COMPRESS_F32_F16 if u != c else 0,
+        decompressor_tdest=DECOMPRESS_F16_F32 if u != c else 0,
+        arith_is_compressed=arith_compressed,
+        arith_tdest=(
+            ARITH_LANE[(arith_dtype, "sum")],
+            ARITH_LANE[(arith_dtype, "max")],
+        ),
+    )
+
+
+#: Default configs for every supported dtype pair, equivalent to
+#: DEFAULT_ARITH_CONFIG (arithconfig.hpp:106-119): identity pairs for
+#: {f16,f32,f64,i32,i64} plus the fp32-over-fp16 compressed pair.
+DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
+    (DataType.float16, DataType.float16): _cfg(DataType.float16, DataType.float16),
+    (DataType.float32, DataType.float32): _cfg(DataType.float32, DataType.float32),
+    (DataType.float64, DataType.float64): _cfg(DataType.float64, DataType.float64),
+    (DataType.int32, DataType.int32): _cfg(DataType.int32, DataType.int32),
+    (DataType.int64, DataType.int64): _cfg(DataType.int64, DataType.int64),
+    (DataType.float32, DataType.float16): _cfg(
+        DataType.float32, DataType.float16, arith_compressed=False
+    ),
+}
+
+
+#: numpy dtype <-> DataType mapping used by the buffer layer.
+NUMPY_TO_DATATYPE = {
+    np.dtype(np.float16): DataType.float16,
+    np.dtype(np.float32): DataType.float32,
+    np.dtype(np.float64): DataType.float64,
+    np.dtype(np.int32): DataType.int32,
+    np.dtype(np.int64): DataType.int64,
+    np.dtype(np.int8): DataType.int8,
+}
+
+DATATYPE_TO_NUMPY = {v: k for k, v in NUMPY_TO_DATATYPE.items()}

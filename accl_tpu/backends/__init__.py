@@ -1,0 +1,13 @@
+"""Device backends implementing the CCLO interface.
+
+Reference analog: the abstract `CCLO` class with FPGADevice / SimDevice /
+CoyoteDevice implementations (driver/xrt/include/accl/cclo.hpp:35).
+TPU-native backends:
+
+- ``EmuDevice``  (emu.py)  — native C++ collective engine + CPU dataplane
+                             over inproc/TCP transport (SimDevice analog).
+- ``TpuDevice``  (tpu.py)  — JAX/XLA/Pallas engine over a device mesh
+                             (FPGADevice analog; ICI replaces the POEs).
+"""
+
+from .base import CCLODevice  # noqa: F401

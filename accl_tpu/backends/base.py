@@ -1,0 +1,68 @@
+"""Abstract device backend ("CCLO") interface.
+
+Mirrors the role of the reference `CCLO` abstraction: start a call
+descriptor asynchronously, expose device memory read/write, and surface
+config/retcode/perf-counter state (reference:
+driver/xrt/include/accl/cclo.hpp:35-160).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..arithconfig import ArithConfig
+from ..buffer import BaseBuffer
+from ..communicator import Communicator
+from ..constants import CCLOCall
+from ..request import Request
+
+
+class CCLODevice(ABC):
+    """One rank's view of the collective engine."""
+
+    # -- call path ----------------------------------------------------
+    @abstractmethod
+    def start(self, call: CCLOCall, request: Request) -> None:
+        """Begin executing a 15-word call descriptor; `request` completes
+        asynchronously with the engine retcode + duration."""
+
+    # -- device memory ------------------------------------------------
+    @abstractmethod
+    def alloc_mem(self, nbytes: int, alignment: int = 64) -> int:
+        ...
+
+    @abstractmethod
+    def free_mem(self, address: int) -> None:
+        ...
+
+    @abstractmethod
+    def read_mem(self, address: int, nbytes: int) -> bytes:
+        ...
+
+    @abstractmethod
+    def write_mem(self, address: int, data: bytes) -> None:
+        ...
+
+    # -- buffers ------------------------------------------------------
+    @abstractmethod
+    def create_buffer(self, length: int, dtype: np.dtype) -> BaseBuffer:
+        ...
+
+    # -- configuration ------------------------------------------------
+    @abstractmethod
+    def setup_rx_buffers(self, n_bufs: int, buf_size: int) -> None:
+        """Provision the eager rx buffer pool + rendezvous spare buffers
+        (reference: accl.cpp:1147-1212)."""
+
+    @abstractmethod
+    def upload_communicator(self, comm: Communicator) -> int:
+        """Install a communicator table; returns the id used in call word 2."""
+
+    @abstractmethod
+    def upload_arithconfig(self, cfg: ArithConfig) -> int:
+        """Install an arithmetic config; returns its table id."""
+
+    def close(self) -> None:
+        """Tear down the backend (join threads, close sockets)."""

@@ -1,0 +1,154 @@
+"""Buffer hierarchy: paired host/device storage with sync and slicing.
+
+Equivalent of the reference buffer stack — abstract `BaseBuffer` with
+`sync_to_device` / `sync_from_device` / `slice`, concretized per backend
+(reference: driver/xrt/include/accl/buffer.hpp:32-226; FPGABuffer =
+XRT BO + host map, fpgabuffer.hpp; SimBuffer mirrors via ZMQ mem writes,
+simbuffer.hpp; DummyBuffer stands in for absent operands, dummybuffer.hpp).
+
+TPU-native mapping:
+- `EmuBuffer`   — host numpy array mirrored into the native emulator's
+                  device memory at an allocated offset (SimBuffer analog).
+- `TpuBuffer`   — host numpy array paired with a jax.Array placed on the
+                  mesh (FPGABuffer analog; defined in backends/tpu.py).
+- `DummyBuffer` — address-0 placeholder substituted for absent operands
+                  (reference: accl.cpp prepare_call dummy substitution).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .arithconfig import NUMPY_TO_DATATYPE
+from .constants import DataType
+
+
+class BaseBuffer:
+    """A typed span of host memory paired with a device residence.
+
+    `address` is the backend-specific device address (emulator devicemem
+    offset, or an opaque handle for the TPU backend) carried in call
+    descriptor words 9-14.
+    """
+
+    def __init__(self, host: np.ndarray, address: int = 0):
+        if host.ndim != 1:
+            host = host.reshape(-1)
+        self._host = host
+        self._address = address
+
+    # -- geometry -----------------------------------------------------
+    @property
+    def host(self) -> np.ndarray:
+        return self._host
+
+    @property
+    def address(self) -> int:
+        return self._address
+
+    @property
+    def length(self) -> int:
+        """Element count."""
+        return int(self._host.size)
+
+    @property
+    def size(self) -> int:
+        """Byte count."""
+        return int(self._host.nbytes)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._host.dtype
+
+    @property
+    def data_type(self) -> DataType:
+        return NUMPY_TO_DATATYPE[self._host.dtype]
+
+    @property
+    def is_dummy(self) -> bool:
+        return False
+
+    # -- data movement ------------------------------------------------
+    def sync_to_device(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def sync_from_device(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def slice(self, start: int, end: int) -> "BaseBuffer":
+        """A sub-span sharing host storage, with device address advanced by
+        the byte offset (reference: buffer.hpp slice())."""
+        raise NotImplementedError
+
+    # -- convenience --------------------------------------------------
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, idx):
+        return self._host[idx]
+
+    def __setitem__(self, idx, val):
+        self._host[idx] = val
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(len={self.length}, dtype={self.dtype}, "
+            f"addr={self._address:#x})"
+        )
+
+
+class DummyBuffer(BaseBuffer):
+    """Placeholder for an absent operand; address 0, no data movement
+    (reference: dummybuffer.hpp)."""
+
+    def __init__(self, dtype=np.float32):
+        super().__init__(np.zeros(0, dtype=dtype), address=0)
+
+    @property
+    def is_dummy(self) -> bool:
+        return True
+
+    def sync_to_device(self) -> None:
+        pass
+
+    def sync_from_device(self) -> None:
+        pass
+
+    def slice(self, start: int, end: int) -> "DummyBuffer":
+        return self
+
+
+class EmuBuffer(BaseBuffer):
+    """Host numpy array mirrored into the native emulator's device memory.
+
+    The emulator owns a flat per-rank device memory (the reference
+    emulator's `vector<char> devicemem`, test/model/emulator/cclo_emu.cpp:57);
+    sync copies bytes across the ctypes boundary like the reference
+    SimBuffer's ZMQ mem read/write (simbuffer.hpp).
+    """
+
+    def __init__(self, host: np.ndarray, device, address: int, owner: bool = True):
+        super().__init__(host, address)
+        self._device = device
+        self._owner = owner
+
+    def sync_to_device(self) -> None:
+        self._device.write_mem(self._address, self._host.tobytes())
+
+    def sync_from_device(self) -> None:
+        raw = self._device.read_mem(self._address, self.size)
+        self._host[:] = np.frombuffer(raw, dtype=self._host.dtype)
+
+    def slice(self, start: int, end: int) -> "EmuBuffer":
+        itemsize = self._host.itemsize
+        return EmuBuffer(
+            self._host[start:end],
+            self._device,
+            self._address + start * itemsize,
+            owner=False,
+        )
+
+    def free(self) -> None:
+        if self._owner:
+            self._device.free_mem(self._address)

@@ -1,0 +1,118 @@
+"""Communicator: the rank table and sub-group machinery.
+
+Equivalent of the reference Communicator, which serializes a table of
+{ip, port, inbound/outbound sequence numbers, session, max_segment_size}
+per rank into device exchange memory and supports readback/dump
+(reference: driver/xrt/include/accl/communicator.hpp:34-95,
+driver/xrt/src/communicator.cpp:23-117).
+
+The TPU build keeps the same table semantics: the emulator backend uploads
+it to the native engine (sequence numbers live device-side and advance per
+segment exactly like the reference); the TPU backend maps ranks onto mesh
+device coordinates instead of ip:port endpoints.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .constants import DEFAULT_MAX_EAGER_SIZE
+
+
+@dataclass
+class Rank:
+    """One row of the communicator table
+    (reference: communicator.hpp:34-39 rank_t)."""
+
+    ip: str = "127.0.0.1"
+    port: int = 0
+    session: int = 0
+    max_segment_size: int = DEFAULT_MAX_EAGER_SIZE
+    #: TPU backend: logical device index in the mesh this rank maps to.
+    device_index: Optional[int] = None
+
+
+class Communicator:
+    """A group of ranks with a local rank, addressable sessions and
+    device-side sequence-number state.
+
+    Unlike the reference (whose table lives in 8KB exchange memory at a
+    fixed address, communicator.cpp:23-64), the table here is uploaded to
+    the backend which returns an opaque communicator id used in call
+    descriptors (word 2 of the ABI).
+    """
+
+    def __init__(self, ranks: Sequence[Rank], local_rank: int, comm_id: int = 0):
+        if not 0 <= local_rank < len(ranks):
+            raise ValueError(f"local_rank {local_rank} out of range for {len(ranks)} ranks")
+        self._ranks = list(ranks)
+        self._local_rank = local_rank
+        self._id = comm_id
+
+    @property
+    def id(self) -> int:
+        return self._id
+
+    @property
+    def ranks(self) -> list[Rank]:
+        return self._ranks
+
+    @property
+    def local_rank(self) -> int:
+        return self._local_rank
+
+    @property
+    def size(self) -> int:
+        return len(self._ranks)
+
+    def to_words(self) -> list[int]:
+        """Serialize for upload to the native engine: [size, local_rank,
+        then per rank: ip(u32), port, session, max_segment_size]
+        (layout equivalent of communicator.cpp:23-64)."""
+        words = [self.size, self.local_rank]
+        for r in self._ranks:
+            words.append(_ip_encode(r.ip))
+            words.append(r.port)
+            words.append(r.session)
+            words.append(r.max_segment_size)
+        return words
+
+    def split(self, indices: Sequence[int], comm_id: int) -> "Communicator":
+        """Create a sub-communicator from a subset of ranks; the local rank
+        must be a member (reference: accl.cpp:971-978 create_communicator
+        on a subset + test_multicomm test.cpp:676)."""
+        if self._local_rank not in indices:
+            raise ValueError("local rank must be part of the new communicator")
+        new_ranks = [self._ranks[i] for i in indices]
+        new_local = list(indices).index(self._local_rank)
+        return Communicator(new_ranks, new_local, comm_id)
+
+    def dump(self) -> str:
+        """Human-readable table dump
+        (reference: accl.cpp:1445-1455 dump_communicator)."""
+        lines = [f"communicator {self._id}: size={self.size} local_rank={self._local_rank}"]
+        for i, r in enumerate(self._ranks):
+            tag = " (local)" if i == self._local_rank else ""
+            lines.append(
+                f"  rank {i}: {r.ip}:{r.port} session={r.session} "
+                f"max_seg={r.max_segment_size} dev={r.device_index}{tag}"
+            )
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return f"Communicator(id={self._id}, size={self.size}, local_rank={self._local_rank})"
+
+
+def _ip_encode(ip: str) -> int:
+    """Dotted-quad to u32 (reference: common.cpp:75-90 ip_encode)."""
+    parts = ip.split(".")
+    if len(parts) != 4:
+        return 0
+    val = 0
+    for p in parts:
+        val = (val << 8) | (int(p) & 0xFF)
+    return val
+
+
+def _ip_decode(val: int) -> str:
+    return ".".join(str((val >> s) & 0xFF) for s in (24, 16, 8, 0))

@@ -1,0 +1,81 @@
+"""Async request layer.
+
+Equivalent of the reference request objects: every collective call returns
+a handle the user can wait on (with optional timeout); completion carries
+the engine retcode and a duration read from the performance counter
+(reference: driver/xrt/include/accl/acclrequest.hpp:39-211 BaseRequest /
+FPGAQueue; driver/xrt/src/fpgadevice.cpp:24-33 finish_fpga_request).
+
+Per-device call serialization (the reference's FPGAQueue) is preserved:
+backends push requests through a `RequestQueue` so only one call is
+outstanding per engine command stream at a time, while the engine itself
+may interleave retried rendezvous calls internally.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Optional
+
+from .constants import ACCLError, OperationStatus, error_code_to_str
+
+
+class Request:
+    """Handle for one in-flight collective call."""
+
+    _ids = itertools.count()
+
+    def __init__(self, description: str = ""):
+        self.id = next(Request._ids)
+        self.description = description
+        self.status = OperationStatus.QUEUED
+        self.retcode: int = 0
+        self.duration_ns: float = 0.0
+        self._done = threading.Event()
+        #: optional callback run on completion (used by the driver to sync
+        #: result buffers back to the host, mirroring the async completion
+        #: thread of the reference backend).
+        self.on_complete: Optional[Callable[["Request"], None]] = None
+
+    def complete(self, retcode: int, duration_ns: float = 0.0) -> None:
+        self.retcode = retcode
+        self.duration_ns = duration_ns
+        self.status = OperationStatus.COMPLETED
+        if self.on_complete is not None:
+            self.on_complete(self)
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until completion; returns False on timeout
+        (reference: cclo.hpp:149-150 wait w/ timeout)."""
+        return self._done.wait(timeout)
+
+    def check(self) -> None:
+        """Raise if the engine reported a non-zero retcode
+        (reference: accl.cpp:1226-1250 check_return_value)."""
+        if self.retcode != 0:
+            raise ACCLError(
+                f"{self.description or 'call'} failed: {error_code_to_str(self.retcode)}",
+                self.retcode,
+            )
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def __repr__(self) -> str:
+        return f"Request(id={self.id}, {self.description!r}, status={self.status.name})"
+
+
+class RequestQueue:
+    """Serializes call submission per device command stream
+    (reference: acclrequest.hpp:153-211 FPGAQueue)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def submit(self, request: Request, start_fn: Callable[[Request], None]) -> Request:
+        with self._lock:
+            request.status = OperationStatus.EXECUTING
+            start_fn(request)
+        return request

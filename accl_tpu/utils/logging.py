@@ -1,0 +1,29 @@
+"""Leveled logging (reference: test/log/log.hpp, 5 levels + per-rank files).
+
+Thin wrapper over the stdlib; honors ACCL_DEBUG like the reference
+driver's debug log switch (driver/xrt/src/common.cpp:91-135).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_configured = False
+
+
+def get_logger(name: str = "accl_tpu", rank: Optional[int] = None) -> logging.Logger:
+    global _configured
+    logger = logging.getLogger(name if rank is None else f"{name}.rank{rank}")
+    if not _configured:
+        level = logging.DEBUG if os.environ.get("ACCL_DEBUG") else logging.WARNING
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("[%(levelname).1s %(name)s] %(message)s")
+        )
+        root = logging.getLogger("accl_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        _configured = True
+    return logger

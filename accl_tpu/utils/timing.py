@@ -1,0 +1,37 @@
+"""Benchmark timer (reference: driver/xrt/include/accl/timing.hpp)."""
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    """Wall-clock timer with the reference Timer's start/end/duration
+    shape (duration in microseconds)."""
+
+    def __init__(self):
+        self._start = 0.0
+        self._end = 0.0
+        self._running = False
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+        self._running = True
+
+    def end(self) -> None:
+        self._end = time.perf_counter()
+        self._running = False
+
+    def durationUs(self) -> float:
+        end = time.perf_counter() if self._running else self._end
+        return (end - self._start) * 1e6
+
+    def duration_ns(self) -> float:
+        end = time.perf_counter() if self._running else self._end
+        return (end - self._start) * 1e9
+
+    def __enter__(self) -> "Timer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()

@@ -1,0 +1,23 @@
+"""Test configuration.
+
+Forces an 8-device virtual CPU platform *before* jax initializes, so the
+multi-chip sharding paths (mesh collectives, shard_map, pjit) run in CI
+without TPU hardware — the TPU translation of the reference's
+run-everything-against-the-CPU-emulator strategy (SURVEY §4).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,125 @@
+"""Unit tests for the driver core: constants/ABI, arithconfig,
+communicator, request layer (reference test analog: driver-level pieces of
+test/host/xrt/src/test.cpp plus constants sanity)."""
+import threading
+
+import numpy as np
+import pytest
+
+from accl_tpu import (
+    ACCLError,
+    CCLOCall,
+    CompressionFlags,
+    Communicator,
+    DataType,
+    Operation,
+    Rank,
+    ReduceFunction,
+    Request,
+    TAG_ANY,
+)
+from accl_tpu.arithconfig import DEFAULT_ARITH_CONFIG
+from accl_tpu.communicator import _ip_decode, _ip_encode
+from accl_tpu.constants import ErrorCode, error_code_to_str
+
+
+def test_operation_codes_match_reference_abi():
+    # scenario codes must stay bit-compatible with the reference
+    # (constants.hpp:191-210)
+    assert Operation.config == 0
+    assert Operation.copy == 1
+    assert Operation.combine == 2
+    assert Operation.send == 3
+    assert Operation.recv == 4
+    assert Operation.bcast == 5
+    assert Operation.scatter == 6
+    assert Operation.gather == 7
+    assert Operation.reduce == 8
+    assert Operation.allgather == 9
+    assert Operation.allreduce == 10
+    assert Operation.reduce_scatter == 11
+    assert Operation.barrier == 12
+    assert Operation.alltoall == 13
+    assert Operation.nop == 255
+
+
+def test_call_descriptor_is_15_words():
+    call = CCLOCall(
+        scenario=Operation.allreduce,
+        count=1024,
+        comm=0,
+        function=int(ReduceFunction.SUM),
+        addr_0=0x1_0000_0040,
+        addr_2=0xDEAD_BEEF_0000,
+    )
+    words = call.to_words()
+    assert len(words) == 15
+    assert words[0] == 10
+    assert words[1] == 1024
+    # 64-bit addresses split low/high
+    assert words[9] == 0x0000_0040 and words[10] == 0x1
+    assert (words[13] | words[14] << 32) == 0xDEAD_BEEF_0000
+
+
+def test_error_code_decode():
+    code = int(ErrorCode.DMA_TIMEOUT_ERROR | ErrorCode.ARITH_ERROR)
+    s = error_code_to_str(code)
+    assert "DMA_TIMEOUT_ERROR" in s and "ARITH_ERROR" in s
+    assert error_code_to_str(0) == "COLLECTIVE_OP_SUCCESS"
+
+
+def test_arithconfig_table_covers_reference_pairs():
+    # identity pairs for the 5 dtypes + fp32-over-fp16 compression
+    # (arithconfig.hpp:106-119)
+    pairs = set(DEFAULT_ARITH_CONFIG)
+    assert (DataType.float32, DataType.float32) in pairs
+    assert (DataType.float32, DataType.float16) in pairs
+    assert len(pairs) == 6
+    cfg = DEFAULT_ARITH_CONFIG[(DataType.float32, DataType.float16)]
+    assert cfg.compression_ratio == 2
+    words = cfg.to_words()
+    assert words[0] == 32 and words[1] == 16
+
+
+def test_communicator_table_and_split():
+    ranks = [Rank(ip="10.1.212.%d" % i, port=5500 + i, session=i) for i in range(4)]
+    comm = Communicator(ranks, local_rank=2)
+    assert comm.size == 4 and comm.local_rank == 2
+    words = comm.to_words()
+    assert words[0] == 4 and words[1] == 2
+    # split keeping ranks {0, 2}: local rank renumbers to 1
+    sub = comm.split([0, 2], comm_id=1)
+    assert sub.size == 2 and sub.local_rank == 1
+    with pytest.raises(ValueError):
+        comm.split([0, 1], comm_id=2)  # local rank 2 not a member
+    assert "rank 2" in comm.dump()
+
+
+def test_ip_encode_roundtrip():
+    assert _ip_decode(_ip_encode("10.1.212.129")) == "10.1.212.129"
+
+
+def test_request_wait_and_check():
+    req = Request("test")
+    assert not req.done
+
+    def completer():
+        req.complete(retcode=0, duration_ns=123.0)
+
+    t = threading.Timer(0.05, completer)
+    t.start()
+    assert req.wait(timeout=5.0)
+    assert req.duration_ns == 123.0
+    req.check()  # no raise
+
+    bad = Request("bad")
+    bad.complete(retcode=int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+    with pytest.raises(ACCLError) as ei:
+        bad.check()
+    assert "RECEIVE_TIMEOUT_ERROR" in str(ei.value)
+
+
+def test_compression_flags_algebra():
+    f = CompressionFlags.OP0_COMPRESSED | CompressionFlags.ETH_COMPRESSED
+    assert int(f) == 9
+    assert CompressionFlags.RES_COMPRESSED & f == 0
