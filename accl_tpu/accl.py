@@ -14,7 +14,7 @@ TPU engine (backends/tpu.py).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Union
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -58,6 +58,9 @@ class ACCL:
         self._initialized = False
         self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
         self.max_rendezvous_size = DEFAULT_MAX_RENDEZVOUS_SIZE
+        #: host-side wait budget for synchronous calls; raise it alongside
+        #: set_timeout for long-running collectives on slow emulator hosts
+        self.call_timeout_s: float = 60.0
         self._last_request: Optional[Request] = None
 
     # ------------------------------------------------------------------
@@ -566,9 +569,17 @@ class ACCL:
         """Submit one call: sync inputs, start async, and either return the
         request handle or wait + sync outputs + check retcode
         (reference: call_async/call_sync accl.cpp:1395-1413)."""
+        # size validation: the descriptor carries the full count, so a
+        # short buffer would silently corrupt (the reference throws from
+        # its buffer slice bounds)
+        for buf, count in (*sync_in, *sync_out):
+            if not buf.is_dummy and count > buf.length:
+                raise ACCLError(
+                    f"{desc}: count {count} exceeds buffer length {buf.length}"
+                )
         for buf, count in sync_in:
             if not buf.is_dummy:
-                buf.slice(0, min(count, buf.length)).sync_to_device()
+                buf.slice(0, count).sync_to_device()
 
         req = Request(desc)
 
@@ -576,14 +587,17 @@ class ACCL:
             if r.retcode == 0:
                 for buf, count in sync_out:
                     if not buf.is_dummy:
-                        buf.slice(0, min(count, buf.length)).sync_from_device()
+                        buf.slice(0, count).sync_from_device()
 
         req.on_complete = finish
         self._queue.submit(req, lambda r: self._device.start(call, r))
         self._last_request = req
         if run_async:
             return req
-        if not req.wait(timeout=60.0):
+        if not req.wait(timeout=self.call_timeout_s):
+            # disarm the result sync so a late completion can't mutate the
+            # user's host buffers after this raise
+            req.on_complete = None
             raise ACCLError(f"{desc} timed out waiting for engine completion")
         req.check()
         return req

@@ -1,0 +1,276 @@
+"""Emulator backend: the native C++ collective engine over ctypes.
+
+Reference analog: `SimDevice`, which forwards call descriptors and buffer
+sync to the `cclo_emu` emulator process over ZMQ (driver/xrt/src/
+simdevice.cpp:38-64, test/model/emulator/cclo_emu.cpp).  Here the
+emulator is an in-process native library (`native/libacclemu.so`): a
+per-rank engine thread runs the collective algorithms against a CPU
+dataplane and an inproc or TCP socket transport.
+
+`EmuWorld` is the test harness equivalent of the reference's
+one-emulator-per-MPI-rank bring-up (test/host/xrt/src/utility.cpp:26-70):
+it creates N ranks in one process and runs per-rank driver code on a
+thread pool, so the MPI-style test corpus ports directly.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..accl import ACCL
+from ..arithconfig import ArithConfig
+from ..buffer import BaseBuffer, EmuBuffer
+from ..communicator import Communicator, Rank
+from ..constants import ACCLError, CCLOCall
+from ..request import Request
+from .base import CCLODevice
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "libacclemu.so",
+)
+
+_lib = None
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        raise ACCLError(
+            f"native engine not built: {_LIB_PATH} missing (run `make -C native`)"
+        )
+    lib = ctypes.CDLL(_LIB_PATH)
+    u64, u32, i32 = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int
+    p = ctypes.c_void_p
+    lib.accl_world_create.restype = p
+    lib.accl_world_create.argtypes = [i32, u64]
+    lib.accl_world_create_tcp.restype = p
+    lib.accl_world_create_tcp.argtypes = [i32, i32, i32, u64]
+    lib.accl_world_destroy.argtypes = [p]
+    lib.accl_cfg_rx.argtypes = [p, i32, i32, u64]
+    lib.accl_set_comm.argtypes = [p, i32, ctypes.POINTER(u32), i32]
+    lib.accl_set_arithcfg.argtypes = [p, i32, ctypes.POINTER(u32), i32]
+    lib.accl_alloc.restype = u64
+    lib.accl_alloc.argtypes = [p, i32, u64, u64]
+    lib.accl_free.argtypes = [p, i32, u64]
+    lib.accl_read_mem.argtypes = [p, i32, u64, ctypes.c_void_p, u64]
+    lib.accl_write_mem.argtypes = [p, i32, u64, ctypes.c_void_p, u64]
+    lib.accl_start_call.restype = u64
+    lib.accl_start_call.argtypes = [p, i32, ctypes.POINTER(u32)]
+    lib.accl_poll_call.argtypes = [p, i32, u64, ctypes.POINTER(u32),
+                                   ctypes.POINTER(ctypes.c_double)]
+    lib.accl_wait_call.argtypes = [p, i32, u64, i32, ctypes.POINTER(u32),
+                                   ctypes.POINTER(ctypes.c_double)]
+    lib.accl_push_krnl.argtypes = [p, i32, ctypes.c_void_p, u64]
+    lib.accl_pop_stream.argtypes = [p, i32, u32, ctypes.c_void_p, u64,
+                                    ctypes.POINTER(u64), i32]
+    lib.accl_dump_rx.argtypes = [p, i32, ctypes.c_char_p, i32]
+    _lib = lib
+    return lib
+
+
+def _words(vals: Sequence[int]):
+    arr = (ctypes.c_uint32 * len(vals))(*[v & 0xFFFFFFFF for v in vals])
+    return arr
+
+
+class EmuDevice(CCLODevice):
+    """One rank's handle on the native engine."""
+
+    def __init__(self, world_handle: ctypes.c_void_p, rank: int,
+                 lib: ctypes.CDLL, call_timeout_s: float = 60.0):
+        self._w = world_handle
+        self._rank = rank
+        self._lib = lib
+        self._timeout_ms = int(call_timeout_s * 1000)
+
+    # -- call path ----------------------------------------------------
+    def start(self, call: CCLOCall, request: Request) -> None:
+        call_id = self._lib.accl_start_call(self._w, self._rank,
+                                            _words(call.to_words()))
+
+        def waiter():
+            ret = ctypes.c_uint32(0)
+            dur = ctypes.c_double(0.0)
+            ok = self._lib.accl_wait_call(self._w, self._rank, call_id,
+                                          self._timeout_ms, ctypes.byref(ret),
+                                          ctypes.byref(dur))
+            if ok:
+                request.complete(ret.value, dur.value)
+            else:
+                from ..constants import ErrorCode
+                request.complete(int(ErrorCode.DMA_TIMEOUT_ERROR), 0.0)
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    # -- device memory ------------------------------------------------
+    def alloc_mem(self, nbytes: int, alignment: int = 64) -> int:
+        addr = self._lib.accl_alloc(self._w, self._rank, nbytes, alignment)
+        if addr == 0:
+            raise ACCLError("emulator device memory exhausted")
+        return addr
+
+    def free_mem(self, address: int) -> None:
+        self._lib.accl_free(self._w, self._rank, address)
+
+    def read_mem(self, address: int, nbytes: int) -> bytes:
+        buf = ctypes.create_string_buffer(nbytes)
+        rc = self._lib.accl_read_mem(self._w, self._rank, address, buf, nbytes)
+        if rc != 0:
+            raise ACCLError(f"read_mem({address:#x}, {nbytes}) out of range")
+        return buf.raw
+
+    def write_mem(self, address: int, data: bytes) -> None:
+        rc = self._lib.accl_write_mem(self._w, self._rank, address, data,
+                                      len(data))
+        if rc != 0:
+            raise ACCLError(f"write_mem({address:#x}, {len(data)}) out of range")
+
+    # -- buffers ------------------------------------------------------
+    def create_buffer(self, length: int, dtype: np.dtype) -> BaseBuffer:
+        host = np.zeros(length, dtype=dtype)
+        addr = self.alloc_mem(max(host.nbytes, 64))
+        return EmuBuffer(host, self, addr)
+
+    # -- configuration ------------------------------------------------
+    def setup_rx_buffers(self, n_bufs: int, buf_size: int) -> None:
+        self._lib.accl_cfg_rx(self._w, self._rank, n_bufs, buf_size)
+
+    def upload_communicator(self, comm: Communicator) -> int:
+        w = comm.to_words()
+        return self._lib.accl_set_comm(self._w, self._rank, _words(w), len(w))
+
+    def upload_arithconfig(self, cfg: ArithConfig) -> int:
+        w = cfg.to_words()
+        return self._lib.accl_set_arithcfg(self._w, self._rank, _words(w),
+                                           len(w))
+
+    # -- streams (PL-kernel equivalent) -------------------------------
+    def push_krnl(self, data: np.ndarray) -> None:
+        """Feed operand bytes into the engine's compute-kernel input
+        stream (OP0_STREAM source; reference data_to_cclo port)."""
+        b = np.ascontiguousarray(data).tobytes()
+        self._lib.accl_push_krnl(self._w, self._rank, b, len(b))
+
+    def pop_stream(self, strm: int, nbytes: int,
+                   timeout_s: float = 10.0) -> Optional[bytes]:
+        """Pull one message from a compute stream (data_from_cclo port)."""
+        buf = ctypes.create_string_buffer(nbytes)
+        got = ctypes.c_uint64(0)
+        ok = self._lib.accl_pop_stream(self._w, self._rank, strm, buf, nbytes,
+                                       ctypes.byref(got),
+                                       int(timeout_s * 1000))
+        return buf.raw[: got.value] if ok else None
+
+    def dump_rx_buffers(self) -> str:
+        out = ctypes.create_string_buffer(65536)
+        self._lib.accl_dump_rx(self._w, self._rank, out, 65536)
+        return out.value.decode()
+
+    def close(self) -> None:
+        pass  # world teardown owns the native handle
+
+
+class EmuRankTcp:
+    """One rank over the TCP socket transport (one process — or thread —
+    per rank; the reference's emulator-per-MPI-rank rung with ZMQ pub/sub
+    replaced by length-prefixed TCP frames)."""
+
+    def __init__(self, rank: int, nranks: int, base_port: int,
+                 devmem_bytes: int = 64 << 20, n_egr_rx_bufs: int = 16,
+                 egr_rx_buf_size: int = 1024,
+                 max_eager_size: Optional[int] = None):
+        self._lib = _load_lib()
+        self.rank = rank
+        self.nranks = nranks
+        self._handle = self._lib.accl_world_create_tcp(rank, nranks, base_port,
+                                                       devmem_bytes)
+        if not self._handle:
+            raise ACCLError(f"TCP emulator rank {rank} failed to start "
+                            f"(port {base_port + rank} busy?)")
+        self.device = EmuDevice(self._handle, rank, self._lib)
+        self.accl = ACCL(self.device)
+        ranks = [Rank(ip="127.0.0.1", port=base_port + r, session=r,
+                      max_segment_size=egr_rx_buf_size)
+                 for r in range(nranks)]
+        kwargs = {}
+        if max_eager_size is not None:
+            kwargs["max_eager_size"] = max_eager_size
+        self.accl.initialize(ranks, rank, n_egr_rx_bufs=n_egr_rx_bufs,
+                             egr_rx_buf_size=egr_rx_buf_size, **kwargs)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.accl_world_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class EmuWorld:
+    """N emulated ranks in one process (inproc transport).
+
+    The MPI-replacement test harness: `run(fn)` executes `fn(accl, rank)`
+    for every rank concurrently, mirroring how the reference test suite
+    runs one driver per MPI rank against one emulator each.
+    """
+
+    def __init__(self, nranks: int, devmem_bytes: int = 64 << 20,
+                 n_egr_rx_bufs: int = 16, egr_rx_buf_size: int = 1024,
+                 max_eager_size: Optional[int] = None,
+                 max_rendezvous_size: Optional[int] = None,
+                 initialize: bool = True):
+        self._lib = _load_lib()
+        self.nranks = nranks
+        self._handle = self._lib.accl_world_create(nranks, devmem_bytes)
+        self.devices = [EmuDevice(self._handle, r, self._lib)
+                        for r in range(nranks)]
+        self.accls = [ACCL(d) for d in self.devices]
+        self._pool = ThreadPoolExecutor(max_workers=nranks)
+        if initialize:
+            ranks = [
+                Rank(ip="127.0.0.1", port=0, session=r,
+                     max_segment_size=egr_rx_buf_size)
+                for r in range(nranks)
+            ]
+            kwargs = {}
+            if max_eager_size is not None:
+                kwargs["max_eager_size"] = max_eager_size
+            if max_rendezvous_size is not None:
+                kwargs["max_rendezvous_size"] = max_rendezvous_size
+            for r, a in enumerate(self.accls):
+                a.initialize(ranks, r, n_egr_rx_bufs=n_egr_rx_bufs,
+                             egr_rx_buf_size=egr_rx_buf_size, **kwargs)
+
+    def run(self, fn: Callable, *args) -> list:
+        """Run `fn(accl, rank, *args)` on every rank concurrently and
+        return per-rank results; exceptions propagate."""
+        futures = [
+            self._pool.submit(fn, self.accls[r], r, *args)
+            for r in range(self.nranks)
+        ]
+        return [f.result(timeout=120) for f in futures]
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        if self._handle:
+            self._lib.accl_world_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "EmuWorld":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -16,8 +16,6 @@ TPU-native mapping:
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from .arithconfig import NUMPY_TO_DATATYPE

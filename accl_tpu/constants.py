@@ -11,7 +11,7 @@ not a translation of the reference firmware.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 class Operation(enum.IntEnum):
@@ -147,7 +147,8 @@ class ErrorCode(enum.IntFlag):
     DMA_TAG_MISMATCH_ERROR = 1 << 26
 
 
-ERROR_CODE_BITS = 26
+#: Bits occupied by engine error codes (bit 0 .. bit 26 inclusive).
+ERROR_CODE_BITS = 27
 
 #: Internal (non-user-visible) signal used by the engine to re-queue a call
 #: whose rendezvous peer has not arrived yet; mirrors the firmware's
@@ -218,8 +219,8 @@ class CCLOCall:
     Field-for-field equivalent of the reference host→device ABI
     (reference: kernels/plugins/hostctrl/hostctrl.cpp:19-63 and
     ccl_offload_control.c:2321-2356): scenario, count, comm, root_src_dst,
-    function, msg_tag, arithcfg, compression_flags, stream_flags,
-    host_flags, addr_0, addr_1, addr_2 (64-bit each), datatype.
+    function, msg_tag, arithcfg, compression_flags, stream+host flags,
+    and three 64-bit operand addresses (low/high word pairs).
     """
 
     scenario: Operation = Operation.nop
@@ -235,8 +236,6 @@ class CCLOCall:
     addr_0: int = 0
     addr_1: int = 0
     addr_2: int = 0
-    count_1: int = 0  # secondary count (uncompressed elems of operand 1)
-    count_2: int = 0  # secondary count (result)
 
     def to_words(self) -> list[int]:
         """Serialize to the 15-word stream format pushed to the engine."""

@@ -36,14 +36,20 @@ class Request:
         #: result buffers back to the host, mirroring the async completion
         #: thread of the reference backend).
         self.on_complete: Optional[Callable[["Request"], None]] = None
+        #: exception raised by on_complete, surfaced via check()
+        self.callback_error: Optional[Exception] = None
 
     def complete(self, retcode: int, duration_ns: float = 0.0) -> None:
         self.retcode = retcode
         self.duration_ns = duration_ns
         self.status = OperationStatus.COMPLETED
-        if self.on_complete is not None:
-            self.on_complete(self)
-        self._done.set()
+        try:
+            if self.on_complete is not None:
+                self.on_complete(self)
+        except Exception as e:  # surface via check(), never lose the event
+            self.callback_error = e
+        finally:
+            self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until completion; returns False on timeout
@@ -51,13 +57,19 @@ class Request:
         return self._done.wait(timeout)
 
     def check(self) -> None:
-        """Raise if the engine reported a non-zero retcode
+        """Raise if the engine reported a non-zero retcode or the
+        completion callback failed
         (reference: accl.cpp:1226-1250 check_return_value)."""
         if self.retcode != 0:
             raise ACCLError(
                 f"{self.description or 'call'} failed: {error_code_to_str(self.retcode)}",
                 self.retcode,
             )
+        if self.callback_error is not None:
+            raise ACCLError(
+                f"{self.description or 'call'} completion failed: "
+                f"{self.callback_error}"
+            ) from self.callback_error
 
     @property
     def done(self) -> bool:
@@ -68,8 +80,11 @@ class Request:
 
 
 class RequestQueue:
-    """Serializes call submission per device command stream
-    (reference: acclrequest.hpp:153-211 FPGAQueue)."""
+    """Serializes the *submission* of calls onto a device command stream
+    (the reference FPGAQueue's enqueue step, acclrequest.hpp:153-211).
+    Engines accept multiple outstanding calls — retried rendezvous calls
+    interleave by design — so completion ordering is backend territory;
+    only the descriptor push is atomic here."""
 
     def __init__(self):
         self._lock = threading.Lock()

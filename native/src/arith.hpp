@@ -1,0 +1,100 @@
+// On-path reduction arithmetic and wire-compression lanes.
+//
+// Equivalent of the reference plugins:
+//  - reduce_ops: 512-bit SIMD elementwise sum/max, lane selected by TDEST,
+//    10 functions over {fp32,fp64,i32,i64,fp16}x{sum,max}
+//    (kernels/plugins/reduce_ops/reduce_ops.cpp:31-107)
+//  - hp_compression: streaming fp32<->fp16 cast at 2:1 width
+//    (kernels/plugins/hp_compression/hp_compression.cpp:70-144)
+//
+// Lane numbering matches accl_tpu/arithconfig.py ARITH_LANE.  On TPU the
+// same lanes are Pallas kernels (accl_tpu/ops/); the emulator runs these
+// scalar loops, which auto-vectorize under -O2.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+#include "common.hpp"
+
+namespace accl {
+
+enum ArithLane : uint32_t {
+  F32_SUM = 0,
+  F32_MAX = 1,
+  F64_SUM = 2,
+  F64_MAX = 3,
+  I32_SUM = 4,
+  I32_MAX = 5,
+  I64_SUM = 6,
+  I64_MAX = 7,
+  F16_SUM = 8,
+  F16_MAX = 9,
+  NUM_LANES = 10,
+};
+
+template <typename T, bool MAX>
+static void reduce_typed(const uint8_t* a, const uint8_t* b, uint8_t* r,
+                         uint64_t nbytes) {
+  uint64_t n = nbytes / sizeof(T);
+  const T* pa = reinterpret_cast<const T*>(a);
+  const T* pb = reinterpret_cast<const T*>(b);
+  T* pr = reinterpret_cast<T*>(r);
+  for (uint64_t i = 0; i < n; ++i) {
+    if constexpr (MAX)
+      pr[i] = pa[i] > pb[i] ? pa[i] : pb[i];
+    else
+      pr[i] = T(pa[i] + pb[i]);
+  }
+}
+
+static inline void reduce_f16(const uint8_t* a, const uint8_t* b, uint8_t* r,
+                              uint64_t nbytes, bool is_max) {
+  uint64_t n = nbytes / 2;
+  const uint16_t* pa = reinterpret_cast<const uint16_t*>(a);
+  const uint16_t* pb = reinterpret_cast<const uint16_t*>(b);
+  uint16_t* pr = reinterpret_cast<uint16_t*>(r);
+  for (uint64_t i = 0; i < n; ++i) {
+    float fa = f16_to_f32(pa[i]), fb = f16_to_f32(pb[i]);
+    pr[i] = f32_to_f16(is_max ? (fa > fb ? fa : fb) : (fa + fb));
+  }
+}
+
+// r[0:n] = lane(a, b); returns an Err bit on unknown lane / ragged size.
+inline uint32_t run_reduce_lane(uint32_t lane, const uint8_t* a,
+                                const uint8_t* b, uint8_t* r,
+                                uint64_t nbytes) {
+  switch (lane) {
+    case F32_SUM: reduce_typed<float, false>(a, b, r, nbytes); break;
+    case F32_MAX: reduce_typed<float, true>(a, b, r, nbytes); break;
+    case F64_SUM: reduce_typed<double, false>(a, b, r, nbytes); break;
+    case F64_MAX: reduce_typed<double, true>(a, b, r, nbytes); break;
+    case I32_SUM: reduce_typed<int32_t, false>(a, b, r, nbytes); break;
+    case I32_MAX: reduce_typed<int32_t, true>(a, b, r, nbytes); break;
+    case I64_SUM: reduce_typed<int64_t, false>(a, b, r, nbytes); break;
+    case I64_MAX: reduce_typed<int64_t, true>(a, b, r, nbytes); break;
+    case F16_SUM: reduce_f16(a, b, r, nbytes, false); break;
+    case F16_MAX: reduce_f16(a, b, r, nbytes, true); break;
+    default: return ARITH_ERROR;
+  }
+  return OK;
+}
+
+// fp32 -> fp16 wire compression, out must hold nbytes/2.
+inline void compress_f32_f16(const uint8_t* in, uint8_t* out, uint64_t nbytes) {
+  uint64_t n = nbytes / 4;
+  const float* pi = reinterpret_cast<const float*>(in);
+  uint16_t* po = reinterpret_cast<uint16_t*>(out);
+  for (uint64_t i = 0; i < n; ++i) po[i] = f32_to_f16(pi[i]);
+}
+
+// fp16 -> fp32 decompression, out must hold nbytes*2.
+inline void decompress_f16_f32(const uint8_t* in, uint8_t* out,
+                               uint64_t nbytes) {
+  uint64_t n = nbytes / 2;
+  const uint16_t* pi = reinterpret_cast<const uint16_t*>(in);
+  float* po = reinterpret_cast<float*>(out);
+  for (uint64_t i = 0; i < n; ++i) po[i] = f16_to_f32(pi[i]);
+}
+
+}  // namespace accl

@@ -1,0 +1,152 @@
+// C API exposed to the Python driver over ctypes.
+//
+// Boundary analog of the reference's ZMQ control protocol between the
+// host driver (SimDevice) and the emulator process — mmio/mem read/write
+// plus "call with 15 args" (test/model/zmq/zmq_server.h:49-156) — but as
+// an in-process FFI: the Python EmuDevice backend calls these directly.
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+#include "engine.hpp"
+
+using namespace accl;
+
+namespace {
+
+struct World {
+  std::vector<std::unique_ptr<Engine>> engines;
+  std::shared_ptr<InprocHub> hub;
+  bool tcp = false;
+
+  Engine* get(int rank) {
+    if (tcp) return engines.empty() ? nullptr : engines[0].get();
+    return rank >= 0 && rank < int(engines.size()) ? engines[rank].get()
+                                                   : nullptr;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// In-process world: N engines wired through a shared hub (the reference's
+// single-board axis3x loopback rung of the test ladder).
+void* accl_world_create(int nranks, uint64_t devmem_bytes) {
+  auto* w = new World();
+  w->hub = std::make_shared<InprocHub>(nranks);
+  for (int r = 0; r < nranks; ++r) {
+    w->engines.push_back(std::make_unique<Engine>(
+        uint32_t(r), devmem_bytes,
+        std::make_unique<InprocTransport>(w->hub, r)));
+  }
+  return w;
+}
+
+// One-process-per-rank world over TCP sockets (the reference's
+// emulator-per-MPI-rank rung).  Returns a world holding this rank only.
+void* accl_world_create_tcp(int rank, int nranks, int base_port,
+                            uint64_t devmem_bytes) {
+  auto* w = new World();
+  w->tcp = true;
+  try {
+    w->engines.push_back(std::make_unique<Engine>(
+        uint32_t(rank), devmem_bytes,
+        std::make_unique<TcpTransport>(rank, nranks, base_port,
+                                       std::vector<std::string>{})));
+  } catch (...) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+void accl_world_destroy(void* wp) { delete static_cast<World*>(wp); }
+
+int accl_cfg_rx(void* wp, int rank, int nbufs, uint64_t bufsize) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->cfg_rx_buffers(uint32_t(nbufs), bufsize);
+  return 0;
+}
+
+int accl_set_comm(void* wp, int rank, const uint32_t* words, int n) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->set_comm(words, n) : -1;
+}
+
+int accl_set_arithcfg(void* wp, int rank, const uint32_t* words, int n) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->set_arithcfg(words, n) : -1;
+}
+
+uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->alloc(nbytes, align) : 0;
+}
+
+void accl_free(void* wp, int rank, uint64_t addr) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (e) e->free_addr(addr);
+}
+
+int accl_read_mem(void* wp, int rank, uint64_t addr, void* dst, uint64_t n) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e && e->read_mem(addr, dst, n) ? 0 : -1;
+}
+
+int accl_write_mem(void* wp, int rank, uint64_t addr, const void* src,
+                   uint64_t n) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e && e->write_mem(addr, src, n) ? 0 : -1;
+}
+
+uint64_t accl_start_call(void* wp, int rank, const uint32_t* w15) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->start_call(w15) : 0;
+}
+
+int accl_poll_call(void* wp, int rank, uint64_t id, uint32_t* ret,
+                   double* dur) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e && e->poll_call(id, ret, dur) ? 1 : 0;
+}
+
+int accl_wait_call(void* wp, int rank, uint64_t id, int timeout_ms,
+                   uint32_t* ret, double* dur) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return 0;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (e->poll_call(id, ret, dur)) return 1;
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+  return 0;
+}
+
+void accl_push_krnl(void* wp, int rank, const void* data, uint64_t n) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (e) e->push_krnl(static_cast<const uint8_t*>(data), n);
+}
+
+int accl_pop_stream(void* wp, int rank, uint32_t strm, void* dst, uint64_t cap,
+                    uint64_t* got, int timeout_ms) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e && e->pop_stream(strm, static_cast<uint8_t*>(dst), cap, got,
+                            timeout_ms)
+             ? 1
+             : 0;
+}
+
+int accl_dump_rx(void* wp, int rank, char* out, int cap) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  std::string s = e->dump_rx();
+  int n = int(std::min<size_t>(s.size(), size_t(cap) - 1));
+  std::memcpy(out, s.data(), size_t(n));
+  out[n] = 0;
+  return n;
+}
+
+}  // extern "C"

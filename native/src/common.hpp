@@ -1,0 +1,283 @@
+// Common types for the ACCL-TPU native collective engine.
+//
+// This library is the TPU build's equivalent of the reference's on-device
+// control plane + dataplane, re-hosted as portable C++ so the whole
+// framework is testable without accelerator hardware — the role the
+// reference's cclo_emu CPU emulator plays (test/model/emulator/cclo_emu.cpp).
+// Nothing here is a translation of the reference sources; the wire header
+// field set and the 15-word call ABI are kept compatible so the Python
+// driver can treat the emulator and the TPU backend identically.
+#pragma once
+
+#include <array>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace accl {
+
+// ---------------------------------------------------------------------------
+// ABI constants (kept bit-compatible with accl_tpu/constants.py and the
+// reference driver; see driver/xrt/include/accl/constants.hpp:191-210).
+// ---------------------------------------------------------------------------
+enum class Op : uint32_t {
+  Config = 0,
+  Copy = 1,
+  Combine = 2,
+  Send = 3,
+  Recv = 4,
+  Bcast = 5,
+  Scatter = 6,
+  Gather = 7,
+  Reduce = 8,
+  Allgather = 9,
+  Allreduce = 10,
+  ReduceScatter = 11,
+  Barrier = 12,
+  Alltoall = 13,
+  Nop = 255,
+};
+
+enum class CfgFunc : uint32_t {
+  ResetPeriph = 0,
+  EnablePkt = 1,
+  SetTimeout = 2,
+  SetMaxEagerMsgSize = 3,
+  SetMaxRendezvousMsgSize = 4,
+};
+
+// Error bits (reference: constants.hpp:355-387).
+enum Err : uint32_t {
+  OK = 0,
+  RECEIVE_TIMEOUT_ERROR = 1u << 11,
+  COLLECTIVE_NOT_IMPLEMENTED = 1u << 14,
+  EAGER_THRESHOLD_INVALID = 1u << 16,
+  RENDEZVOUS_THRESHOLD_INVALID = 1u << 17,
+  DMA_SIZE_ERROR = 1u << 18,
+  ARITH_ERROR = 1u << 19,
+  PACK_SEQ_NUMBER_ERROR = 1u << 21,
+  COMPRESSION_ERROR = 1u << 22,
+  SEGMENTER_EXPECTED_BTT_ERROR = 1u << 25,
+};
+
+// Wire message types (reference: eth_intf.h:42-45).
+enum class MsgType : uint8_t {
+  EgrMsg = 0,
+  RndzvsMsg = 1,
+  RndzvsInit = 2,
+  RndzvsWrDone = 3,
+};
+
+constexpr uint32_t TAG_ANY = 0xFFFFFFFFu;
+constexpr uint32_t MAX_PACKETSIZE = 4096;  // transport write-chunk quantum
+
+// ---------------------------------------------------------------------------
+// Wire header: 64 bytes, self-describing, field set equivalent to the
+// reference's eth_header {count,tag,src,seqn,strm,dst,msg_type,host,vaddr}
+// (eth_intf.h:94-151) with a comm id in previously-reserved space (the
+// reference derives the communicator from the session id; carrying it
+// explicitly keeps the socket transport stateless).
+// ---------------------------------------------------------------------------
+struct WireHeader {
+  uint32_t count = 0;  // payload bytes (compressed size if compressed)
+  uint32_t tag = 0;
+  uint32_t src = 0;   // source rank within comm
+  uint32_t seqn = 0;  // per (comm, src->dst) sequence number
+  uint32_t strm = 0;  // nonzero: route to compute stream id, not memory
+  uint16_t dst_session = 0;
+  uint8_t msg_type = 0;
+  uint8_t host = 0;
+  uint64_t vaddr = 0;  // rendezvous target address
+  uint32_t comm_id = 0;
+  uint32_t compressed = 0;  // wire payload is fp16-compressed fp32
+  uint8_t pad[64 - 40] = {0};
+};
+static_assert(sizeof(WireHeader) == 64, "wire header must be 64 bytes");
+
+// ---------------------------------------------------------------------------
+// 15-word call descriptor (reference ABI: hostctrl.cpp:19-63).
+// ---------------------------------------------------------------------------
+struct CallDesc {
+  std::array<uint32_t, 15> w{};
+  uint64_t id = 0;
+  uint32_t current_step = 0;  // rendezvous resume point (fw :34,:2336)
+
+  Op scenario() const { return static_cast<Op>(w[0]); }
+  uint32_t count() const { return w[1]; }
+  uint32_t comm() const { return w[2]; }
+  uint32_t root_src_dst() const { return w[3]; }
+  uint32_t function() const { return w[4]; }
+  uint32_t tag() const { return w[5]; }
+  uint32_t arithcfg() const { return w[6]; }
+  uint32_t compression() const { return w[7]; }
+  uint32_t stream_flags() const { return w[8] & 0xFF; }
+  uint32_t host_flags() const { return (w[8] >> 8) & 0xFF; }
+  uint64_t addr0() const { return uint64_t(w[9]) | (uint64_t(w[10]) << 32); }
+  uint64_t addr1() const { return uint64_t(w[11]) | (uint64_t(w[12]) << 32); }
+  uint64_t addr2() const { return uint64_t(w[13]) | (uint64_t(w[14]) << 32); }
+};
+
+// Thrown by a rendezvous wait-point whose peer state has not arrived;
+// the engine loop re-queues the whole call with its resume step
+// (reference retry path: ccl_offload_control.c:2460-2479).
+struct NotReadyEx {
+  uint32_t step;
+};
+
+// ---------------------------------------------------------------------------
+// Bounded-ish MPMC fifo used for command/status/notification streams
+// (role of the hlslib FIFOs wiring the reference emulator threads).
+// ---------------------------------------------------------------------------
+template <typename T>
+class Fifo {
+ public:
+  void push(T v) {
+    {
+      std::lock_guard<std::mutex> g(m_);
+      q_.push_back(std::move(v));
+    }
+    cv_.notify_all();
+  }
+
+  std::optional<T> pop_wait(std::chrono::nanoseconds timeout) {
+    std::unique_lock<std::mutex> g(m_);
+    if (!cv_.wait_for(g, timeout, [&] { return !q_.empty() || closed_; }))
+      return std::nullopt;
+    if (q_.empty()) return std::nullopt;
+    T v = std::move(q_.front());
+    q_.pop_front();
+    return v;
+  }
+
+  std::optional<T> try_pop() {
+    std::lock_guard<std::mutex> g(m_);
+    if (q_.empty()) return std::nullopt;
+    T v = std::move(q_.front());
+    q_.pop_front();
+    return v;
+  }
+
+  // Wait until pred matches an element; remove and return it.  Other
+  // elements stay queued (out-of-order matching for rendezvous queues).
+  std::optional<T> pop_match(std::function<bool(const T&)> pred,
+                             std::chrono::nanoseconds timeout) {
+    std::unique_lock<std::mutex> g(m_);
+    auto deadline = std::chrono::steady_clock::now() + timeout;
+    for (;;) {
+      for (auto it = q_.begin(); it != q_.end(); ++it) {
+        if (pred(*it)) {
+          T v = std::move(*it);
+          q_.erase(it);
+          return v;
+        }
+      }
+      if (closed_) return std::nullopt;
+      if (cv_.wait_until(g, deadline) == std::cv_status::timeout) {
+        // one last scan after timeout
+        for (auto it = q_.begin(); it != q_.end(); ++it) {
+          if (pred(*it)) {
+            T v = std::move(*it);
+            q_.erase(it);
+            return v;
+          }
+        }
+        return std::nullopt;
+      }
+    }
+  }
+
+  bool empty() const {
+    std::lock_guard<std::mutex> g(m_);
+    return q_.empty();
+  }
+
+  size_t size() const {
+    std::lock_guard<std::mutex> g(m_);
+    return q_.size();
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> g(m_);
+      closed_ = true;
+    }
+    cv_.notify_all();
+  }
+
+ private:
+  mutable std::mutex m_;
+  std::condition_variable cv_;
+  std::deque<T> q_;
+  bool closed_ = false;
+};
+
+// fp16 <-> fp32 conversion (the emulator arithmetic/compression lanes'
+// scalar core; the reference uses Vitis HLS half types in
+// kernels/plugins/hp_compression/hp_compression.cpp).
+inline uint16_t f32_to_f16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  uint32_t sign = (x >> 16) & 0x8000u;
+  int32_t exp = int32_t((x >> 23) & 0xFF) - 127 + 15;
+  uint32_t mant = x & 0x7FFFFFu;
+  if (((x >> 23) & 0xFF) == 0xFF) {  // inf/nan
+    return uint16_t(sign | 0x7C00u | (mant ? 0x200u : 0));
+  }
+  if (exp >= 0x1F) return uint16_t(sign | 0x7C00u);  // overflow -> inf
+  if (exp <= 0) {                                    // subnormal / zero
+    if (exp < -10) return uint16_t(sign);
+    mant |= 0x800000u;
+    uint32_t shift = uint32_t(14 - exp);
+    uint32_t half_mant = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    if (rem > (1u << (shift - 1)) ||
+        (rem == (1u << (shift - 1)) && (half_mant & 1)))
+      half_mant++;
+    return uint16_t(sign | half_mant);
+  }
+  uint32_t half = sign | (uint32_t(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1FFFu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
+  return uint16_t(half);
+}
+
+inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = uint32_t(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t mant = h & 0x3FFu;
+  uint32_t x;
+  if (exp == 0) {
+    if (mant == 0) {
+      x = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3FFu;
+      x = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1F) {
+    x = sign | 0x7F800000u | (mant << 13);
+  } else {
+    x = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &x, 4);
+  return f;
+}
+
+}  // namespace accl

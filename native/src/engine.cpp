@@ -1,0 +1,921 @@
+// Engine implementation: event loop, protocol primitives, collective
+// schedules.  See engine.hpp for the reference mapping.
+#include "engine.hpp"
+
+#include <algorithm>
+#include <cassert>
+
+namespace accl {
+
+using namespace std::chrono;
+using std::chrono::nanoseconds;
+
+// Tag reserved for barrier traffic (the reference exchanges empty
+// rendezvous notifications instead, fw :2077-2120; a reserved eager tag
+// keeps the same synchronization with the socket transport).
+static constexpr uint32_t BARRIER_TAG = 0xBA771E12u;
+// Stream ids >= 9 address compute-kernel streams (reference: accl.cpp:197).
+static constexpr uint32_t FIRST_KRNL_STREAM = 9;
+
+Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
+               std::unique_ptr<Transport> transport)
+    : global_rank_(global_rank),
+      devicemem_(devmem_bytes),
+      transport_(std::move(transport)) {
+  free_spans_[0x1000] = devmem_bytes - 0x1000;
+  // avoid vector reallocation races between the engine loop and host-side
+  // configuration (the reference's exchange memory is likewise written
+  // live while the firmware polls it)
+  comms_.reserve(64);
+  arithcfgs_.reserve(64);
+  transport_->start([this](Message&& m) { ingress(std::move(m)); });
+  loop_thread_ = std::thread([this] { loop(); });
+}
+
+Engine::~Engine() {
+  running_ = false;
+  cmd_q_.close();
+  completions_.close();
+  pending_addrs_.close();
+  if (loop_thread_.joinable()) loop_thread_.join();
+  transport_->stop();
+}
+
+// ---------------------------------------------------------------------------
+// host-facing config
+// ---------------------------------------------------------------------------
+void Engine::cfg_rx_buffers(uint32_t nbufs, uint64_t bufsize) {
+  rx_.configure(nbufs, bufsize);
+}
+
+int Engine::set_comm(const uint32_t* words, int nwords) {
+  std::lock_guard<std::mutex> g(cfg_mu_);
+  CommTable t;
+  t.size = words[0];
+  t.local = words[1];
+  if (nwords < int(2 + 4 * t.size)) return -1;
+  for (uint32_t i = 0; i < t.size; ++i) {
+    CommTable::Row r;
+    r.ip = words[2 + 4 * i];
+    r.port = words[3 + 4 * i];
+    r.session = words[4 + 4 * i];
+    r.max_seg = words[5 + 4 * i];
+    t.rows.push_back(r);
+  }
+  t.inbound_seq.assign(t.size, 0);
+  t.outbound_seq.assign(t.size, 0);
+  comms_.push_back(std::move(t));
+  return int(comms_.size()) - 1;
+}
+
+int Engine::set_arithcfg(const uint32_t* words, int nwords) {
+  std::lock_guard<std::mutex> g(cfg_mu_);
+  ArithCfgN a;
+  a.ubits = words[0];
+  a.cbits = words[1];
+  a.ratio_log = words[2];
+  a.compressor = words[3];
+  a.decompressor = words[4];
+  a.arith_compressed = words[5];
+  uint32_t nlanes = words[6];
+  for (uint32_t i = 0; i < nlanes && int(7 + i) < nwords; ++i)
+    a.lanes.push_back(words[7 + i]);
+  arithcfgs_.push_back(std::move(a));
+  return int(arithcfgs_.size()) - 1;
+}
+
+// ---------------------------------------------------------------------------
+// device memory (first-fit free-list allocator over the flat devicemem,
+// playing the role of the reference's per-bank XRT BO allocation)
+// ---------------------------------------------------------------------------
+uint64_t Engine::alloc(uint64_t nbytes, uint64_t align) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  if (align == 0) align = 64;
+  if (nbytes == 0) nbytes = align;
+  for (auto it = free_spans_.begin(); it != free_spans_.end(); ++it) {
+    uint64_t base = it->first, size = it->second;
+    uint64_t aligned = (base + align - 1) / align * align;
+    uint64_t pad = aligned - base;
+    if (size < pad + nbytes) continue;
+    free_spans_.erase(it);
+    if (pad) free_spans_[base] = pad;
+    uint64_t rest = size - pad - nbytes;
+    if (rest) free_spans_[aligned + nbytes] = rest;
+    alloc_sizes_[aligned] = nbytes;
+    return aligned;
+  }
+  return 0;  // OOM
+}
+
+void Engine::free_addr(uint64_t addr) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  auto it = alloc_sizes_.find(addr);
+  if (it == alloc_sizes_.end()) return;
+  uint64_t size = it->second;
+  alloc_sizes_.erase(it);
+  // insert + merge with neighbors
+  auto next = free_spans_.lower_bound(addr);
+  if (next != free_spans_.end() && addr + size == next->first) {
+    size += next->second;
+    next = free_spans_.erase(next);
+  }
+  if (next != free_spans_.begin()) {
+    auto prev = std::prev(next);
+    if (prev->first + prev->second == addr) {
+      prev->second += size;
+      return;
+    }
+  }
+  free_spans_[addr] = size;
+}
+
+bool Engine::read_mem(uint64_t addr, void* dst, uint64_t n) {
+  if (addr + n > devicemem_.size()) return false;
+  std::memcpy(dst, devicemem_.data() + addr, n);
+  return true;
+}
+
+bool Engine::write_mem(uint64_t addr, const void* src, uint64_t n) {
+  if (addr + n > devicemem_.size()) return false;
+  std::memcpy(devicemem_.data() + addr, src, n);
+  return true;
+}
+
+uint8_t* Engine::mem(uint64_t addr, uint64_t n) {
+  if (addr + n > devicemem_.size() || (n > 0 && addr == 0)) {
+    sticky_err_ |= DMA_SIZE_ERROR;
+    static thread_local std::vector<uint8_t> bitbucket;
+    bitbucket.assign(std::max<uint64_t>(n, 64), 0);
+    return bitbucket.data();
+  }
+  return devicemem_.data() + addr;
+}
+
+// ---------------------------------------------------------------------------
+// call path
+// ---------------------------------------------------------------------------
+uint64_t Engine::start_call(const uint32_t* w15) {
+  CallDesc c;
+  std::copy(w15, w15 + 15, c.w.begin());
+  c.id = next_call_id_++;
+  {
+    std::lock_guard<std::mutex> g(results_mu_);
+    results_[c.id] = CallResult{};
+  }
+  cmd_q_.push(c);
+  return c.id;
+}
+
+bool Engine::poll_call(uint64_t id, uint32_t* retcode, double* duration_ns) {
+  std::lock_guard<std::mutex> g(results_mu_);
+  auto it = results_.find(id);
+  if (it == results_.end() || !it->second.done) return false;
+  if (retcode) *retcode = it->second.retcode;
+  if (duration_ns) *duration_ns = it->second.duration_ns;
+  results_.erase(it);
+  return true;
+}
+
+void Engine::push_krnl(const uint8_t* data, uint64_t n) {
+  krnl_in_.push(std::vector<uint8_t>(data, data + n));
+}
+
+bool Engine::pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap,
+                        uint64_t* got, int timeout_ms) {
+  std::shared_ptr<Fifo<std::vector<uint8_t>>> q;
+  {
+    std::lock_guard<std::mutex> g(streams_mu_);
+    auto& slot = streams_[strm];
+    if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
+    q = slot;
+  }
+  auto v = q->pop_wait(milliseconds(timeout_ms));
+  if (!v) return false;
+  uint64_t n = std::min<uint64_t>(cap, v->size());
+  std::memcpy(dst, v->data(), n);
+  if (got) *got = n;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// ingress demux — the depacketizer role: eager payloads to the rx pool,
+// kernel-stream payloads to stream FIFOs, rendezvous control up to the
+// engine's pending/completion queues (reference: udp_depacketizer.cpp
+// strm routing :136-147, rdma_depacketizer notification routing)
+// ---------------------------------------------------------------------------
+void Engine::ingress(Message&& msg) {
+  switch (static_cast<MsgType>(msg.hdr.msg_type)) {
+    case MsgType::EgrMsg:
+      if (msg.hdr.strm >= FIRST_KRNL_STREAM) {
+        std::shared_ptr<Fifo<std::vector<uint8_t>>> q;
+        {
+          std::lock_guard<std::mutex> g(streams_mu_);
+          auto& slot = streams_[msg.hdr.strm];
+          if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
+          q = slot;
+        }
+        q->push(std::move(msg.payload));
+      } else {
+        rx_.deposit(std::move(msg));
+      }
+      break;
+    case MsgType::RndzvsInit:
+      pending_addrs_.push(RndzvAddr{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
+                                    msg.hdr.vaddr, msg.hdr.count});
+      break;
+    case MsgType::RndzvsMsg: {
+      // one-sided write into our device memory (the RDMA WRITE landing),
+      // then surface a local completion (the WR_DONE the reference's
+      // depacketizer routes up to the firmware notification stream)
+      {
+        std::lock_guard<std::mutex> g(mem_mu_);
+        if (msg.hdr.vaddr + msg.payload.size() <= devicemem_.size())
+          std::memcpy(devicemem_.data() + msg.hdr.vaddr, msg.payload.data(),
+                      msg.payload.size());
+      }
+      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag});
+      break;
+    }
+    case MsgType::RndzvsWrDone:
+      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag});
+      break;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// engine event loop (fw run_accl :2264-2306): new calls take priority;
+// retried rendezvous calls progress cooperatively in between.
+// ---------------------------------------------------------------------------
+void Engine::loop() {
+  while (running_) {
+    CallDesc c;
+    bool have = false;
+    if (auto o = cmd_q_.try_pop()) {
+      c = *o;
+      have = true;
+    } else if (!retry_q_.empty()) {
+      c = retry_q_.front();
+      retry_q_.pop_front();
+      have = true;
+    } else if (auto o2 = cmd_q_.pop_wait(milliseconds(2))) {
+      c = *o2;
+      have = true;
+    }
+    if (!have) continue;
+
+    auto t0 = steady_clock::now();
+    sticky_err_ = 0;
+    bool retry = false;
+    try {
+      uint32_t ret = execute(c);
+      auto dt = duration_cast<nanoseconds>(steady_clock::now() - t0).count();
+      std::lock_guard<std::mutex> g(results_mu_);
+      auto& r = results_[c.id];
+      r.retcode = ret;
+      r.duration_ns = double(dt);
+      r.done = true;
+    } catch (NotReadyEx&) {
+      retry = true;
+    }
+    if (retry) {
+      retry_q_.push_back(c);
+      // cooperative pacing so retries don't spin hot (the firmware's
+      // round-robin between host cmd stream and retry FIFO)
+      std::this_thread::sleep_for(microseconds(200));
+    }
+  }
+}
+
+uint32_t Engine::execute(CallDesc& c) {
+  Progress p(c);
+  switch (c.scenario()) {
+    case Op::Config: do_config(c); break;
+    case Op::Nop: break;
+    case Op::Copy: {
+      uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+      local_copy(c.addr0(), c.addr2(), bytes);
+      break;
+    }
+    case Op::Combine: {
+      uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+      const ArithCfgN& a = arith_for(c);
+      uint32_t lane = c.function() < a.lanes.size() ? a.lanes[c.function()]
+                                                    : uint32_t(NUM_LANES);
+      local_reduce(lane, c.addr0(), c.addr1(), c.addr2(), bytes);
+      break;
+    }
+    case Op::Send: coll_send(c, p); break;
+    case Op::Recv: coll_recv(c, p); break;
+    case Op::Bcast: coll_bcast(c, p); break;
+    case Op::Scatter: coll_scatter(c, p); break;
+    case Op::Gather: coll_gather(c, p); break;
+    case Op::Allgather: coll_allgather(c, p); break;
+    case Op::Reduce: coll_reduce(c, p); break;
+    case Op::ReduceScatter: coll_reduce_scatter(c, p); break;
+    case Op::Allreduce: coll_allreduce(c, p); break;
+    case Op::Alltoall: coll_alltoall(c, p); break;
+    case Op::Barrier: coll_barrier(c, p); break;
+    default: sticky_err_ |= COLLECTIVE_NOT_IMPLEMENTED; break;
+  }
+  return sticky_err_;
+}
+
+void Engine::do_config(CallDesc& c) {
+  switch (static_cast<CfgFunc>(c.function())) {
+    case CfgFunc::ResetPeriph: {
+      // soft reset (fw HOUSEKEEP_SWRST :2420-2423): drop transient state
+      retry_q_.clear();
+      while (pending_addrs_.try_pop()) {}
+      while (completions_.try_pop()) {}
+      for (auto& t : comms_) {
+        std::fill(t.inbound_seq.begin(), t.inbound_seq.end(), 0);
+        std::fill(t.outbound_seq.begin(), t.outbound_seq.end(), 0);
+      }
+      pkt_enabled_ = false;
+      break;
+    }
+    case CfgFunc::EnablePkt: pkt_enabled_ = true; break;
+    case CfgFunc::SetTimeout: timeout_ = c.count(); break;
+    case CfgFunc::SetMaxEagerMsgSize:
+      // must cover at least one rx buffer (fw :2432-2441)
+      if (rx_.buf_size() && c.count() < rx_.buf_size())
+        sticky_err_ |= EAGER_THRESHOLD_INVALID;
+      else
+        max_eager_ = c.count();
+      break;
+    case CfgFunc::SetMaxRendezvousMsgSize:
+      if (c.count() < max_eager_)
+        sticky_err_ |= RENDEZVOUS_THRESHOLD_INVALID;
+      else
+        max_rndzv_ = c.count();
+      break;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// helpers
+// ---------------------------------------------------------------------------
+const CommTable& Engine::comm_for(const CallDesc& c) const {
+  static CommTable empty;
+  return c.comm() < comms_.size() ? comms_[c.comm()] : empty;
+}
+
+const ArithCfgN& Engine::arith_for(const CallDesc& c) const {
+  static ArithCfgN dflt;
+  return c.arithcfg() < arithcfgs_.size() ? arithcfgs_[c.arithcfg()] : dflt;
+}
+
+uint64_t Engine::elem_bytes(const CallDesc& c) const {
+  return arith_for(c).ubits / 8;
+}
+
+nanoseconds Engine::timeout_budget() const {
+  // 1 emulated cycle = 1us (the reference counts 4ns cycles on hardware;
+  // the emulator scales so the default 1e6-cycle timeout is 1s of wall
+  // clock, tolerant of CI scheduling)
+  return microseconds(timeout_);
+}
+
+bool Engine::use_rendezvous(const CallDesc& c, uint64_t bytes) const {
+  // eager if small, compressed, or streamed (fw send :589, recv :669)
+  if (bytes <= max_eager_) return false;
+  if (c.compression() != 0) return false;
+  if (c.stream_flags() != 0) return false;
+  return true;
+}
+
+uint32_t Engine::local_copy(uint64_t src, uint64_t dst, uint64_t bytes) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  uint8_t* s = mem(src, bytes);
+  uint8_t* d = mem(dst, bytes);
+  std::memmove(d, s, bytes);
+  return sticky_err_;
+}
+
+uint32_t Engine::local_reduce(uint32_t lane, uint64_t a, uint64_t b,
+                              uint64_t dst, uint64_t bytes) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  uint8_t* pa = mem(a, bytes);
+  uint8_t* pb = mem(b, bytes);
+  uint8_t* pd = mem(dst, bytes);
+  sticky_err_ |= run_reduce_lane(lane, pa, pb, pd, bytes);
+  return sticky_err_;
+}
+
+// ---------------------------------------------------------------------------
+// eager protocol primitives
+// ---------------------------------------------------------------------------
+void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
+                        uint64_t bytes, bool from_stream, uint32_t to_strm) {
+  CommTable& t = comms_[c.comm()];
+  const ArithCfgN& a = arith_for(c);
+  bool compress = (c.compression() != 0) && a.ratio_log > 0;
+  uint64_t seg_wire = t.rows[dst].max_seg ? t.rows[dst].max_seg
+                                          : (rx_.buf_size() ? rx_.buf_size()
+                                                            : 1024);
+  uint64_t seg_u = compress ? seg_wire << a.ratio_log : seg_wire;
+
+  uint64_t off = 0;
+  bool first = true;
+  while (off < bytes || (first && bytes == 0)) {
+    first = false;
+    uint64_t chunk = std::min(seg_u, bytes - off);
+    Message msg;
+    if (from_stream) {
+      // operand streamed from the local compute kernel (OP0_STREAM;
+      // reference vadd_put path accl_hls.h / fw :575)
+      auto v = krnl_in_.pop_wait(timeout_budget());
+      if (!v || v->size() != chunk) {
+        sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
+        return;
+      }
+      msg.payload = std::move(*v);
+    } else {
+      std::lock_guard<std::mutex> g(mem_mu_);
+      uint8_t* p = mem(addr + off, chunk);
+      msg.payload.assign(p, p + chunk);
+    }
+    if (compress) {
+      std::vector<uint8_t> packed(msg.payload.size() >> a.ratio_log);
+      compress_f32_f16(msg.payload.data(), packed.data(), msg.payload.size());
+      msg.payload = std::move(packed);
+      msg.hdr.compressed = 1;
+    }
+    msg.hdr.count = uint32_t(msg.payload.size());
+    msg.hdr.tag = tag;
+    msg.hdr.src = t.local;
+    // stream-destined messages bypass the rx pool on the receiver, so
+    // they must not consume the eager sequence space (seqn discipline is
+    // per rx-pool stream; SURVEY §7 hard part (e))
+    msg.hdr.seqn =
+        to_strm >= FIRST_KRNL_STREAM ? 0 : t.outbound_seq[dst]++;
+    msg.hdr.strm = to_strm;
+    msg.hdr.dst_session = uint16_t(t.rows[dst].session);
+    msg.hdr.msg_type = uint8_t(MsgType::EgrMsg);
+    msg.hdr.comm_id = c.comm();
+    transport_->send(t.rows[dst].session, std::move(msg));
+    off += chunk;
+  }
+}
+
+void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
+                        uint64_t bytes, RecvMode mode, uint32_t strm) {
+  CommTable& t = comms_[c.comm()];
+  const ArithCfgN& a = arith_for(c);
+  bool compress = (c.compression() != 0) && a.ratio_log > 0;
+  uint64_t seg_wire = t.rows[t.local].max_seg
+                          ? t.rows[t.local].max_seg
+                          : (rx_.buf_size() ? rx_.buf_size() : 1024);
+  uint64_t seg_u = compress ? seg_wire << a.ratio_log : seg_wire;
+
+  uint64_t off = 0;
+  bool first = true;
+  while (off < bytes || (first && bytes == 0)) {
+    first = false;
+    uint64_t chunk = std::min(seg_u, bytes - off);
+    auto note = rx_.seek(c.comm(), src, tag, t.inbound_seq[src],
+                         timeout_budget());
+    if (!note) {
+      sticky_err_ |= RECEIVE_TIMEOUT_ERROR;
+      return;
+    }
+    t.inbound_seq[src]++;
+    const uint8_t* data = rx_.data(note->index);
+    uint64_t got = note->bytes;
+    std::vector<uint8_t> dec;
+    if (note->compressed) {
+      dec.resize(got << a.ratio_log);
+      decompress_f16_f32(data, dec.data(), got);
+      data = dec.data();
+      got = dec.size();
+    }
+    if (got != chunk) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
+    uint64_t n = std::min(got, chunk);
+    switch (mode) {
+      case RecvMode::COPY: {
+        std::lock_guard<std::mutex> g(mem_mu_);
+        std::memcpy(mem(addr + off, n), data, n);
+        break;
+      }
+      case RecvMode::REDUCE: {
+        const ArithCfgN& ac = arith_for(c);
+        uint32_t lane = c.function() < ac.lanes.size()
+                            ? ac.lanes[c.function()]
+                            : uint32_t(NUM_LANES);
+        std::lock_guard<std::mutex> g(mem_mu_);
+        uint8_t* d = mem(addr + off, n);
+        sticky_err_ |= run_reduce_lane(lane, d, data, d, n);
+        break;
+      }
+      case RecvMode::STREAM: {
+        std::shared_ptr<Fifo<std::vector<uint8_t>>> q;
+        {
+          std::lock_guard<std::mutex> g(streams_mu_);
+          auto& slot = streams_[strm];
+          if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
+          q = slot;
+        }
+        q->push(std::vector<uint8_t>(data, data + n));
+        break;
+      }
+    }
+    rx_.release(note->index);
+    off += chunk;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// rendezvous protocol primitives (fw :142-350; SURVEY §3.5)
+// ---------------------------------------------------------------------------
+void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
+                             uint32_t tag, uint64_t addr, uint64_t bytes) {
+  CommTable& t = comms_[c.comm()];
+  if (p.pending()) {
+    // advertise our landing address to the sender (RNDZVS_INIT)
+    Message msg;
+    msg.hdr.count = uint32_t(bytes);
+    msg.hdr.tag = tag;
+    msg.hdr.src = t.local;
+    msg.hdr.vaddr = addr;
+    msg.hdr.msg_type = uint8_t(MsgType::RndzvsInit);
+    msg.hdr.comm_id = c.comm();
+    transport_->send(t.rows[src].session, std::move(msg));
+  }
+  p.done();
+}
+
+void Engine::rndzv_wait_done(CallDesc& c, Progress& p, uint32_t src,
+                             uint32_t tag) {
+  if (p.pending()) {
+    // wait for the write-done completion; not ready -> re-queue the call
+    auto done = completions_.pop_match(
+        [&](const RndzvDone& d) {
+          return d.comm == c.comm() && d.src == src && d.tag == tag;
+        },
+        milliseconds(2));
+    if (!done) throw NotReadyEx{c.current_step};
+  }
+  p.done();
+}
+
+void Engine::rndzv_recv(CallDesc& c, Progress& p, uint32_t src, uint32_t tag,
+                        uint64_t addr, uint64_t bytes) {
+  rndzv_post_addr(c, p, src, tag, addr, bytes);
+  rndzv_wait_done(c, p, src, tag);
+}
+
+void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
+                        uint64_t addr, uint64_t bytes) {
+  CommTable& t = comms_[c.comm()];
+  if (p.pending()) {
+    // step: match the receiver's advertised address, then issue the
+    // one-sided write (single step so the INIT can't be consumed twice)
+    auto a = pending_addrs_.pop_match(
+        [&](const RndzvAddr& r) {
+          return r.comm == c.comm() && r.src == dst && r.tag == tag;
+        },
+        milliseconds(2));
+    if (!a) throw NotReadyEx{c.current_step};
+    Message msg;
+    msg.hdr.count = uint32_t(bytes);
+    msg.hdr.tag = tag;
+    msg.hdr.src = t.local;
+    msg.hdr.vaddr = a->vaddr;
+    msg.hdr.msg_type = uint8_t(MsgType::RndzvsMsg);
+    msg.hdr.comm_id = c.comm();
+    {
+      std::lock_guard<std::mutex> g(mem_mu_);
+      uint8_t* pdata = mem(addr, bytes);
+      msg.payload.assign(pdata, pdata + bytes);
+    }
+    transport_->send(t.rows[dst].session, std::move(msg));
+  }
+  p.done();
+}
+
+// ---------------------------------------------------------------------------
+// collective schedules
+// ---------------------------------------------------------------------------
+void Engine::coll_send(CallDesc& c, Progress& p) {
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t dst = c.root_src_dst();
+  bool from_stream = c.stream_flags() & 0x1;  // OP0_STREAM
+  uint32_t to_strm =
+      (c.stream_flags() & 0x2) ? c.tag() : 0;  // RES_STREAM: remote stream
+  if (use_rendezvous(c, bytes)) {
+    rndzv_send(c, p, dst, c.tag(), c.addr0(), bytes);
+  } else {
+    send_eager(c, dst, c.tag(), c.addr0(), bytes, from_stream, to_strm);
+  }
+}
+
+void Engine::coll_recv(CallDesc& c, Progress& p) {
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t src = c.root_src_dst();
+  if (use_rendezvous(c, bytes)) {
+    rndzv_recv(c, p, src, c.tag(), c.addr2(), bytes);
+  } else {
+    RecvMode mode =
+        (c.stream_flags() & 0x2) ? RecvMode::STREAM : RecvMode::COPY;
+    recv_eager(c, src, c.tag(), c.addr2(), bytes, mode, c.tag());
+  }
+}
+
+// Broadcast: root sends to every rank; the rendezvous path for large
+// payloads naturally overlaps the one-sided writes (tree schedules arrive
+// with the rendezvous milestone; reference fw :798-990).
+void Engine::coll_bcast(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t root = c.root_src_dst();
+  if (t.size <= 1) return;
+  if (t.local == root) {
+    for (uint32_t r = 0; r < t.size; ++r) {
+      if (r == root) continue;
+      if (use_rendezvous(c, bytes))
+        rndzv_send(c, p, r, c.tag(), c.addr0(), bytes);
+      else
+        send_eager(c, r, c.tag(), c.addr0(), bytes, false, 0);
+    }
+  } else {
+    if (use_rendezvous(c, bytes))
+      rndzv_recv(c, p, root, c.tag(), c.addr2(), bytes);
+    else
+      recv_eager(c, root, c.tag(), c.addr2(), bytes, RecvMode::COPY, 0);
+  }
+}
+
+// Scatter: root walks the rank-strided source (the reference's
+// MOVE_INCREMENT addressing, fw :1082-1124), local chunk copied in place.
+void Engine::coll_scatter(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t root = c.root_src_dst();
+  if (t.local == root) {
+    for (uint32_t r = 0; r < t.size; ++r) {
+      uint64_t src = c.addr0() + uint64_t(r) * bytes;
+      if (r == root) {
+        local_copy(src, c.addr2(), bytes);
+      } else if (use_rendezvous(c, bytes)) {
+        rndzv_send(c, p, r, c.tag(), src, bytes);
+      } else {
+        send_eager(c, r, c.tag(), src, bytes, false, 0);
+      }
+    }
+  } else {
+    if (use_rendezvous(c, bytes))
+      rndzv_recv(c, p, root, c.tag(), c.addr2(), bytes);
+    else
+      recv_eager(c, root, c.tag(), c.addr2(), bytes, RecvMode::COPY, 0);
+  }
+}
+
+// Gather: eager ring relay — every non-root forwards toward the root,
+// which receives blocks in ring order (fw :1207-1295).  Large payloads
+// use direct rendezvous writes to the root (flat; fan-in control comes
+// with the tuning milestone, fw :1163).
+void Engine::coll_gather(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t root = c.root_src_dst();
+  uint32_t P = t.size;
+  if (P == 1) {
+    local_copy(c.addr0(), c.addr2(), bytes);
+    return;
+  }
+  bool rndzv = use_rendezvous(c, bytes);
+  uint32_t d = (t.local + P - root) % P;  // distance to root along ring
+  if (rndzv) {
+    // flat tree with out-of-order address arrival (fw :1011-1081 shape):
+    // the root posts every landing address up front, then collects
+    // completions in whatever order the writes land
+    if (t.local == root) {
+      local_copy(c.addr0(), c.addr2() + uint64_t(root) * bytes, bytes);
+      for (uint32_t i = 1; i < P; ++i) {
+        uint32_t r = (root + i) % P;
+        rndzv_post_addr(c, p, r, c.tag(), c.addr2() + uint64_t(r) * bytes,
+                        bytes);
+      }
+      for (uint32_t i = 1; i < P; ++i)
+        rndzv_wait_done(c, p, (root + i) % P, c.tag());
+    } else {
+      rndzv_send(c, p, root, c.tag(), c.addr0(), bytes);
+    }
+    return;
+  }
+  if (t.local == root) {
+    local_copy(c.addr0(), c.addr2() + uint64_t(root) * bytes, bytes);
+    uint32_t next = (t.local + 1) % P;
+    for (uint32_t i = 0; i < P - 1; ++i) {
+      uint32_t origin = (root + 1 + i) % P;
+      recv_eager(c, next, c.tag(), c.addr2() + uint64_t(origin) * bytes,
+                 bytes, RecvMode::COPY, 0);
+    }
+  } else {
+    uint32_t prev = (t.local + P - 1) % P;
+    uint32_t next = (t.local + 1) % P;
+    send_eager(c, prev, c.tag(), c.addr0(), bytes, false, 0);
+    // relay the blocks of everyone farther from the root through scratch
+    uint64_t tmp = alloc(bytes, 64);
+    for (uint32_t i = 0; i < P - 1 - d; ++i) {
+      recv_eager(c, next, c.tag(), tmp, bytes, RecvMode::COPY, 0);
+      send_eager(c, prev, c.tag(), tmp, bytes, false, 0);
+    }
+    free_addr(tmp);
+  }
+}
+
+// All-gather: ring relay with a local self-copy first (fw :1404-1502).
+void Engine::coll_allgather(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t P = t.size;
+  local_copy(c.addr0(), c.addr2() + uint64_t(t.local) * bytes, bytes);
+  if (P == 1) return;
+  uint32_t next = (t.local + 1) % P;
+  uint32_t prev = (t.local + P - 1) % P;
+  for (uint32_t s = 0; s < P - 1; ++s) {
+    uint32_t send_origin = (t.local + P - s) % P;
+    uint32_t recv_origin = (t.local + P - 1 - s) % P;
+    send_eager(c, next, c.tag(), c.addr2() + uint64_t(send_origin) * bytes,
+               bytes, false, 0);
+    recv_eager(c, prev, c.tag(), c.addr2() + uint64_t(recv_origin) * bytes,
+               bytes, RecvMode::COPY, 0);
+  }
+}
+
+// Reduce: eager ring/daisy-chain with fused recv-reduce(-send) at the
+// interior ranks (fw :1730-1743).
+void Engine::coll_reduce(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t root = c.root_src_dst();
+  uint32_t P = t.size;
+  if (P == 1) {
+    local_copy(c.addr0(), c.addr2(), bytes);
+    return;
+  }
+  uint32_t pos = (t.local + P - root) % P;  // chain position; root = 0
+  uint32_t next = (t.local + 1) % P;
+  uint32_t prev = (t.local + P - 1) % P;
+  if (pos == 1) {
+    // head of the chain: just forward our contribution
+    send_eager(c, next, c.tag(), c.addr0(), bytes, false, 0);
+  } else if (pos != 0) {
+    // interior: receive partial, fold our contribution, forward
+    uint64_t tmp = alloc(bytes, 64);
+    local_copy(c.addr0(), tmp, bytes);
+    recv_eager(c, prev, c.tag(), tmp, bytes, RecvMode::REDUCE, 0);
+    send_eager(c, next, c.tag(), tmp, bytes, false, 0);
+    free_addr(tmp);
+  } else {
+    // root: receive the chain's partial, fold our contribution into res
+    local_copy(c.addr0(), c.addr2(), bytes);
+    recv_eager(c, prev, c.tag(), c.addr2(), bytes, RecvMode::REDUCE, 0);
+  }
+}
+
+// Ring reduce-scatter core shared by reduce_scatter and allreduce
+// (fw :1782-1850, :1888-2071): step 0 sends chunk (rank-1); interior
+// steps fuse recv+reduce+forward; the final step folds chunk `rank`.
+void Engine::ring_reduce_scatter(CallDesc& c, uint64_t src_base,
+                                 const std::vector<uint64_t>& off,
+                                 const std::vector<uint64_t>& len,
+                                 uint64_t own_dst) {
+  const CommTable& t = comm_for(c);
+  uint32_t P = t.size;
+  uint32_t r = t.local;
+  uint32_t next = (r + 1) % P;
+  uint32_t prev = (r + P - 1) % P;
+  if (P == 1) {
+    local_copy(src_base + off[0], own_dst, len[0]);
+    return;
+  }
+  uint32_t first = (r + P - 1) % P;
+  send_eager(c, next, c.tag(), src_base + off[first], len[first], false, 0);
+  uint64_t maxlen = *std::max_element(len.begin(), len.end());
+  uint64_t tmp = alloc(std::max<uint64_t>(maxlen, 64), 64);
+  for (uint32_t s = 1; s <= P - 1; ++s) {
+    // chunk index arriving this step: (r - 1 - s) mod P
+    uint32_t chunk =
+        uint32_t(((int64_t(r) - 1 - int64_t(s)) % int64_t(P) + P) % P);
+    local_copy(src_base + off[chunk], tmp, len[chunk]);
+    recv_eager(c, prev, c.tag(), tmp, len[chunk], RecvMode::REDUCE, 0);
+    if (chunk == r) {
+      local_copy(tmp, own_dst, len[chunk]);
+    } else {
+      send_eager(c, next, c.tag(), tmp, len[chunk], false, 0);
+    }
+  }
+  free_addr(tmp);
+}
+
+// Ring all-gather over chunks already resident in dst (fw :1990-2066).
+void Engine::ring_allgather(CallDesc& c, uint64_t base,
+                            const std::vector<uint64_t>& off,
+                            const std::vector<uint64_t>& len) {
+  const CommTable& t = comm_for(c);
+  uint32_t P = t.size;
+  uint32_t r = t.local;
+  if (P == 1) return;
+  uint32_t next = (r + 1) % P;
+  uint32_t prev = (r + P - 1) % P;
+  for (uint32_t s = 0; s < P - 1; ++s) {
+    uint32_t send_chunk = uint32_t(((int64_t(r) - int64_t(s)) % int64_t(P) + P) % P);
+    uint32_t recv_chunk = uint32_t(((int64_t(r) - 1 - int64_t(s)) % int64_t(P) + P) % P);
+    send_eager(c, next, c.tag(), base + off[send_chunk], len[send_chunk],
+               false, 0);
+    recv_eager(c, prev, c.tag(), base + off[recv_chunk], len[recv_chunk],
+               RecvMode::COPY, 0);
+  }
+}
+
+void Engine::coll_reduce_scatter(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);  // per-rank result
+  uint32_t P = t.size;
+  std::vector<uint64_t> off(P), len(P, bytes);
+  for (uint32_t i = 0; i < P; ++i) off[i] = uint64_t(i) * bytes;
+  ring_reduce_scatter(c, c.addr0(), off, len, c.addr2());
+}
+
+void Engine::coll_allreduce(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint32_t P = t.size;
+  uint64_t eb = elem_bytes(c);
+  uint64_t total = uint64_t(c.count());
+  if (P == 1) {
+    local_copy(c.addr0(), c.addr2(), total * eb);
+    return;
+  }
+  // chunk the element range across ranks (bulk/tail split for ragged
+  // sizes, fw :1909-1912)
+  std::vector<uint64_t> off(P), len(P);
+  uint64_t base_elems = total / P, extra = total % P, cursor = 0;
+  for (uint32_t i = 0; i < P; ++i) {
+    uint64_t e = base_elems + (i < extra ? 1 : 0);
+    off[i] = cursor * eb;
+    len[i] = e * eb;
+    cursor += e;
+  }
+  ring_reduce_scatter(c, c.addr0(), off, len, c.addr2() + off[t.local]);
+  ring_allgather(c, c.addr2(), off, len);
+}
+
+// All-to-all: send every peer its slice, then collect ours (the
+// reference's eager path is unimplemented — COLLECTIVE_NOT_IMPLEMENTED,
+// fw :2213-2215 — we implement it; the rendezvous path mirrors the
+// reference's fused simultaneous flat trees :2123-2218).
+void Engine::coll_alltoall(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint32_t P = t.size;
+  local_copy(c.addr0() + uint64_t(t.local) * bytes,
+             c.addr2() + uint64_t(t.local) * bytes, bytes);
+  bool rndzv = use_rendezvous(c, bytes);
+  if (rndzv) {
+    // fused simultaneous flat trees (fw :2123-2218): publish all landing
+    // addresses, write as peer addresses arrive (out of order), then
+    // drain completions
+    for (uint32_t i = 1; i < P; ++i) {
+      uint32_t r = (t.local + P - i) % P;
+      rndzv_post_addr(c, p, r, c.tag(), c.addr2() + uint64_t(r) * bytes,
+                      bytes);
+    }
+    for (uint32_t i = 1; i < P; ++i) {
+      uint32_t r = (t.local + i) % P;
+      rndzv_send(c, p, r, c.tag(), c.addr0() + uint64_t(r) * bytes, bytes);
+    }
+    for (uint32_t i = 1; i < P; ++i)
+      rndzv_wait_done(c, p, (t.local + P - i) % P, c.tag());
+    return;
+  }
+  for (uint32_t i = 1; i < P; ++i) {
+    uint32_t r = (t.local + i) % P;
+    send_eager(c, r, c.tag(), c.addr0() + uint64_t(r) * bytes, bytes, false,
+               0);
+  }
+  for (uint32_t i = 1; i < P; ++i) {
+    uint32_t r = (t.local + P - i) % P;
+    recv_eager(c, r, c.tag(), c.addr2() + uint64_t(r) * bytes, bytes,
+               RecvMode::COPY, 0);
+  }
+}
+
+// Barrier: gather-to-0 + scatter-from-0 of empty messages (fw :2077-2120).
+void Engine::coll_barrier(CallDesc& c, Progress& p) {
+  const CommTable& t = comm_for(c);
+  uint32_t P = t.size;
+  if (P == 1) return;
+  if (t.local == 0) {
+    for (uint32_t r = 1; r < P; ++r)
+      recv_eager(c, r, BARRIER_TAG, 0, 0, RecvMode::COPY, 0);
+    for (uint32_t r = 1; r < P; ++r)
+      send_eager(c, r, BARRIER_TAG, 0, 0, false, 0);
+  } else {
+    send_eager(c, 0, BARRIER_TAG, 0, 0, false, 0);
+    recv_eager(c, 0, BARRIER_TAG, 0, 0, RecvMode::COPY, 0);
+  }
+}
+
+}  // namespace accl

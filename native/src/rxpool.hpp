@@ -1,0 +1,124 @@
+// Eager RX buffer pool with notification matching.
+//
+// Equivalent of the reference rx-buffer offload engines: a table of spare
+// buffers cycling IDLE -> RESERVED -> IDLE, a notification queue written
+// at ingress, and a seek operation matching (src, tag, seqn) with
+// wildcard tags (reference: kernels/cclo/hls/rxbuf_offload/
+// rxbuf_enqueue.cpp / rxbuf_dequeue.cpp / rxbuf_seek.cpp; status machine
+// ccl_offload_control.h:287-290).  Overflowing ingress parks in a staging
+// queue, modeling the transport backpressure the reference gets from its
+// TCP/RDMA stacks when no spare buffer is free.
+#pragma once
+
+#include "common.hpp"
+#include "transport.hpp"
+
+namespace accl {
+
+struct RxNotification {
+  uint32_t index = 0;  // buffer table index
+  uint32_t bytes = 0;  // payload bytes in buffer (wire size)
+  uint32_t tag = 0;
+  uint32_t src = 0;
+  uint32_t seqn = 0;
+  uint32_t comm = 0;
+  uint32_t compressed = 0;
+};
+
+class RxPool {
+ public:
+  enum class Status : uint8_t { IDLE = 0, RESERVED = 1 };
+
+  void configure(uint32_t nbufs, uint64_t bufsize) {
+    std::lock_guard<std::mutex> g(m_);
+    bufs_.assign(nbufs, std::vector<uint8_t>(bufsize));
+    status_.assign(nbufs, Status::IDLE);
+    bufsize_ = bufsize;
+  }
+
+  uint64_t buf_size() const { return bufsize_; }
+
+  // Ingress path (called from the transport sink).
+  void deposit(Message&& msg) {
+    {
+      std::lock_guard<std::mutex> g(m_);
+      int idx = find_idle_locked();
+      if (idx >= 0) {
+        install_locked(uint32_t(idx), msg);
+        return;
+      }
+      staging_.push_back(std::move(msg));
+    }
+  }
+
+  // Seek a notification matching (comm, src, tag|TAG_ANY, seqn); blocks up
+  // to `timeout`.  Returns nullopt on timeout (-> RECEIVE_TIMEOUT_ERROR).
+  std::optional<RxNotification> seek(uint32_t comm, uint32_t src, uint32_t tag,
+                                     uint32_t seqn,
+                                     std::chrono::nanoseconds timeout) {
+    return notif_.pop_match(
+        [=](const RxNotification& n) {
+          return n.comm == comm && n.src == src && n.seqn == seqn &&
+                 (tag == TAG_ANY || n.tag == tag);
+        },
+        timeout);
+  }
+
+  const uint8_t* data(uint32_t index) const { return bufs_[index].data(); }
+
+  // Release a buffer back to IDLE and pull one staged message in
+  // (rxbuf_seek release path + re-enqueue).
+  void release(uint32_t index) {
+    std::lock_guard<std::mutex> g(m_);
+    status_[index] = Status::IDLE;
+    if (!staging_.empty()) {
+      Message msg = std::move(staging_.front());
+      staging_.pop_front();
+      install_locked(index, msg);
+    }
+  }
+
+  std::string dump() const {
+    std::lock_guard<std::mutex> g(m_);
+    std::string out = "rx pool: " + std::to_string(bufs_.size()) + " x " +
+                      std::to_string(bufsize_) + "B, " +
+                      std::to_string(staging_.size()) + " staged, " +
+                      std::to_string(notif_.size()) + " pending\n";
+    for (size_t i = 0; i < bufs_.size(); ++i) {
+      out += "  buf " + std::to_string(i) + ": " +
+             (status_[i] == Status::IDLE ? "IDLE" : "RESERVED") + "\n";
+    }
+    return out;
+  }
+
+ private:
+  int find_idle_locked() {
+    for (size_t i = 0; i < status_.size(); ++i)
+      if (status_[i] == Status::IDLE) return int(i);
+    return -1;
+  }
+
+  void install_locked(uint32_t idx, Message& msg) {
+    status_[idx] = Status::RESERVED;
+    size_t n = std::min<size_t>(msg.payload.size(), bufs_[idx].size());
+    if (n) std::memcpy(bufs_[idx].data(), msg.payload.data(), n);
+    RxNotification note;
+    note.index = idx;
+    note.bytes = uint32_t(n);
+    note.tag = msg.hdr.tag;
+    note.src = msg.hdr.src;
+    note.seqn = msg.hdr.seqn;
+    note.comm = msg.hdr.comm_id;
+    note.compressed = msg.hdr.compressed;
+    notif_.push(note);
+  }
+
+  mutable std::mutex m_;
+  std::vector<std::vector<uint8_t>> bufs_;
+  std::vector<Status> status_;
+  std::deque<Message> staging_;
+  Fifo<RxNotification> notif_;
+  uint64_t bufsize_ = 0;
+};
+
+}  // namespace accl

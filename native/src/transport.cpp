@@ -1,0 +1,164 @@
+// TCP transport implementation (see transport.hpp).
+#include "transport.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+
+namespace accl {
+
+static bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    // chunk writes at the reference's max packet size; purely a pacing
+    // quantum here (TCP re-frames anyway)
+    size_t chunk = n < MAX_PACKETSIZE ? n : size_t(MAX_PACKETSIZE);
+    ssize_t w = ::write(fd, p, chunk);
+    if (w <= 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= size_t(w);
+  }
+  return true;
+}
+
+static bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= size_t(r);
+  }
+  return true;
+}
+
+TcpTransport::TcpTransport(int rank, int nranks, int base_port,
+                           std::vector<std::string> peer_ips)
+    : rank_(rank),
+      nranks_(nranks),
+      base_port_(base_port),
+      peer_ips_(std::move(peer_ips)),
+      peer_fds_(nranks, -1),
+      peer_mu_(nranks) {}
+
+TcpTransport::~TcpTransport() { stop(); }
+
+void TcpTransport::start(Sink sink) {
+  sink_ = std::move(sink);
+  running_ = true;
+
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(uint16_t(base_port_ + rank_));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    throw std::runtime_error("TcpTransport: bind failed on port " +
+                             std::to_string(base_port_ + rank_));
+  ::listen(listen_fd_, nranks_ + 4);
+  threads_.emplace_back([this] { accept_loop(); });
+}
+
+void TcpTransport::stop() {
+  if (!running_.exchange(false)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  for (int& fd : peer_fds_) {
+    if (fd >= 0) {
+      ::shutdown(fd, SHUT_RDWR);
+      ::close(fd);
+      fd = -1;
+    }
+  }
+  for (auto& t : threads_)
+    if (t.joinable()) t.join();
+  threads_.clear();
+}
+
+void TcpTransport::accept_loop() {
+  while (running_) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (!running_) break;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> g(conn_mu_);
+    threads_.emplace_back([this, fd] { reader_loop(fd); });
+  }
+}
+
+void TcpTransport::reader_loop(int fd) {
+  while (running_) {
+    uint32_t len = 0;
+    if (!read_all(fd, &len, 4)) break;
+    if (len < sizeof(WireHeader)) break;
+    Message msg;
+    if (!read_all(fd, &msg.hdr, sizeof(WireHeader))) break;
+    msg.payload.resize(len - sizeof(WireHeader));
+    if (!msg.payload.empty() &&
+        !read_all(fd, msg.payload.data(), msg.payload.size()))
+      break;
+    if (sink_) sink_(std::move(msg));
+  }
+  ::close(fd);
+}
+
+int TcpTransport::connect_to(uint32_t dst) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(base_port_ + int(dst)));
+  const std::string& ip =
+      dst < peer_ips_.size() && !peer_ips_[dst].empty() ? peer_ips_[dst]
+                                                        : "127.0.0.1";
+  ::inet_pton(AF_INET, ip.c_str(), &addr.sin_addr);
+  // retry: peers race to come up (the reference exchanges sessions at
+  // configure time; we tolerate startup skew instead)
+  for (int attempt = 0; attempt < 200; ++attempt) {
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(25));
+  }
+  ::close(fd);
+  return -1;
+}
+
+void TcpTransport::send(uint32_t dst, Message&& msg) {
+  std::lock_guard<std::mutex> g(peer_mu_[dst]);
+  if (peer_fds_[dst] < 0) {
+    peer_fds_[dst] = connect_to(dst);
+    if (peer_fds_[dst] < 0)
+      throw std::runtime_error("TcpTransport: connect to rank " +
+                               std::to_string(dst) + " failed");
+  }
+  uint32_t len = uint32_t(sizeof(WireHeader) + msg.payload.size());
+  int fd = peer_fds_[dst];
+  if (!write_all(fd, &len, 4) || !write_all(fd, &msg.hdr, sizeof(WireHeader)) ||
+      (!msg.payload.empty() &&
+       !write_all(fd, msg.payload.data(), msg.payload.size())))
+    throw std::runtime_error("TcpTransport: write to rank " +
+                             std::to_string(dst) + " failed");
+}
+
+}  // namespace accl

@@ -1,0 +1,105 @@
+// Transport layer: moves framed {header, payload} messages between ranks.
+//
+// Role of the reference's protocol-offload engines + ZMQ emulation glue
+// (SURVEY §5 "Distributed communication backend"): the reference drives
+// TCP/UDP/RDMA offload engines on hardware and ZMQ pub/sub in emulation
+// (test/model/zmq/zmq_server.h).  Here:
+//  - InprocTransport: all ranks in one process, lock-free handoff to the
+//    receiver's dispatcher (the reference's axis3x single-board loopback
+//    analog).
+//  - TcpTransport: one process per rank, length-prefixed frames over
+//    sockets with a rank-indexed port convention (the reference emulator's
+//    multi-process ZMQ rung; zmq_server.cpp port scheme).
+// On TPU hardware the ICI mesh replaces this layer entirely.
+#pragma once
+
+#include <functional>
+
+#include "common.hpp"
+
+namespace accl {
+
+struct Message {
+  WireHeader hdr;
+  std::vector<uint8_t> payload;
+};
+
+class Transport {
+ public:
+  using Sink = std::function<void(Message&&)>;
+  virtual ~Transport() = default;
+  // Send to a global rank endpoint; must be thread-safe.
+  virtual void send(uint32_t global_dst, Message&& msg) = 0;
+  virtual void start(Sink sink) = 0;
+  virtual void stop() = 0;
+};
+
+// Shared in-process hub: global rank -> sink.
+class InprocHub {
+ public:
+  explicit InprocHub(int nranks) : sinks_(nranks) {}
+  void attach(int rank, Transport::Sink sink) {
+    std::lock_guard<std::mutex> g(m_);
+    sinks_[rank] = std::move(sink);
+  }
+  void detach(int rank) {
+    std::lock_guard<std::mutex> g(m_);
+    sinks_[rank] = nullptr;
+  }
+  void deliver(uint32_t dst, Message&& msg) {
+    Transport::Sink sink;
+    {
+      std::lock_guard<std::mutex> g(m_);
+      if (dst < sinks_.size()) sink = sinks_[dst];
+    }
+    if (sink) sink(std::move(msg));
+  }
+
+ private:
+  std::mutex m_;
+  std::vector<Transport::Sink> sinks_;
+};
+
+class InprocTransport : public Transport {
+ public:
+  InprocTransport(std::shared_ptr<InprocHub> hub, int rank)
+      : hub_(std::move(hub)), rank_(rank) {}
+  void send(uint32_t dst, Message&& msg) override {
+    hub_->deliver(dst, std::move(msg));
+  }
+  void start(Sink sink) override { hub_->attach(rank_, std::move(sink)); }
+  void stop() override { hub_->detach(rank_); }
+
+ private:
+  std::shared_ptr<InprocHub> hub_;
+  int rank_;
+};
+
+// One-process-per-rank sockets.  Rank r listens on base_port + r;
+// connections to peers are opened lazily on first send.
+class TcpTransport : public Transport {
+ public:
+  TcpTransport(int rank, int nranks, int base_port,
+               std::vector<std::string> peer_ips);
+  ~TcpTransport() override;
+  void send(uint32_t dst, Message&& msg) override;
+  void start(Sink sink) override;
+  void stop() override;
+
+ private:
+  int connect_to(uint32_t dst);
+  void accept_loop();
+  void reader_loop(int fd);
+
+  int rank_, nranks_, base_port_;
+  std::vector<std::string> peer_ips_;
+  int listen_fd_ = -1;
+  std::vector<int> peer_fds_;       // lazily-opened outbound sockets
+  std::vector<std::mutex> peer_mu_; // serialize writes per peer
+  Sink sink_;
+  std::atomic<bool> running_{false};
+  std::vector<std::thread> threads_;
+  std::mutex conn_mu_;
+};
+
+}  // namespace accl
