@@ -158,6 +158,17 @@ class ACCL:
     def set_timeout(self, timeout: int) -> None:
         self._config_call(CfgFunc.set_timeout, value=timeout)
 
+    # flat-tree schedule thresholds (reference exchange-memory tuning
+    # registers, accl.cpp:1214-1224 / ccl_offload_control.h:86-90)
+    BCAST_FLAT_TREE_MAX_RANKS = 0
+    REDUCE_FLAT_TREE_MAX_RANKS = 1
+    GATHER_FLAT_TREE_MAX_FANIN = 2
+
+    def set_tuning(self, key: int, value: int) -> None:
+        setter = getattr(self._device, "set_tuning", None)
+        if setter is not None:
+            setter(key, value)
+
     def get_duration(self, request: Optional[Request] = None) -> float:
         """Duration in ns of a completed call, from the engine's
         performance counter (reference: accl.cpp:1387 get_duration;

@@ -58,6 +58,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_cfg_rx.argtypes = [p, i32, i32, u64]
     lib.accl_set_comm.argtypes = [p, i32, ctypes.POINTER(u32), i32]
     lib.accl_set_arithcfg.argtypes = [p, i32, ctypes.POINTER(u32), i32]
+    lib.accl_set_tuning.argtypes = [p, i32, u32, u32]
     lib.accl_alloc.restype = u64
     lib.accl_alloc.argtypes = [p, i32, u64, u64]
     lib.accl_free.argtypes = [p, i32, u64]
@@ -152,6 +153,13 @@ class EmuDevice(CCLODevice):
         w = cfg.to_words()
         return self._lib.accl_set_arithcfg(self._w, self._rank, _words(w),
                                            len(w))
+
+    def set_tuning(self, key: int, value: int) -> None:
+        """Write a flat-tree tuning register (reference:
+        configure_tuning_parameters, accl.cpp:1214-1224).
+        Keys: 0=BCAST_FLAT_TREE_MAX_RANKS, 1=REDUCE_FLAT_TREE_MAX_RANKS,
+        2=GATHER_FLAT_TREE_MAX_FANIN."""
+        self._lib.accl_set_tuning(self._w, self._rank, key, value)
 
     # -- streams (PL-kernel equivalent) -------------------------------
     def push_krnl(self, data: np.ndarray) -> None:

@@ -80,6 +80,13 @@ int accl_set_arithcfg(void* wp, int rank, const uint32_t* words, int n) {
   return e ? e->set_arithcfg(words, n) : -1;
 }
 
+int accl_set_tuning(void* wp, int rank, uint32_t key, uint32_t value) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->set_tuning(key, value);
+  return 0;
+}
+
 uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
   Engine* e = static_cast<World*>(wp)->get(rank);
   return e ? e->alloc(nbytes, align) : 0;

@@ -113,6 +113,9 @@ struct CallDesc {
   std::array<uint32_t, 15> w{};
   uint64_t id = 0;
   uint32_t current_step = 0;  // rendezvous resume point (fw :34,:2336)
+  // scratch device-memory leases that persist across retries (the role of
+  // the reference's SPARE1-3 rendezvous scratch buffers, accl.cpp:1190)
+  uint64_t scratch0 = 0, scratch1 = 0;
 
   Op scenario() const { return static_cast<Op>(w[0]); }
   uint32_t count() const { return w[1]; }

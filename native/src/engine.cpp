@@ -286,6 +286,16 @@ void Engine::loop() {
   }
 }
 
+void Engine::set_tuning(uint32_t key, uint32_t value) {
+  switch (key) {
+    case BCAST_FLAT_TREE_MAX_RANKS: bcast_flat_max_ranks_ = value; break;
+    case REDUCE_FLAT_TREE_MAX_RANKS: reduce_flat_max_ranks_ = value; break;
+    case GATHER_FLAT_TREE_MAX_FANIN:
+      gather_flat_max_fanin_ = value ? value : 1;
+      break;
+  }
+}
+
 uint32_t Engine::execute(CallDesc& c) {
   Progress p(c);
   switch (c.scenario()) {
@@ -317,7 +327,72 @@ uint32_t Engine::execute(CallDesc& c) {
     case Op::Barrier: coll_barrier(c, p); break;
     default: sticky_err_ |= COLLECTIVE_NOT_IMPLEMENTED; break;
   }
+  // release rendezvous scratch leases (kept alive across retries)
+  if (c.scratch0) {
+    free_addr(c.scratch0);
+    c.scratch0 = 0;
+  }
+  if (c.scratch1) {
+    free_addr(c.scratch1);
+    c.scratch1 = 0;
+  }
   return sticky_err_;
+}
+
+static uint32_t floor_log2(uint32_t v) {
+  uint32_t r = 0;
+  while (v >>= 1) ++r;
+  return r;
+}
+
+// Binomial tree broadcast (fw :816-869): each round doubles the set of
+// ranks holding the payload; position is measured from the root.
+void Engine::tree_bcast(CallDesc& c, Progress& p, uint32_t root,
+                        uint64_t src_addr, uint64_t dst_addr,
+                        uint64_t bytes) {
+  const CommTable& t = comm_for(c);
+  uint32_t P = t.size;
+  uint32_t pos = (t.local + P - root) % P;
+  uint64_t from = src_addr;
+  uint32_t k0 = 0;
+  if (pos != 0) {
+    uint32_t pk = floor_log2(pos);
+    uint32_t parent = pos - (1u << pk);
+    rndzv_recv(c, p, (root + parent) % P, c.tag(), dst_addr, bytes);
+    from = dst_addr;
+    k0 = pk + 1;
+  }
+  for (uint32_t k = k0; (1u << k) < P; ++k) {
+    uint32_t child = pos + (1u << k);
+    if (child < P)
+      rndzv_send(c, p, (root + child) % P, c.tag(), from, bytes);
+  }
+}
+
+// Binomial tree reduce (fw :1603-1728): leaves push partials up; interior
+// positions fold each child's partial into an accumulator, then forward.
+void Engine::tree_reduce(CallDesc& c, Progress& p, uint32_t root,
+                         uint64_t src_addr, uint64_t acc_addr,
+                         uint64_t tmp_addr, uint64_t bytes) {
+  const CommTable& t = comm_for(c);
+  uint32_t P = t.size;
+  uint32_t pos = (t.local + P - root) % P;
+  const ArithCfgN& a = arith_for(c);
+  uint32_t lane =
+      c.function() < a.lanes.size() ? a.lanes[c.function()] : uint32_t(NUM_LANES);
+  step_local(p, [&] { local_copy(src_addr, acc_addr, bytes); });
+  for (uint32_t k = 0; (1u << k) < P; ++k) {
+    uint32_t bit = 1u << k;
+    if (pos & bit) {
+      rndzv_send(c, p, (root + pos - bit) % P, c.tag(), acc_addr, bytes);
+      return;
+    }
+    if (pos + bit < P) {
+      rndzv_recv(c, p, (root + pos + bit) % P, c.tag(), tmp_addr, bytes);
+      step_local(p,
+                 [&] { local_reduce(lane, acc_addr, tmp_addr, acc_addr, bytes); });
+    }
+  }
 }
 
 void Engine::do_config(CallDesc& c) {
@@ -621,27 +696,31 @@ void Engine::coll_recv(CallDesc& c, Progress& p) {
   }
 }
 
-// Broadcast: root sends to every rank; the rendezvous path for large
-// payloads naturally overlaps the one-sided writes (tree schedules arrive
-// with the rendezvous milestone; reference fw :798-990).
+// Broadcast (fw :798-990): eager = root loops over ranks; rendezvous =
+// out-of-order flat tree for small worlds, binomial tree otherwise
+// (threshold = BCAST_FLAT_TREE_MAX_RANKS tuning register).
 void Engine::coll_bcast(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
   uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
   uint32_t root = c.root_src_dst();
   if (t.size <= 1) return;
-  if (t.local == root) {
-    for (uint32_t r = 0; r < t.size; ++r) {
-      if (r == root) continue;
-      if (use_rendezvous(c, bytes))
-        rndzv_send(c, p, r, c.tag(), c.addr0(), bytes);
-      else
-        send_eager(c, r, c.tag(), c.addr0(), bytes, false, 0);
-    }
-  } else {
-    if (use_rendezvous(c, bytes))
+  if (use_rendezvous(c, bytes)) {
+    if (t.size > bcast_flat_max_ranks_) {
+      tree_bcast(c, p, root, t.local == root ? c.addr0() : 0, c.addr2(),
+                 bytes);
+    } else if (t.local == root) {
+      for (uint32_t r = 0; r < t.size; ++r)
+        if (r != root) rndzv_send(c, p, r, c.tag(), c.addr0(), bytes);
+    } else {
       rndzv_recv(c, p, root, c.tag(), c.addr2(), bytes);
-    else
-      recv_eager(c, root, c.tag(), c.addr2(), bytes, RecvMode::COPY, 0);
+    }
+    return;
+  }
+  if (t.local == root) {
+    for (uint32_t r = 0; r < t.size; ++r)
+      if (r != root) send_eager(c, r, c.tag(), c.addr0(), bytes, false, 0);
+  } else {
+    recv_eager(c, root, c.tag(), c.addr2(), bytes, RecvMode::COPY, 0);
   }
 }
 
@@ -687,17 +766,23 @@ void Engine::coll_gather(CallDesc& c, Progress& p) {
   uint32_t d = (t.local + P - root) % P;  // distance to root along ring
   if (rndzv) {
     // flat tree with out-of-order address arrival (fw :1011-1081 shape):
-    // the root posts every landing address up front, then collects
-    // completions in whatever order the writes land
+    // the root publishes landing addresses in windows of at most
+    // GATHER_FLAT_TREE_MAX_FANIN (fw :1163) and collects completions in
+    // whatever order the writes land
     if (t.local == root) {
       local_copy(c.addr0(), c.addr2() + uint64_t(root) * bytes, bytes);
-      for (uint32_t i = 1; i < P; ++i) {
-        uint32_t r = (root + i) % P;
-        rndzv_post_addr(c, p, r, c.tag(), c.addr2() + uint64_t(r) * bytes,
-                        bytes);
+      uint32_t i = 1;
+      while (i < P) {
+        uint32_t w = std::min(gather_flat_max_fanin_, P - i);
+        for (uint32_t j = 0; j < w; ++j) {
+          uint32_t r = (root + i + j) % P;
+          rndzv_post_addr(c, p, r, c.tag(), c.addr2() + uint64_t(r) * bytes,
+                          bytes);
+        }
+        for (uint32_t j = 0; j < w; ++j)
+          rndzv_wait_done(c, p, (root + i + j) % P, c.tag());
+        i += w;
       }
-      for (uint32_t i = 1; i < P; ++i)
-        rndzv_wait_done(c, p, (root + i) % P, c.tag());
     } else {
       rndzv_send(c, p, root, c.tag(), c.addr0(), bytes);
     }
@@ -745,7 +830,9 @@ void Engine::coll_allgather(CallDesc& c, Progress& p) {
 }
 
 // Reduce: eager ring/daisy-chain with fused recv-reduce(-send) at the
-// interior ranks (fw :1730-1743).
+// interior ranks (fw :1730-1743); rendezvous = flat gather-and-accumulate
+// for small worlds (fw :1533-1602) or binomial tree with scratchpads
+// (fw :1603-1728).
 void Engine::coll_reduce(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
   uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
@@ -753,6 +840,37 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   uint32_t P = t.size;
   if (P == 1) {
     local_copy(c.addr0(), c.addr2(), bytes);
+    return;
+  }
+  if (use_rendezvous(c, bytes)) {
+    const ArithCfgN& a = arith_for(c);
+    uint32_t lane = c.function() < a.lanes.size() ? a.lanes[c.function()]
+                                                  : uint32_t(NUM_LANES);
+    if (P <= reduce_flat_max_ranks_) {
+      // flat: root accumulates every contribution through one scratchpad
+      if (t.local == root) {
+        if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
+        step_local(p, [&] { local_copy(c.addr0(), c.addr2(), bytes); });
+        for (uint32_t i = 1; i < P; ++i) {
+          rndzv_recv(c, p, (root + i) % P, c.tag(), c.scratch0, bytes);
+          step_local(p, [&] {
+            local_reduce(lane, c.addr2(), c.scratch0, c.addr2(), bytes);
+          });
+        }
+      } else {
+        rndzv_send(c, p, root, c.tag(), c.addr0(), bytes);
+      }
+    } else {
+      // binomial tree: root accumulates in the result buffer, interior
+      // nodes in a scratch lease; every receiver needs a landing pad
+      uint64_t acc = t.local == root ? c.addr2() : 0;
+      if (t.local != root) {
+        if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
+        acc = c.scratch0;
+      }
+      if (!c.scratch1) c.scratch1 = alloc(bytes, 64);
+      tree_reduce(c, p, root, c.addr0(), acc, c.scratch1, bytes);
+    }
     return;
   }
   uint32_t pos = (t.local + P - root) % P;  // chain position; root = 0
@@ -834,6 +952,22 @@ void Engine::coll_reduce_scatter(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
   uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);  // per-rank result
   uint32_t P = t.size;
+  if (P > 1 && use_rendezvous(c, bytes * P)) {
+    // rendezvous: tree-reduce the whole vector to rank 0, then scatter
+    // the slices (fw :1768-1781 reduce-to-0 + scatter)
+    uint64_t total = bytes * P;
+    if (!c.scratch0) c.scratch0 = alloc(total, 64);
+    if (!c.scratch1) c.scratch1 = alloc(total, 64);
+    tree_reduce(c, p, 0, c.addr0(), c.scratch0, c.scratch1, total);
+    if (t.local == 0) {
+      step_local(p, [&] { local_copy(c.scratch0, c.addr2(), bytes); });
+      for (uint32_t r = 1; r < P; ++r)
+        rndzv_send(c, p, r, c.tag(), c.scratch0 + uint64_t(r) * bytes, bytes);
+    } else {
+      rndzv_recv(c, p, 0, c.tag(), c.addr2(), bytes);
+    }
+    return;
+  }
   std::vector<uint64_t> off(P), len(P, bytes);
   for (uint32_t i = 0; i < P; ++i) off[i] = uint64_t(i) * bytes;
   ring_reduce_scatter(c, c.addr0(), off, len, c.addr2());
@@ -846,6 +980,15 @@ void Engine::coll_allreduce(CallDesc& c, Progress& p) {
   uint64_t total = uint64_t(c.count());
   if (P == 1) {
     local_copy(c.addr0(), c.addr2(), total * eb);
+    return;
+  }
+  if (use_rendezvous(c, total * eb)) {
+    // rendezvous: tree reduce to rank 0 accumulating directly in every
+    // rank's result buffer, then tree broadcast the final value
+    // (fw :1878-1887 reduce-then-bcast)
+    if (!c.scratch0) c.scratch0 = alloc(total * eb, 64);
+    tree_reduce(c, p, 0, c.addr0(), c.addr2(), c.scratch0, total * eb);
+    tree_bcast(c, p, 0, c.addr2(), c.addr2(), total * eb);
     return;
   }
   // chunk the element range across ranks (bulk/tail split for ragged

@@ -151,6 +151,20 @@ class Engine {
   void coll_barrier(CallDesc& c, Progress& p);
   void do_config(CallDesc& c);
 
+  // binomial tree schedules for the rendezvous protocol (fw tree bcast
+  // :816-869, tree reduce :1603-1728); resume-safe via Progress
+  void tree_bcast(CallDesc& c, Progress& p, uint32_t root, uint64_t src_addr,
+                  uint64_t dst_addr, uint64_t bytes);
+  void tree_reduce(CallDesc& c, Progress& p, uint32_t root, uint64_t src_addr,
+                   uint64_t acc_addr, uint64_t tmp_addr, uint64_t bytes);
+  // a local op as one resumable step (local side effects must not replay
+  // when a rendezvous retry re-enters the schedule)
+  template <typename F>
+  void step_local(Progress& p, F&& f) {
+    if (p.pending()) f();
+    p.done();
+  }
+
   // ring schedule cores shared by reduce_scatter/allreduce (fw :1782-2071)
   void ring_reduce_scatter(CallDesc& c, uint64_t src_base,
                            const std::vector<uint64_t>& off,
@@ -184,6 +198,22 @@ class Engine {
   uint64_t max_eager_ = 32 * 1024;
   uint64_t max_rndzv_ = 32 * 1024;
   bool pkt_enabled_ = false;
+
+ public:
+  // Runtime tuning registers (the reference's exchange-memory flat-tree
+  // thresholds, ccl_offload_control.h:86-90, written by the driver at
+  // bring-up accl.cpp:1214-1224).
+  enum TuningKey : uint32_t {
+    BCAST_FLAT_TREE_MAX_RANKS = 0,
+    REDUCE_FLAT_TREE_MAX_RANKS = 1,
+    GATHER_FLAT_TREE_MAX_FANIN = 2,
+  };
+  void set_tuning(uint32_t key, uint32_t value);
+
+ private:
+  uint32_t bcast_flat_max_ranks_ = 4;
+  uint32_t reduce_flat_max_ranks_ = 4;
+  uint32_t gather_flat_max_fanin_ = 64;
 
   Fifo<CallDesc> cmd_q_;
   std::deque<CallDesc> retry_q_;  // firmware retry FIFO (fw :2460-2479)

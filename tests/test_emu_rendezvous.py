@@ -1,0 +1,114 @@
+"""Rendezvous-protocol collective tests: payloads above the eager
+threshold take the address-exchange/one-sided-write path, with flat or
+binomial-tree schedules selected by the tuning registers
+(reference: fw tree bcast :816-869, tree reduce :1603-1728, flat
+variants :870-922/:1533-1602, reduce-then-bcast allreduce :1878-1887,
+reduce-to-0-then-scatter reduce_scatter :1768-1781)."""
+import numpy as np
+import pytest
+
+from accl_tpu import ACCL, ReduceFunction
+from accl_tpu.backends.emu import EmuWorld
+
+NRANKS = 4
+COUNT = 2048  # 8 KB fp32 > 1 KB eager threshold -> rendezvous
+
+
+@pytest.fixture(scope="module", params=["flat", "tree"])
+def world(request):
+    with EmuWorld(NRANKS) as w:
+        if request.param == "tree":
+            # force binomial trees by lowering the flat thresholds
+            def tune(accl, rank):
+                accl.set_tuning(ACCL.BCAST_FLAT_TREE_MAX_RANKS, 2)
+                accl.set_tuning(ACCL.REDUCE_FLAT_TREE_MAX_RANKS, 2)
+                accl.set_tuning(ACCL.GATHER_FLAT_TREE_MAX_FANIN, 2)
+            w.run(tune)
+        yield w
+
+
+def _data(count, rank, salt=0):
+    rng = np.random.default_rng(31 + rank + salt * 97)
+    return rng.standard_normal(count).astype(np.float32)
+
+
+@pytest.mark.parametrize("root", [0, 1, 3])
+def test_bcast_rendezvous(world, root):
+    def fn(accl, rank):
+        buf = accl.create_buffer_like(_data(COUNT, rank, salt=root))
+        accl.bcast(buf, COUNT, root)
+        np.testing.assert_array_equal(buf.host,
+                                      _data(COUNT, root, salt=root))
+
+    world.run(fn)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+@pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
+def test_reduce_rendezvous(world, root, func):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.reduce(send, recv, COUNT, root, func)
+        if rank == root:
+            inputs = [_data(COUNT, r) for r in range(NRANKS)]
+            exp = (np.sum(inputs, axis=0) if func == ReduceFunction.SUM
+                   else np.max(inputs, axis=0))
+            np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-4)
+
+    world.run(fn)
+
+
+def test_allreduce_rendezvous(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.allreduce(send, recv, COUNT, ReduceFunction.SUM)
+        exp = np.sum([_data(COUNT, r) for r in range(NRANKS)], axis=0)
+        np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-4)
+
+    world.run(fn)
+
+
+def test_reduce_scatter_rendezvous(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT * NRANKS, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.reduce_scatter(send, recv, COUNT, ReduceFunction.SUM)
+        inputs = [_data(COUNT * NRANKS, r) for r in range(NRANKS)]
+        exp = np.sum(inputs, axis=0)[rank * COUNT:(rank + 1) * COUNT]
+        np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-4)
+
+    world.run(fn)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_gather_scatter_rendezvous(world, root):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT * NRANKS, np.float32)
+        accl.gather(send, recv, COUNT, root)
+        if rank == root:
+            exp = np.concatenate([_data(COUNT, r) for r in range(NRANKS)])
+            np.testing.assert_array_equal(recv.host, exp)
+        # scatter it back out
+        out = accl.create_buffer(COUNT, np.float32)
+        accl.scatter(recv, out, COUNT, root)
+        if rank == root:
+            np.testing.assert_array_equal(out.host, _data(COUNT, root))
+
+    world.run(fn)
+
+
+def test_alltoall_rendezvous(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT * NRANKS, rank))
+        recv = accl.create_buffer(COUNT * NRANKS, np.float32)
+        accl.alltoall(send, recv, COUNT)
+        exp = np.concatenate([
+            _data(COUNT * NRANKS, r)[rank * COUNT:(rank + 1) * COUNT]
+            for r in range(NRANKS)
+        ])
+        np.testing.assert_array_equal(recv.host, exp)
+
+    world.run(fn)
