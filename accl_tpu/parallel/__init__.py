@@ -1,0 +1,26 @@
+"""SPMD parallelism layer: the TPU-native functional face of the
+framework.
+
+Where the driver API (accl_tpu.ACCL) mirrors the reference's imperative
+per-rank interface, this package is the idiomatic JAX surface: explicit
+meshes, sharding-annotated functional collectives, and the parallelism
+strategies (data/tensor/pipeline/expert/sequence) the reference's
+collectives exist to serve (SURVEY §2.8)."""
+
+from .mesh import make_mesh, MeshConfig  # noqa: F401
+from .collectives import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    gather,
+    ppermute,
+    reduce,
+    reduce_scatter,
+    ring_all_gather,
+    ring_all_reduce,
+    ring_reduce_scatter,
+    scatter,
+    send_recv,
+)

@@ -1,0 +1,70 @@
+"""Device-mesh construction helpers.
+
+The reference binds ranks to network endpoints (ip/port/session tables,
+accl_network_utils.cpp:264-289 generate_ranks); the TPU equivalent binds
+logical parallelism axes to the physical ICI topology via
+`jax.sharding.Mesh`.  Axis conventions used across the framework:
+
+- ``dp``: data parallel (gradient all-reduce / ZeRO reduce-scatter)
+- ``fsdp``: fully-sharded data parallel (param all-gather axis)
+- ``tp``: tensor parallel (matmul-sharded all-reduce/all-gather)
+- ``sp``: sequence/context parallel (ring attention / Ulysses all-to-all)
+- ``pp``: pipeline parallel (stage-to-stage send/recv)
+- ``ep``: expert parallel (MoE all-to-all)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class MeshConfig:
+    """Logical axis sizes; unspecified axes default to 1 and axes of size
+    1 are dropped from the mesh."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def axes(self) -> dict[str, int]:
+        return {k: v for k, v in vars(self).items() if v > 1}
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for v in vars(self).values():
+            n *= v
+        return n
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None,
+              **axis_sizes) -> "object":
+    """Build a Mesh with the requested logical axes.
+
+    `make_mesh(dp=2, tp=4)` on 8 devices → Mesh with axes ("dp", "tp").
+    Axis order follows the declaration order of MeshConfig, which places
+    the fastest-communicating axes (tp/sp) innermost so they map onto
+    contiguous ICI neighbors ("How to Scale Your Model" recipe: pick a
+    mesh, let XLA insert collectives along it).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if config is None:
+        config = MeshConfig(**axis_sizes)
+    axes = config.axes()
+    if not axes:
+        axes = {"dp": 1}
+    devs = list(devices) if devices is not None else jax.devices()
+    need = int(np.prod(list(axes.values())))
+    if len(devs) < need:
+        raise ValueError(f"mesh needs {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))

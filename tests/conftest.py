@@ -7,12 +7,19 @@ run-everything-against-the-CPU-emulator strategy (SURVEY §4).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# jax may already have been imported by the environment's sitecustomize
+# (with a hardware platform baked in); the runtime config update is what
+# actually pins tests to the virtual CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,200 @@
+"""Driver-parity tests against the TPU backend (XLA collectives over a
+mesh; virtual 8-device CPU platform in CI).
+
+Same corpus shape as the emulator tests: the per-rank ACCL driver API is
+identical, so user code moves between the emulator and the TPU backend
+by swapping the world object (SURVEY §4: one suite, every rung)."""
+import numpy as np
+import pytest
+
+from accl_tpu import DataType, ReduceFunction
+from accl_tpu.backends.tpu import TpuWorld
+
+NRANKS = 4
+COUNT = 64
+
+
+@pytest.fixture(scope="module")
+def world():
+    with TpuWorld(NRANKS) as w:
+        yield w
+
+
+def _data(count, rank, salt=0):
+    rng = np.random.default_rng(500 + rank + salt * 131)
+    return rng.standard_normal(count).astype(np.float32)
+
+
+def test_copy_combine(world):
+    def fn(accl, rank):
+        src = accl.create_buffer_like(_data(COUNT, rank))
+        dst = accl.create_buffer(COUNT, np.float32)
+        accl.copy(src, dst, COUNT)
+        np.testing.assert_array_equal(dst.host, _data(COUNT, rank))
+        op1 = accl.create_buffer_like(_data(COUNT, rank, salt=1))
+        res = accl.create_buffer(COUNT, np.float32)
+        accl.combine(COUNT, ReduceFunction.SUM, src, op1, res)
+        np.testing.assert_allclose(
+            res.host, _data(COUNT, rank) + _data(COUNT, rank, salt=1),
+            rtol=1e-6)
+
+    world.run(fn)
+
+
+def test_sendrecv(world):
+    def fn(accl, rank):
+        nxt, prv = (rank + 1) % NRANKS, (rank - 1) % NRANKS
+        src = accl.create_buffer_like(_data(COUNT, rank))
+        dst = accl.create_buffer(COUNT, np.float32)
+        sreq = accl.send(src, COUNT, nxt, tag=3, run_async=True)
+        accl.recv(dst, COUNT, prv, tag=3)
+        assert sreq.wait(30)
+        sreq.check()
+        np.testing.assert_array_equal(dst.host, _data(COUNT, prv))
+
+    world.run(fn)
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_bcast(world, root):
+    def fn(accl, rank):
+        buf = accl.create_buffer_like(_data(COUNT, rank, salt=root))
+        accl.bcast(buf, COUNT, root)
+        np.testing.assert_array_equal(buf.host, _data(COUNT, root, salt=root))
+
+    world.run(fn)
+
+
+def test_scatter_gather(world):
+    root = 1
+
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT * NRANKS, rank, salt=7))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.scatter(send, recv, COUNT, root)
+        exp = _data(COUNT * NRANKS, root, salt=7)
+        np.testing.assert_array_equal(recv.host,
+                                      exp[rank * COUNT:(rank + 1) * COUNT])
+        back = accl.create_buffer(COUNT * NRANKS, np.float32)
+        accl.gather(recv, back, COUNT, root)
+        if rank == root:
+            np.testing.assert_array_equal(back.host, exp)
+
+    world.run(fn)
+
+
+def test_allgather(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT * NRANKS, np.float32)
+        accl.allgather(send, recv, COUNT)
+        exp = np.concatenate([_data(COUNT, r) for r in range(NRANKS)])
+        np.testing.assert_array_equal(recv.host, exp)
+
+    world.run(fn)
+
+
+@pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
+def test_reduce(world, func):
+    root = 1
+
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.reduce(send, recv, COUNT, root, func)
+        if rank == root:
+            inputs = [_data(COUNT, r) for r in range(NRANKS)]
+            exp = (np.sum(inputs, axis=0) if func == ReduceFunction.SUM
+                   else np.max(inputs, axis=0))
+            np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-5)
+
+    world.run(fn)
+
+
+def test_allreduce(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.allreduce(send, recv, COUNT, ReduceFunction.SUM)
+        exp = np.sum([_data(COUNT, r) for r in range(NRANKS)], axis=0)
+        np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-5)
+
+    world.run(fn)
+
+
+def test_reduce_scatter(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT * NRANKS, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.reduce_scatter(send, recv, COUNT, ReduceFunction.SUM)
+        inputs = [_data(COUNT * NRANKS, r) for r in range(NRANKS)]
+        exp = np.sum(inputs, axis=0)[rank * COUNT:(rank + 1) * COUNT]
+        np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-5)
+
+    world.run(fn)
+
+
+def test_alltoall(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT * NRANKS, rank))
+        recv = accl.create_buffer(COUNT * NRANKS, np.float32)
+        accl.alltoall(send, recv, COUNT)
+        exp = np.concatenate([
+            _data(COUNT * NRANKS, r)[rank * COUNT:(rank + 1) * COUNT]
+            for r in range(NRANKS)
+        ])
+        np.testing.assert_array_equal(recv.host, exp)
+
+    world.run(fn)
+
+
+def test_barrier(world):
+    def fn(accl, rank):
+        accl.barrier()
+
+    world.run(fn)
+
+
+def test_allreduce_compressed(world):
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.allreduce(send, recv, COUNT, ReduceFunction.SUM,
+                       compress_dtype=DataType.float16)
+        exp = np.sum([_data(COUNT, r) for r in range(NRANKS)], axis=0)
+        np.testing.assert_allclose(recv.host, exp, rtol=5e-2, atol=5e-2)
+
+    world.run(fn)
+
+
+def test_stream_put(world):
+    strm = 9
+
+    def fn(accl, rank):
+        if rank == 0:
+            src = accl.create_buffer_like(_data(COUNT, 0, salt=3))
+            accl.stream_put(src, COUNT, dst=2, stream_id=strm)
+        elif rank == 2:
+            raw = accl.device.pop_stream(strm, COUNT * 4, timeout_s=30)
+            assert raw is not None
+            np.testing.assert_array_equal(
+                np.frombuffer(raw, dtype=np.float32), _data(COUNT, 0, salt=3))
+
+    world.run(fn)
+
+
+def test_sub_communicator(world):
+    # split {0, 2} and allreduce inside it (reference: test_multicomm)
+    members = [0, 2]
+
+    def fn(accl, rank):
+        if rank not in members:
+            return
+        cid = accl.create_communicator(members)
+        send = accl.create_buffer_like(_data(COUNT, rank, salt=9))
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.allreduce(send, recv, COUNT, ReduceFunction.SUM, comm_id=cid)
+        exp = np.sum([_data(COUNT, m, salt=9) for m in members], axis=0)
+        np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-5)
+
+    world.run(fn)
