@@ -1,0 +1,25 @@
+"""Pallas TPU kernels — the dataplane's compute lanes.
+
+Reference plugin mapping (SURVEY §2.5):
+
+- ``reduce_ops``   → reduce_ops.py: tiled VPU elementwise sum/max over
+                     {f32,f64→f32,i32,i64,f16,bf16} (the 512-bit SIMD
+                     reduce_ops plugin, reduce_ops.cpp:31-107)
+- ``hp_compression`` → compression.py: fp32↔fp16/bf16 streaming cast
+                     lanes incl. stochastic rounding
+                     (hp_compression.cpp:70-144)
+- eager/rendezvous ring schedules → ring.py: ring collectives over
+                     `make_async_remote_copy` + semaphores (the firmware
+                     ring schedules on ICI instead of the DMA-mover)
+- ``vadd_put``     → fused.py: compute fused with a collective (the
+                     PL-kernel compute/comm fusion example)
+"""
+
+from .reduce_ops import reduce_lane, pallas_add, pallas_max  # noqa: F401
+from .compression import compress_cast, decompress_cast  # noqa: F401
+from .ring import (  # noqa: F401
+    ring_all_gather_pallas,
+    ring_all_reduce_pallas,
+    ring_reduce_scatter_pallas,
+)
+from .fused import fused_matmul_allreduce  # noqa: F401

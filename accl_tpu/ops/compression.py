@@ -1,0 +1,102 @@
+"""Wire-compression lanes as Pallas TPU kernels.
+
+Equivalent of the reference hp_compression plugin — streaming fp32↔fp16
+casts at a 2:1 width ratio, instantiated three times for the op0/op1/res
+lanes (kernels/plugins/hp_compression/hp_compression.cpp:70-144;
+emulator wiring cclo_emu.cpp:396-399).  The TPU build generalizes the
+target to {float16, bfloat16} (bf16 is the native TPU half type) and
+adds optional stochastic rounding via the on-core PRNG — the technique
+EQuARX-style quantized allreduce uses to stop bias accumulating over
+ring hops (PAPERS.md).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_ROWS = 512
+_LANES = 128
+
+
+def _cast_kernel(dtype):
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:].astype(dtype)
+
+    return kernel
+
+
+def _stochastic_kernel(dtype):
+    def kernel(seed_ref, x_ref, o_ref):
+        from jax.experimental.pallas import tpu as pltpu
+
+        pltpu.prng_seed(seed_ref[0])
+        bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
+        o_ref[:] = pltpu.stochastic_round(x_ref[:], bits, target_dtype=dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("dtype", "stochastic", "interpret"))
+def _cast_2d(x, seed, dtype, stochastic: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, cols = x.shape
+    block_rows = min(_BLOCK_ROWS, rows)
+    grid = (pl.cdiv(rows, block_rows),)
+    spec = pl.BlockSpec((block_rows, cols), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct(x.shape, dtype)
+    if stochastic:
+        return pl.pallas_call(
+            _stochastic_kernel(dtype),
+            out_shape=out_shape,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=[spec],
+                out_specs=spec,
+            ),
+            interpret=interpret,
+        )(seed, x)
+    return pl.pallas_call(
+        _cast_kernel(dtype),
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(x)
+
+
+def _tiles(x):
+    n = x.size
+    flat = x.reshape(-1)
+    rows = -(-n // _LANES)
+    pad = rows * _LANES - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, x.dtype)])
+    return flat.reshape(rows, _LANES), n
+
+
+def compress_cast(x, dtype=jnp.bfloat16, stochastic: bool = False,
+                  seed: int = 0, interpret: bool = False):
+    """Compress lane (hp_compression TDEST 0): fp32 → fp16/bf16.
+
+    `stochastic=True` rounds with PRNG bits instead of
+    round-to-nearest-even (TPU-only; requires the Mosaic PRNG)."""
+    x2, n = _tiles(x)
+    seed_arr = jnp.array([seed], jnp.int32)
+    out = _cast_2d(x2, seed_arr, jnp.dtype(dtype), stochastic, interpret)
+    return out.reshape(-1)[:n].reshape(x.shape)
+
+
+def decompress_cast(x, dtype=jnp.float32, interpret: bool = False):
+    """Decompress lane (hp_compression TDEST 1): fp16/bf16 → fp32."""
+    x2, n = _tiles(x)
+    out = _cast_2d(x2, jnp.array([0], jnp.int32), jnp.dtype(dtype), False,
+                   interpret)
+    return out.reshape(-1)[:n].reshape(x.shape)
