@@ -1,0 +1,147 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+SURVEY §5 notes the reference's ring schedules with fused
+recv-reduce-send are "precisely ring attention's comm pattern"; this
+module builds that pattern as a first-class feature.  Each member holds
+a sequence shard of Q/K/V; K/V blocks rotate around the ring (ppermute —
+the eager ring relay, fw :1404-1502) while a streaming-softmax
+accumulator folds each arriving block into the local output — the
+fused_recv_reduce of the firmware (fw :718) with the log-sum-exp
+update playing the reduction operator.
+
+Causal masking is blockwise: a K/V block strictly in the future
+contributes nothing, the diagonal block takes a triangular mask, past
+blocks attend fully.
+
+Call inside shard_map with q/k/v sharded on the sequence axis:
+    out = ring_attention(q, k, v, axis="sp", causal=True)
+    q,k,v: [B, T_local, H, D] → out: [B, T_local, H, D]
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias):
+    """Scores + masked streaming-softmax contributions for one K/V block.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D], bias: [Tq, Tk] additive mask.
+    Returns (m_blk [B,H,Tq], p [B,H,Tq,Tk], pv [B,Tq,H,D])."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    # [B, H, Tq, Tk]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + bias[None, None, :, :]
+    m_blk = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m_blk[..., None])
+    # zero fully-masked rows (m_blk == NEG_INF -> exp(0)=1 garbage)
+    dead = m_blk <= NEG_INF / 2
+    p = jnp.where(dead[..., None], 0.0, p)
+    m_blk = jnp.where(dead, NEG_INF, m_blk)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    return m_blk, p, pv
+
+
+def ring_attention(q, k, v, axis: str = "sp", causal: bool = False):
+    """Exact attention over the full (ring-distributed) sequence.
+
+    Per-member shapes [B, T_local, H, D]; the global sequence is the
+    rank-major concatenation of shards.  Numerics accumulate in fp32
+    regardless of input dtype.
+    """
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    qf = q.astype(jnp.float32)
+
+    def step(s, carry):
+        o, m, l, kc, vc = carry
+        # current block originated at rank (idx - s) mod P
+        src = (idx - s) % P
+        if causal:
+            qpos = idx * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+            kpos = src * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+            bias = jnp.where(qpos >= kpos, 0.0, NEG_INF).astype(jnp.float32)
+        else:
+            bias = jnp.zeros((Tl, Tl), jnp.float32)
+        m_blk, p, pv = _block_attn(qf, kc.astype(jnp.float32),
+                                   vc.astype(jnp.float32), bias)
+        m_new = jnp.maximum(m, m_blk)
+        # guard the all-dead case (exp(NEG_INF - NEG_INF) = 1)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        beta = jnp.where(m_blk <= NEG_INF / 2, 0.0, jnp.exp(m_blk - m_new))
+        l_new = l * alpha + jnp.sum(p, axis=-1) * beta
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                 + pv * beta.transpose(0, 2, 1)[..., None])
+        # rotate K/V one hop (the ring relay)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return o_new, m_new, l_new, kc, vc
+
+    # accumulators start device-varying (lax.pvary) so the loop carry
+    # type matches the axis-varying values produced inside the steps
+    o0 = lax.pcast(jnp.zeros((B, Tl, H, D), jnp.float32), to="varying", axes=(axis,))
+    m0 = lax.pcast(jnp.full((B, H, Tl), NEG_INF, jnp.float32), to="varying", axes=(axis,))
+    l0 = lax.pcast(jnp.zeros((B, H, Tl), jnp.float32), to="varying", axes=(axis,))
+    o, m, l, _, _ = lax.fori_loop(0, P, step, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all reshards
+    sequence↔heads so each member runs *full-sequence* attention on a
+    head subset, then reshards back (built on the reference's alltoall,
+    fw :2123-2218).  Requires H % P == 0.
+
+    q/k/v: [B, T_local, H, D] → out: [B, T_local, H, D]
+    """
+    P = lax.axis_size(axis)
+    B, Tl, H, D = q.shape
+    if H % P != 0:
+        raise ValueError(f"heads {H} not divisible by sp={P}")
+
+    def seq_to_heads(x):
+        # [B, Tl, H, D] -> [B, P*Tl, H/P, D]
+        x = x.reshape(B, Tl, P, H // P, D)
+        x = lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+        return x.reshape(B, P * Tl, H // P, D)  # squeeze the split axis
+
+    def heads_to_seq(x):
+        x = x.reshape(B, P * Tl, 1, H // P, D)
+        x = lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+        return x.reshape(B, Tl, H, D)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attn_fn is None:
+        attn_fn = functools.partial(_dense_attention, causal=causal)
+    og = attn_fn(qg, kg, vg)
+    return heads_to_seq(og)
+
+
+def _dense_attention(q, k, v, causal: bool = False):
+    """Reference dense attention [B, T, H, D] (fp32 accumulation)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        qpos = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
