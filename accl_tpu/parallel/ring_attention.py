@@ -87,11 +87,11 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False):
         vc = lax.ppermute(vc, axis, perm)
         return o_new, m_new, l_new, kc, vc
 
-    # accumulators start device-varying (lax.pvary) so the loop carry
+    # accumulators start device-varying (lax.pcast) so the loop carry
     # type matches the axis-varying values produced inside the steps
-    o0 = lax.pcast(jnp.zeros((B, Tl, H, D), jnp.float32), to="varying", axes=(axis,))
-    m0 = lax.pcast(jnp.full((B, H, Tl), NEG_INF, jnp.float32), to="varying", axes=(axis,))
-    l0 = lax.pcast(jnp.zeros((B, H, Tl), jnp.float32), to="varying", axes=(axis,))
+    o0 = lax.pcast(jnp.zeros((B, Tl, H, D), jnp.float32), axis, to="varying")
+    m0 = lax.pcast(jnp.full((B, H, Tl), NEG_INF, jnp.float32), axis, to="varying")
+    l0 = lax.pcast(jnp.zeros((B, H, Tl), jnp.float32), axis, to="varying")
     o, m, l, _, _ = lax.fori_loop(0, P, step, (o0, m0, l0, k, v))
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]

@@ -126,7 +126,7 @@ def pipeline_apply(stage_fn: Callable, params, x_microbatches,
         # last stage's output for microbatch (t - (P-1)) appears at tick t
         return act_next, y
 
-    zeros = lax.pcast(jnp.zeros(act_shape, jnp.float32), to="varying", axes=(axis,))
+    zeros = lax.pcast(jnp.zeros(act_shape, jnp.float32), axis, to="varying")
     _, ys = lax.scan(tick, zeros, jnp.arange(M + P - 1))
     # member P-1 produced microbatch m at tick m + P - 1
     outs = ys[P - 1:P - 1 + M]
