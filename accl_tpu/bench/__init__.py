@@ -1,0 +1,1 @@
+from .sweep import SweepConfig, run_sweep  # noqa: F401

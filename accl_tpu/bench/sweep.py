@@ -1,0 +1,148 @@
+"""Collective benchmark sweep — the reference bench harness.
+
+Equivalent of the reference ACCLSweepBenchmark: parameterized sweep over
+2^4..2^19 elements for every collective, timing via the engine's
+performance counter, CSV rows out (test/host/xrt/src/bench.cpp:25-61;
+csv fixture.hpp:75-85,126-133; parse_bench_results.py).
+
+Works against any world object exposing `accls` + `run` (EmuWorld or
+TpuWorld), so the same sweep runs on the emulator rung and the TPU
+backend — and the busbw column is directly comparable to the
+allreduce-busbw metric of record (BASELINE.md).
+"""
+from __future__ import annotations
+
+import csv
+import io
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..constants import ReduceFunction
+
+COLLECTIVES = ("sendrecv", "bcast", "scatter", "gather", "allgather",
+               "reduce", "allreduce", "reduce_scatter", "alltoall")
+
+
+@dataclass
+class SweepConfig:
+    collectives: tuple = COLLECTIVES
+    count_pows: Iterable[int] = tuple(range(4, 20))  # 2^4 .. 2^19 elements
+    dtype: str = "float32"
+    repetitions: int = 3
+    root: int = 0
+
+
+def _busbw_factor(coll: str, p: int) -> float:
+    """Bus-bandwidth correction factors (nccl-tests conventions)."""
+    if coll in ("allreduce",):
+        return 2.0 * (p - 1) / p
+    if coll in ("allgather", "reduce_scatter", "alltoall"):
+        return (p - 1) / p
+    return 1.0
+
+
+def run_sweep(world, config: SweepConfig = SweepConfig(),
+              writer: Optional[io.TextIOBase] = None) -> list[dict]:
+    """Run the sweep; returns rows and optionally streams CSV."""
+    rows: list[dict] = []
+    csv_writer = None
+    if writer is not None:
+        csv_writer = csv.DictWriter(writer, fieldnames=[
+            "collective", "count", "bytes", "duration_us", "algbw_GBps",
+            "busbw_GBps", "repetition"])
+        csv_writer.writeheader()
+
+    P = world.nranks
+    dtype = np.dtype(config.dtype)
+
+    for coll in config.collectives:
+        for pw in config.count_pows:
+            count = 1 << pw
+            for rep in range(config.repetitions):
+                dur_s = _run_once(world, coll, count, dtype, config.root)
+                nbytes = count * dtype.itemsize
+                algbw = nbytes / dur_s / 1e9 if dur_s > 0 else 0.0
+                row = {
+                    "collective": coll,
+                    "count": count,
+                    "bytes": nbytes,
+                    "duration_us": round(dur_s * 1e6, 2),
+                    "algbw_GBps": round(algbw, 4),
+                    "busbw_GBps": round(algbw * _busbw_factor(coll, P), 4),
+                    "repetition": rep,
+                }
+                rows.append(row)
+                if csv_writer:
+                    csv_writer.writerow(row)
+    return rows
+
+
+def _run_once(world, coll: str, count: int, dtype, root: int) -> float:
+    """One timed collective across all ranks; returns max duration (s)."""
+    P = world.nranks
+
+    def body(accl, rank):
+        data = np.ones(count, dtype) * (rank + 1)
+        if coll == "sendrecv":
+            src = accl.create_buffer_like(data)
+            dst = accl.create_buffer(count, dtype)
+            t0 = time.perf_counter()
+            nxt, prv = (rank + 1) % P, (rank - 1) % P
+            sreq = accl.send(src, count, nxt, tag=1, run_async=True)
+            accl.recv(dst, count, prv, tag=1)
+            sreq.wait(60)
+            return time.perf_counter() - t0
+        if coll == "bcast":
+            buf = accl.create_buffer_like(data)
+            t0 = time.perf_counter()
+            accl.bcast(buf, count, root)
+            return time.perf_counter() - t0
+        if coll == "scatter":
+            send = accl.create_buffer_like(np.tile(data, P))
+            recv = accl.create_buffer(count, dtype)
+            t0 = time.perf_counter()
+            accl.scatter(send, recv, count, root)
+            return time.perf_counter() - t0
+        if coll == "gather":
+            send = accl.create_buffer_like(data)
+            recv = accl.create_buffer(count * P, dtype)
+            t0 = time.perf_counter()
+            accl.gather(send, recv, count, root)
+            return time.perf_counter() - t0
+        if coll == "allgather":
+            send = accl.create_buffer_like(data)
+            recv = accl.create_buffer(count * P, dtype)
+            t0 = time.perf_counter()
+            accl.allgather(send, recv, count)
+            return time.perf_counter() - t0
+        if coll == "reduce":
+            send = accl.create_buffer_like(data)
+            recv = accl.create_buffer(count, dtype)
+            t0 = time.perf_counter()
+            accl.reduce(send, recv, count, root, ReduceFunction.SUM)
+            return time.perf_counter() - t0
+        if coll == "allreduce":
+            send = accl.create_buffer_like(data)
+            recv = accl.create_buffer(count, dtype)
+            t0 = time.perf_counter()
+            accl.allreduce(send, recv, count, ReduceFunction.SUM)
+            return time.perf_counter() - t0
+        if coll == "reduce_scatter":
+            send = accl.create_buffer_like(np.tile(data, P))
+            recv = accl.create_buffer(count, dtype)
+            t0 = time.perf_counter()
+            accl.reduce_scatter(send, recv, count, ReduceFunction.SUM)
+            return time.perf_counter() - t0
+        if coll == "alltoall":
+            send = accl.create_buffer_like(np.tile(data, P))
+            recv = accl.create_buffer(count * P, dtype)
+            t0 = time.perf_counter()
+            accl.alltoall(send, recv, count)
+            return time.perf_counter() - t0
+        raise ValueError(f"unknown collective {coll!r}")
+
+    durations = world.run(body)
+    return max(durations)

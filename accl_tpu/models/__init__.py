@@ -1,0 +1,19 @@
+"""Model family built on the framework's parallelism layer.
+
+The reference is a collectives library, not a model zoo; these models
+exist to exercise every parallelism strategy end-to-end the way the
+reference's test/bench applications exercise its collectives
+(SURVEY §2.8): a transformer LM composing tensor parallelism (column/row
+linears + psum), sequence parallelism (ring attention), data parallelism
+(gradient all-reduce with optional wire compression), and optional
+pipeline/expert stages.
+"""
+
+from .transformer import (  # noqa: F401
+    ModelConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)

@@ -87,11 +87,15 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False):
         vc = lax.ppermute(vc, axis, perm)
         return o_new, m_new, l_new, kc, vc
 
-    # accumulators start device-varying (lax.pcast) so the loop carry
-    # type matches the axis-varying values produced inside the steps
-    o0 = lax.pcast(jnp.zeros((B, Tl, H, D), jnp.float32), axis, to="varying")
-    m0 = lax.pcast(jnp.full((B, H, Tl), NEG_INF, jnp.float32), axis, to="varying")
-    l0 = lax.pcast(jnp.zeros((B, H, Tl), jnp.float32), axis, to="varying")
+    # accumulators must carry the same device-variance (vma) as the
+    # values the loop produces — derive their zeros from q/k/v so the
+    # fori_loop carry types match under any mesh composition
+    zkv = (jnp.sum(k).astype(jnp.float32)
+           + jnp.sum(v).astype(jnp.float32)) * 0.0
+    o0 = qf * 0.0 + zkv
+    zt = jnp.transpose(jnp.sum(o0, axis=-1), (0, 2, 1))  # [B, H, Tl] zeros
+    m0 = zt + NEG_INF
+    l0 = zt
     o, m, l, _, _ = lax.fori_loop(0, P, step, (o0, m0, l0, k, v))
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]

@@ -1,0 +1,68 @@
+"""Benchmark of record — runs on real TPU hardware (one chip).
+
+Measures the sustained throughput of the on-path reduction arithmetic
+lane (accl_tpu.ops.reduce_ops, the reference reduce_ops plugin's role)
+on large fp32 buffers.  This is the directly comparable single-device
+anchor in BASELINE.md: the reference CCLO's internal datapath moves
+64 B/cycle @ 250 MHz = 16 GB/s through its reduction unit; the TPU lane
+streams both operands + result through HBM, so the metric is effective
+reduction bandwidth = 3 x bytes / time.
+
+vs_baseline = throughput / 16 GB/s (reference CCLO datapath ceiling,
+BASELINE.md "CCLO internal datapath").
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    # 64 Mi elements = 256 MB per operand on TPU; small on CPU fallback
+    n = (64 << 20) if on_tpu else (1 << 20)
+
+    from accl_tpu.ops.reduce_ops import pallas_add
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n,), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.float32)
+    jax.block_until_ready((a, b))
+
+    interpret = not on_tpu
+
+    def run():
+        return pallas_add(a, b, interpret=interpret)
+
+    # warmup / compile
+    out = run()
+    jax.block_until_ready(out)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    nbytes = 3 * n * 4  # read a, read b, write out
+    gbps = nbytes / dt / 1e9
+    baseline_gbps = 16.0  # reference CCLO datapath (BASELINE.md)
+    print(json.dumps({
+        "metric": "on-path reduction lane sustained throughput (fp32 sum, "
+                  f"{'TPU' if on_tpu else 'CPU-interpret fallback'})",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / baseline_gbps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

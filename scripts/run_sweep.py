@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Run the collective benchmark sweep to CSV.
+
+Equivalent of the reference bench binary + parse_bench_results.py
+(test/host/xrt/src/bench.cpp): sweep 2^4..2^19 elements over every
+collective against the chosen backend.
+
+Usage:
+  python scripts/run_sweep.py --design emu-inproc --nranks 4 --out sweep.csv
+  python scripts/run_sweep.py --design tpu --nranks 4 --pows 4 19
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--design", default="emu-inproc",
+                    choices=["emu-inproc", "tpu"])
+    ap.add_argument("--nranks", type=int, default=4)
+    ap.add_argument("--pows", type=int, nargs=2, default=(4, 19),
+                    metavar=("LO", "HI"))
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--collectives", nargs="*", default=None)
+    ap.add_argument("--out", default="-")
+    args = ap.parse_args()
+
+    if args.design == "tpu":
+        import jax  # noqa: F401  (leave platform to the environment)
+
+    sys.path.insert(0, ".")
+    from accl_tpu.bench import SweepConfig, run_sweep
+    from accl_tpu.utils.bringup import Design, initialize_world
+
+    cfg = SweepConfig(
+        count_pows=range(args.pows[0], args.pows[1] + 1),
+        repetitions=args.reps,
+        collectives=tuple(args.collectives) if args.collectives else
+        SweepConfig.collectives,
+    )
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    design = Design.EMU_INPROC if args.design == "emu-inproc" else Design.TPU
+    world = initialize_world(design, args.nranks,
+                             max_eager_size=32 * 1024,
+                             egr_rx_buf_size=16 * 1024) \
+        if args.design == "emu-inproc" else initialize_world(design,
+                                                             args.nranks)
+    try:
+        run_sweep(world, cfg, writer=out)
+    finally:
+        world.close()
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
