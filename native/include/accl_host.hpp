@@ -1,0 +1,199 @@
+// Native C++ host driver — the libaccl-equivalent API surface.
+//
+// Reference analog: class ACCL::ACCL and its buffer/communicator
+// surfaces (driver/xrt/include/accl.hpp:46-1148).  This facade drives
+// the native engine directly (no FFI), giving C++ applications the same
+// collectives the Python driver exposes; the Python layer is an
+// alternative binding over the same engine, not the implementation.
+//
+// Synchronous API: each call marshals the 15-word descriptor, starts it,
+// and blocks for the retcode (reference call_sync, accl.cpp:1404-1413).
+#pragma once
+
+#include <cstring>
+#include <memory>
+#include <stdexcept>
+#include <vector>
+
+#include "../src/engine.hpp"
+
+namespace accl {
+namespace host {
+
+enum class Reduce : uint32_t { SUM = 0, MAX = 1 };
+
+// Typed device buffer handle (reference: Buffer<T>, buffer.hpp:155).
+template <typename T>
+class Buffer {
+ public:
+  Buffer(Engine* e, uint64_t n) : e_(e), n_(n) {
+    addr_ = e_->alloc(n * sizeof(T), 64);
+    if (!addr_) throw std::runtime_error("device memory exhausted");
+    host_.resize(n);
+  }
+  ~Buffer() {
+    if (addr_) e_->free_addr(addr_);
+  }
+  Buffer(const Buffer&) = delete;
+  Buffer& operator=(const Buffer&) = delete;
+
+  T* data() { return host_.data(); }
+  const T* data() const { return host_.data(); }
+  T& operator[](size_t i) { return host_[i]; }
+  uint64_t length() const { return n_; }
+  uint64_t address() const { return addr_; }
+
+  void sync_to_device() {
+    e_->write_mem(addr_, host_.data(), n_ * sizeof(T));
+  }
+  void sync_from_device() {
+    e_->read_mem(addr_, host_.data(), n_ * sizeof(T));
+  }
+
+ private:
+  Engine* e_;
+  uint64_t n_, addr_ = 0;
+  std::vector<T> host_;
+};
+
+// One rank's driver handle.
+class ACCL {
+ public:
+  explicit ACCL(Engine* engine) : e_(engine) {}
+
+  // Bring-up (reference initialize(), accl.cpp:1082-1130): rx pool,
+  // communicator, fp32 arithmetic config, thresholds, enable.
+  void initialize(const std::vector<uint32_t>& sessions, uint32_t local_rank,
+                  uint32_t n_rx_bufs = 16, uint64_t rx_buf_size = 1024,
+                  uint64_t max_eager = 0) {
+    config(CfgFunc::ResetPeriph, 0);
+    e_->cfg_rx_buffers(n_rx_bufs, rx_buf_size);
+    std::vector<uint32_t> words{uint32_t(sessions.size()), local_rank};
+    for (uint32_t s : sessions) {
+      words.push_back(0);                       // ip (unused in-proc)
+      words.push_back(0);                       // port
+      words.push_back(s);                       // session = global rank
+      words.push_back(uint32_t(rx_buf_size));   // max segment
+    }
+    comm_ = e_->set_comm(words.data(), int(words.size()));
+    // fp32 identity arithcfg: lanes[SUM, MAX] = {F32_SUM, F32_MAX}
+    std::vector<uint32_t> acfg{32, 32, 0, 0, 0, 0, 2, F32_SUM, F32_MAX};
+    arith_f32_ = e_->set_arithcfg(acfg.data(), int(acfg.size()));
+    config(CfgFunc::SetTimeout, 1'000'000);
+    config(CfgFunc::SetMaxEagerMsgSize,
+           uint32_t(max_eager ? max_eager : rx_buf_size));
+    config(CfgFunc::SetMaxRendezvousMsgSize, 64u << 20);
+    config(CfgFunc::EnablePkt, 0);
+    world_ = uint32_t(sessions.size());
+    rank_ = local_rank;
+  }
+
+  uint32_t rank() const { return rank_; }
+  uint32_t world() const { return world_; }
+  Engine* engine() { return e_; }
+
+  template <typename T>
+  std::unique_ptr<Buffer<T>> create_buffer(uint64_t n) {
+    return std::make_unique<Buffer<T>>(e_, n);
+  }
+
+  // ---- collectives (reference accl.cpp entry points) ----
+  uint64_t start(Op op, uint32_t count, uint32_t root, uint32_t func,
+                 uint32_t tag, uint64_t a0, uint64_t a1, uint64_t a2) {
+    std::array<uint32_t, 15> w{};
+    w[0] = uint32_t(op);
+    w[1] = count;
+    w[2] = comm_;
+    w[3] = root;
+    w[4] = func;
+    w[5] = tag;
+    w[6] = arith_f32_;
+    w[9] = uint32_t(a0);
+    w[10] = uint32_t(a0 >> 32);
+    w[11] = uint32_t(a1);
+    w[12] = uint32_t(a1 >> 32);
+    w[13] = uint32_t(a2);
+    w[14] = uint32_t(a2 >> 32);
+    return e_->start_call(w.data());
+  }
+
+  uint32_t wait(uint64_t id, int timeout_ms = 60000) {
+    uint32_t ret = 0;
+    double dur = 0;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      if (e_->poll_call(id, &ret, &dur)) {
+        last_duration_ns_ = dur;
+        return ret;
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(100));
+    }
+    throw std::runtime_error("collective timed out");
+  }
+
+  void check(uint32_t ret) {
+    if (ret != 0)
+      throw std::runtime_error("collective failed, retcode=" +
+                               std::to_string(ret));
+  }
+
+  double last_duration_ns() const { return last_duration_ns_; }
+
+  template <typename T>
+  uint64_t send_async(Buffer<T>& b, uint32_t count, uint32_t dst,
+                      uint32_t tag) {
+    b.sync_to_device();
+    return start(Op::Send, count, dst, 0, tag, b.address(), 0, 0);
+  }
+
+  template <typename T>
+  void recv(Buffer<T>& b, uint32_t count, uint32_t src, uint32_t tag) {
+    check(wait(start(Op::Recv, count, src, 0, tag, 0, 0, b.address())));
+    b.sync_from_device();
+  }
+
+  template <typename T>
+  void allreduce(Buffer<T>& sendb, Buffer<T>& recvb, uint32_t count,
+                 Reduce fn = Reduce::SUM) {
+    sendb.sync_to_device();
+    check(wait(start(Op::Allreduce, count, 0, uint32_t(fn), TAG_ANY,
+                     sendb.address(), 0, recvb.address())));
+    recvb.sync_from_device();
+  }
+
+  template <typename T>
+  void bcast(Buffer<T>& b, uint32_t count, uint32_t root) {
+    if (rank_ == root) {
+      b.sync_to_device();
+      check(wait(start(Op::Bcast, count, root, 0, TAG_ANY, b.address(), 0,
+                       b.address())));
+    } else {
+      check(wait(start(Op::Bcast, count, root, 0, TAG_ANY, 0, 0,
+                       b.address())));
+      b.sync_from_device();
+    }
+  }
+
+  template <typename T>
+  void barrier() {
+    check(wait(start(Op::Barrier, 0, 0, 0, TAG_ANY, 0, 0, 0)));
+  }
+
+ private:
+  void config(CfgFunc f, uint32_t value) {
+    std::array<uint32_t, 15> w{};
+    w[0] = uint32_t(Op::Config);
+    w[1] = value;
+    w[4] = uint32_t(f);
+    check(wait(e_->start_call(w.data())));
+  }
+
+  Engine* e_;
+  uint32_t comm_ = 0, rank_ = 0, world_ = 1;
+  int arith_f32_ = 0;
+  double last_duration_ns_ = 0;
+};
+
+}  // namespace host
+}  // namespace accl
