@@ -399,8 +399,8 @@ class ACCL:
 
     def reduce(
         self,
-        sendbuf: BaseBuffer,
-        recvbuf: BaseBuffer,
+        sendbuf: Optional[BaseBuffer],
+        recvbuf: Optional[BaseBuffer],
         count: int,
         root: int,
         function: ReduceFunction = ReduceFunction.SUM,
@@ -408,18 +408,36 @@ class ACCL:
         from_fpga: bool = False,
         to_fpga: bool = False,
         compress_dtype: Optional[DataType] = None,
+        stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+        stream_id: int = 9,
         run_async: bool = False,
     ):
-        """Rooted reduction (reference: accl.cpp:627-794, 4 overloads)."""
+        """Rooted reduction (reference: accl.cpp:627-794, 4 overloads).
+
+        The mem<->stream variants (reference: test.cpp:813-910) are selected
+        with `stream_flags`: OP0_STREAM takes the operand from the local
+        compute-kernel stream (`sendbuf` may be None; feed bytes with
+        `device.push_krnl`), RES_STREAM delivers the root's result to local
+        compute stream `stream_id` (`recvbuf` may be None; read it with
+        `device.pop_stream`)."""
         comm = self._communicators[comm_id]
         is_root = comm.local_rank == root
+        op_stream = bool(stream_flags & StreamFlags.OP0_STREAM)
+        res_stream = bool(stream_flags & StreamFlags.RES_STREAM)
+        if res_stream and stream_id < 9:
+            raise ACCLError("stream ids < 9 are reserved")  # accl.cpp:197
         call = self._build(
             Operation.reduce, count, comm_id, root_src_dst=root,
-            function=int(function), op0=sendbuf,
-            res=recvbuf if is_root else None, compress_dtype=compress_dtype,
+            function=int(function),
+            tag=stream_id if res_stream else TAG_ANY,
+            op0=None if op_stream else sendbuf,
+            res=recvbuf if (is_root and not res_stream) else None,
+            stream_flags=stream_flags, compress_dtype=compress_dtype,
         )
-        sync_out = [(recvbuf, count)] if (is_root and not to_fpga) else []
-        return self._execute(call, sync_in=[] if from_fpga else [(sendbuf, count)],
+        sync_in = [] if (from_fpga or op_stream) else [(sendbuf, count)]
+        sync_out = ([(recvbuf, count)]
+                    if (is_root and not to_fpga and not res_stream) else [])
+        return self._execute(call, sync_in=sync_in,
                              sync_out=sync_out, run_async=run_async,
                              desc=f"reduce(root={root},{function.name})")
 

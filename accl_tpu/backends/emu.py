@@ -39,10 +39,33 @@ _LIB_PATH = os.path.join(
 _lib = None
 
 
+def _build_lib_if_stale() -> None:
+    """Build (or rebuild) the native engine when the .so is missing or
+    older than any of its sources, so a fresh checkout and an edited
+    engine both work without a manual `make -C native` step."""
+    import glob
+    import subprocess
+
+    native_dir = os.path.dirname(_LIB_PATH)
+    sources = glob.glob(os.path.join(native_dir, "src", "*")) + [
+        os.path.join(native_dir, "Makefile")
+    ]
+    if os.path.exists(_LIB_PATH):
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        if all(os.path.getmtime(s) <= lib_mtime for s in sources):
+            return
+    proc = subprocess.run(["make", "-C", native_dir], capture_output=True,
+                          text=True)
+    if proc.returncode != 0:
+        raise ACCLError(
+            f"native engine build failed:\n{proc.stdout}\n{proc.stderr}")
+
+
 def _load_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
+    _build_lib_if_stale()
     if not os.path.exists(_LIB_PATH):
         raise ACCLError(
             f"native engine not built: {_LIB_PATH} missing (run `make -C native`)"

@@ -33,11 +33,26 @@ def load_pytree(path: str, like: Any) -> Any:
     import jax.numpy as jnp
 
     leaves, treedef = jax.tree_util.tree_flatten(like)
+    with open(path + ".json") as f:
+        meta = json.load(f)
+    if meta["n_leaves"] != len(leaves):
+        raise ValueError(
+            f"checkpoint has {meta['n_leaves']} leaves, "
+            f"`like` has {len(leaves)}")
+    if meta["treedef"] != str(treedef):
+        raise ValueError(
+            f"checkpoint tree structure {meta['treedef']} does not match "
+            f"`like` structure {treedef}")
     with np.load(path + ".npz") as data:
         loaded = [data[f"leaf_{i}"] for i in range(len(leaves))]
-    for got, exp in zip(loaded, leaves):
+    for i, (got, exp) in enumerate(zip(loaded, leaves)):
         if got.shape != tuple(exp.shape):
             raise ValueError(
-                f"checkpoint leaf shape {got.shape} != expected {exp.shape}")
+                f"checkpoint leaf {i} shape {got.shape} != expected "
+                f"{tuple(exp.shape)}")
+        if got.dtype != np.dtype(exp.dtype):
+            raise ValueError(
+                f"checkpoint leaf {i} dtype {got.dtype} != expected "
+                f"{np.dtype(exp.dtype)}")
     return jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(x) for x in loaded])

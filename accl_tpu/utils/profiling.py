@@ -42,8 +42,7 @@ def time_fn(fn, *args, iters: int = 10, warmup: int = 2) -> float:
     import jax
 
     for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
+        jax.block_until_ready(fn(*args))
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)

@@ -175,7 +175,6 @@ class ACCL {
     }
   }
 
-  template <typename T>
   void barrier() {
     check(wait(start(Op::Barrier, 0, 0, 0, TAG_ANY, 0, 0, 0)));
   }

@@ -139,6 +139,12 @@ struct NotReadyEx {
   uint32_t step;
 };
 
+// Thrown when a message exceeds the configured rendezvous maximum size —
+// the transfer cannot be expressed by either protocol, so the call
+// finalizes immediately with the accumulated error code (the reference
+// stores this register but never enforces it; here it is a hard cap).
+struct SizeCapEx {};
+
 // ---------------------------------------------------------------------------
 // Bounded-ish MPMC fifo used for command/status/notification streams
 // (role of the hlslib FIFOs wiring the reference emulator threads).

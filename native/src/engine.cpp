@@ -180,16 +180,16 @@ void Engine::push_krnl(const uint8_t* data, uint64_t n) {
   krnl_in_.push(std::vector<uint8_t>(data, data + n));
 }
 
+std::shared_ptr<Fifo<std::vector<uint8_t>>> Engine::stream_for(uint32_t strm) {
+  std::lock_guard<std::mutex> g(streams_mu_);
+  auto& slot = streams_[strm];
+  if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
+  return slot;
+}
+
 bool Engine::pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap,
                         uint64_t* got, int timeout_ms) {
-  std::shared_ptr<Fifo<std::vector<uint8_t>>> q;
-  {
-    std::lock_guard<std::mutex> g(streams_mu_);
-    auto& slot = streams_[strm];
-    if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
-    q = slot;
-  }
-  auto v = q->pop_wait(milliseconds(timeout_ms));
+  auto v = stream_for(strm)->pop_wait(milliseconds(timeout_ms));
   if (!v) return false;
   uint64_t n = std::min<uint64_t>(cap, v->size());
   std::memcpy(dst, v->data(), n);
@@ -207,14 +207,7 @@ void Engine::ingress(Message&& msg) {
   switch (static_cast<MsgType>(msg.hdr.msg_type)) {
     case MsgType::EgrMsg:
       if (msg.hdr.strm >= FIRST_KRNL_STREAM) {
-        std::shared_ptr<Fifo<std::vector<uint8_t>>> q;
-        {
-          std::lock_guard<std::mutex> g(streams_mu_);
-          auto& slot = streams_[msg.hdr.strm];
-          if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
-          q = slot;
-        }
-        q->push(std::move(msg.payload));
+        stream_for(msg.hdr.strm)->push(std::move(msg.payload));
       } else {
         rx_.deposit(std::move(msg));
       }
@@ -276,6 +269,15 @@ void Engine::loop() {
       r.done = true;
     } catch (NotReadyEx&) {
       retry = true;
+    } catch (SizeCapEx&) {
+      if (c.scratch0) free_addr(c.scratch0), c.scratch0 = 0;
+      if (c.scratch1) free_addr(c.scratch1), c.scratch1 = 0;
+      auto dt = duration_cast<nanoseconds>(steady_clock::now() - t0).count();
+      std::lock_guard<std::mutex> g(results_mu_);
+      auto& r = results_[c.id];
+      r.retcode = sticky_err_;
+      r.duration_ns = double(dt);
+      r.done = true;
     }
     if (retry) {
       retry_q_.push_back(c);
@@ -451,12 +453,46 @@ nanoseconds Engine::timeout_budget() const {
   return microseconds(timeout_);
 }
 
-bool Engine::use_rendezvous(const CallDesc& c, uint64_t bytes) const {
+bool Engine::use_rendezvous(const CallDesc& c, uint64_t bytes) {
   // eager if small, compressed, or streamed (fw send :589, recv :669)
   if (bytes <= max_eager_) return false;
   if (c.compression() != 0) return false;
   if (c.stream_flags() != 0) return false;
+  // enforce the rendezvous size register as a hard cap (the reference
+  // validates the register, fw :2442-2448, but never checks transfers
+  // against it; transfers over the cap fail fast instead of wedging)
+  if (bytes > max_rndzv_) {
+    sticky_err_ |= DMA_SIZE_ERROR;
+    throw SizeCapEx{};
+  }
   return true;
+}
+
+bool Engine::drain_krnl_to(uint64_t addr, uint64_t bytes) {
+  uint64_t off = 0;
+  while (off < bytes) {
+    auto v = krnl_in_.pop_wait(timeout_budget());
+    if (!v) {
+      sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
+      return false;
+    }
+    uint64_t n = std::min<uint64_t>(v->size(), bytes - off);
+    if (v->size() > bytes - off) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
+    std::lock_guard<std::mutex> g(mem_mu_);
+    std::memcpy(mem(addr + off, n), v->data(), n);
+    off += n;
+  }
+  return true;
+}
+
+void Engine::push_local_stream(uint32_t strm, uint64_t addr, uint64_t bytes) {
+  std::vector<uint8_t> out;
+  {
+    std::lock_guard<std::mutex> g(mem_mu_);
+    uint8_t* p = mem(addr, bytes);
+    out.assign(p, p + bytes);
+  }
+  stream_for(strm)->push(std::move(out));
 }
 
 uint32_t Engine::local_copy(uint64_t src, uint64_t dst, uint64_t bytes) {
@@ -583,14 +619,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
         break;
       }
       case RecvMode::STREAM: {
-        std::shared_ptr<Fifo<std::vector<uint8_t>>> q;
-        {
-          std::lock_guard<std::mutex> g(streams_mu_);
-          auto& slot = streams_[strm];
-          if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
-          q = slot;
-        }
-        q->push(std::vector<uint8_t>(data, data + n));
+        stream_for(strm)->push(std::vector<uint8_t>(data, data + n));
         break;
       }
     }
@@ -838,8 +867,33 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
   uint32_t root = c.root_src_dst();
   uint32_t P = t.size;
+  // mem<->stream reduce variants (reference: test.cpp:813-910): a
+  // streamed operand is materialized from the kernel stream into a
+  // scratch lease, and a streamed result is pushed to the local compute
+  // stream addressed by the tag, after the schedule runs over buffers.
+  bool op_stream = c.stream_flags() & 0x1;   // OP0_STREAM
+  bool res_stream = c.stream_flags() & 0x2;  // RES_STREAM
+  uint64_t op_addr = c.addr0();
+  uint64_t res_addr = c.addr2();
+  uint64_t op_scratch = 0, res_scratch = 0;
+  bool is_root = t.local == root;
+  if (op_stream) {
+    op_scratch = alloc(bytes, 64);
+    if (!drain_krnl_to(op_scratch, bytes)) {
+      free_addr(op_scratch);
+      return;
+    }
+    op_addr = op_scratch;
+  }
+  if (res_stream && is_root) {
+    res_scratch = alloc(bytes, 64);
+    res_addr = res_scratch;
+  }
   if (P == 1) {
-    local_copy(c.addr0(), c.addr2(), bytes);
+    local_copy(op_addr, res_addr, bytes);
+    if (res_scratch) push_local_stream(c.tag(), res_addr, bytes);
+    if (op_scratch) free_addr(op_scratch);
+    if (res_scratch) free_addr(res_scratch);
     return;
   }
   if (use_rendezvous(c, bytes)) {
@@ -878,19 +932,22 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   uint32_t prev = (t.local + P - 1) % P;
   if (pos == 1) {
     // head of the chain: just forward our contribution
-    send_eager(c, next, c.tag(), c.addr0(), bytes, false, 0);
+    send_eager(c, next, c.tag(), op_addr, bytes, false, 0);
   } else if (pos != 0) {
     // interior: receive partial, fold our contribution, forward
     uint64_t tmp = alloc(bytes, 64);
-    local_copy(c.addr0(), tmp, bytes);
+    local_copy(op_addr, tmp, bytes);
     recv_eager(c, prev, c.tag(), tmp, bytes, RecvMode::REDUCE, 0);
     send_eager(c, next, c.tag(), tmp, bytes, false, 0);
     free_addr(tmp);
   } else {
     // root: receive the chain's partial, fold our contribution into res
-    local_copy(c.addr0(), c.addr2(), bytes);
-    recv_eager(c, prev, c.tag(), c.addr2(), bytes, RecvMode::REDUCE, 0);
+    local_copy(op_addr, res_addr, bytes);
+    recv_eager(c, prev, c.tag(), res_addr, bytes, RecvMode::REDUCE, 0);
+    if (res_scratch) push_local_stream(c.tag(), res_addr, bytes);
   }
+  if (op_scratch) free_addr(op_scratch);
+  if (res_scratch) free_addr(res_scratch);
 }
 
 // Ring reduce-scatter core shared by reduce_scatter and allreduce

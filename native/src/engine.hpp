@@ -130,7 +130,15 @@ class Engine {
   void rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
                   uint64_t addr, uint64_t bytes);
 
-  bool use_rendezvous(const CallDesc& c, uint64_t bytes) const;
+  bool use_rendezvous(const CallDesc& c, uint64_t bytes);
+
+  // Materialize a kernel-stream operand (OP0_STREAM) into device memory
+  // so reduction schedules can treat it like a buffer operand.
+  bool drain_krnl_to(uint64_t addr, uint64_t bytes);
+  // Push a device-memory range into a local compute stream (RES_STREAM).
+  void push_local_stream(uint32_t strm, uint64_t addr, uint64_t bytes);
+  // Get-or-create the FIFO backing compute stream `strm`.
+  std::shared_ptr<Fifo<std::vector<uint8_t>>> stream_for(uint32_t strm);
 
   // local ops
   uint32_t local_copy(uint64_t src, uint64_t dst, uint64_t bytes);

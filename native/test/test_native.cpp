@@ -3,6 +3,7 @@
 // against the emulator; here rank threads in one process).
 #include <cassert>
 #include <cmath>
+#include <atomic>
 #include <cstdio>
 #include <thread>
 
@@ -11,7 +12,8 @@
 using namespace accl;
 using namespace accl::host;
 
-static void run_rank(Engine* e, int rank, int nranks, int* failures) {
+static void run_rank(Engine* e, int rank, int nranks,
+                     std::atomic<int>* failures) {
   try {
     ACCL accl(e);
     std::vector<uint32_t> sessions;
@@ -45,11 +47,11 @@ static void run_rank(Engine* e, int rank, int nranks, int* failures) {
     accl.bcast(*c, N, 1);
     for (uint32_t i = 0; i < N; ++i) assert((*c)[i] == 42.0f);
 
-    accl.barrier<float>();
+    accl.barrier();
     assert(accl.last_duration_ns() >= 0);
   } catch (const std::exception& ex) {
     std::fprintf(stderr, "rank %d failed: %s\n", rank, ex.what());
-    ++*failures;
+    failures->fetch_add(1);
   }
 }
 
@@ -62,14 +64,14 @@ int main() {
         uint32_t(r), 16ull << 20,
         std::make_unique<InprocTransport>(hub, r)));
 
-  int failures = 0;
+  std::atomic<int> failures{0};
   std::vector<std::thread> threads;
   for (int r = 0; r < NRANKS; ++r)
     threads.emplace_back(run_rank, engines[r].get(), r, NRANKS, &failures);
   for (auto& t : threads) t.join();
   engines.clear();
   if (failures) {
-    std::printf("FAILED (%d ranks)\n", failures);
+    std::printf("FAILED (%d ranks)\n", failures.load());
     return 1;
   }
   std::printf("native host driver smoke test: OK\n");

@@ -138,3 +138,97 @@ def test_tcp_transport_allreduce():
     exp = _data(count, 0) + _data(count, 1)
     for r in range(nranks):
         np.testing.assert_allclose(results[r], exp, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# mem<->stream reduce variants (reference: test.cpp:813-910 — reduce with
+# a streamed operand and/or a streamed result)
+# ---------------------------------------------------------------------------
+def test_reduce_from_stream(world):
+    from accl_tpu import StreamFlags
+
+    root = 1
+
+    def fn(accl, rank):
+        accl.device.push_krnl(_data(COUNT, rank, salt=3))
+        recv = accl.create_buffer(COUNT, np.float32) if rank == root else None
+        accl.reduce(None, recv, COUNT, root,
+                    stream_flags=StreamFlags.OP0_STREAM)
+        if rank == root:
+            expect = sum(_data(COUNT, r, salt=3) for r in range(NRANKS))
+            np.testing.assert_allclose(recv.host, expect, rtol=1e-4,
+                                       atol=1e-4)
+
+    world.run(fn)
+
+
+def test_reduce_to_stream(world):
+    from accl_tpu import StreamFlags
+
+    root, strm = 0, 10
+
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank, salt=4))
+        accl.reduce(send, None, COUNT, root,
+                    stream_flags=StreamFlags.RES_STREAM, stream_id=strm)
+        if rank == root:
+            raw = accl.device.pop_stream(strm, COUNT * 4, timeout_s=20)
+            assert raw is not None
+            expect = sum(_data(COUNT, r, salt=4) for r in range(NRANKS))
+            np.testing.assert_allclose(np.frombuffer(raw, np.float32),
+                                       expect, rtol=1e-4, atol=1e-4)
+
+    world.run(fn)
+
+
+def test_reduce_stream_to_stream(world):
+    from accl_tpu import StreamFlags
+
+    root, strm = 2, 11
+
+    def fn(accl, rank):
+        accl.device.push_krnl(_data(COUNT, rank, salt=5))
+        accl.reduce(None, None, COUNT, root,
+                    stream_flags=StreamFlags.OP0_STREAM
+                    | StreamFlags.RES_STREAM, stream_id=strm)
+        if rank == root:
+            raw = accl.device.pop_stream(strm, COUNT * 4, timeout_s=20)
+            assert raw is not None
+            expect = sum(_data(COUNT, r, salt=5) for r in range(NRANKS))
+            np.testing.assert_allclose(np.frombuffer(raw, np.float32),
+                                       expect, rtol=1e-4, atol=1e-4)
+
+    world.run(fn)
+
+
+# ---------------------------------------------------------------------------
+# the rendezvous max-size register is enforced as a hard cap: transfers
+# that fit neither protocol fail fast with DMA_SIZE_ERROR instead of
+# wedging (the reference validates but never enforces, fw :2442-2448)
+# ---------------------------------------------------------------------------
+def test_rendezvous_size_cap():
+    from accl_tpu import ACCLError
+    from accl_tpu.backends.emu import EmuWorld as _World
+
+    n = 16384  # 64 KB fp32 > default 32 KB rendezvous cap
+
+    def fn(accl, rank):
+        src = accl.create_buffer(n, np.float32)
+        dst = accl.create_buffer(n, np.float32)
+        with pytest.raises(ACCLError, match="DMA_SIZE"):
+            if rank == 0:
+                accl.send(src, n, 1, tag=99)
+            else:
+                accl.recv(dst, n, 0, tag=99)
+        # raising the register re-enables the transfer
+        accl.set_max_rendezvous_msg_size(1 << 20)
+        src.host[:] = float(rank + 1)
+        src.sync_to_device()
+        if rank == 0:
+            accl.send(src, n, 1, tag=100)
+        else:
+            accl.recv(dst, n, 0, tag=100)
+            np.testing.assert_allclose(dst.host, 1.0)
+
+    with _World(2) as w:
+        w.run(fn)
