@@ -73,6 +73,9 @@ ARITH_LANE = {
     (DataType.int64, "max"): 7,
     (DataType.float16, "sum"): 8,
     (DataType.float16, "max"): 9,
+    # TPU extension lanes (bf16 is not in the reference's reduce_ops set)
+    (DataType.bfloat16, "sum"): 10,
+    (DataType.bfloat16, "max"): 11,
 }
 
 # Compression lane ids (reference hp_compression plugin: TDEST 0=compress
@@ -105,6 +108,8 @@ def _cfg(u: DataType, c: DataType, arith_compressed: bool = False) -> ArithConfi
 #: {f16,f32,f64,i32,i64} plus the fp32-over-fp16 compressed pair.
 DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
     (DataType.float16, DataType.float16): _cfg(DataType.float16, DataType.float16),
+    (DataType.bfloat16, DataType.bfloat16): _cfg(DataType.bfloat16,
+                                                 DataType.bfloat16),
     (DataType.float32, DataType.float32): _cfg(DataType.float32, DataType.float32),
     (DataType.float64, DataType.float64): _cfg(DataType.float64, DataType.float64),
     (DataType.int32, DataType.int32): _cfg(DataType.int32, DataType.int32),
@@ -124,5 +129,12 @@ NUMPY_TO_DATATYPE = {
     np.dtype(np.int64): DataType.int64,
     np.dtype(np.int8): DataType.int8,
 }
+
+try:  # bf16 numpy dtype ships via ml_dtypes (bundled with jax)
+    import ml_dtypes
+
+    NUMPY_TO_DATATYPE[np.dtype(ml_dtypes.bfloat16)] = DataType.bfloat16
+except ImportError:  # pragma: no cover
+    pass
 
 DATATYPE_TO_NUMPY = {v: k for k, v in NUMPY_TO_DATATYPE.items()}

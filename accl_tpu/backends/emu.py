@@ -54,11 +54,26 @@ def _build_lib_if_stale() -> None:
         lib_mtime = os.path.getmtime(_LIB_PATH)
         if all(os.path.getmtime(s) <= lib_mtime for s in sources):
             return
-    proc = subprocess.run(["make", "-C", native_dir], capture_output=True,
-                          text=True)
-    if proc.returncode != 0:
-        raise ACCLError(
-            f"native engine build failed:\n{proc.stdout}\n{proc.stderr}")
+    # serialize concurrent builders (e.g. parallel CI jobs sharing one
+    # checkout) so two `make` runs can't corrupt the same .so
+    lock_path = os.path.join(native_dir, ".build.lock")
+    with open(lock_path, "w") as lock:
+        try:
+            import fcntl
+
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except ImportError:  # pragma: no cover (non-POSIX)
+            pass
+        try:
+            proc = subprocess.run(["make", "-C", native_dir],
+                                  capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise ACCLError(
+                f"native engine not built and `make` unavailable: {e} "
+                f"(build {_LIB_PATH} manually)") from e
+        if proc.returncode != 0:
+            raise ACCLError(
+                f"native engine build failed:\n{proc.stdout}\n{proc.stderr}")
 
 
 def _load_lib() -> ctypes.CDLL:

@@ -35,6 +35,16 @@ class SweepConfig:
     root: int = 0
 
 
+def _resolve_dtype(name) -> np.dtype:
+    """np.dtype, accepting accelerator dtypes (bfloat16 via ml_dtypes)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, str(name)))
+
+
 def _busbw_factor(coll: str, p: int) -> float:
     """Bus-bandwidth correction factors (nccl-tests conventions)."""
     if coll in ("allreduce",):
@@ -53,10 +63,17 @@ def run_sweep(world, config: SweepConfig = SweepConfig(),
         csv_writer = csv.DictWriter(writer, fieldnames=[
             "collective", "count", "bytes", "duration_us", "algbw_GBps",
             "busbw_GBps", "repetition"])
-        csv_writer.writeheader()
+        # only emit the header at the start of the stream, so several
+        # sweeps (e.g. one per dtype) can append to one CSV
+        try:
+            at_start = writer.tell() == 0
+        except (OSError, AttributeError):
+            at_start = True
+        if at_start:
+            csv_writer.writeheader()
 
     P = world.nranks
-    dtype = np.dtype(config.dtype)
+    dtype = _resolve_dtype(config.dtype)
 
     for coll in config.collectives:
         for pw in config.count_pows:
@@ -85,7 +102,7 @@ def _run_once(world, coll: str, count: int, dtype, root: int) -> float:
     P = world.nranks
 
     def body(accl, rank):
-        data = np.ones(count, dtype) * (rank + 1)
+        data = np.full(count, rank + 1, dtype)
         if coll == "sendrecv":
             src = accl.create_buffer_like(data)
             dst = accl.create_buffer(count, dtype)

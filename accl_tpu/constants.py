@@ -66,6 +66,9 @@ class DataType(enum.IntEnum):
     float64 = 4
     int32 = 5
     int64 = 6
+    # TPU extension: the MXU's native 16-bit float (not in the reference's
+    # dtype set, constants.hpp:254-262)
+    bfloat16 = 7
 
 
 #: Width in bits of each DataType (reference: constants.hpp:268-272).
@@ -77,6 +80,7 @@ DATA_TYPE_SIZE = {
     DataType.float64: 64,
     DataType.int32: 32,
     DataType.int64: 64,
+    DataType.bfloat16: 16,
 }
 
 

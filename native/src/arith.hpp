@@ -30,7 +30,9 @@ enum ArithLane : uint32_t {
   I64_MAX = 7,
   F16_SUM = 8,
   F16_MAX = 9,
-  NUM_LANES = 10,
+  BF16_SUM = 10,
+  BF16_MAX = 11,
+  NUM_LANES = 12,
 };
 
 template <typename T, bool MAX>
@@ -60,6 +62,34 @@ static inline void reduce_f16(const uint8_t* a, const uint8_t* b, uint8_t* r,
   }
 }
 
+// bfloat16 <-> fp32: bf16 is the top 16 bits of an ieee fp32 (the TPU's
+// native 16-bit float; round-to-nearest-even on the way down).
+static inline float bf16_to_f32(uint16_t h) {
+  uint32_t bits = uint32_t(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t rounding = 0x7FFFu + ((bits >> 16) & 1);
+  return uint16_t((bits + rounding) >> 16);
+}
+
+static inline void reduce_bf16(const uint8_t* a, const uint8_t* b, uint8_t* r,
+                               uint64_t nbytes, bool is_max) {
+  uint64_t n = nbytes / 2;
+  const uint16_t* pa = reinterpret_cast<const uint16_t*>(a);
+  const uint16_t* pb = reinterpret_cast<const uint16_t*>(b);
+  uint16_t* pr = reinterpret_cast<uint16_t*>(r);
+  for (uint64_t i = 0; i < n; ++i) {
+    float fa = bf16_to_f32(pa[i]), fb = bf16_to_f32(pb[i]);
+    pr[i] = f32_to_bf16(is_max ? (fa > fb ? fa : fb) : (fa + fb));
+  }
+}
+
 // r[0:n] = lane(a, b); returns an Err bit on unknown lane / ragged size.
 inline uint32_t run_reduce_lane(uint32_t lane, const uint8_t* a,
                                 const uint8_t* b, uint8_t* r,
@@ -75,6 +105,8 @@ inline uint32_t run_reduce_lane(uint32_t lane, const uint8_t* a,
     case I64_MAX: reduce_typed<int64_t, true>(a, b, r, nbytes); break;
     case F16_SUM: reduce_f16(a, b, r, nbytes, false); break;
     case F16_MAX: reduce_f16(a, b, r, nbytes, true); break;
+    case BF16_SUM: reduce_bf16(a, b, r, nbytes, false); break;
+    case BF16_MAX: reduce_bf16(a, b, r, nbytes, true); break;
     default: return ARITH_ERROR;
   }
   return OK;

@@ -269,15 +269,6 @@ void Engine::loop() {
       r.done = true;
     } catch (NotReadyEx&) {
       retry = true;
-    } catch (SizeCapEx&) {
-      if (c.scratch0) free_addr(c.scratch0), c.scratch0 = 0;
-      if (c.scratch1) free_addr(c.scratch1), c.scratch1 = 0;
-      auto dt = duration_cast<nanoseconds>(steady_clock::now() - t0).count();
-      std::lock_guard<std::mutex> g(results_mu_);
-      auto& r = results_[c.id];
-      r.retcode = sticky_err_;
-      r.duration_ns = double(dt);
-      r.done = true;
     }
     if (retry) {
       retry_q_.push_back(c);
@@ -300,6 +291,25 @@ void Engine::set_tuning(uint32_t key, uint32_t value) {
 
 uint32_t Engine::execute(CallDesc& c) {
   Progress p(c);
+  try {
+    dispatch(c, p);
+  } catch (SizeCapEx&) {
+    // size-cap violation: finalize immediately with the sticky error
+    // (NotReadyEx, by contrast, propagates to the retry queue)
+  }
+  // release rendezvous scratch leases (kept alive across retries)
+  if (c.scratch0) {
+    free_addr(c.scratch0);
+    c.scratch0 = 0;
+  }
+  if (c.scratch1) {
+    free_addr(c.scratch1);
+    c.scratch1 = 0;
+  }
+  return sticky_err_;
+}
+
+void Engine::dispatch(CallDesc& c, Progress& p) {
   switch (c.scenario()) {
     case Op::Config: do_config(c); break;
     case Op::Nop: break;
@@ -329,16 +339,6 @@ uint32_t Engine::execute(CallDesc& c) {
     case Op::Barrier: coll_barrier(c, p); break;
     default: sticky_err_ |= COLLECTIVE_NOT_IMPLEMENTED; break;
   }
-  // release rendezvous scratch leases (kept alive across retries)
-  if (c.scratch0) {
-    free_addr(c.scratch0);
-    c.scratch0 = 0;
-  }
-  if (c.scratch1) {
-    free_addr(c.scratch1);
-    c.scratch1 = 0;
-  }
-  return sticky_err_;
 }
 
 static uint32_t floor_log2(uint32_t v) {
@@ -875,25 +875,23 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   bool res_stream = c.stream_flags() & 0x2;  // RES_STREAM
   uint64_t op_addr = c.addr0();
   uint64_t res_addr = c.addr2();
-  uint64_t op_scratch = 0, res_scratch = 0;
   bool is_root = t.local == root;
+  // scratch leases live in the descriptor so execute() frees them on
+  // every exit path (stream-flagged calls never reach the rendezvous
+  // schedules, which use the same lease slots)
   if (op_stream) {
-    op_scratch = alloc(bytes, 64);
-    if (!drain_krnl_to(op_scratch, bytes)) {
-      free_addr(op_scratch);
-      return;
-    }
-    op_addr = op_scratch;
+    if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
+    if (!drain_krnl_to(c.scratch0, bytes)) return;
+    op_addr = c.scratch0;
   }
   if (res_stream && is_root) {
-    res_scratch = alloc(bytes, 64);
-    res_addr = res_scratch;
+    if (!c.scratch1) c.scratch1 = alloc(bytes, 64);
+    res_addr = c.scratch1;
   }
   if (P == 1) {
     local_copy(op_addr, res_addr, bytes);
-    if (res_scratch) push_local_stream(c.tag(), res_addr, bytes);
-    if (op_scratch) free_addr(op_scratch);
-    if (res_scratch) free_addr(res_scratch);
+    if (res_stream && is_root && sticky_err_ == 0)
+      push_local_stream(c.tag(), res_addr, bytes);
     return;
   }
   if (use_rendezvous(c, bytes)) {
@@ -944,10 +942,11 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
     // root: receive the chain's partial, fold our contribution into res
     local_copy(op_addr, res_addr, bytes);
     recv_eager(c, prev, c.tag(), res_addr, bytes, RecvMode::REDUCE, 0);
-    if (res_scratch) push_local_stream(c.tag(), res_addr, bytes);
+    // deliver to the compute stream only on success — a consumer must
+    // not be handed a correctly-sized but partially-reduced payload
+    if (res_stream && sticky_err_ == 0)
+      push_local_stream(c.tag(), res_addr, bytes);
   }
-  if (op_scratch) free_addr(op_scratch);
-  if (res_scratch) free_addr(res_scratch);
 }
 
 // Ring reduce-scatter core shared by reduce_scatter and allreduce

@@ -88,6 +88,8 @@ class Engine {
   // engine loop
   void loop();
   uint32_t execute(CallDesc& c);
+  struct Progress;
+  void dispatch(CallDesc& c, Progress& p);
 
   // transport ingress demux (the depacketizer role, eth_intf routing)
   void ingress(Message&& msg);

@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""The five benchmark configs of record from BASELINE.json.
+
+Each config reproduces one of the reference-derived benchmark setups
+(BASELINE.md "Benchmark configs to reproduce"):
+
+  1. 2-rank fp32 all-reduce, 1KB-1MB, emulator mode (CPU baseline)
+  2. 8-rank ring all-reduce fp32 sweep, nccl-tests style (1KB-1GB with
+     --full; capped at 16MB by default so it runs on small hosts)
+  3. 8-rank all-gather + reduce-scatter, fp16/bf16 on-path reduction
+  4. 16-rank broadcast/scatter/gather tree-topology latency sweep
+  5. Streaming compute + all-reduce fusion (reference vadd_put ->
+     fused matmul+psum, accl_tpu/ops/fused.py)
+
+Configs 2-3 run on the TPU backend (real chips, or the virtual CPU mesh
+when JAX_PLATFORMS=cpu); 1 and 4 run on the native emulator; 5 measures
+the jitted fused path on whatever mesh is available.
+
+Usage:
+  python scripts/baseline_bench.py --config 1 --out cfg1.csv
+  python scripts/baseline_bench.py --config all --outdir bench_out
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/baseline_bench.py --config 2
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _open_out(path):
+    return sys.stdout if path in (None, "-") else open(path, "w")
+
+
+def _apply_platform_env() -> None:
+    """jax may have been imported by the interpreter's sitecustomize with
+    a hardware platform already selected; re-apply JAX_PLATFORMS from the
+    environment so `JAX_PLATFORMS=cpu XLA_FLAGS=...device_count=8` works
+    for the virtual-mesh configs (same trick as tests/conftest.py)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def config1(out, full: bool = False, reps: int = 3):
+    """2-rank fp32 all-reduce 1KB-1MB on the emulator (CPU baseline)."""
+    from accl_tpu.bench import SweepConfig, run_sweep
+    from accl_tpu.backends.emu import EmuWorld
+
+    pows = range(8, 19)  # 2^8..2^18 fp32 elements = 1KB..1MB
+    with EmuWorld(2, egr_rx_buf_size=16 * 1024,
+                  max_eager_size=32 * 1024,
+                  max_rendezvous_size=1 << 30) as world:
+        return run_sweep(world, SweepConfig(collectives=("allreduce",),
+                                            count_pows=pows,
+                                            repetitions=reps), writer=out)
+
+
+def config2(out, full: bool = False, reps: int = 3):
+    """8-rank ring all-reduce fp32 sweep (nccl-tests style)."""
+    from accl_tpu.bench import SweepConfig, run_sweep
+    from accl_tpu.backends.tpu import TpuWorld
+
+    hi = 28 if full else 22  # 2^28 fp32 = 1GB; default caps at 16MB
+    with TpuWorld(8) as world:
+        return run_sweep(world, SweepConfig(collectives=("allreduce",),
+                                            count_pows=range(8, hi + 1, 2),
+                                            repetitions=reps), writer=out)
+
+
+def config3(out, full: bool = False, reps: int = 3):
+    """8-rank all-gather + reduce-scatter with fp16/bf16 reduction."""
+    from accl_tpu.bench import SweepConfig, run_sweep
+    from accl_tpu.backends.tpu import TpuWorld
+
+    hi = 22 if full else 16
+    rows = []
+    for dtype in ("float16", "bfloat16"):
+        with TpuWorld(8) as world:
+            rows += run_sweep(
+                world,
+                SweepConfig(collectives=("allgather", "reduce_scatter"),
+                            count_pows=range(8, hi + 1, 2), dtype=dtype,
+                            repetitions=reps), writer=out)
+    return rows
+
+
+def config4(out, full: bool = False, reps: int = 3):
+    """16-rank broadcast/scatter/gather tree-topology latency sweep.
+
+    Small messages stay eager; counts past the eager threshold cross
+    into the rendezvous tree schedules (binomial bcast, windowed-fan-in
+    gather), so the sweep covers both topologies."""
+    from accl_tpu.bench import SweepConfig, run_sweep
+    from accl_tpu.backends.emu import EmuWorld
+
+    hi = 13 if full else 11
+    with EmuWorld(16, egr_rx_buf_size=1024,
+                  max_rendezvous_size=1 << 26) as world:
+        return run_sweep(world,
+                         SweepConfig(collectives=("bcast", "scatter",
+                                                  "gather"),
+                                     count_pows=range(4, hi + 1),
+                                     repetitions=reps), writer=out)
+
+
+def config5(out, full: bool = False, reps: int = 5):
+    """Streaming compute + all-reduce fusion (vadd_put -> fused
+    matmul+psum): fused kernel vs unfused matmul-then-psum."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from accl_tpu.ops.fused import fused_matmul_allreduce
+    from accl_tpu.utils.profiling import time_fn
+
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    m = 1024 if full else 256
+    k_per = 512 if full else 128
+    n = 1024 if full else 256
+    dtype = jnp.bfloat16
+    x = jnp.ones((m, k_per * n_dev), dtype)
+    w = jnp.ones((k_per * n_dev, n), dtype)
+
+    use_pallas = jax.default_backend() == "tpu"
+
+    @jax.jit
+    def fused(x, w):
+        return shard_map(
+            lambda xs, ws: fused_matmul_allreduce(xs, ws, axis="tp",
+                                                  use_pallas=use_pallas),
+            mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P(None, None))(x, w)
+
+    @jax.jit
+    def unfused(x, w):
+        return shard_map(
+            lambda xs, ws: jax.lax.psum(
+                jnp.dot(xs, ws, preferred_element_type=jnp.float32
+                        ).astype(xs.dtype), "tp"),
+            mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P(None, None))(x, w)
+
+    np.testing.assert_allclose(np.asarray(fused(x, w), np.float32),
+                               np.asarray(unfused(x, w), np.float32),
+                               rtol=2e-2)
+    t_fused = time_fn(fused, x, w, iters=reps)
+    t_unfused = time_fn(unfused, x, w, iters=reps)
+    flops = 2.0 * m * k_per * n_dev * n
+    rows = [
+        {"variant": "fused", "seconds": t_fused,
+         "tflops": flops / t_fused / 1e12},
+        {"variant": "unfused", "seconds": t_unfused,
+         "tflops": flops / t_unfused / 1e12},
+        {"variant": "speedup", "seconds": t_unfused / t_fused, "tflops": 0.0},
+    ]
+    w_csv = csv.DictWriter(out, fieldnames=["variant", "seconds", "tflops"])
+    w_csv.writeheader()
+    for r in rows:
+        w_csv.writerow(r)
+    return rows
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all",
+                    help="1-5 or 'all'")
+    ap.add_argument("--full", action="store_true",
+                    help="full reference sizes (needs big host / real TPUs)")
+    ap.add_argument("--reps", type=int, default=None)
+    ap.add_argument("--out", default="-", help="CSV path (single config)")
+    ap.add_argument("--outdir", default=None, help="directory (all configs)")
+    args = ap.parse_args()
+
+    _apply_platform_env()
+    ids = list(CONFIGS) if args.config == "all" else [int(args.config)]
+    for cid in ids:
+        fn = CONFIGS[cid]
+        kwargs = {"full": args.full}
+        if args.reps:
+            kwargs["reps"] = args.reps
+        if args.outdir:
+            os.makedirs(args.outdir, exist_ok=True)
+            path = os.path.join(args.outdir, f"baseline_cfg{cid}.csv")
+        else:
+            path = args.out if len(ids) == 1 else "-"
+        out = _open_out(path)
+        t0 = time.time()
+        try:
+            fn(out, **kwargs)
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        print(f"config {cid} done in {time.time() - t0:.1f}s"
+              + (f" -> {path}" if path != "-" else ""), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

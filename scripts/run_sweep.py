@@ -44,7 +44,10 @@ def main() -> int:
     design = Design.EMU_INPROC if args.design == "emu-inproc" else Design.TPU
     world = initialize_world(design, args.nranks,
                              max_eager_size=32 * 1024,
-                             egr_rx_buf_size=16 * 1024) \
+                             egr_rx_buf_size=16 * 1024,
+                             # lift the rendezvous size cap above the
+                             # largest swept message (2^19 fp32 = 2 MB)
+                             max_rendezvous_size=1 << 30) \
         if args.design == "emu-inproc" else initialize_world(design,
                                                              args.nranks)
     try:

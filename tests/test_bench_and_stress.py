@@ -70,3 +70,45 @@ def test_generate_ranks_and_bringup():
             return float(b.host[0])
 
         assert world.run(fn) == [2.0, 2.0]
+
+
+def _import_baseline_bench():
+    import importlib
+    import os
+    import sys
+
+    scripts = os.path.join(os.path.dirname(__file__), os.pardir, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    return importlib.import_module("baseline_bench")
+
+
+# ---------------------------------------------------------------------------
+# the five benchmark configs of record (BASELINE.json / BASELINE.md) run
+# end-to-end in miniature
+# ---------------------------------------------------------------------------
+def test_baseline_config1_cpu_baseline():
+    import io
+    baseline_bench = _import_baseline_bench()
+
+    rows = baseline_bench.config1(io.StringIO(), reps=1)
+    assert {r["collective"] for r in rows} == {"allreduce"}
+    assert all(r["duration_us"] > 0 for r in rows)
+
+
+def test_baseline_config3_bf16_fp16():
+    import io
+    baseline_bench = _import_baseline_bench()
+
+    rows = baseline_bench.config3(io.StringIO(), reps=1)
+    colls = {r["collective"] for r in rows}
+    assert colls == {"allgather", "reduce_scatter"}
+
+
+def test_baseline_config5_fusion():
+    import io
+    baseline_bench = _import_baseline_bench()
+
+    rows = baseline_bench.config5(io.StringIO(), reps=1)
+    by = {r["variant"]: r for r in rows}
+    assert by["fused"]["seconds"] > 0 and by["unfused"]["seconds"] > 0

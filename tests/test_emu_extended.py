@@ -178,3 +178,41 @@ def test_timeout_surfaces_as_error(world):
         accl.set_timeout(1_000_000)
 
     world.run(fn)
+
+
+# ---------------------------------------------------------------------------
+# bfloat16 on-path reduction (TPU-extension arithmetic lanes 10/11 — the
+# reference reduce_ops set stops at fp16, reduce_ops.cpp:31-107)
+# ---------------------------------------------------------------------------
+def test_allreduce_bfloat16(world):
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    count = 256
+
+    def fn(accl, rank):
+        send = accl.create_buffer_like(np.full(count, rank + 1, bf16))
+        recv = accl.create_buffer(count, bf16)
+        accl.allreduce(send, recv, count)
+        expect = sum(range(1, world.nranks + 1))
+        np.testing.assert_allclose(recv.host.astype(np.float32),
+                                   float(expect))
+
+    world.run(fn)
+
+
+def test_combine_max_bfloat16(world):
+    import ml_dtypes
+    from accl_tpu import ReduceFunction
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    count = 64
+
+    def fn(accl, rank):
+        a = accl.create_buffer_like(np.full(count, 2.5, bf16))
+        b = accl.create_buffer_like(np.full(count, 7.5, bf16))
+        r = accl.create_buffer(count, bf16)
+        accl.combine(count, ReduceFunction.MAX, a, b, r)
+        np.testing.assert_allclose(r.host.astype(np.float32), 7.5)
+
+    world.run(fn)
