@@ -31,6 +31,7 @@ from .constants import (  # noqa: F401
     StreamFlags,
     TAG_ANY,
 )
+from .device_api import ACCLCommand, ACCLData, DeviceCollectives  # noqa: F401
 from .request import Request  # noqa: F401
 
 __version__ = "0.1.0"

@@ -64,5 +64,16 @@ class CCLODevice(ABC):
     def upload_arithconfig(self, cfg: ArithConfig) -> int:
         """Install an arithmetic config; returns its table id."""
 
+    # -- kernel streams (the PL-kernel data ports; reference
+    # data_to_cclo/data_from_cclo, accl_hls.h:502-543) -----------------
+    def push_krnl(self, data: np.ndarray) -> None:
+        """Feed operand bytes into the compute-kernel input stream."""
+        raise NotImplementedError(f"{type(self).__name__} has no kernel streams")
+
+    def pop_stream(self, strm: int, nbytes: int,
+                   timeout_s: float = 10.0) -> Optional[bytes]:
+        """Pull one message from a compute output stream."""
+        raise NotImplementedError(f"{type(self).__name__} has no kernel streams")
+
     def close(self) -> None:
         """Tear down the backend (join threads, close sockets)."""

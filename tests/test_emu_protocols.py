@@ -114,13 +114,19 @@ def test_send_from_kernel_stream(world):
 # sockets in between — the multi-node rung of the test ladder
 # ---------------------------------------------------------------------------
 def test_tcp_transport_allreduce():
-    nranks, count, base_port = 2, 128, 18650
+    # port picked per-process to dodge TIME_WAIT from earlier runs; the
+    # engine receive timeout is raised because rank startup is staggered
+    # by real connect/accept latency (slow under a loaded single core)
+    import os
+    nranks, count = 2, 128
+    base_port = 18650 + (os.getpid() % 2000)
     results = {}
     errors = []
 
     def rank_main(r):
         try:
             with EmuRankTcp(r, nranks, base_port) as node:
+                node.accl.set_timeout(60_000_000)
                 send = node.accl.create_buffer_like(_data(count, r))
                 recv = node.accl.create_buffer(count, np.float32)
                 node.accl.allreduce(send, recv, count, ReduceFunction.SUM)
