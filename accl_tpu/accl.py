@@ -194,6 +194,18 @@ class ACCL:
         buf.host[:] = np.asarray(data).reshape(-1)
         return buf
 
+    def create_buffer_p2p(self, length: int, dtype=np.float32) -> BaseBuffer:
+        """Allocate a buffer directly addressable by peer engines without
+        host staging (reference: FPGABufferP2P — PCIe-p2p-visible BO,
+        fpgabufferp2p.hpp).  On this build every device buffer is already
+        peer-addressable (emulator: shared device memory; TPU: HBM
+        reachable over ICI), so this maps to the backend's p2p variant
+        when it has one and a plain device buffer otherwise."""
+        make = getattr(self._device, "create_buffer_p2p", None)
+        if make is not None:
+            return make(length, np.dtype(dtype))
+        return self._device.create_buffer(length, np.dtype(dtype))
+
     # ------------------------------------------------------------------
     # collectives — each mirrors one reference entry point in accl.cpp
     # ------------------------------------------------------------------
@@ -276,6 +288,40 @@ class ACCL:
         return self._execute(call, sync_in=[] if from_fpga else [(srcbuf, count)],
                              sync_out=[] if to_fpga else [(dstbuf, count)],
                              run_async=run_async, desc="copy")
+
+    def copy_to_stream(
+        self,
+        srcbuf: BaseBuffer,
+        count: int,
+        stream_id: int = 9,
+        from_fpga: bool = False,
+        run_async: bool = False,
+    ):
+        """Copy a device buffer into a local kernel stream
+        (reference: accl.cpp copy_to_stream — copy with RES_STREAM; the
+        result lane is routed to the external-kernel switch port)."""
+        if stream_id < 9:
+            raise ACCLError("stream ids < 9 are reserved")  # accl.cpp:197
+        call = self._build(Operation.copy, count, GLOBAL_COMM, op0=srcbuf,
+                           tag=stream_id, stream_flags=StreamFlags.RES_STREAM)
+        return self._execute(call, sync_in=[] if from_fpga else [(srcbuf, count)],
+                             sync_out=[], run_async=run_async,
+                             desc=f"copy_to_stream({stream_id})")
+
+    def copy_from_stream(
+        self,
+        dstbuf: BaseBuffer,
+        count: int,
+        to_fpga: bool = False,
+        run_async: bool = False,
+    ):
+        """Copy from the local kernel input stream into a device buffer
+        (reference: accl.cpp copy_from_stream — copy with OP0_STREAM)."""
+        call = self._build(Operation.copy, count, GLOBAL_COMM, res=dstbuf,
+                           stream_flags=StreamFlags.OP0_STREAM)
+        return self._execute(call, sync_in=[],
+                             sync_out=[] if to_fpga else [(dstbuf, count)],
+                             run_async=run_async, desc="copy_from_stream")
 
     def combine(
         self,

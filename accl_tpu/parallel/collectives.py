@@ -161,3 +161,19 @@ def ring_all_reduce(x, axis: str = "rank"):
     fused (fw :1888-2071).  `x`: [P * n, ...] with P | x.shape[0]."""
     chunk = ring_reduce_scatter(x, axis)
     return ring_all_gather(chunk, axis)
+
+
+def hierarchical_all_reduce(x, ici_axis: str, dcn_axis: str):
+    """Two-level allreduce for multi-slice meshes: reduce-scatter inside
+    the slice (ICI), all-reduce the shards across slices (DCN), then
+    all-gather back inside the slice.  Crosses DCN with 1/|ici| of the
+    bytes a flat psum over both axes would — the same
+    bandwidth-hierarchy trick as the reference's ring schedules over its
+    100G POE links (fw allreduce :1888-2071), applied to the ICI/DCN
+    hierarchy of a multi-slice mesh (`make_hybrid_mesh`).
+
+    `x`'s leading dim must be divisible by the ici axis size.
+    """
+    shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, dcn_axis)
+    return lax.all_gather(shard, ici_axis, axis=0, tiled=True)

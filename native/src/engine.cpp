@@ -314,8 +314,31 @@ void Engine::dispatch(CallDesc& c, Progress& p) {
     case Op::Config: do_config(c); break;
     case Op::Nop: break;
     case Op::Copy: {
+      // mem<->stream copy variants (reference: accl.cpp copy_to_stream/
+      // copy_from_stream wrap copy with RES_STREAM/OP0_STREAM; the
+      // dma_mover routes the lane to the external-kernel switch port)
       uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
-      local_copy(c.addr0(), c.addr2(), bytes);
+      bool op_stream = c.stream_flags() & 0x1;   // OP0_STREAM
+      bool res_stream = c.stream_flags() & 0x2;  // RES_STREAM
+      // a consumer must not be handed a correctly-sized but corrupt
+      // payload: push to the stream only with a clean error state (same
+      // guard as the streamed-result reduce path)
+      if (op_stream && res_stream) {
+        // kernel input port -> named local stream, staged via scratch
+        uint64_t tmp = alloc(bytes, 64);
+        if (tmp && drain_krnl_to(tmp, bytes) && sticky_err_ == 0)
+          push_local_stream(c.tag(), tmp, bytes);
+        else if (!tmp)
+          sticky_err_ |= DMA_SIZE_ERROR;
+        if (tmp) free_addr(tmp);
+      } else if (op_stream) {
+        drain_krnl_to(c.addr2(), bytes);
+      } else if (res_stream) {
+        if (sticky_err_ == 0)
+          push_local_stream(c.tag(), c.addr0(), bytes);
+      } else {
+        local_copy(c.addr0(), c.addr2(), bytes);
+      }
       break;
     }
     case Op::Combine: {

@@ -238,3 +238,69 @@ def test_rendezvous_size_cap():
 
     with _World(2) as w:
         w.run(fn)
+
+
+# ---------------------------------------------------------------------------
+# copy stream variants + p2p buffers (reference: test_copy_stream :46,
+# test_copy_p2p :63) and the segmentation boundary matrix (reference:
+# test_sendrcv_segmentation :265 — counts at segment_size multiples +/-1)
+# ---------------------------------------------------------------------------
+def test_copy_stream(world):
+    # copy_to_stream pushes the buffer out the kernel port; the test plays
+    # the loopback kernel (the emulator's --loopback wiring) by feeding the
+    # payload back into the kernel input; copy_from_stream lands it in mem
+    count = 64
+
+    def fn(accl, rank):
+        if rank != 0:
+            return
+        src = accl.create_buffer_like(_data(count, 0, salt=21))
+        dst = accl.create_buffer(count, np.float32)
+        accl.copy_to_stream(src, count, stream_id=11)
+        raw = accl.device.pop_stream(11, count * 4)
+        assert raw is not None
+        accl.device.push_krnl(np.frombuffer(raw, np.float32))
+        accl.copy_from_stream(dst, count)
+        np.testing.assert_array_equal(dst.host, _data(count, 0, salt=21))
+
+    world.run(fn)
+
+
+def test_copy_p2p(world):
+    count = 64
+
+    def fn(accl, rank):
+        if rank != 0:
+            return
+        src = accl.create_buffer_like(_data(count, 0, salt=22))
+        p2p = accl.create_buffer_p2p(count, np.float32)
+        accl.copy(src, p2p, count)
+        np.testing.assert_array_equal(p2p.host, _data(count, 0, salt=22))
+
+    world.run(fn)
+
+
+@pytest.mark.parametrize("multiplier", [1, 2])
+@pytest.mark.parametrize("offset", [-1, 0, 1])
+def test_sendrecv_segmentation(world, multiplier, offset):
+    # default eager rx buffer = 1KB -> 256 fp32 per segment; sweep counts
+    # at segment multiples +/-1 element, echoing both directions like the
+    # reference (send next, recv prev, send back, recv back)
+    seg_elems = 256
+    count = seg_elems * multiplier + offset
+
+    def fn(accl, rank):
+        nxt, prv = (rank + 1) % NRANKS, (rank - 1) % NRANKS
+        src = accl.create_buffer_like(_data(count, rank, salt=30 + offset))
+        mid = accl.create_buffer(count, np.float32)
+        res = accl.create_buffer(count, np.float32)
+        s0 = accl.send(src, count, nxt, tag=0, run_async=True)
+        accl.recv(mid, count, prv, tag=0)
+        assert s0.wait(timeout=30); s0.check()
+        # echo what we received back to its sender
+        s1 = accl.send(mid, count, prv, tag=1, run_async=True)
+        accl.recv(res, count, nxt, tag=1)
+        assert s1.wait(timeout=30); s1.check()
+        np.testing.assert_array_equal(res.host, _data(count, rank, salt=30 + offset))
+
+    world.run(fn)

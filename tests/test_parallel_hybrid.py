@@ -1,0 +1,71 @@
+"""Multi-slice (ICI x DCN) hybrid mesh tests.
+
+The reference scales past one machine by running its protocol offload
+engines on the machine-room network (SURVEY §5 "distributed
+communication backend"); here the equivalent is a hybrid mesh whose
+outer axes span slices over DCN.  CI has one host, so these validate
+the sharding/collective semantics on the 8-device virtual CPU platform
+(2 "slices" x 4 "chips"); the driver's dryrun does the same for the
+full training step.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from accl_tpu.parallel.mesh import make_hybrid_mesh
+from accl_tpu.parallel.collectives import hierarchical_all_reduce
+
+
+@pytest.fixture(scope="module")
+def hybrid_mesh():
+    return make_hybrid_mesh(ici={"ici": 4}, dcn={"dcn": 2})
+
+
+def test_hybrid_mesh_axis_order(hybrid_mesh):
+    # DCN axes must be outermost (slowest-varying) so ICI neighbors stay
+    # contiguous — the scaling-book layout rule
+    assert hybrid_mesh.axis_names == ("dcn", "ici")
+    assert hybrid_mesh.devices.shape == (2, 4)
+
+
+def test_hierarchical_all_reduce_matches_flat(hybrid_mesh):
+    n = 8 * 16
+    x = jnp.arange(n, dtype=jnp.float32).reshape(8, 16)
+
+    def body(xs):
+        v = xs.reshape(xs.shape[1:])  # [16] per device
+        h = hierarchical_all_reduce(v, "ici", "dcn")
+        from jax import lax
+        flat = lax.psum(v, ("dcn", "ici"))
+        return h[None], flat[None]
+
+    fn = jax.shard_map(body, mesh=hybrid_mesh,
+                       in_specs=P(("dcn", "ici")),
+                       out_specs=(P(("dcn", "ici")), P(("dcn", "ici"))))
+    h, flat = jax.jit(fn)(x)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(flat), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h)[0], np.asarray(x).sum(0),
+                               rtol=1e-6)
+
+
+def test_hybrid_train_step_compiles_and_runs(hybrid_mesh):
+    # dp across slices (DCN), tp within a slice (ICI) — gradients ride
+    # the hierarchy exactly as a 2-slice deployment would
+    from accl_tpu.models.transformer import (
+        ModelConfig, init_params, make_train_step, shard_params)
+
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, d_head=16,
+                      n_layers=1, d_ff=128)
+    params = init_params(np.random.default_rng(0), cfg)
+    mesh = make_hybrid_mesh(ici={"tp": 4}, dcn={"dp": 2})
+    params = shard_params(params, mesh, cfg)
+    step, _specs = make_train_step(mesh, cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab, (4, 32)))
+    params2, loss = step(params, tokens)
+    assert np.isfinite(float(loss))
+    params3, loss2 = step(params2, tokens)
+    assert float(loss2) < float(loss)
