@@ -64,13 +64,18 @@ def _to_tiles(x):
     return flat.reshape(rows, _LANES), n
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_add(a, b, interpret: bool = False):
-    """Elementwise sum lane (reduce_ops TDEST 0/2/4/6/8)."""
+    """Elementwise sum lane (reduce_ops TDEST 0/2/4/6/8).  Jitted end to
+    end so the tiling reshapes are layout no-ops instead of device
+    copies."""
     a2, n = _to_tiles(a)
     b2, _ = _to_tiles(b)
     out = _pallas_combine_2d(a2, b2, is_max=False, interpret=interpret)
     return out.reshape(-1)[:n].reshape(a.shape)
 
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_max(a, b, interpret: bool = False):
     """Elementwise max lane (reduce_ops TDEST 1/3/5/7/9)."""
     a2, n = _to_tiles(a)

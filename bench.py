@@ -8,6 +8,15 @@ anchor in BASELINE.md: the reference CCLO's internal datapath moves
 streams both operands + result through HBM, so the metric is effective
 reduction bandwidth = 3 x bytes / time.
 
+Methodology notes (important on remote-tunneled devices, where
+`block_until_ready` can return at enqueue-ack rather than completion):
+- iterations are CHAINED (out feeds the next call) so no caching or
+  cross-call elision is possible;
+- completion is forced by a scalar device->host readback, which cannot
+  resolve before the producing op finishes;
+- the readback round-trip cost is measured separately and subtracted;
+- the reported value is the median of several trials.
+
 vs_baseline = throughput / 16 GB/s (reference CCLO datapath ceiling,
 BASELINE.md "CCLO internal datapath").
 
@@ -17,13 +26,13 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import statistics
 import time
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     on_tpu = jax.default_backend() == "tpu"
     # 64 Mi elements = 256 MB per operand on TPU; small on CPU fallback
@@ -31,26 +40,39 @@ def main() -> None:
 
     from accl_tpu.ops.reduce_ops import pallas_add
 
-    key = jax.random.PRNGKey(0)
-    a = jax.random.normal(key, (n,), jnp.float32)
+    a = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
     b = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.float32)
-    jax.block_until_ready((a, b))
 
     interpret = not on_tpu
 
-    def run():
-        return pallas_add(a, b, interpret=interpret)
+    def run(x):
+        return pallas_add(x, b, interpret=interpret)
 
-    # warmup / compile
-    out = run()
-    jax.block_until_ready(out)
+    probe = jax.jit(lambda x: x[-1])
 
-    iters = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run()
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
+    # warmup / compile (both the kernel and the sync probe)
+    out = run(a)
+    float(probe(out))
+
+    # measure the sync round-trip alone so it can be subtracted
+    syncs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(probe(a))
+        syncs.append(time.perf_counter() - t0)
+    sync_s = statistics.median(syncs)
+
+    iters = 30 if on_tpu else 3
+    trials = 3
+    vals = []
+    for _ in range(trials):
+        out = a
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(out)
+        float(probe(out))  # true completion barrier
+        vals.append((time.perf_counter() - t0 - sync_s) / iters)
+    dt = statistics.median(vals)
 
     nbytes = 3 * n * 4  # read a, read b, write out
     gbps = nbytes / dt / 1e9

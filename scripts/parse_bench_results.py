@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Summarize / plot sweep CSVs.
+
+Equivalent of the reference post-processing pair — parse_bench_results.py
+(cycle-count CSVs) and Coyote run_scripts/plot.py (throughput/busbw
+curves vs a baseline).
+
+Usage:
+  python scripts/parse_bench_results.py sweep.csv
+  python scripts/parse_bench_results.py sweep.csv --collective allreduce
+  python scripts/parse_bench_results.py sweep.csv --baseline other.csv
+  python scripts/parse_bench_results.py sweep.csv --plot sweep.png
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import statistics
+import sys
+from collections import defaultdict
+
+
+def load(path: str) -> dict:
+    """-> {(collective, count): {"bytes", "dur_us", "algbw", "busbw"}}
+    with medians over repetitions."""
+    acc = defaultdict(lambda: defaultdict(list))
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            key = (row["collective"], int(row["count"]))
+            acc[key]["bytes"].append(int(row["bytes"]))
+            acc[key]["dur_us"].append(float(row["duration_us"]))
+            acc[key]["algbw"].append(float(row["algbw_GBps"]))
+            acc[key]["busbw"].append(float(row["busbw_GBps"]))
+    return {
+        k: {
+            "bytes": v["bytes"][0],
+            "dur_us": statistics.median(v["dur_us"]),
+            "algbw": statistics.median(v["algbw"]),
+            "busbw": statistics.median(v["busbw"]),
+        }
+        for k, v in sorted(acc.items())
+    }
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:g}{unit}"
+    return f"{n}B"
+
+
+def report(data: dict, baseline: dict | None = None,
+           collective: str | None = None, out=sys.stdout) -> None:
+    colls = sorted({c for c, _ in data})
+    if collective:
+        colls = [c for c in colls if c == collective]
+    for coll in colls:
+        rows = [(cnt, st) for (c, cnt), st in data.items() if c == coll]
+        print(f"\n== {coll} ==", file=out)
+        hdr = f"{'size':>8} {'time(us)':>12} {'algbw GB/s':>12} {'busbw GB/s':>12}"
+        if baseline:
+            hdr += f" {'vs baseline':>12}"
+        print(hdr, file=out)
+        for cnt, st in rows:
+            line = (f"{_fmt_bytes(st['bytes']):>8} {st['dur_us']:>12.2f} "
+                    f"{st['algbw']:>12.3f} {st['busbw']:>12.3f}")
+            if baseline:
+                b = baseline.get((coll, cnt))
+                line += (f" {st['busbw'] / b['busbw']:>11.2f}x"
+                         if b and b["busbw"] > 0 else f" {'-':>12}")
+            print(line, file=out)
+        peak = max((st["busbw"] for _, st in rows), default=0.0)
+        print(f"peak busbw: {peak:.3f} GB/s", file=out)
+
+
+def plot(data: dict, path: str, baseline: dict | None = None) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    colls = sorted({c for c, _ in data})
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for coll in colls:
+        pts = sorted((st["bytes"], st["busbw"])
+                     for (c, _), st in data.items() if c == coll)
+        line, = ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                        marker="o", ms=3, label=coll)
+        if baseline:
+            bpts = sorted((st["bytes"], st["busbw"])
+                          for (c, _), st in baseline.items() if c == coll)
+            if bpts:
+                # baseline dashed in the same color as its collective
+                ax.plot([p[0] for p in bpts], [p[1] for p in bpts],
+                        ls="--", lw=1, alpha=0.5, color=line.get_color(),
+                        label=f"{coll} (baseline)")
+    ax.set_xscale("log", base=2)
+    ax.set_yscale("log")
+    ax.set_xlabel("message size (bytes)")
+    ax.set_ylabel("bus bandwidth (GB/s)")
+    ax.legend(fontsize=8)
+    ax.grid(True, which="both", alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("csv")
+    ap.add_argument("--collective")
+    ap.add_argument("--baseline", help="second CSV to compare busbw against")
+    ap.add_argument("--plot", help="write a busbw-vs-size PNG")
+    args = ap.parse_args()
+
+    data = load(args.csv)
+    base = load(args.baseline) if args.baseline else None
+    report(data, base, args.collective)
+    if args.plot:
+        plot(data, args.plot, base)
+        print(f"\nwrote {args.plot}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

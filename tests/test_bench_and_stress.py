@@ -112,3 +112,31 @@ def test_baseline_config5_fusion():
     rows = baseline_bench.config5(io.StringIO(), reps=1)
     by = {r["variant"]: r for r in rows}
     assert by["fused"]["seconds"] > 0 and by["unfused"]["seconds"] > 0
+
+
+def test_parse_bench_results_roundtrip(tmp_path):
+    # the postprocessing pair of the reference (parse_bench_results.py /
+    # Coyote plot.py): sweep CSV -> median table + ratio vs a baseline
+    import importlib.util
+    import io as _io
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                        "parse_bench_results.py")
+    spec = importlib.util.spec_from_file_location("parse_bench_results", path)
+    parse = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(parse)
+
+    csv_text = (
+        "collective,count,bytes,duration_us,algbw_GBps,busbw_GBps,repetition\n"
+        "allreduce,16,64,10.0,0.006,0.009,0\n"
+        "allreduce,16,64,20.0,0.004,0.006,1\n"
+        "allreduce,32,128,10.0,0.012,0.018,0\n")
+    p = tmp_path / "sweep.csv"
+    p.write_text(csv_text)
+    data = parse.load(str(p))
+    assert data[("allreduce", 16)]["dur_us"] == 15.0  # median of reps
+    out = _io.StringIO()
+    parse.report(data, baseline=data, out=out)
+    text = out.getvalue()
+    assert "allreduce" in text and "1.00x" in text and "peak busbw" in text
