@@ -71,7 +71,11 @@ def main() -> None:
         for _ in range(iters):
             out = run(out)
         float(probe(out))  # true completion barrier
-        vals.append((time.perf_counter() - t0 - sync_s) / iters)
+        elapsed = time.perf_counter() - t0
+        # RTT jitter can push elapsed below the pre-measured sync median;
+        # fall back to the unsubtracted time rather than go negative
+        net = elapsed - sync_s if elapsed > sync_s else elapsed
+        vals.append(net / iters)
     dt = statistics.median(vals)
 
     nbytes = 3 * n * 4  # read a, read b, write out

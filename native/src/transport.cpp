@@ -122,7 +122,6 @@ void TcpTransport::reader_loop(int fd) {
 }
 
 int TcpTransport::connect_to(uint32_t dst) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(uint16_t(base_port_ + int(dst)));
@@ -131,16 +130,20 @@ int TcpTransport::connect_to(uint32_t dst) {
                                                         : "127.0.0.1";
   ::inet_pton(AF_INET, ip.c_str(), &addr.sin_addr);
   // retry: peers race to come up (the reference exchanges sessions at
-  // configure time; we tolerate startup skew instead)
-  for (int attempt = 0; attempt < 200; ++attempt) {
+  // configure time; we tolerate startup skew instead).  A fresh socket
+  // per attempt — after a failed connect(2) the fd is in an unspecified
+  // state and further connects on it can fail instantly.
+  for (int attempt = 0; attempt < 400; ++attempt) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       return fd;
     }
+    ::close(fd);
     std::this_thread::sleep_for(std::chrono::milliseconds(25));
   }
-  ::close(fd);
   return -1;
 }
 

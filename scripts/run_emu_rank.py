@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""One emulator rank as its own OS process over the TCP transport.
+
+Equivalent of the reference emulator launcher (test/model/emulator/
+run.py:45-77 starts one `cclo_emu` process per rank; the MPI test
+binaries attach one driver each).  Launch N of these with rank ids
+0..N-1 and the same base port; each runs a self-checking collective
+workload and exits non-zero on any mismatch.
+
+Usage:
+  python scripts/run_emu_rank.py --rank R --nranks N --port 19000 \
+      [--count 1024] [--workload allreduce|ring|all]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--nranks", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--count", type=int, default=1024)
+    ap.add_argument("--workload", default="all",
+                    choices=["allreduce", "ring", "bcast", "all"])
+    args = ap.parse_args()
+
+    import numpy as np
+
+    sys.path.insert(0, ".")
+    from accl_tpu import ReduceFunction
+    from accl_tpu.backends.emu import EmuRankTcp
+
+    r, P, n = args.rank, args.nranks, args.count
+
+    def data(rank, salt=0):
+        rng = np.random.default_rng(900 + rank + salt * 100)
+        return rng.standard_normal(n).astype(np.float32)
+
+    with EmuRankTcp(r, P, args.port) as node:
+        accl = node.accl
+        accl.set_timeout(120_000_000)  # generous: process startup skew
+
+        if args.workload in ("allreduce", "all"):
+            send = accl.create_buffer_like(data(r))
+            recv = accl.create_buffer(n, np.float32)
+            accl.allreduce(send, recv, n, ReduceFunction.SUM)
+            exp = np.sum([data(i) for i in range(P)], axis=0)
+            np.testing.assert_allclose(recv.host, exp, rtol=1e-5)
+
+        if args.workload in ("ring", "all"):
+            src = accl.create_buffer_like(data(r, salt=1))
+            dst = accl.create_buffer(n, np.float32)
+            nxt, prv = (r + 1) % P, (r - 1) % P
+            sreq = accl.send(src, n, nxt, tag=3, run_async=True)
+            accl.recv(dst, n, prv, tag=3)
+            assert sreq.wait(timeout=120)
+            sreq.check()
+            np.testing.assert_array_equal(dst.host, data(prv, salt=1))
+
+        if args.workload in ("bcast", "all"):
+            buf = accl.create_buffer_like(data(r, salt=2))
+            accl.bcast(buf, n, root=0)
+            np.testing.assert_array_equal(buf.host, data(0, salt=2))
+
+    print(f"rank {r}/{P}: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

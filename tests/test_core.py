@@ -125,3 +125,23 @@ def test_compression_flags_algebra():
     f = CompressionFlags.OP0_COMPRESSED | CompressionFlags.ETH_COMPRESSED
     assert int(f) == 9
     assert CompressionFlags.RES_COMPRESSED & f == 0
+
+
+def test_native_host_driver_suite():
+    # the C++ host-driver binary (native/test/test_native.cpp) — the
+    # reference's gtest rung for its C++ driver — built and run via
+    # `make -C native check`
+    import fcntl
+    import os
+    import subprocess
+
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    # serialize with the emu backend's auto-builder: both compile the
+    # shared native objects (emu.py _build_lib_if_stale takes this lock)
+    with open(os.path.join(root, "native", ".build.lock"), "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        proc = subprocess.run(["make", "-C", "native", "check"],
+                              cwd=root, capture_output=True, text=True,
+                              timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -1,0 +1,43 @@
+"""Process-per-rank emulation over real TCP sockets.
+
+The reference's multi-node-without-cluster mechanism: one emulator
+process per MPI rank, network = sockets between processes (SURVEY §4;
+test/model/emulator/run.py).  Here each rank is a separate Python
+process running scripts/run_emu_rank.py with its own native engine;
+only the TCP transport connects them.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+@pytest.mark.parametrize("nranks", [2, 3])
+def test_multiprocess_tcp_world(nranks):
+    port = 21000 + (os.getpid() % 1500) + nranks * 100
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join("scripts", "run_emu_rank.py"),
+             "--rank", str(r), "--nranks", str(nranks),
+             "--port", str(port), "--count", "512"],
+            cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for r in range(nranks)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"rank {r}/{nranks}: OK" in out
