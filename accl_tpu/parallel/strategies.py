@@ -113,7 +113,6 @@ def pipeline_apply(stage_fn: Callable, params, x_microbatches,
     idx = lax.axis_index(axis)
     M = x_microbatches.shape[0]
     fwd = [(i, i + 1) for i in range(P - 1)]  # no wraparound
-    act_shape = stage_fn(params, x_microbatches[0]).shape
 
     def tick(carry, t):
         act = carry
@@ -126,7 +125,12 @@ def pipeline_apply(stage_fn: Callable, params, x_microbatches,
         # last stage's output for microbatch (t - (P-1)) appears at tick t
         return act_next, y
 
-    zeros = lax.pcast(jnp.zeros(act_shape, jnp.float32), axis, to="varying")
+    # derive the initial carry from a real stage output so its
+    # varying-axes type matches the loop body under shard_map (the
+    # inputs may vary over other mesh axes besides `axis`)
+    zeros = jnp.zeros_like(
+        lax.ppermute(stage_fn(params, x_microbatches[0].astype(jnp.float32)),
+                     axis, fwd))
     _, ys = lax.scan(tick, zeros, jnp.arange(M + P - 1))
     # member P-1 produced microbatch m at tick m + P - 1
     outs = ys[P - 1:P - 1 + M]

@@ -198,3 +198,38 @@ def test_sub_communicator(world):
         np.testing.assert_allclose(recv.host, exp, rtol=1e-5, atol=1e-5)
 
     world.run(fn)
+
+
+@pytest.mark.parametrize("dtype", [np.int32])
+def test_allreduce_dtypes(world, dtype):
+    # dtype coverage on the XLA path (reference arith configs).  float64
+    # is exercised on the emulator rung only: TPUs have no f64 units and
+    # jax downcasts without the global x64 flag — the native engine's
+    # arith lanes keep the reference's full f64 semantics
+    # (tests/test_emu_collectives.py::test_allreduce_dtypes)
+    def gen(rank):
+        return np.random.default_rng(40 + rank).integers(
+            -50, 50, COUNT).astype(dtype)
+
+    def fn(accl, rank):
+        send = accl.create_buffer_like(gen(rank))
+        recv = accl.create_buffer(COUNT, dtype)
+        accl.allreduce(send, recv, COUNT, ReduceFunction.SUM)
+        return recv.host.copy()
+
+    outs = world.run(fn)
+    exp = np.sum([gen(r) for r in range(NRANKS)], axis=0)
+    for got in outs:
+        np.testing.assert_array_equal(got, exp)
+
+
+def test_duration_counter(world):
+    # per-call perf counter surfaces through the XLA backend too
+    # (reference: test_perf_counter :1010)
+    def fn(accl, rank):
+        send = accl.create_buffer_like(_data(COUNT, rank, salt=13))
+        recv = accl.create_buffer(COUNT, np.float32)
+        req = accl.allreduce(send, recv, COUNT)
+        assert accl.get_duration(req) > 0
+
+    world.run(fn)
