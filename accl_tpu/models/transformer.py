@@ -33,6 +33,14 @@ class ModelConfig:
     d_head: int = 32
     d_ff: int = 512
     dtype: str = "float32"  # compute dtype; bf16 on real TPU
+    #: local attention implementation: "dense" (materialized scores) or
+    #: "flash" (the Pallas tiled online-softmax kernel, ops/flash.py).
+    #: flash requires the local sequence length to divide its blocks.
+    attn: str = "dense"
+
+    def __post_init__(self):
+        if self.attn not in ("dense", "flash"):
+            raise ValueError(f"unknown attn implementation {self.attn!r}")
 
     @property
     def jdtype(self):
@@ -103,6 +111,12 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
         if sp_axis is not None:
             attn = ring_attention(q, k, v, axis=sp_axis, causal=True)
+        elif cfg.attn == "flash":
+            from ..ops.flash import flash_attention
+            attn = flash_attention(q, k, v, causal=True,
+                                   block_q=min(128, q.shape[1]),
+                                   block_k=min(128, q.shape[1]),
+                                   interpret=jax.default_backend() != "tpu")
         else:
             attn = _dense_attention(q, k, v, causal=True)
         o = jnp.einsum("bthk,hkd->btd", attn, blk["wo"].astype(cfg.jdtype))

@@ -13,8 +13,12 @@ Reference plugin mapping (SURVEY §2.5):
                      ring schedules on ICI instead of the DMA-mover)
 - ``vadd_put``     → fused.py: compute fused with a collective (the
                      PL-kernel compute/comm fusion example)
+- flash.py         → tiled online-softmax attention (MXU-resident; the
+                     local-compute half of the ring-attention pattern —
+                     no reference analog, TPU-first addition)
 """
 
+from .flash import flash_attention  # noqa: F401
 from .reduce_ops import reduce_lane, pallas_add, pallas_max  # noqa: F401
 from .compression import compress_cast, decompress_cast  # noqa: F401
 from .ring import (  # noqa: F401

@@ -1,0 +1,133 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The MXU-resident attention block for the model families: tiled
+QK^T -> online-softmax -> PV with the running (max, denominator)
+carried in VMEM scratch across K-block grid steps, so the [Tq, Tk]
+score matrix never materializes in HBM.
+
+This is the local-compute half of the long-context story: ring
+attention (accl_tpu.parallel.ring_attention) rotates K/V shards around
+the ICI ring — the reference's fused recv-reduce-send ring schedule
+(ccl_offload_control.c:1404-1502, :718) — and each arriving block is
+consumed by exactly this kernel's math.  The streaming-softmax update
+here is the same log-sum-exp fold the ring layer applies across shards.
+
+Layout: grid (batch*heads, q_blocks, k_blocks); k is the innermost
+(sequential) axis, so the VMEM scratch accumulator is valid across the
+k steps of one (bh, q_block) cell.  Causal masking is blockwise via
+row/col iota comparison.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
+                  *, scale: float, causal: bool, block_q: int,
+                  block_k: int, nk: int):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # a causal k-block strictly in this q-block's future contributes
+    # nothing — skip its whole body (roughly halves the MXU work)
+    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [bq, D]
+        k = k_ref[0].astype(jnp.float32)            # [bk, D]
+        v = v_ref[0].astype(jnp.float32)            # [bk, D]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_s[:]                             # [bq, 1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        # fully-masked block rows keep m at NEG_INF; exp(s - NEG_INF)
+        # would be exp(+big) — guard by clamping the shift
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - shift)                      # [bq, bk]
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
+                          jnp.exp(m_prev - shift))  # rescale of old state
+        l_new = alpha * l_s[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+        l_s[:] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        denom = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
+        o_ref[0] = (acc[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
+    causal mask).  T must be divisible by the block sizes."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    bq, bk = min(block_q, T), min(block_k, T)
+    if T % bq != 0 or T % bk != 0:
+        raise ValueError(
+            f"sequence length {T} not divisible by blocks ({bq}, {bk})")
+    nq, nk = T // bq, T // bk
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def pack(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    qp, kp, vp = pack(q), pack(k), pack(v)
+    scale = 1.0 / float(D) ** 0.5
+
+    grid = (B * H, nq, nk)
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    o_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=o_spec,
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),   # acc
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denom
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
