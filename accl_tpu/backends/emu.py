@@ -112,6 +112,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_pop_stream.argtypes = [p, i32, u32, ctypes.c_void_p, u64,
                                     ctypes.POINTER(u64), i32]
     lib.accl_dump_rx.argtypes = [p, i32, ctypes.c_char_p, i32]
+    lib.accl_inject_fault.argtypes = [p, i32, u32]
     _lib = lib
     return lib
 
@@ -220,6 +221,21 @@ class EmuDevice(CCLODevice):
         out = ctypes.create_string_buffer(65536)
         self._lib.accl_dump_rx(self._w, self._rank, out, 65536)
         return out.value.decode()
+
+    #: fault kinds for inject_fault (one-shot, next egress message)
+    FAULT_DROP = 1
+    FAULT_DUPLICATE = 2
+    FAULT_CORRUPT_SEQ = 3
+
+    def inject_fault(self, kind: int) -> None:
+        """Arm a one-shot egress fault on this rank's engine — the
+        fault-injection hook of the failure-detection subsystem
+        (SURVEY §5; the reference's closest analog is its segmentation
+        edge tests)."""
+        rc = self._lib.accl_inject_fault(self._w, self._rank, kind)
+        if rc != 0:
+            raise ACCLError(f"inject_fault({kind}) failed for rank "
+                            f"{self._rank}")
 
     def close(self) -> None:
         pass  # world teardown owns the native handle

@@ -110,6 +110,11 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
         v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
         if sp_axis is not None:
+            if cfg.attn == "flash":
+                raise ValueError(
+                    "attn='flash' is the single-shard attention kernel; "
+                    "with sequence parallelism the ring layer owns the "
+                    "attention schedule — use attn='dense' when sp is on")
             attn = ring_attention(q, k, v, axis=sp_axis, causal=True)
         elif cfg.attn == "flash":
             from ..ops.flash import flash_attention

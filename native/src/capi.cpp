@@ -87,6 +87,13 @@ int accl_set_tuning(void* wp, int rank, uint32_t key, uint32_t value) {
   return 0;
 }
 
+int accl_inject_fault(void* wp, int rank, uint32_t kind) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->inject_fault(kind);
+  return 0;
+}
+
 uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
   Engine* e = static_cast<World*>(wp)->get(rank);
   return e ? e->alloc(nbytes, align) : 0;

@@ -212,6 +212,14 @@ class Fifo {
     return q_.empty();
   }
 
+  // Non-destructive scan: does any queued element satisfy pred?
+  bool any(std::function<bool(const T&)> pred) const {
+    std::lock_guard<std::mutex> g(m_);
+    for (const auto& v : q_)
+      if (pred(v)) return true;
+    return false;
+  }
+
   size_t size() const {
     std::lock_guard<std::mutex> g(m_);
     return q_.size();

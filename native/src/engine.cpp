@@ -198,6 +198,31 @@ bool Engine::pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap,
 }
 
 // ---------------------------------------------------------------------------
+// egress funnel — every wire message leaves through here so the test
+// harness can inject one-shot faults (drop / duplicate / seqn corruption)
+// against the detection machinery (SURVEY §5 failure detection)
+// ---------------------------------------------------------------------------
+void Engine::send_out(uint32_t session, Message&& msg) {
+  switch (fault_.exchange(0)) {
+    case 1:  // drop: the message never reaches the wire
+      return;
+    case 2: {  // duplicate: deliver twice with identical header/seqn
+      Message dup;
+      dup.hdr = msg.hdr;
+      dup.payload = msg.payload;
+      transport_->send(session, std::move(dup));
+      break;
+    }
+    case 3:  // corrupt the sequence number
+      msg.hdr.seqn += 7;
+      break;
+    default:
+      break;
+  }
+  transport_->send(session, std::move(msg));
+}
+
+// ---------------------------------------------------------------------------
 // ingress demux — the depacketizer role: eager payloads to the rx pool,
 // kernel-stream payloads to stream FIFOs, rendezvous control up to the
 // engine's pending/completion queues (reference: udp_depacketizer.cpp
@@ -587,7 +612,7 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
     msg.hdr.dst_session = uint16_t(t.rows[dst].session);
     msg.hdr.msg_type = uint8_t(MsgType::EgrMsg);
     msg.hdr.comm_id = c.comm();
-    transport_->send(t.rows[dst].session, std::move(msg));
+    send_out(t.rows[dst].session, std::move(msg));
     off += chunk;
   }
 }
@@ -610,7 +635,15 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     auto note = rx_.seek(c.comm(), src, tag, t.inbound_seq[src],
                          timeout_budget());
     if (!note) {
-      sticky_err_ |= RECEIVE_TIMEOUT_ERROR;
+      // distinguish "nothing arrived" from "a segment with the wrong
+      // sequence number is sitting in the pool" (out-of-order /
+      // corrupted wire traffic — the reference's PACK_SEQ error class);
+      // offenders are evicted so the pool doesn't leak and later
+      // timeouts on this route classify cleanly
+      sticky_err_ |= rx_.evict_seq_mismatch(c.comm(), src, tag,
+                                            t.inbound_seq[src]) > 0
+                         ? PACK_SEQ_NUMBER_ERROR
+                         : RECEIVE_TIMEOUT_ERROR;
       return;
     }
     t.inbound_seq[src]++;
@@ -666,7 +699,7 @@ void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
     msg.hdr.vaddr = addr;
     msg.hdr.msg_type = uint8_t(MsgType::RndzvsInit);
     msg.hdr.comm_id = c.comm();
-    transport_->send(t.rows[src].session, std::move(msg));
+    send_out(t.rows[src].session, std::move(msg));
   }
   p.done();
 }
@@ -715,7 +748,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
       uint8_t* pdata = mem(addr, bytes);
       msg.payload.assign(pdata, pdata + bytes);
     }
-    transport_->send(t.rows[dst].session, std::move(msg));
+    send_out(t.rows[dst].session, std::move(msg));
   }
   p.done();
 }

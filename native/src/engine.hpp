@@ -84,6 +84,13 @@ class Engine {
   std::string dump_rx() const { return rx_.dump(); }
   uint32_t rank() const { return global_rank_; }
 
+  // ---- fault injection (test harness; SURVEY §5 failure detection) ----
+  // Applied to the NEXT egress message only: 1=drop, 2=duplicate,
+  // 3=corrupt sequence number.  Exercises the detection machinery
+  // (seqn discipline, receive timeout, retry) the way the reference's
+  // segmentation edge tests probe its engines.
+  void inject_fault(uint32_t kind) { fault_.store(kind); }
+
  private:
   // engine loop
   void loop();
@@ -193,6 +200,10 @@ class Engine {
   std::mutex mem_mu_;
 
   std::unique_ptr<Transport> transport_;
+  //: pending one-shot egress fault (0 = none); see inject_fault()
+  std::atomic<uint32_t> fault_{0};
+  //: egress funnel applying any injected fault before the transport
+  void send_out(uint32_t session, Message&& msg);
   RxPool rx_;
   Fifo<RndzvAddr> pending_addrs_;
   Fifo<RndzvDone> completions_;

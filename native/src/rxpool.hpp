@@ -64,6 +64,31 @@ class RxPool {
         timeout);
   }
 
+  // Sequence-number discipline (reference: dma_mover.cpp:579-611 checks
+  // seqn at seek; PACK_SEQ_NUMBER_ERROR eth_ack :333-353): a pending
+  // notification from the same (comm, src, tag) with a DIFFERENT seqn
+  // means segments arrived out of order or corrupted, not merely late.
+  // Offending entries are EVICTED and their buffers released — the
+  // stream is already broken at this point, and leaving them queued
+  // would leak pool buffers and misclassify every later timeout on the
+  // route.  Returns the number evicted (0 = clean timeout).
+  int evict_seq_mismatch(uint32_t comm, uint32_t src, uint32_t tag,
+                         uint32_t expected_seqn) {
+    int evicted = 0;
+    for (;;) {
+      auto n = notif_.pop_match(
+          [=](const RxNotification& x) {
+            return x.comm == comm && x.src == src &&
+                   (tag == TAG_ANY || x.tag == tag) &&
+                   x.seqn != expected_seqn;
+          },
+          std::chrono::nanoseconds(0));
+      if (!n) return evicted;
+      release(n->index);
+      ++evicted;
+    }
+  }
+
   const uint8_t* data(uint32_t index) const { return bufs_[index].data(); }
 
   // Release a buffer back to IDLE and pull one staged message in

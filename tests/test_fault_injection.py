@@ -1,0 +1,113 @@
+"""Fault injection against the failure-detection machinery.
+
+SURVEY §5: the reference protects correctness with per-peer sequence
+numbers checked at seek time, sticky error codes, and receive timeouts —
+but ships no fault injector.  This harness injects one-shot egress
+faults (drop / duplicate / seqn corruption) and asserts the detection
+paths fire with the right error class.
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError
+from accl_tpu.backends.emu import EmuDevice, EmuWorld
+from accl_tpu.constants import ErrorCode
+
+NRANKS = 2
+COUNT = 64
+
+
+@pytest.fixture()
+def world():
+    # function-scoped: faults poison comm state (seqn skew), so each
+    # test gets a fresh world
+    with EmuWorld(NRANKS) as w:
+        yield w
+
+
+def _data(count, salt=0):
+    rng = np.random.default_rng(4242 + salt)
+    return rng.standard_normal(count).astype(np.float32)
+
+
+def test_dropped_message_times_out(world):
+    def fn(accl, rank):
+        accl.set_timeout(1_000_000)  # 1s receive timeout
+        if rank == 0:
+            src = accl.create_buffer_like(_data(COUNT))
+            accl.device.inject_fault(EmuDevice.FAULT_DROP)
+            accl.send(src, COUNT, 1, tag=1)  # vanishes on the wire
+        else:
+            dst = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError) as e:
+                accl.recv(dst, COUNT, 0, tag=1)
+            assert e.value.code & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+
+    world.run(fn)
+
+
+def test_corrupt_seqn_detected(world):
+    def fn(accl, rank):
+        accl.set_timeout(1_000_000)
+        if rank == 0:
+            src = accl.create_buffer_like(_data(COUNT))
+            accl.device.inject_fault(EmuDevice.FAULT_CORRUPT_SEQ)
+            accl.send(src, COUNT, 1, tag=2)
+        else:
+            dst = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError) as e:
+                accl.recv(dst, COUNT, 0, tag=2)
+            # the wrong-seqn segment is IN the pool: classified as a
+            # sequence error, not a bare timeout
+            assert e.value.code & int(ErrorCode.PACK_SEQ_NUMBER_ERROR)
+
+    world.run(fn)
+
+
+def test_duplicate_message_tolerated(world):
+    # a duplicated segment must not corrupt the stream: the first copy
+    # matches, the stale copy is ignored by seqn discipline, and later
+    # traffic still matches its expected sequence numbers
+    def fn(accl, rank):
+        accl.set_timeout(5_000_000)
+        if rank == 0:
+            a = accl.create_buffer_like(_data(COUNT, salt=1))
+            b = accl.create_buffer_like(_data(COUNT, salt=2))
+            accl.device.inject_fault(EmuDevice.FAULT_DUPLICATE)
+            accl.send(a, COUNT, 1, tag=3)
+            accl.send(b, COUNT, 1, tag=4)
+        else:
+            da = accl.create_buffer(COUNT, np.float32)
+            db = accl.create_buffer(COUNT, np.float32)
+            accl.recv(da, COUNT, 0, tag=3)
+            accl.recv(db, COUNT, 0, tag=4)
+            np.testing.assert_array_equal(da.host, _data(COUNT, salt=1))
+            np.testing.assert_array_equal(db.host, _data(COUNT, salt=2))
+
+    world.run(fn)
+
+
+def test_seq_error_evicts_and_other_routes_survive(world):
+    # after a corrupt-seqn detection the offending segment is evicted:
+    # the pool does not leak and traffic on other routes is unaffected
+    def fn(accl, rank):
+        # rank 1 deliberately burns its 1s receive timeout on the broken
+        # route; rank 0 must out-wait that before the reverse transfer
+        accl.set_timeout(30_000_000 if rank == 0 else 1_000_000)
+        if rank == 0:
+            b = accl.create_buffer_like(_data(COUNT, salt=7))
+            accl.device.inject_fault(EmuDevice.FAULT_CORRUPT_SEQ)
+            accl.send(b, COUNT, 1, tag=5)
+            # reverse direction still works after the fault
+            d = accl.create_buffer(COUNT, np.float32)
+            accl.recv(d, COUNT, 1, tag=6)
+            np.testing.assert_array_equal(d.host, _data(COUNT, salt=8))
+        else:
+            d = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError):
+                accl.recv(d, COUNT, 0, tag=5)
+            assert "0 staged" in accl.dump_rx_buffers()  # nothing leaked
+            b = accl.create_buffer_like(_data(COUNT, salt=8))
+            accl.send(b, COUNT, 0, tag=6)
+
+    world.run(fn)

@@ -66,3 +66,15 @@ def test_transformer_flash_matches_dense():
     flash = forward(params, tokens, replace(cfg, attn="flash"))
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_with_sp_rejected():
+    # flash is the single-shard kernel; the ring layer owns attention
+    # under sequence parallelism — the conflict must be loud
+    from accl_tpu.models.transformer import ModelConfig, forward, init_params
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2,
+                      d_head=16, d_ff=64, attn="flash")
+    params = init_params(np.random.default_rng(0), cfg)
+    tokens = jnp.zeros((1, 64), jnp.int32)
+    with pytest.raises(ValueError):
+        forward(params, tokens, cfg, sp_axis="sp")
