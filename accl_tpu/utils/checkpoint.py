@@ -2,9 +2,14 @@
 
 The reference is a stateless communication library — its only resume
 mechanism is the retry queue's current_step (SURVEY §5).  The framework
-still ships a minimal checkpointing utility for the model layer so
-training loops built on it can snapshot/restore parameter pytrees
-without further dependencies (orbax remains the heavyweight option).
+still ships checkpointing for the model layer:
+
+- `save_pytree`/`load_pytree`: dependency-free host snapshots of a
+  parameter pytree (npz + structure manifest with validation).
+- `save_sharded`/`load_sharded`: distributed checkpoints via orbax —
+  mesh-sharded train state is written from and restored onto its
+  shardings, so a multi-chip training job resumes without gathering
+  parameters through one host.
 """
 from __future__ import annotations
 
@@ -56,3 +61,48 @@ def load_pytree(path: str, like: Any) -> Any:
                 f"{np.dtype(exp.dtype)}")
     return jax.tree_util.tree_unflatten(
         treedef, [jnp.asarray(x) for x in loaded])
+
+
+def _require_absolute(path: str) -> str:
+    # each host writes its own shards: a relative path would resolve
+    # per-process and scatter the checkpoint across working directories
+    if not os.path.isabs(path):
+        raise ValueError(f"sharded checkpoint path must be absolute: {path!r}")
+    return path
+
+
+def save_sharded(path: str, tree: Any) -> None:
+    """Write a (possibly mesh-sharded) pytree as an orbax checkpoint.
+
+    `path` must be an absolute directory path and must not already
+    exist — save each step to its own path (e.g. ``.../step_000100``)
+    so a crash mid-write never destroys the previous recovery point."""
+    import orbax.checkpoint as ocp
+
+    path = _require_absolute(path)
+    if os.path.exists(path):
+        raise ValueError(
+            f"checkpoint path exists: {path!r} — write each step to a "
+            f"fresh path; overwriting would delete the only recovery "
+            f"point before the new write is finalized")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+
+
+def load_sharded(path: str, like: Any) -> Any:
+    """Restore an orbax checkpoint onto the shapes/dtypes/shardings of
+    `like` (typically the freshly-sharded init state): each device
+    reads only its own shards.  Non-array leaves (step counters etc.)
+    are restored by shape/dtype via numpy coercion."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    def abstract(x):
+        if not hasattr(x, "shape") or not hasattr(x, "dtype"):
+            x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=getattr(x, "sharding", None))
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(_require_absolute(path),
+                             jax.tree_util.tree_map(abstract, like))
