@@ -203,6 +203,10 @@ class ACCLData:
         raw = self._device.pop_stream(stream_id, nbytes, timeout)
         if raw is None:
             raise TimeoutError(f"no message on stream {stream_id}")
+        if len(raw) != nbytes:
+            raise ValueError(
+                f"stream {stream_id} message is {len(raw)} bytes, "
+                f"expected {nbytes} ({count} x {np.dtype(dtype).name})")
         return np.frombuffer(raw, dtype=dtype).copy()
 
 

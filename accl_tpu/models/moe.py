@@ -24,7 +24,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import _dense_attention
-from .transformer import _rmsnorm
+from .transformer import _rmsnorm, sum_count_device_step
 
 
 @dataclass
@@ -145,7 +145,13 @@ def forward(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
 
 
 def loss_fn(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
-    """Next-token cross entropy + router load-balance aux."""
+    """Next-token cross entropy + router load-balance aux.
+
+    Returns ``(loss_sum, count)`` local to the device — the same
+    sum-and-count discipline as transformer.loss_fn, so the train step
+    can psum both and scale once.  The aux term is count-weighted
+    (``aux * count``) so that after global division by total count the
+    result is the token-weighted mean of per-device aux losses."""
     B, T = tokens.shape
     logits, aux = forward(params, tokens, cfg, ep_axis)
     logits = logits.astype(jnp.float32)
@@ -156,7 +162,8 @@ def loss_fn(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     nll = jnp.where(valid, nll, 0.0)
     count = jnp.sum(valid.astype(jnp.float32))
-    return jnp.sum(nll) / count + cfg.router_aux_weight * aux
+    loss_sum = jnp.sum(nll) + cfg.router_aux_weight * aux * count
+    return loss_sum, count
 
 
 def make_train_step(mesh, cfg: MoEConfig, lr: float = 1e-3,
@@ -178,27 +185,12 @@ def make_train_step(mesh, cfg: MoEConfig, lr: float = 1e-3,
     tok_spec = P(tuple(a for a in (dp, ep) if a) or None)
     data_axes = tuple(a for a in (dp, ep) if a)
 
-    def _sync_grad(g_, spec):
-        # expert-sharded leaves (spec mentions ep) hold per-member banks:
-        # their gradients are local; everything else is data-parallel
-        # over every data axis
-        red = tuple(a for a in data_axes if not (ep is not None and ep in spec))
-        return lax.pmean(g_, red) if red else g_
-
     def device_step(params, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg, ep))(params)
-        if data_axes:
-            loss = lax.pmean(loss, data_axes)
-            flat_g, tdef = jax.tree_util.tree_flatten(grads)
-            flat_s = jax.tree_util.tree_flatten(
-                specs, is_leaf=lambda x: isinstance(x, P))[0]
-            grads = jax.tree_util.tree_unflatten(
-                tdef, [_sync_grad(g_, s_)
-                       for g_, s_ in zip(flat_g, flat_s)])
-        new_params = jax.tree_util.tree_map(
-            lambda p_, g_: p_ - lr * g_, params, grads)
-        return new_params, loss
+        # ep-sharded expert banks keep per-shard grads (psummed over dp
+        # only by the vma transpose); everything else follows the shared
+        # sum-and-count discipline
+        return sum_count_device_step(
+            lambda p: loss_fn(p, tokens, cfg, ep), params, data_axes, lr)
 
     step = jax.shard_map(device_step, mesh=mesh,
                          in_specs=(specs, tok_spec),

@@ -169,6 +169,29 @@ def loss_fn(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
     return jnp.sum(nll), jnp.sum(valid.astype(jnp.float32))
 
 
+def sum_count_device_step(loss_closure, params, data_axes, lr):
+    """Shared per-device SGD step for loss functions returning a LOCAL
+    ``(loss_sum, count)`` pair (the sum-and-count discipline).
+
+    Gradients of replicated parameters come back from ``value_and_grad``
+    already psummed over the axes they are unvarying on (jax's
+    replication-aware vma transpose), and sharded leaves keep per-shard
+    grads — so re-reducing here would multiply the gradient by the mesh
+    size.  The only remaining work is the global count/loss psum and a
+    single lr/total scale.  Returns ``(new_params, mean_loss)``.
+    """
+    (loss_sum, count), grads = jax.value_and_grad(
+        loss_closure, has_aux=True)(params)
+    total, loss_tot = count, loss_sum
+    for a in data_axes:
+        total = lax.psum(total, a)
+        loss_tot = lax.psum(loss_tot, a)
+    scale = lr / jnp.maximum(total, 1.0)
+    new_params = jax.tree_util.tree_map(
+        lambda p_, g_: p_ - scale * g_, params, grads)
+    return new_params, loss_tot / jnp.maximum(total, 1.0)
+
+
 def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
                     dp: Optional[str] = "dp", tp: Optional[str] = "tp",
                     sp: Optional[str] = "sp"):
@@ -194,16 +217,8 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
     data_axes = tuple(a for a in (dp, sp) if a)
 
     def device_step(params, tokens):
-        (loss_sum, count), grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg, tp, sp), has_aux=True)(params)
-        total, loss_tot = count, loss_sum
-        for a in data_axes:
-            total = lax.psum(total, a)
-            loss_tot = lax.psum(loss_tot, a)
-        scale = lr / jnp.maximum(total, 1.0)
-        new_params = jax.tree_util.tree_map(
-            lambda p_, g_: p_ - scale * g_, params, grads)
-        return new_params, loss_tot / jnp.maximum(total, 1.0)
+        return sum_count_device_step(
+            lambda p: loss_fn(p, tokens, cfg, tp, sp), params, data_axes, lr)
 
     step = jax.shard_map(device_step, mesh=mesh,
                          in_specs=(specs, tok_spec),

@@ -637,13 +637,24 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     if (!note) {
       // distinguish "nothing arrived" from "a segment with the wrong
       // sequence number is sitting in the pool" (out-of-order /
-      // corrupted wire traffic — the reference's PACK_SEQ error class);
-      // offenders are evicted so the pool doesn't leak and later
-      // timeouts on this route classify cleanly
-      sticky_err_ |= rx_.evict_seq_mismatch(c.comm(), src, tag,
-                                            t.inbound_seq[src]) > 0
-                         ? PACK_SEQ_NUMBER_ERROR
-                         : RECEIVE_TIMEOUT_ERROR;
+      // corrupted wire traffic — the reference's PACK_SEQ error class).
+      // Stale duplicates (seqn behind expected) can never match and are
+      // evicted so the pool doesn't leak; ahead-of-sequence entries
+      // stay queued — they may legally match a recv posted later in a
+      // different tag order — but their presence on this route still
+      // classifies the failure as a sequence error, not a bare timeout.
+      int stale = rx_.drop_stale(c.comm(), src, tag, t.inbound_seq[src] - 1);
+      bool mismatched = stale > 0 ||
+                        rx_.has_route_entry(c.comm(), src, tag);
+      // reclamation bound: if the pool is exhausted, the broken route's
+      // pinned segments would starve every other route (deposit() parks
+      // everything in staging with no release to drain it) — force-evict
+      // the route under pressure; otherwise leave ahead entries queued
+      // for a possibly differently-ordered future recv
+      if (mismatched && !rx_.has_idle())
+        rx_.evict_route(c.comm(), src, tag);
+      sticky_err_ |= mismatched ? PACK_SEQ_NUMBER_ERROR
+                                : RECEIVE_TIMEOUT_ERROR;
       return;
     }
     t.inbound_seq[src]++;
@@ -680,6 +691,10 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
       }
     }
     rx_.release(note->index);
+    // a duplicated segment's stale copy (seqn <= the one just consumed)
+    // can never match a future seek; drop it now instead of letting it
+    // pin a pool buffer until some later timeout runs eviction
+    rx_.drop_stale(c.comm(), src, tag, note->seqn);
     off += chunk;
   }
 }

@@ -66,21 +66,67 @@ class RxPool {
 
   // Sequence-number discipline (reference: dma_mover.cpp:579-611 checks
   // seqn at seek; PACK_SEQ_NUMBER_ERROR eth_ack :333-353): a pending
-  // notification from the same (comm, src, tag) with a DIFFERENT seqn
-  // means segments arrived out of order or corrupted, not merely late.
-  // Offending entries are EVICTED and their buffers released — the
-  // stream is already broken at this point, and leaving them queued
-  // would leak pool buffers and misclassify every later timeout on the
-  // route.  Returns the number evicted (0 = clean timeout).
-  int evict_seq_mismatch(uint32_t comm, uint32_t src, uint32_t tag,
-                         uint32_t expected_seqn) {
+  // notification from the same (comm, src, tag) with a seqn BEHIND the
+  // expected one is a stale duplicate — its slot can never match again,
+  // so it is evicted and the buffer released.  Ahead-of-sequence
+  // entries stay queued: the per-src seqn counter is shared across
+  // tags, so a recv posted in a different tag order than the sends is
+  // a legal future match, not corruption (a past regression evicted
+  // those too and turned a recoverable timeout into
+  // PACK_SEQ_NUMBER_ERROR).  Returns the number evicted.
+  // Non-destructive: is any notification queued on (comm, src, tag)?
+  // After a failed seek this means a wrong-seqn segment is present —
+  // the sequence-error signal — without consuming entries that could
+  // still match a differently-ordered future recv.
+  bool has_route_entry(uint32_t comm, uint32_t src, uint32_t tag) const {
+    return notif_.any([=](const RxNotification& x) {
+      return x.comm == comm && x.src == src &&
+             (tag == TAG_ANY || x.tag == tag);
+    });
+  }
+
+  // Forced reclamation of a broken route: evict EVERY queued entry on
+  // (comm, src, tag) regardless of seqn.  Used when the pool is under
+  // pressure (no idle buffer) and a sequence error was just classified
+  // on the route — a genuinely corrupted stream must not pin buffers
+  // until the whole world starves.  Returns the number evicted.
+  int evict_route(uint32_t comm, uint32_t src, uint32_t tag) {
+    int evicted = 0;
+    for (;;) {
+      auto n = notif_.pop_match(
+          [=](const RxNotification& x) {
+            return x.comm == comm && x.src == src &&
+                   (tag == TAG_ANY || x.tag == tag);
+          },
+          std::chrono::nanoseconds(0));
+      if (!n) return evicted;
+      release(n->index);
+      ++evicted;
+    }
+  }
+
+  // Is at least one buffer IDLE right now?  (pressure probe)
+  bool has_idle() const {
+    std::lock_guard<std::mutex> g(m_);
+    for (auto s : status_)
+      if (s == Status::IDLE) return true;
+    return false;
+  }
+
+  // Drop queued notifications on (comm, src, tag) whose seqn is at or
+  // behind `upto_seqn` (wrap-aware) — duplicates of already-consumed
+  // segments that would otherwise pin pool buffers until a timeout
+  // happens to run eviction on the route.  Called after a successful
+  // seek consumes `upto_seqn`.
+  int drop_stale(uint32_t comm, uint32_t src, uint32_t tag,
+                 uint32_t upto_seqn) {
     int evicted = 0;
     for (;;) {
       auto n = notif_.pop_match(
           [=](const RxNotification& x) {
             return x.comm == comm && x.src == src &&
                    (tag == TAG_ANY || x.tag == tag) &&
-                   x.seqn != expected_seqn;
+                   int32_t(x.seqn - upto_seqn) <= 0;
           },
           std::chrono::nanoseconds(0));
       if (!n) return evicted;

@@ -87,9 +87,38 @@ def test_duplicate_message_tolerated(world):
     world.run(fn)
 
 
-def test_seq_error_evicts_and_other_routes_survive(world):
-    # after a corrupt-seqn detection the offending segment is evicted:
-    # the pool does not leak and traffic on other routes is unaffected
+def test_ahead_of_sequence_message_survives_misordered_recv(world):
+    # the per-src seqn counter is shared across tags: a recv posted in a
+    # different tag order than the sends must classify as a sequence
+    # error BUT leave the still-valid future message queued, so the
+    # correctly-ordered recvs afterwards succeed (no eviction of legal
+    # ahead-of-sequence traffic)
+    def fn(accl, rank):
+        if rank == 0:
+            a = accl.create_buffer_like(_data(COUNT, salt=11))
+            b = accl.create_buffer_like(_data(COUNT, salt=12))
+            accl.send(a, COUNT, 1, tag=21)  # seqn 0
+            accl.send(b, COUNT, 1, tag=22)  # seqn 1
+        else:
+            accl.set_timeout(1_000_000)
+            db = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError) as e:
+                accl.recv(db, COUNT, 0, tag=22)  # expects seqn 0, has 1
+            assert e.value.code & int(ErrorCode.PACK_SEQ_NUMBER_ERROR)
+            da = accl.create_buffer(COUNT, np.float32)
+            accl.recv(da, COUNT, 0, tag=21)  # seqn 0 still matches
+            accl.recv(db, COUNT, 0, tag=22)  # seqn 1 now matches
+            np.testing.assert_array_equal(da.host, _data(COUNT, salt=11))
+            np.testing.assert_array_equal(db.host, _data(COUNT, salt=12))
+
+    world.run(fn)
+
+
+def test_seq_error_classified_and_other_routes_survive(world):
+    # a corrupt-seqn segment is classified as a sequence error; while the
+    # pool has spare capacity the offending (ahead) segment stays queued
+    # (it could be a differently-ordered legal message), nothing is
+    # parked in staging, and traffic on other routes is unaffected
     def fn(accl, rank):
         # rank 1 deliberately burns its 1s receive timeout on the broken
         # route; rank 0 must out-wait that before the reverse transfer
@@ -106,8 +135,36 @@ def test_seq_error_evicts_and_other_routes_survive(world):
             d = accl.create_buffer(COUNT, np.float32)
             with pytest.raises(ACCLError):
                 accl.recv(d, COUNT, 0, tag=5)
-            assert "0 staged" in accl.dump_rx_buffers()  # nothing leaked
+            assert "0 staged" in accl.dump_rx_buffers()  # nothing parked
             b = accl.create_buffer_like(_data(COUNT, salt=8))
             accl.send(b, COUNT, 0, tag=6)
 
     world.run(fn)
+
+
+def test_pool_exhaustion_reclaims_broken_route():
+    # reclamation bound: when a corrupted stream's ahead-of-sequence
+    # segments fill the whole pool, the sequence-error path must
+    # force-evict the route so the pool cannot starve the world
+    import time
+
+    from accl_tpu.backends.emu import EmuWorld as W
+    with W(NRANKS, n_egr_rx_bufs=4) as world:
+        def fn(accl, rank):
+            if rank == 0:
+                accl.device.inject_fault(EmuDevice.FAULT_CORRUPT_SEQ)
+                for i in range(5):  # seqn 0 (corrupted), then 1..4
+                    b = accl.create_buffer_like(_data(COUNT, salt=20 + i))
+                    accl.send(b, COUNT, 1, tag=5)
+            else:
+                accl.set_timeout(1_000_000)
+                time.sleep(0.5)  # let every segment land / fill the pool
+                d = accl.create_buffer(COUNT, np.float32)
+                with pytest.raises(ACCLError) as e:
+                    accl.recv(d, COUNT, 0, tag=5)  # expects seqn 0
+                assert e.value.code & int(ErrorCode.PACK_SEQ_NUMBER_ERROR)
+                dump = accl.dump_rx_buffers()
+                assert "RESERVED" not in dump  # route evicted, pool free
+                assert "0 staged" in dump      # staging drained too
+
+        world.run(fn)

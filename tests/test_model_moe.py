@@ -68,6 +68,33 @@ def test_moe_train_step_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+def test_moe_grad_matches_dense_full_batch():
+    # regression: the distributed step's effective gradient must equal
+    # the dense single-device full-batch gradient (NOT n_devices x it) —
+    # mesh size must not change training dynamics
+    lr = 1e-2
+    params = init_params(np.random.default_rng(0), CFG)
+    tokens = _tokens(8, 16, seed=4)
+
+    # dense reference step on the full batch
+    (ls, cnt), grads = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, CFG, None), has_aux=True)(params)
+    ref = jax.tree_util.tree_map(
+        lambda p, g: p - (lr / cnt) * g, params, grads)
+
+    mesh = make_mesh(dp=2, ep=4)
+    sharded = shard_params(params, mesh, CFG)
+    step, _ = make_train_step(mesh, CFG, lr=lr)
+    new_params, _loss = step(sharded, tokens)
+
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(new_params)[0],
+            jax.tree_util.tree_flatten_with_path(ref)[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=str(path))
+
+
 def test_moe_ep_size_mismatch_raises():
     mesh = make_mesh(ep=2)
     with pytest.raises(ValueError):
