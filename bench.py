@@ -8,6 +8,17 @@ anchor in BASELINE.md: the reference CCLO's internal datapath moves
 streams both operands + result through HBM, so the metric is effective
 reduction bandwidth = 3 x bytes / time.
 
+Robustness contract (this file's one job is to ALWAYS land a number):
+- the TPU ("axon") backend claim can hang forever or die with
+  UNAVAILABLE when no chip is free, and the sitecustomize re-pins the
+  platform so ``import jax`` itself can block — therefore ALL
+  measurement happens in worker subprocesses with hard timeouts;
+- the TPU attempt is retried (claim contention is transient);
+- on failure it falls back to a clearly-labeled CPU measurement, and
+  if even jax-on-CPU is broken, to a numpy measurement — the process
+  exits 0 with exactly one JSON line on stdout in every case;
+- diagnostics go to stderr only.
+
 Methodology notes (important on remote-tunneled devices, where
 `block_until_ready` can return at enqueue-ack rather than completion):
 - iterations are CHAINED (out feeds the next call) so no caching or
@@ -21,20 +32,49 @@ vs_baseline = throughput / 16 GB/s (reference CCLO datapath ceiling,
 BASELINE.md "CCLO internal datapath").
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
 """
 from __future__ import annotations
 
 import json
+import os
 import statistics
+import subprocess
+import sys
 import time
 
+BASELINE_GBPS = 16.0  # reference CCLO datapath (BASELINE.md)
 
-def main() -> None:
+# Wall-clock budgets (seconds).  The TPU claim itself can eat minutes;
+# two attempts bound the total below typical driver patience.
+TPU_ATTEMPT_TIMEOUTS = (
+    int(os.environ.get("ACCL_BENCH_TPU_TIMEOUT_S", "420")),
+    180,
+)
+CPU_TIMEOUT_S = 420
+
+
+# ---------------------------------------------------------------------------
+# worker: the actual measurement, run inside a subprocess
+# ---------------------------------------------------------------------------
+
+def _measure(platform: str) -> dict:
     import jax
+
+    if platform == "cpu":
+        # the axon sitecustomize re-pins the platform at interpreter
+        # start; the runtime config update is what actually frees us
+        # from the TPU claim (see tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
-    on_tpu = jax.default_backend() == "tpu"
+    t0 = time.perf_counter()
+    backend = jax.default_backend()
+    print(f"[bench worker] backend={backend} init took "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    on_tpu = backend not in ("cpu",)
+
     # 64 Mi elements = 256 MB per operand on TPU; small on CPU fallback
     n = (64 << 20) if on_tpu else (1 << 20)
 
@@ -80,14 +120,155 @@ def main() -> None:
 
     nbytes = 3 * n * 4  # read a, read b, write out
     gbps = nbytes / dt / 1e9
-    baseline_gbps = 16.0  # reference CCLO datapath (BASELINE.md)
-    print(json.dumps({
+
+    result = {
         "metric": "on-path reduction lane sustained throughput (fp32 sum, "
-                  f"{'TPU' if on_tpu else 'CPU-interpret fallback'})",
+                  + ("TPU" if on_tpu else "CPU-interpret fallback") + ")",
         "value": round(gbps, 2),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / baseline_gbps, 2),
-    }))
+        "vs_baseline": round(gbps / BASELINE_GBPS, 2),
+        "platform": backend,
+    }
+    if on_tpu:
+        result["detail"] = _secondary_kernels(jax, jnp, probe)
+    return result
+
+
+def _secondary_kernels(jax, jnp, probe) -> dict:
+    """Compiled-on-TPU runs of the flash-attention and compression
+    kernels (the round-1 gap: Pallas kernels had only ever executed
+    under the CPU interpreter).  Best-effort — failures are recorded,
+    not fatal."""
+    detail: dict = {}
+    try:
+        from accl_tpu.ops.flash import flash_attention
+        B, T, H, D = 1, 1024, 4, 64
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = jax.random.normal(k1, (B, T, H, D), jnp.float32)
+        k = jax.random.normal(k2, (B, T, H, D), jnp.float32)
+        v = jax.random.normal(k3, (B, T, H, D), jnp.float32)
+        o = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                            interpret=False)
+        float(probe(o.reshape(-1)))
+        t0 = time.perf_counter()
+        o = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                            interpret=False)
+        float(probe(o.reshape(-1)))
+        # causal: ~half the 4*B*H*T^2*D matmul flops
+        flops = 2 * B * H * T * T * D * 2 / 2
+        detail["flash_attention_tflops"] = round(
+            flops / (time.perf_counter() - t0) / 1e12, 3)
+    except Exception as e:  # noqa: BLE001 — best-effort detail metric
+        detail["flash_attention_error"] = f"{type(e).__name__}: {e}"
+    try:
+        from accl_tpu.ops.compression import compress_cast
+        x = jax.random.normal(jax.random.PRNGKey(3), (16 << 20,), jnp.float32)
+        y = compress_cast(x, jnp.bfloat16, interpret=False)
+        float(probe(y.astype(jnp.float32)))
+        t0 = time.perf_counter()
+        y = compress_cast(x, jnp.bfloat16, interpret=False)
+        float(probe(y.astype(jnp.float32)))
+        nbytes = x.size * 4 + x.size * 2
+        detail["compression_gbps"] = round(
+            nbytes / (time.perf_counter() - t0) / 1e9, 2)
+    except Exception as e:  # noqa: BLE001 — best-effort detail metric
+        detail["compression_error"] = f"{type(e).__name__}: {e}"
+    return detail
+
+
+def _numpy_last_resort() -> dict:
+    """If jax itself is broken, still land a labeled number."""
+    import numpy as np
+    n = 1 << 22
+    a = np.random.default_rng(0).standard_normal(n).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+    a + b  # warm caches / allocator
+    t0 = time.perf_counter()
+    iters = 10
+    out = a
+    for _ in range(iters):
+        out = out + b
+    dt = (time.perf_counter() - t0) / iters
+    gbps = 3 * n * 4 / dt / 1e9
+    return {
+        "metric": "on-path reduction lane sustained throughput "
+                  "(fp32 sum, numpy last-resort fallback — jax unavailable)",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 2),
+        "platform": "numpy",
+    }
+
+
+# ---------------------------------------------------------------------------
+# orchestrator: subprocess + timeout around every jax touch
+# ---------------------------------------------------------------------------
+
+def _run_worker(platform: str, timeout_s: int) -> dict | None:
+    """Run `python bench.py --worker <platform>` and parse its last
+    stdout line as JSON.  Returns None on timeout / crash / bad JSON."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", platform]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {platform} worker timed out after {timeout_s}s "
+              "(TPU claim hung?)", file=sys.stderr)
+        return None
+    dt = time.perf_counter() - t0
+    tail = "\n".join(proc.stderr.strip().splitlines()[-8:])
+    if tail:
+        print(f"[bench] {platform} worker stderr tail:\n{tail}",
+              file=sys.stderr)
+    if proc.returncode != 0:
+        print(f"[bench] {platform} worker exited rc={proc.returncode} "
+              f"after {dt:.0f}s", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"[bench] {platform} worker produced no JSON line; stdout was: "
+          f"{proc.stdout[-500:]!r}", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        print(json.dumps(_measure(sys.argv[2])))
+        return
+
+    result = None
+    for i, budget in enumerate(TPU_ATTEMPT_TIMEOUTS):
+        print(f"[bench] TPU attempt {i + 1}/{len(TPU_ATTEMPT_TIMEOUTS)} "
+              f"(budget {budget}s)", file=sys.stderr)
+        result = _run_worker("tpu", budget)
+        if result is not None:
+            break
+    if result is None:
+        print("[bench] TPU unavailable — falling back to CPU "
+              "(interpret-mode Pallas; NOT a hardware number)",
+              file=sys.stderr)
+        result = _run_worker("cpu", CPU_TIMEOUT_S)
+    if result is None:
+        print("[bench] jax CPU worker failed too — numpy last resort",
+              file=sys.stderr)
+        try:
+            result = _numpy_last_resort()
+        except Exception as e:  # noqa: BLE001 — must still print a line
+            result = {
+                "metric": "benchmark could not run (all fallbacks failed)",
+                "value": 0.0,
+                "unit": "GB/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}",
+            }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
