@@ -335,14 +335,10 @@ class TpuEngine:
             self._exec_gang(int(call.scenario), call.comm, ready)
 
     def _exec_gang(self, scenario: int, comm_id: int, gang: dict) -> None:
-        import time
-
-        t0 = time.perf_counter_ns()
         try:
-            self._run_collective(Operation(scenario), comm_id, gang)
-            dt = float(time.perf_counter_ns() - t0)
+            dt_ns = self._run_collective(Operation(scenario), comm_id, gang)
             for call, request in gang.values():
-                request.complete(0, dt)
+                request.complete(0, float(dt_ns))
         except Exception as e:
             from ..constants import ErrorCode
 
@@ -350,14 +346,23 @@ class TpuEngine:
                 request.description += f" [{e}]"
                 request.complete(int(ErrorCode.DMA_INTERNAL_ERROR), 0.0)
 
-    def _run_collective(self, op: Operation, comm_id: int, gang: dict) -> None:
+    def _run_collective(self, op: Operation, comm_id: int, gang: dict) -> int:
+        """Assemble the gang's operands into one sharded array, execute
+        the AOT-compiled SPMD collective, and scatter result shards back
+        into the per-rank device buffers — everything stays jax.Arrays
+        on device end to end (the reference's zero-copy device-resident
+        call path, accl.cpp:796-839).  Returns execution nanoseconds
+        (dispatch + device time, compile excluded — the perf-counter
+        role, fw :2280-2303)."""
+        import time
+
         jax, jnp, Mesh, NamedSharding, P = _import_jax()
         members = self._comms[comm_id]
         nranks = len(members)
         mesh = self._mesh_for(tuple(members))
 
         if op == Operation.barrier:
-            return  # gang completion IS the synchronization
+            return 0  # gang completion IS the synchronization
 
         any_call = next(iter(gang.values()))[0]
         n = any_call.count
@@ -394,16 +399,22 @@ class TpuEngine:
                 shard = jnp.concatenate([shard, pad])
             shards.append(jax.device_put(shard[None, :], self.devices[g]))
 
-        sharding = NamedSharding(mesh, P("rank", None))
         x = jax.make_array_from_single_device_arrays(
-            (nranks, in_len), sharding, shards)
+            (nranks, in_len), NamedSharding(mesh, P("rank", None)), shards)
 
-        fn = _collective_fn(mesh, op, nranks, in_len, root, func, compressed,
-                            str(np.dtype(dtype)))
-        y = jax.jit(fn)(x)
+        # compiled once per (mesh, op, shape, root, func, ...) and cached;
+        # donate_argnums lets XLA reuse the assembled operand's buffers
+        compiled = _collective_fn(mesh, op, nranks, in_len, root, func,
+                                  compressed, str(np.dtype(dtype)))
+        t0 = time.perf_counter_ns()
+        y = compiled(x)
+        jax.block_until_ready(y)
+        dt_ns = time.perf_counter_ns() - t0
 
-        # scatter results back into per-rank result buffers
-        out_shards = {self._dev_to_rank[s.device]: np.asarray(s.data)[0]
+        # scatter result shards back into per-rank result buffers without
+        # leaving the device: each addressable shard is already a
+        # single-device jax.Array on its gang member's chip
+        out_shards = {self._dev_to_rank[s.device]: s.data
                       for s in y.addressable_shards}
         for li, g in enumerate(members):
             call, _ = gang[g]
@@ -412,10 +423,8 @@ class TpuEngine:
             res, roff = self.resolve(g, call.addr_2)
             if res is None:
                 continue
-            out = out_shards[g]
-            import jax.numpy as jnp2
-
-            res.set_dev_range(roff, jnp2.asarray(out))
+            res.set_dev_range(roff, out_shards[g][0])
+        return dt_ns
 
     # ------------------------------------------------------------------
     # kernel streams
@@ -446,15 +455,79 @@ def _f16_roundtrip(x):
     return x
 
 
+def _tree_bcast(v, nranks: int, root: int):
+    """Binomial-tree broadcast over ppermute: log2(P) rounds of doubling
+    senders; every device receives the payload exactly once, so wire
+    traffic is n*(P-1) total — vs n*(P-1) *per device* for the old
+    all_gather-then-index lowering (the reference's rendezvous tree
+    bcast, fw :816-869)."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jax.lax.axis_index("rank")
+    rel = (idx - root) % nranks
+    k = 1
+    while k < nranks:
+        perm = [((root + j) % nranks, (root + j + k) % nranks)
+                for j in range(k) if j + k < nranks]
+        recvd = jax.lax.ppermute(v, "rank", perm)
+        got_now = jnp.logical_and(rel >= k, rel < 2 * k)
+        v = jnp.where(got_now, recvd, v)
+        k *= 2
+    return v
+
+
+def _tree_gather(v, nranks: int, root: int):
+    """Binomial-tree gather: payload sizes double each round
+    (dynamic_slice/update at rel-rank offsets), so total wire traffic is
+    O(P*n*log2(P)/2) and each non-root device forwards at most once —
+    vs every device receiving the full (P-1)*n under all_gather.  The
+    rel-ordered accumulator is rolled into global rank order at the end
+    (the reference's ring-relay gather with stride bookkeeping,
+    fw :1207-1295, re-shaped as a tree for ICI)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = v.shape[0]
+    idx = jax.lax.axis_index("rank")
+    rel = (idx - root) % nranks
+    # accumulator padded to the next power of two so the doubling-block
+    # dynamic slices never clamp at the edge for non-power-of-2 worlds
+    # (clamping would silently shift a block over a neighbor's slice)
+    pow2 = 1
+    while pow2 < nranks:
+        pow2 *= 2
+    acc = jnp.zeros((pow2 * n,), v.dtype)
+    acc = jax.lax.dynamic_update_slice(acc, v, (rel * n,))
+    k = 1
+    while k < nranks:
+        # senders: rel % 2k == k; receivers: rel % 2k == 0 with rel+k < P
+        perm = [((root + j + k) % nranks, (root + j) % nranks)
+                for j in range(0, nranks, 2 * k) if j + k < nranks]
+        # every device extracts its own k*n block (senders' payload)
+        chunk = jax.lax.dynamic_slice(acc, (rel * n,), (k * n,))
+        recvd = jax.lax.ppermute(chunk, "rank", perm)
+        is_recv = jnp.logical_and(rel % (2 * k) == 0, rel + k < nranks)
+        merged = jax.lax.dynamic_update_slice(acc, recvd, ((rel + k) * n,))
+        acc = jnp.where(is_recv, merged, acc)
+        k *= 2
+    # acc holds rel-ordered slices; global rank j sits at rel (j-root)%P,
+    # one static roll restores global order
+    return jnp.roll(acc[:nranks * n], root * n)
+
+
 @lru_cache(maxsize=256)
 def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
                    func: int, compressed: bool, dtype: str) -> Callable:
-    """Build the SPMD body for one collective: a shard_map whose inner
-    program is the corresponding XLA HLO collective over ICI."""
+    """Build + AOT-compile the SPMD program for one collective: a
+    shard_map whose inner program is the XLA HLO collective (or the
+    ppermute tree schedule) over ICI.  Compilation happens here, once
+    per cache key, so execution timing in the caller never includes
+    compile (get_duration = the perf-counter role)."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = in_len if op not in (Operation.scatter, Operation.reduce_scatter,
                              Operation.alltoall) else in_len // nranks
@@ -470,15 +543,19 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
             out = (jax.lax.pmax(v, "rank") if is_max
                    else jax.lax.psum(v, "rank"))
         elif op == Operation.bcast:
-            g = jax.lax.all_gather(v, "rank")
-            out = g[root]
-        elif op == Operation.allgather or op == Operation.gather:
+            out = _tree_bcast(v, nranks, root)
+        elif op == Operation.gather:
+            out = _tree_gather(v, nranks, root)
+        elif op == Operation.allgather:
             out = jax.lax.all_gather(v, "rank").reshape(-1)
         elif op == Operation.scatter:
-            g = jax.lax.all_gather(v, "rank")
-            row = g[root]
+            # only the root's operand matters: mask everyone else to
+            # zero and ride the bandwidth-optimal reduce-scatter ring —
+            # O(n*P) total wire traffic vs O(n*P^2) for all_gather
             idx = jax.lax.axis_index("rank")
-            out = jax.lax.dynamic_slice(row, (idx * n,), (n,))
+            masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+            out = jax.lax.psum_scatter(masked, "rank", scatter_dimension=0,
+                                       tiled=True)
         elif op == Operation.reduce_scatter:
             out = jax.lax.psum_scatter(v, "rank", scatter_dimension=0,
                                        tiled=True)
@@ -491,20 +568,12 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
             raise ACCLError(f"collective {op} not lowered")
         return quant(out)[None, :]
 
-    out_len = {
-        Operation.allreduce: in_len,
-        Operation.reduce: in_len,
-        Operation.bcast: in_len,
-        Operation.allgather: in_len * nranks,
-        Operation.gather: in_len * nranks,
-        Operation.scatter: n,
-        Operation.reduce_scatter: n,
-        Operation.alltoall: in_len,
-    }[op]
-    del out_len  # shape inferred by shard_map
-
-    return shard_map(body, mesh=mesh, in_specs=P("rank", None),
-                     out_specs=P("rank", None))
+    fn = shard_map(body, mesh=mesh, in_specs=P("rank", None),
+                   out_specs=P("rank", None))
+    arg = jax.ShapeDtypeStruct(
+        (nranks, in_len), np.dtype(dtype),
+        sharding=NamedSharding(mesh, P("rank", None)))
+    return jax.jit(fn, donate_argnums=0).lower(arg).compile()
 
 
 class TpuDeviceView(CCLODevice):
