@@ -128,6 +128,15 @@ class TpuEngine:
         self.devices = list(all_devices[:nranks])
         self._dev_to_rank = {d: r for r, d in enumerate(self.devices)}
         self._lock = threading.Lock()
+        # large-message (rendezvous-analog) path: payloads at or above
+        # this many bytes route through the Pallas ring kernels
+        # (ops/ring.py segmented drivers) instead of the XLA HLO
+        # collective — the firmware's eager/rendezvous protocol switch
+        # (fw send :589, set_max_eager_msg_size accl.cpp:1415-1423)
+        import os as _os
+
+        self.ring_threshold_bytes = int(
+            _os.environ.get("ACCL_RING_THRESHOLD", str(4 << 20)))
         # per-rank address -> buffer registry
         self._buffers: list[dict[int, TpuBuffer]] = [dict() for _ in range(nranks)]
         self._next_addr = [_ADDR_STRIDE] * nranks
@@ -402,10 +411,17 @@ class TpuEngine:
         x = jax.make_array_from_single_device_arrays(
             (nranks, in_len), NamedSharding(mesh, P("rank", None)), shards)
 
+        # large payloads ride the Pallas ring kernels (rendezvous path)
+        ring = (op in (Operation.allreduce, Operation.allgather,
+                       Operation.reduce_scatter)
+                and nranks > 1
+                and in_len * np.dtype(dtype).itemsize
+                >= self.ring_threshold_bytes)
+
         # compiled once per (mesh, op, shape, root, func, ...) and cached;
         # donate_argnums lets XLA reuse the assembled operand's buffers
         compiled = _collective_fn(mesh, op, nranks, in_len, root, func,
-                                  compressed, str(np.dtype(dtype)))
+                                  compressed, str(np.dtype(dtype)), ring)
         t0 = time.perf_counter_ns()
         y = compiled(x)
         jax.block_until_ready(y)
@@ -518,12 +534,15 @@ def _tree_gather(v, nranks: int, root: int):
 
 @lru_cache(maxsize=256)
 def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
-                   func: int, compressed: bool, dtype: str) -> Callable:
+                   func: int, compressed: bool, dtype: str,
+                   ring: bool = False) -> Callable:
     """Build + AOT-compile the SPMD program for one collective: a
     shard_map whose inner program is the XLA HLO collective (or the
-    ppermute tree schedule) over ICI.  Compilation happens here, once
-    per cache key, so execution timing in the caller never includes
-    compile (get_duration = the perf-counter role)."""
+    ppermute tree schedule) over ICI — or, with ``ring=True``, the
+    segmented Pallas ring kernel (the rendezvous large-message path).
+    Compilation happens here, once per cache key, so execution timing in
+    the caller never includes compile (get_duration = the perf-counter
+    role)."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -532,14 +551,31 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
     n = in_len if op not in (Operation.scatter, Operation.reduce_scatter,
                              Operation.alltoall) else in_len // nranks
     is_max = func == int(ReduceFunction.MAX)
+    # Pallas kernels execute under the TPU interpreter on the CPU rung
+    interpret = jax.default_backend() == "cpu"
+    red = "max" if is_max else "sum"
 
     def quant(v):
         return (v.astype(jnp.float16).astype(v.dtype)
                 if compressed and v.dtype == jnp.float32 else v)
 
+    def ring_body(v):
+        from ..ops import ring as ring_ops
+
+        if op == Operation.allreduce:
+            return ring_ops.ring_all_reduce_segmented(
+                v, "rank", op=red, interpret=interpret)
+        if op == Operation.allgather:
+            return ring_ops.ring_all_gather_segmented(
+                v, "rank", interpret=interpret)
+        return ring_ops.ring_reduce_scatter_segmented(
+            v, "rank", op=red, interpret=interpret)
+
     def body(x):  # x: [1, in_len] block on each device
         v = quant(x[0])
-        if op == Operation.allreduce or op == Operation.reduce:
+        if ring:
+            out = ring_body(v)
+        elif op == Operation.allreduce or op == Operation.reduce:
             out = (jax.lax.pmax(v, "rank") if is_max
                    else jax.lax.psum(v, "rank"))
         elif op == Operation.bcast:
@@ -568,8 +604,9 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
             raise ACCLError(f"collective {op} not lowered")
         return quant(out)[None, :]
 
+    # vma checking can't see through the Pallas remote-DMA kernels
     fn = shard_map(body, mesh=mesh, in_specs=P("rank", None),
-                   out_specs=P("rank", None))
+                   out_specs=P("rank", None), check_vma=not ring)
     arg = jax.ShapeDtypeStruct(
         (nranks, in_len), np.dtype(dtype),
         sharding=NamedSharding(mesh, P("rank", None)))

@@ -218,7 +218,8 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
 
 
 def ring_all_reduce_pallas(x, axis: str = "rank", op: str = "sum",
-                           interpret: bool = False):
+                           interpret: bool = False, cid_rs: int = 1,
+                           cid_ag: int = 0):
     """Segmented ring allreduce = ring reduce-scatter + ring all-gather
     (fw :1888-2071).  Per-member x: [P * n, ...] → same shape, reduced.
 
@@ -231,7 +232,111 @@ def ring_all_reduce_pallas(x, axis: str = "rank", op: str = "sum",
     n = x.shape[0] // P
     chunks = x.reshape((P, n) + x.shape[1:])
     mine = ring_reduce_scatter_pallas(chunks, axis, op=op,
-                                      interpret=interpret, collective_id=1)
+                                      interpret=interpret,
+                                      collective_id=cid_rs)
     gathered = ring_all_gather_pallas(mine, axis, interpret=interpret,
-                                      collective_id=0)
+                                      collective_id=cid_ag)
     return gathered.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# segmentation drivers — the firmware's rx-buffer segmentation above the
+# ring kernels (fw :1888-2071: chunk to rx-buf size, bulk/tail split for
+# ragged payloads).  Chunks are sized to fit VMEM; the Python segment
+# loop unrolls under jit, and alternating collective_id pairs per
+# segment parity keep consecutive segments' barrier semaphores distinct
+# so XLA may overlap them (the firmware's 2-deep end_move window).
+# ---------------------------------------------------------------------------
+
+#: default segment length in ELEMENTS of the flat payload (1 MiB fp32);
+#: each ring chunk is seg/P elements — comfortably inside ~16 MB VMEM
+#: with the double-buffered landing slots
+DEFAULT_SEG_ELEMS = 1 << 18
+
+
+def _pad_to(x, length):
+    if x.shape[0] == length:
+        return x
+    pad = jnp.zeros((length - x.shape[0],) + x.shape[1:], x.dtype)
+    return jnp.concatenate([x, pad])
+
+
+def ring_all_reduce_segmented(x, axis: str = "rank", op: str = "sum",
+                              seg_elems: int = DEFAULT_SEG_ELEMS,
+                              interpret: bool = False):
+    """Flat per-member [N] → [N] allreduced, segmented through the ring
+    kernels.  Handles ragged tails by padding the last segment up to a
+    multiple of the ring size (the firmware's bulk/tail counts,
+    fw :1909-1912)."""
+    P = lax.axis_size(axis)
+    if P == 1:
+        return x
+    N = x.shape[0]
+    seg = max(P, (min(seg_elems, N) // P) * P)
+    outs = []
+    off = 0
+    i = 0
+    while off < N:
+        s = min(seg, N - off)
+        xs = x[off:off + s]
+        padded = _pad_to(xs, -(-s // P) * P)
+        cid = 2 * (i % 2)
+        red = ring_all_reduce_pallas(padded, axis, op=op,
+                                     interpret=interpret,
+                                     cid_rs=cid, cid_ag=cid + 1)
+        outs.append(red[:s])
+        off += s
+        i += 1
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
+def ring_all_gather_segmented(x, axis: str = "rank",
+                              seg_elems: int = DEFAULT_SEG_ELEMS,
+                              interpret: bool = False):
+    """Flat per-member [n] → [P * n] (rank-major), segmented.  Each
+    segment gathers to [P, s]; blocks are re-interleaved so the final
+    layout matches one whole-payload all-gather."""
+    P = lax.axis_size(axis)
+    if P == 1:
+        return x
+    n = x.shape[0]
+    seg = min(seg_elems, n)
+    pieces = []  # list of [P, s_i]
+    off = 0
+    i = 0
+    while off < n:
+        s = min(seg, n - off)
+        g = ring_all_gather_pallas(x[off:off + s], axis,
+                                   interpret=interpret,
+                                   collective_id=i % 2)
+        pieces.append(g)
+        off += s
+        i += 1
+    if len(pieces) == 1:
+        return pieces[0].reshape(-1)
+    return jnp.concatenate(pieces, axis=1).reshape(-1)
+
+
+def ring_reduce_scatter_segmented(x, axis: str = "rank", op: str = "sum",
+                                  seg_elems: int = DEFAULT_SEG_ELEMS,
+                                  interpret: bool = False):
+    """Flat per-member [P * n] (rank-major) → member's reduced [n],
+    segmented along the per-rank chunk dimension."""
+    P = lax.axis_size(axis)
+    if P == 1:
+        return x
+    n = x.shape[0] // P
+    chunks = x.reshape(P, n)
+    seg = min(seg_elems, n)
+    outs = []
+    off = 0
+    i = 0
+    while off < n:
+        s = min(seg, n - off)
+        r = ring_reduce_scatter_pallas(chunks[:, off:off + s], axis, op=op,
+                                       interpret=interpret,
+                                       collective_id=i % 2)
+        outs.append(r)
+        off += s
+        i += 1
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)

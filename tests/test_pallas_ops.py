@@ -117,12 +117,9 @@ def test_ring_all_gather_pallas():
     def body(xb):
         return ring_all_gather_pallas(xb[0], "dp", interpret=INTERP)[None]
 
-    try:
-        f = shard_map(body, mesh=mesh, in_specs=P("dp", None, None),
-                      out_specs=P("dp", None, None, None), check_vma=False)
-        out = np.asarray(jax.jit(f)(x))
-    except Exception as e:  # pragma: no cover
-        pytest.skip(f"pallas interpret mode lacks remote DMA here: {e}")
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None, None),
+                  out_specs=P("dp", None, None, None), check_vma=False)
+    out = np.asarray(jax.jit(f)(x))
     for r in range(NR):
         np.testing.assert_array_equal(out[r], d)
 
@@ -135,12 +132,9 @@ def test_ring_reduce_scatter_pallas():
     def body(xb):
         return ring_reduce_scatter_pallas(xb[0], "dp", interpret=INTERP)[None]
 
-    try:
-        f = shard_map(body, mesh=mesh, in_specs=P("dp", None, None, None),
-                      out_specs=P("dp", None, None), check_vma=False)
-        out = np.asarray(jax.jit(f)(x))
-    except Exception as e:  # pragma: no cover
-        pytest.skip(f"pallas interpret mode lacks remote DMA here: {e}")
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None, None, None),
+                  out_specs=P("dp", None, None), check_vma=False)
+    out = np.asarray(jax.jit(f)(x))
     exp = d.sum(axis=0)
     for r in range(NR):
         np.testing.assert_allclose(out[r], exp[r], rtol=1e-4, atol=1e-4)
@@ -154,12 +148,69 @@ def test_ring_all_reduce_pallas():
     def body(xb):
         return ring_all_reduce_pallas(xb[0], "dp", interpret=INTERP)[None]
 
-    try:
-        f = shard_map(body, mesh=mesh, in_specs=P("dp", None, None),
-                      out_specs=P("dp", None, None), check_vma=False)
-        out = np.asarray(jax.jit(f)(x))
-    except Exception as e:  # pragma: no cover
-        pytest.skip(f"pallas interpret mode lacks remote DMA here: {e}")
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None, None),
+                  out_specs=P("dp", None, None), check_vma=False)
+    out = np.asarray(jax.jit(f)(x))
     exp = d.sum(axis=0)
     for r in range(NR):
         np.testing.assert_allclose(out[r], exp, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("n", [96, 1000])  # multi-segment + ragged tail
+def test_ring_all_reduce_segmented(n):
+    from accl_tpu.ops.ring import ring_all_reduce_segmented
+
+    mesh = _ring_mesh()
+    d = _rand((NR, n), seed=13)
+    x = jax.device_put(d, NamedSharding(mesh, P("dp", None)))
+
+    def body(xb):
+        return ring_all_reduce_segmented(xb[0], "dp", seg_elems=32,
+                                         interpret=INTERP)[None]
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                  out_specs=P("dp", None), check_vma=False)
+    out = np.asarray(jax.jit(f)(x))
+    exp = d.sum(axis=0)
+    for r in range(NR):
+        np.testing.assert_allclose(out[r], exp, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_all_gather_segmented_interleaving():
+    from accl_tpu.ops.ring import ring_all_gather_segmented
+
+    mesh = _ring_mesh()
+    n = 50  # 2 segments of 32 + ragged 18
+    d = _rand((NR, n), seed=14)
+    x = jax.device_put(d, NamedSharding(mesh, P("dp", None)))
+
+    def body(xb):
+        return ring_all_gather_segmented(xb[0], "dp", seg_elems=32,
+                                         interpret=INTERP)[None]
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                  out_specs=P("dp", None), check_vma=False)
+    out = np.asarray(jax.jit(f)(x))
+    exp = d.reshape(-1)  # rank-major whole-payload layout
+    for r in range(NR):
+        np.testing.assert_array_equal(out[r], exp)
+
+
+def test_ring_reduce_scatter_segmented():
+    from accl_tpu.ops.ring import ring_reduce_scatter_segmented
+
+    mesh = _ring_mesh()
+    n = 70  # ragged: 3 segments of 32/32/6 per chunk
+    d = _rand((NR, NR * n), seed=15)
+    x = jax.device_put(d, NamedSharding(mesh, P("dp", None)))
+
+    def body(xb):
+        return ring_reduce_scatter_segmented(xb[0], "dp", seg_elems=32,
+                                             interpret=INTERP)[None]
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                  out_specs=P("dp", None), check_vma=False)
+    out = np.asarray(jax.jit(f)(x))
+    exp = d.reshape(NR, NR, n).sum(axis=0)  # [rank chunk, n]
+    for r in range(NR):
+        np.testing.assert_allclose(out[r], exp[r], rtol=1e-4, atol=1e-4)

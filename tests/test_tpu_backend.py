@@ -268,6 +268,40 @@ def test_tree_schedules_odd_world_sizes(nranks):
         w.run(fn)
 
 
+def test_ring_path_forced_on_driver_corpus():
+    # the rendezvous-analog large-message path: with the threshold at 0,
+    # every eligible collective rides the segmented Pallas ring kernels
+    # inside the gang program — results must match the XLA path exactly
+    with TpuWorld(4) as w:
+        w.engine.ring_threshold_bytes = 0
+
+        def fn(accl, rank):
+            n = 300  # odd size: exercises ragged segmentation too
+            # allreduce (sum + max)
+            send = accl.create_buffer_like(_data(n, rank, salt=41))
+            recv = accl.create_buffer(n, np.float32)
+            accl.allreduce(send, recv, n)
+            exp = np.sum([_data(n, r, salt=41) for r in range(4)], axis=0)
+            np.testing.assert_allclose(recv.host, exp, rtol=1e-4, atol=1e-5)
+            accl.allreduce(send, recv, n, function=ReduceFunction.MAX)
+            expm = np.max([_data(n, r, salt=41) for r in range(4)], axis=0)
+            np.testing.assert_allclose(recv.host, expm, rtol=1e-4, atol=1e-5)
+            # allgather
+            ag = accl.create_buffer(n * 4, np.float32)
+            accl.allgather(send, ag, n)
+            expg = np.concatenate([_data(n, r, salt=41) for r in range(4)])
+            np.testing.assert_allclose(ag.host, expg, rtol=1e-6)
+            # reduce_scatter
+            big = accl.create_buffer_like(_data(n * 4, rank, salt=42))
+            part = accl.create_buffer(n, np.float32)
+            accl.reduce_scatter(big, part, n)
+            inputs = [_data(n * 4, r, salt=42) for r in range(4)]
+            exps = np.sum(inputs, axis=0)[rank * n:(rank + 1) * n]
+            np.testing.assert_allclose(part.host, exps, rtol=1e-4, atol=1e-5)
+
+        w.run(fn)
+
+
 def test_driver_allreduce_close_to_raw_psum():
     # the device-resident call path must not be orders of magnitude off
     # a bare jitted psum on the same mesh (VERDICT r1: no host
