@@ -27,6 +27,7 @@ from .constants import (
     CCLOCall,
     CfgFunc,
     CompressionFlags,
+    DATA_TYPE_SIZE,
     DataType,
     DEFAULT_EAGER_RX_BUFS,
     DEFAULT_EAGER_RX_BUF_SIZE,
@@ -389,6 +390,7 @@ class ACCL:
             Operation.scatter, count, comm_id, root_src_dst=root,
             op0=sendbuf if is_root else None, res=recvbuf,
             compress_dtype=compress_dtype,
+            op0_dtype=sendbuf.data_type if sendbuf is not None else None,
         )
         sync_in = [(sendbuf, count * comm.size)] if (is_root and not from_fpga) else []
         sync_out = [] if to_fpga else [(recvbuf, count)]
@@ -415,6 +417,7 @@ class ACCL:
             Operation.gather, count, comm_id, root_src_dst=root,
             op0=sendbuf, res=recvbuf if is_root else None,
             compress_dtype=compress_dtype,
+            res_dtype=recvbuf.data_type if recvbuf is not None else None,
         )
         sync_in = [] if from_fpga else [(sendbuf, count)]
         sync_out = [(recvbuf, count * comm.size)] if (is_root and not to_fpga) else []
@@ -479,6 +482,8 @@ class ACCL:
             op0=None if op_stream else sendbuf,
             res=recvbuf if (is_root and not res_stream) else None,
             stream_flags=stream_flags, compress_dtype=compress_dtype,
+            res_dtype=(recvbuf.data_type
+                       if (recvbuf is not None and not res_stream) else None),
         )
         sync_in = [] if (from_fpga or op_stream) else [(sendbuf, count)]
         sync_out = ([(recvbuf, count)]
@@ -578,39 +583,102 @@ class ACCL:
         res: Optional[BaseBuffer] = None,
         stream_flags: StreamFlags = StreamFlags.NO_STREAM,
         compress_dtype: Optional[DataType] = None,
+        op0_dtype: Optional[DataType] = None,
+        res_dtype: Optional[DataType] = None,
     ) -> CCLOCall:
         """Build a call descriptor: select the arithmetic config from the
-        (uncompressed, compressed) dtype pair, derive compression flags,
-        substitute dummies for absent operands — the same responsibilities
-        as the reference prepare_call (accl.cpp:1252-1372)."""
+        (uncompressed, compressed) dtype pair, derive per-operand and
+        on-the-wire compression flags, substitute dummies for absent
+        operands — the same responsibilities as the reference prepare_call
+        (accl.cpp:1252-1372).
+
+        The full reference flag algebra is implemented: mixed-dtype
+        operands mark whichever of OP0/OP1/RES holds the *compressed*
+        (narrower) representation (accl.cpp:1310-1335); `compress_dtype`
+        additionally requests wire compression (ETH_COMPRESSED,
+        accl.cpp:1338-1367), and operands already typed as the compressed
+        dtype get their per-operand bit as well.
+
+        Cross-rank contract (same as the reference): every rank of a
+        collective must derive the same arithcfg + ETH flag, since each
+        engine computes the wire format from its own descriptor.  Absent
+        operands therefore contribute dtype hints (op0_dtype/res_dtype,
+        the reference's data_type_io_* fields) — so mixed-dtype rooted
+        collectives must either pass the absent-side buffer everywhere
+        (reduce/gather/scatter do this automatically when the buffer
+        argument is supplied on every rank) or set compress_dtype, which
+        pins the wire format regardless of per-rank operand layout
+        (tests/test_compression_matrix.py ROOTED_COMBOS)."""
         dummy = DummyBuffer()
         op0 = op0 if op0 is not None else dummy
         op1 = op1 if op1 is not None else dummy
         res = res if res is not None else dummy
 
-        # dtype consistency across present operands (accl.cpp:1262-1296)
-        dtypes = {b.data_type for b in (op0, op1, res) if not b.is_dummy}
-        if len(dtypes) > 1:
-            raise ACCLError(f"mismatched buffer dtypes in call: {dtypes}")
-        dtype = dtypes.pop() if dtypes else DataType.float32
-
+        # absent operands still contribute their dtype so every rank of a
+        # rooted collective derives the same arithcfg + wire format (the
+        # reference's data_type_io_* hints, accl.cpp:1259-1281)
+        present = [b for b in (op0, op1, res) if not b.is_dummy]
+        dtypes = {b.data_type for b in present}
+        if op0.is_dummy and op0_dtype is not None:
+            dtypes.add(op0_dtype)
+        if res.is_dummy and res_dtype is not None:
+            dtypes.add(res_dtype)
+        dtypes.discard(DataType.none)
         compression = CompressionFlags.NO_COMPRESSION
-        if compress_dtype is not None and compress_dtype != dtype:
-            pair = (dtype, compress_dtype)
-            if pair not in self._arith_ids:
-                raise ACCLError(f"no arithmetic config for dtype pair {pair}")
-            arithcfg = self._arith_ids[pair]
-            # Only on-the-wire compression is requested at the API level;
-            # per-operand COMPRESSED flags are derived by the engine per
-            # collective step (flag algebra, e.g. fw :1408-1411).
-            compression = CompressionFlags.ETH_COMPRESSED
+
+        def flag_operands(compressed_dtype: DataType) -> CompressionFlags:
+            flags = CompressionFlags.NO_COMPRESSION
+            if not op0.is_dummy and op0.data_type == compressed_dtype:
+                flags |= CompressionFlags.OP0_COMPRESSED
+            if not op1.is_dummy and op1.data_type == compressed_dtype:
+                flags |= CompressionFlags.OP1_COMPRESSED
+            if not res.is_dummy and res.data_type == compressed_dtype:
+                flags |= CompressionFlags.RES_COMPRESSED
+            return flags
+
+        if compress_dtype is None:
+            if len(dtypes) <= 1:
+                # homogeneous operands: identity pair (accl.cpp:1297-1307)
+                dtype = dtypes.pop() if dtypes else DataType.float32
+                pair = (dtype, dtype)
+                if pair not in self._arith_ids and scenario not in (
+                    Operation.config, Operation.nop, Operation.barrier,
+                ):
+                    raise ACCLError(f"unsupported dtype {dtype!r}")
+                arithcfg = self._arith_ids.get(pair, 0)
+            elif len(dtypes) == 2:
+                # operand compression without wire compression: the
+                # narrower dtype is the compressed representation
+                # (accl.cpp:1310-1335)
+                d1, d2 = sorted(dtypes, key=lambda d: DATA_TYPE_SIZE[d])
+                pair = (d2, d1)
+                if pair not in self._arith_ids:
+                    raise ACCLError(f"no arithmetic config for dtype pair {pair}")
+                arithcfg = self._arith_ids[pair]
+                compression = flag_operands(d1)
+            else:
+                raise ACCLError(f"unsupported dtype combination: {dtypes}")
         else:
-            pair = (dtype, dtype)
-            if pair not in self._arith_ids and scenario not in (
-                Operation.config, Operation.nop, Operation.barrier,
-            ):
-                raise ACCLError(f"unsupported dtype {dtype!r}")
-            arithcfg = self._arith_ids.get(pair, 0)
+            # wire compression requested (accl.cpp:1338-1367)
+            operand_dtypes = dtypes - {compress_dtype}
+            if len(operand_dtypes) > 1:
+                raise ACCLError(f"unsupported dtype combination: {dtypes}")
+            uncompressed = (operand_dtypes.pop() if operand_dtypes
+                            else compress_dtype)
+            if uncompressed == compress_dtype:
+                # all operands already typed as the wire dtype: identity
+                # config; ETH flag is set for descriptor fidelity but the
+                # ratio-0 config makes it a no-op in the engine
+                pair = (uncompressed, uncompressed)
+                arithcfg = self._arith_ids.get(pair, 0)
+                compression = CompressionFlags.ETH_COMPRESSED
+            else:
+                pair = (uncompressed, compress_dtype)
+                if pair not in self._arith_ids:
+                    raise ACCLError(f"no arithmetic config for dtype pair {pair}")
+                arithcfg = self._arith_ids[pair]
+                compression = (CompressionFlags.ETH_COMPRESSED
+                               | flag_operands(compress_dtype))
 
         return CCLOCall(
             scenario=scenario,

@@ -79,9 +79,27 @@ ARITH_LANE = {
 }
 
 # Compression lane ids (reference hp_compression plugin: TDEST 0=compress
-# fp32->fp16, 1=decompress; hp_compression.cpp:70-144).
+# fp32->fp16, 1=decompress; hp_compression.cpp:70-144).  The bf16 lanes
+# are a TPU-native extension (bf16 is the MXU's 16-bit wire format).
 COMPRESS_F32_F16 = 0
 DECOMPRESS_F16_F32 = 1
+COMPRESS_F32_BF16 = 2
+DECOMPRESS_BF16_F32 = 3
+
+_COMPRESSOR_LANES = {
+    (DataType.float32, DataType.float16): (COMPRESS_F32_F16,
+                                           DECOMPRESS_F16_F32),
+    (DataType.float32, DataType.bfloat16): (COMPRESS_F32_BF16,
+                                            DECOMPRESS_BF16_F32),
+}
+
+#: Compressor lane id -> numpy/jnp dtype name of the wire representation
+#: (single source of truth for backends that emulate the wire hop by
+#: dtype roundtrip, e.g. backends/tpu.py _wire_roundtrip).
+COMPRESSOR_WIRE_DTYPE = {
+    COMPRESS_F32_F16: "float16",
+    COMPRESS_F32_BF16: "bfloat16",
+}
 
 
 def _cfg(u: DataType, c: DataType, arith_compressed: bool = False) -> ArithConfig:
@@ -89,12 +107,13 @@ def _cfg(u: DataType, c: DataType, arith_compressed: bool = False) -> ArithConfi
     cbits = DATA_TYPE_SIZE[c]
     ratio_log = max(0, (ubits // max(cbits, 1)).bit_length() - 1)
     arith_dtype = c if arith_compressed else u
+    comp, decomp = _COMPRESSOR_LANES.get((u, c), (0, 0))
     return ArithConfig(
         uncompressed_elem_bits=ubits,
         compressed_elem_bits=cbits,
         elem_ratio_log=ratio_log,
-        compressor_tdest=COMPRESS_F32_F16 if u != c else 0,
-        decompressor_tdest=DECOMPRESS_F16_F32 if u != c else 0,
+        compressor_tdest=comp,
+        decompressor_tdest=decomp,
         arith_is_compressed=arith_compressed,
         arith_tdest=(
             ARITH_LANE[(arith_dtype, "sum")],
@@ -105,7 +124,10 @@ def _cfg(u: DataType, c: DataType, arith_compressed: bool = False) -> ArithConfi
 
 #: Default configs for every supported dtype pair, equivalent to
 #: DEFAULT_ARITH_CONFIG (arithconfig.hpp:106-119): identity pairs for
-#: {f16,f32,f64,i32,i64} plus the fp32-over-fp16 compressed pair.
+#: {f16,bf16,f32,f64,i32,i64} plus the fp32-over-fp16 compressed pair
+#: (arith on the compressed representation, matching the reference's
+#: ArithConfig(4,2,0,0,1,true,{4,9}) mixed-precision entry) and a
+#: TPU-native fp32-over-bf16 pair.
 DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
     (DataType.float16, DataType.float16): _cfg(DataType.float16, DataType.float16),
     (DataType.bfloat16, DataType.bfloat16): _cfg(DataType.bfloat16,
@@ -115,7 +137,10 @@ DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
     (DataType.int32, DataType.int32): _cfg(DataType.int32, DataType.int32),
     (DataType.int64, DataType.int64): _cfg(DataType.int64, DataType.int64),
     (DataType.float32, DataType.float16): _cfg(
-        DataType.float32, DataType.float16, arith_compressed=False
+        DataType.float32, DataType.float16, arith_compressed=True
+    ),
+    (DataType.float32, DataType.bfloat16): _cfg(
+        DataType.float32, DataType.bfloat16, arith_compressed=True
     ),
 }
 

@@ -129,4 +129,42 @@ inline void decompress_f16_f32(const uint8_t* in, uint8_t* out,
   for (uint64_t i = 0; i < n; ++i) po[i] = f16_to_f32(pi[i]);
 }
 
+// fp32 -> bf16 wire compression (TPU-native 16-bit pair; no reference
+// analog — the hp_compression plugin only ships f32<->f16).
+inline void compress_f32_bf16(const uint8_t* in, uint8_t* out,
+                              uint64_t nbytes) {
+  uint64_t n = nbytes / 4;
+  const float* pi = reinterpret_cast<const float*>(in);
+  uint16_t* po = reinterpret_cast<uint16_t*>(out);
+  for (uint64_t i = 0; i < n; ++i) po[i] = f32_to_bf16(pi[i]);
+}
+
+inline void decompress_bf16_f32(const uint8_t* in, uint8_t* out,
+                                uint64_t nbytes) {
+  uint64_t n = nbytes / 2;
+  const uint16_t* pi = reinterpret_cast<const uint16_t*>(in);
+  float* po = reinterpret_cast<float*>(out);
+  for (uint64_t i = 0; i < n; ++i) po[i] = bf16_to_f32(pi[i]);
+}
+
+// Compressor-lane dispatch (arithconfig.py ids: compressor 0=f32->f16,
+// 2=f32->bf16; decompressor = compressor+1).  Element-count based.
+inline uint32_t run_compress_lane(uint32_t kind, const uint8_t* in,
+                                  uint8_t* out, uint64_t elems) {
+  switch (kind) {
+    case 0: compress_f32_f16(in, out, elems * 4); return OK;
+    case 2: compress_f32_bf16(in, out, elems * 4); return OK;
+    default: return COMPRESSION_ERROR;
+  }
+}
+
+inline uint32_t run_decompress_lane(uint32_t kind, const uint8_t* in,
+                                    uint8_t* out, uint64_t elems) {
+  switch (kind) {
+    case 0: decompress_f16_f32(in, out, elems * 2); return OK;
+    case 2: decompress_bf16_f32(in, out, elems * 2); return OK;
+    default: return COMPRESSION_ERROR;
+  }
+}
+
 }  // namespace accl

@@ -101,7 +101,11 @@ struct WireHeader {
   uint8_t host = 0;
   uint64_t vaddr = 0;  // rendezvous target address
   uint32_t comm_id = 0;
-  uint32_t compressed = 0;  // wire payload is fp16-compressed fp32
+  uint32_t compressed = 0;  // wire payload is in the compressed
+                            // representation (diagnostic only: both ends
+                            // derive the wire format from their OWN
+                            // arithcfg + flags, like the reference's
+                            // marker-free eth header)
   uint8_t pad[64 - 40] = {0};
 };
 static_assert(sizeof(WireHeader) == 64, "wire header must be 64 bytes");

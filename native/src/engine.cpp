@@ -17,6 +17,15 @@ static constexpr uint32_t BARRIER_TAG = 0xBA771E12u;
 // Stream ids >= 9 address compute-kernel streams (reference: accl.cpp:197).
 static constexpr uint32_t FIRST_KRNL_STREAM = 9;
 
+// Compression flag bits of descriptor word 7 (reference:
+// constants.hpp:320-325; bit-compatible with accl_tpu/constants.py).
+enum CompFlag : uint32_t {
+  OP0_COMPRESSED = 1,
+  OP1_COMPRESSED = 2,
+  RES_COMPRESSED = 4,
+  ETH_COMPRESSED = 8,
+};
+
 Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
                std::unique_ptr<Transport> transport)
     : global_rank_(global_rank),
@@ -244,12 +253,43 @@ void Engine::ingress(Message&& msg) {
     case MsgType::RndzvsMsg: {
       // one-sided write into our device memory (the RDMA WRITE landing),
       // then surface a local completion (the WR_DONE the reference's
-      // depacketizer routes up to the firmware notification stream)
+      // depacketizer routes up to the firmware notification stream).
+      // The depacketizer converts the wire representation into the
+      // landing representation using OUR OWN posted-address record (the
+      // eager path's own-flag-algebra discipline; the sender's header is
+      // advisory only) — this is the ETH-compressed rendezvous path.
+      std::optional<PostedRndzv> post;
+      {
+        std::lock_guard<std::mutex> g(posted_mu_);
+        auto it = posted_.find(PostedKey{msg.hdr.comm_id, msg.hdr.src,
+                                         msg.hdr.tag, msg.hdr.vaddr});
+        if (it != posted_.end()) {
+          post = it->second;
+          posted_.erase(it);
+        }
+      }
       {
         std::lock_guard<std::mutex> g(mem_mu_);
-        if (msg.hdr.vaddr + msg.payload.size() <= devicemem_.size())
+        if (post && post->wire_c != post->lnd_c) {
+          // clamp to what actually arrived: a short payload (divergent
+          // arithcfg, stale posted entry) must not read past the wire
+          // buffer — all compressed pairs are 4 <-> 2 bytes/elem
+          uint64_t wire_eb = post->wire_c ? 2 : 4;
+          uint64_t elems =
+              std::min<uint64_t>(post->elems, msg.payload.size() / wire_eb);
+          uint64_t lnd_bytes = elems * (post->lnd_c ? 2 : 4);
+          if (msg.hdr.vaddr + lnd_bytes <= devicemem_.size()) {
+            if (post->wire_c)
+              run_decompress_lane(post->comp_kind, msg.payload.data(),
+                                  devicemem_.data() + msg.hdr.vaddr, elems);
+            else
+              run_compress_lane(post->comp_kind, msg.payload.data(),
+                                devicemem_.data() + msg.hdr.vaddr, elems);
+          }
+        } else if (msg.hdr.vaddr + msg.payload.size() <= devicemem_.size()) {
           std::memcpy(devicemem_.data() + msg.hdr.vaddr, msg.payload.data(),
                       msg.payload.size());
+        }
       }
       completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag});
       break;
@@ -342,7 +382,9 @@ void Engine::dispatch(CallDesc& c, Progress& p) {
       // mem<->stream copy variants (reference: accl.cpp copy_to_stream/
       // copy_from_stream wrap copy with RES_STREAM/OP0_STREAM; the
       // dma_mover routes the lane to the external-kernel switch port)
-      uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+      Dom d = dom(c);
+      uint64_t elems = c.count();
+      uint64_t bytes = elems * d.ub;  // streams carry uncompressed
       bool op_stream = c.stream_flags() & 0x1;   // OP0_STREAM
       bool res_stream = c.stream_flags() & 0x2;  // RES_STREAM
       // a consumer must not be handed a correctly-sized but corrupt
@@ -357,21 +399,42 @@ void Engine::dispatch(CallDesc& c, Progress& p) {
           sticky_err_ |= DMA_SIZE_ERROR;
         if (tmp) free_addr(tmp);
       } else if (op_stream) {
-        drain_krnl_to(c.addr2(), bytes);
+        if (d.res) {
+          // stream -> compressed result buffer: stage then compress
+          uint64_t tmp = alloc(bytes, 64);
+          if (tmp && drain_krnl_to(tmp, bytes))
+            local_move(c, tmp, c.addr2(), elems, false, true);
+          else if (!tmp)
+            sticky_err_ |= DMA_SIZE_ERROR;
+          if (tmp) free_addr(tmp);
+        } else {
+          drain_krnl_to(c.addr2(), bytes);
+        }
       } else if (res_stream) {
-        if (sticky_err_ == 0)
+        if (d.op0) {
+          // compressed operand -> stream: decompress into scratch first
+          uint64_t tmp = alloc(bytes, 64);
+          if (tmp && local_move(c, c.addr0(), tmp, elems, true, false) == 0)
+            push_local_stream(c.tag(), tmp, bytes);
+          else if (!tmp)
+            sticky_err_ |= DMA_SIZE_ERROR;
+          if (tmp) free_addr(tmp);
+        } else if (sticky_err_ == 0) {
           push_local_stream(c.tag(), c.addr0(), bytes);
+        }
       } else {
-        local_copy(c.addr0(), c.addr2(), bytes);
+        local_move(c, c.addr0(), c.addr2(), elems, d.op0, d.res);
       }
       break;
     }
     case Op::Combine: {
-      uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
-      const ArithCfgN& a = arith_for(c);
-      uint32_t lane = c.function() < a.lanes.size() ? a.lanes[c.function()]
-                                                    : uint32_t(NUM_LANES);
-      local_reduce(lane, c.addr0(), c.addr1(), c.addr2(), bytes);
+      Dom d = dom(c);
+      uint64_t elems = c.count();
+      std::lock_guard<std::mutex> g(mem_mu_);
+      uint8_t* a0 = mem(c.addr0(), elems * d.eb(d.op0));
+      uint8_t* a1 = mem(c.addr1(), elems * d.eb(d.op1));
+      uint8_t* r = mem(c.addr2(), elems * d.eb(d.res));
+      reduce_mixed(c, a0, d.op0, a1, d.op1, r, d.res, elems);
       break;
     }
     case Op::Send: coll_send(c, p); break;
@@ -398,49 +461,59 @@ static uint32_t floor_log2(uint32_t v) {
 // Binomial tree broadcast (fw :816-869): each round doubles the set of
 // ranks holding the payload; position is measured from the root.
 void Engine::tree_bcast(CallDesc& c, Progress& p, uint32_t root,
-                        uint64_t src_addr, uint64_t dst_addr,
-                        uint64_t bytes) {
+                        uint64_t src_addr, uint64_t dst_addr, uint64_t elems,
+                        bool src_c, bool dst_c) {
   const CommTable& t = comm_for(c);
   uint32_t P = t.size;
   uint32_t pos = (t.local + P - root) % P;
   uint64_t from = src_addr;
+  bool from_c = src_c;
   uint32_t k0 = 0;
   if (pos != 0) {
     uint32_t pk = floor_log2(pos);
     uint32_t parent = pos - (1u << pk);
-    rndzv_recv(c, p, (root + parent) % P, c.tag(), dst_addr, bytes);
+    rndzv_recv(c, p, (root + parent) % P, c.tag(), dst_addr, elems, dst_c);
+    // relay: the buffer we received with RES domain becomes the OP0
+    // source of the forwarding hops (fw :1408-1411)
     from = dst_addr;
+    from_c = dst_c;
     k0 = pk + 1;
   }
   for (uint32_t k = k0; (1u << k) < P; ++k) {
     uint32_t child = pos + (1u << k);
     if (child < P)
-      rndzv_send(c, p, (root + child) % P, c.tag(), from, bytes);
+      rndzv_send(c, p, (root + child) % P, c.tag(), from, elems, from_c);
   }
 }
 
 // Binomial tree reduce (fw :1603-1728): leaves push partials up; interior
 // positions fold each child's partial into an accumulator, then forward.
+// tmp scratch always holds the uncompressed representation.
 void Engine::tree_reduce(CallDesc& c, Progress& p, uint32_t root,
                          uint64_t src_addr, uint64_t acc_addr,
-                         uint64_t tmp_addr, uint64_t bytes) {
+                         uint64_t tmp_addr, uint64_t elems, bool src_c,
+                         bool acc_c) {
   const CommTable& t = comm_for(c);
   uint32_t P = t.size;
   uint32_t pos = (t.local + P - root) % P;
-  const ArithCfgN& a = arith_for(c);
-  uint32_t lane =
-      c.function() < a.lanes.size() ? a.lanes[c.function()] : uint32_t(NUM_LANES);
-  step_local(p, [&] { local_copy(src_addr, acc_addr, bytes); });
+  step_local(p, [&] { local_move(c, src_addr, acc_addr, elems, src_c, acc_c); });
   for (uint32_t k = 0; (1u << k) < P; ++k) {
     uint32_t bit = 1u << k;
     if (pos & bit) {
-      rndzv_send(c, p, (root + pos - bit) % P, c.tag(), acc_addr, bytes);
+      rndzv_send(c, p, (root + pos - bit) % P, c.tag(), acc_addr, elems,
+                 acc_c);
       return;
     }
     if (pos + bit < P) {
-      rndzv_recv(c, p, (root + pos + bit) % P, c.tag(), tmp_addr, bytes);
-      step_local(p,
-                 [&] { local_reduce(lane, acc_addr, tmp_addr, acc_addr, bytes); });
+      rndzv_recv(c, p, (root + pos + bit) % P, c.tag(), tmp_addr, elems,
+                 false);
+      step_local(p, [&] {
+        Dom d = dom(c);
+        std::lock_guard<std::mutex> g(mem_mu_);
+        uint8_t* acc = mem(acc_addr, elems * d.eb(acc_c && d.pair));
+        uint8_t* tmp = mem(tmp_addr, elems * d.ub);
+        reduce_mixed(c, acc, acc_c, tmp, false, acc, acc_c, elems);
+      });
     }
   }
 }
@@ -452,6 +525,10 @@ void Engine::do_config(CallDesc& c) {
       retry_q_.clear();
       while (pending_addrs_.try_pop()) {}
       while (completions_.try_pop()) {}
+      {
+        std::lock_guard<std::mutex> g(posted_mu_);
+        posted_.clear();
+      }
       for (auto& t : comms_) {
         std::fill(t.inbound_seq.begin(), t.inbound_seq.end(), 0);
         std::fill(t.outbound_seq.begin(), t.outbound_seq.end(), 0);
@@ -494,6 +571,76 @@ uint64_t Engine::elem_bytes(const CallDesc& c) const {
   return arith_for(c).ubits / 8;
 }
 
+Engine::Dom Engine::dom(const CallDesc& c) const {
+  const ArithCfgN& a = arith_for(c);
+  Dom d;
+  d.ub = a.ubits ? a.ubits / 8 : 4;
+  d.cb = a.cbits ? a.cbits / 8 : d.ub;
+  d.ratio_log = a.ratio_log;
+  d.comp_kind = a.compressor;
+  d.pair = a.ratio_log > 0;
+  uint32_t f = c.compression();
+  d.op0 = d.pair && (f & OP0_COMPRESSED);
+  d.op1 = d.pair && (f & OP1_COMPRESSED);
+  d.res = d.pair && (f & RES_COMPRESSED);
+  d.eth = d.pair && (f & ETH_COMPRESSED);
+  return d;
+}
+
+uint32_t Engine::convert_elems(const Dom& d, const uint8_t* in, bool in_c,
+                               uint8_t* out, bool out_c, uint64_t elems) {
+  if (in_c == out_c) {
+    std::memmove(out, in, elems * d.eb(in_c));
+    return OK;
+  }
+  uint32_t err = in_c ? run_decompress_lane(d.comp_kind, in, out, elems)
+                      : run_compress_lane(d.comp_kind, in, out, elems);
+  sticky_err_ |= err;
+  return err;
+}
+
+uint32_t Engine::reduce_mixed(const CallDesc& c, const uint8_t* a0, bool a0c,
+                              const uint8_t* a1, bool a1c, uint8_t* r, bool rc,
+                              uint64_t elems) {
+  const ArithCfgN& a = arith_for(c);
+  Dom d = dom(c);
+  // the arithcfg chooses the accumulate domain: mixed-precision pairs run
+  // their lanes on the compressed representation when arith_compressed
+  // (reference DEFAULT_ARITH_CONFIG {f32,f16} pair, arithconfig.hpp:106-119)
+  bool ac = d.pair && a.arith_compressed != 0;
+  uint32_t lane =
+      c.function() < a.lanes.size() ? a.lanes[c.function()] : uint32_t(NUM_LANES);
+  uint64_t abytes = elems * d.eb(ac);
+  if (a0c == ac && a1c == ac && rc == ac) {
+    uint32_t err = run_reduce_lane(lane, a0, a1, r, abytes);
+    sticky_err_ |= err;
+    return err;
+  }
+  thread_local std::vector<uint8_t> s0, s1, sr;
+  const uint8_t* p0 = a0;
+  const uint8_t* p1 = a1;
+  if (a0c != ac) {
+    s0.resize(abytes);
+    if (convert_elems(d, a0, a0c, s0.data(), ac, elems)) return sticky_err_;
+    p0 = s0.data();
+  }
+  if (a1c != ac) {
+    s1.resize(abytes);
+    if (convert_elems(d, a1, a1c, s1.data(), ac, elems)) return sticky_err_;
+    p1 = s1.data();
+  }
+  if (rc == ac) {
+    uint32_t err = run_reduce_lane(lane, p0, p1, r, abytes);
+    sticky_err_ |= err;
+    return err;
+  }
+  sr.resize(abytes);
+  uint32_t err = run_reduce_lane(lane, p0, p1, sr.data(), abytes);
+  sticky_err_ |= err;
+  if (err) return err;
+  return convert_elems(d, sr.data(), ac, r, rc, elems);
+}
+
 nanoseconds Engine::timeout_budget() const {
   // 1 emulated cycle = 1us (the reference counts 4ns cycles on hardware;
   // the emulator scales so the default 1e6-cycle timeout is 1s of wall
@@ -501,10 +648,19 @@ nanoseconds Engine::timeout_budget() const {
   return microseconds(timeout_);
 }
 
-bool Engine::use_rendezvous(const CallDesc& c, uint64_t bytes) {
-  // eager if small, compressed, or streamed (fw send :589, recv :669)
+bool Engine::use_rendezvous(const CallDesc& c, uint64_t elems) {
+  // eager if small or streamed (fw send :589, recv :669).  Unlike the
+  // reference firmware — which forces eager for any nonzero compression
+  // flag and leaves compressed rendezvous as a TODO (fw :589, :615-620) —
+  // the rendezvous primitives here are domain-aware, so protocol
+  // selection depends only on size.  The threshold is measured against
+  // the WIRE payload: that is the one quantity both peers of a
+  // directional pair (e.g. f16 sender / f32+compress receiver) derive
+  // identically from their own arithcfg + ETH flag, so protocol choice
+  // can never diverge across ranks.
+  Dom d = dom(c);
+  uint64_t bytes = elems * d.eb(d.eth);
   if (bytes <= max_eager_) return false;
-  if (c.compression() != 0) return false;
   if (c.stream_flags() != 0) return false;
   // enforce the rendezvous size register as a hard cap (the reference
   // validates the register, fw :2442-2448, but never checks transfers
@@ -551,6 +707,21 @@ uint32_t Engine::local_copy(uint64_t src, uint64_t dst, uint64_t bytes) {
   return sticky_err_;
 }
 
+// Domain-aware element copy: routes through the compressor/decompressor
+// lane when source and destination representations differ (the role of
+// the reference dma_mover's per-operand lane routing).
+uint32_t Engine::local_move(const CallDesc& c, uint64_t src, uint64_t dst,
+                            uint64_t elems, bool src_c, bool dst_c) {
+  Dom d = dom(c);
+  src_c = src_c && d.pair;
+  dst_c = dst_c && d.pair;
+  std::lock_guard<std::mutex> g(mem_mu_);
+  uint8_t* s = mem(src, elems * d.eb(src_c));
+  uint8_t* t = mem(dst, elems * d.eb(dst_c));
+  convert_elems(d, s, src_c, t, dst_c, elems);
+  return sticky_err_;
+}
+
 uint32_t Engine::local_reduce(uint32_t lane, uint64_t a, uint64_t b,
                               uint64_t dst, uint64_t bytes) {
   std::lock_guard<std::mutex> g(mem_mu_);
@@ -565,41 +736,51 @@ uint32_t Engine::local_reduce(uint32_t lane, uint64_t a, uint64_t b,
 // eager protocol primitives
 // ---------------------------------------------------------------------------
 void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
-                        uint64_t bytes, bool from_stream, uint32_t to_strm) {
+                        uint64_t elems, bool from_stream, uint32_t to_strm,
+                        uint32_t comp) {
   CommTable& t = comms_[c.comm()];
-  const ArithCfgN& a = arith_for(c);
-  bool compress = (c.compression() != 0) && a.ratio_log > 0;
+  Dom d = dom(c);
+  bool src_c = d.pair && (comp & OP0_COMPRESSED) && !from_stream;
+  bool wire_c = d.pair && (comp & ETH_COMPRESSED);
   uint64_t seg_wire = t.rows[dst].max_seg ? t.rows[dst].max_seg
                                           : (rx_.buf_size() ? rx_.buf_size()
                                                             : 1024);
-  uint64_t seg_u = compress ? seg_wire << a.ratio_log : seg_wire;
+  // segmentation is against the rx buffer in WIRE representation: a
+  // compressed wire carries ratio-more elements per segment (fw :621-623
+  // computes max_seg_count from the element size the same way)
+  uint64_t seg_elems = std::max<uint64_t>(1, seg_wire / d.eb(wire_c));
 
   uint64_t off = 0;
   bool first = true;
-  while (off < bytes || (first && bytes == 0)) {
+  while (off < elems || (first && elems == 0)) {
     first = false;
-    uint64_t chunk = std::min(seg_u, bytes - off);
+    uint64_t chunk = std::min(seg_elems, elems - off);
     Message msg;
     if (from_stream) {
       // operand streamed from the local compute kernel (OP0_STREAM;
-      // reference vadd_put path accl_hls.h / fw :575)
+      // reference vadd_put path accl_hls.h / fw :575) — streams carry
+      // the uncompressed representation
       auto v = krnl_in_.pop_wait(timeout_budget());
-      if (!v || v->size() != chunk) {
+      if (!v || v->size() != chunk * d.ub) {
         sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
         return;
       }
       msg.payload = std::move(*v);
+      if (wire_c) {
+        std::vector<uint8_t> packed(chunk * d.cb);
+        if (convert_elems(d, msg.payload.data(), false, packed.data(), true,
+                          chunk))
+          return;
+        msg.payload = std::move(packed);
+      }
     } else {
       std::lock_guard<std::mutex> g(mem_mu_);
-      uint8_t* p = mem(addr + off, chunk);
-      msg.payload.assign(p, p + chunk);
+      uint8_t* p = mem(addr + off * d.eb(src_c), chunk * d.eb(src_c));
+      msg.payload.resize(chunk * d.eb(wire_c));
+      if (convert_elems(d, p, src_c, msg.payload.data(), wire_c, chunk))
+        return;
     }
-    if (compress) {
-      std::vector<uint8_t> packed(msg.payload.size() >> a.ratio_log);
-      compress_f32_f16(msg.payload.data(), packed.data(), msg.payload.size());
-      msg.payload = std::move(packed);
-      msg.hdr.compressed = 1;
-    }
+    msg.hdr.compressed = wire_c ? 1 : 0;
     msg.hdr.count = uint32_t(msg.payload.size());
     msg.hdr.tag = tag;
     msg.hdr.src = t.local;
@@ -618,20 +799,23 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
 }
 
 void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
-                        uint64_t bytes, RecvMode mode, uint32_t strm) {
+                        uint64_t elems, RecvMode mode, uint32_t strm,
+                        uint32_t comp) {
   CommTable& t = comms_[c.comm()];
-  const ArithCfgN& a = arith_for(c);
-  bool compress = (c.compression() != 0) && a.ratio_log > 0;
+  Dom d = dom(c);
+  bool dst_c = d.pair && (comp & RES_COMPRESSED) && mode != RecvMode::STREAM;
+  bool wire_c = d.pair && (comp & ETH_COMPRESSED);
   uint64_t seg_wire = t.rows[t.local].max_seg
                           ? t.rows[t.local].max_seg
                           : (rx_.buf_size() ? rx_.buf_size() : 1024);
-  uint64_t seg_u = compress ? seg_wire << a.ratio_log : seg_wire;
+  // must mirror the sender's wire-domain segmentation exactly
+  uint64_t seg_elems = std::max<uint64_t>(1, seg_wire / d.eb(wire_c));
 
   uint64_t off = 0;
   bool first = true;
-  while (off < bytes || (first && bytes == 0)) {
+  while (off < elems || (first && elems == 0)) {
     first = false;
-    uint64_t chunk = std::min(seg_u, bytes - off);
+    uint64_t chunk = std::min(seg_elems, elems - off);
     auto note = rx_.seek(c.comm(), src, tag, t.inbound_seq[src],
                          timeout_budget());
     if (!note) {
@@ -659,34 +843,35 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     }
     t.inbound_seq[src]++;
     const uint8_t* data = rx_.data(note->index);
-    uint64_t got = note->bytes;
-    std::vector<uint8_t> dec;
-    if (note->compressed) {
-      dec.resize(got << a.ratio_log);
-      decompress_f16_f32(data, dec.data(), got);
-      data = dec.data();
-      got = dec.size();
-    }
-    if (got != chunk) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
-    uint64_t n = std::min(got, chunk);
+    // interpret the arriving bytes via OUR OWN flag algebra — the
+    // reference eth header carries no compressed marker; each end derives
+    // the wire representation from its arithcfg + ETH flag, which is what
+    // makes directional pairs (f16 sender / f32+compress receiver) agree
+    bool got_c = wire_c;
+    uint64_t got_elems = note->bytes / std::max<uint64_t>(1, d.eb(got_c));
+    if (got_elems != chunk) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
+    uint64_t n = std::min(got_elems, chunk);
     switch (mode) {
       case RecvMode::COPY: {
         std::lock_guard<std::mutex> g(mem_mu_);
-        std::memcpy(mem(addr + off, n), data, n);
+        uint8_t* dst = mem(addr + off * d.eb(dst_c), n * d.eb(dst_c));
+        convert_elems(d, data, got_c, dst, dst_c, n);
         break;
       }
       case RecvMode::REDUCE: {
-        const ArithCfgN& ac = arith_for(c);
-        uint32_t lane = c.function() < ac.lanes.size()
-                            ? ac.lanes[c.function()]
-                            : uint32_t(NUM_LANES);
+        // fused recv-reduce: the wire payload is OP1, the accumulator at
+        // addr is OP0 and RES (mixed-precision accumulate per arithcfg;
+        // ETH>>2 -> OP1_COMPRESSED shifting, fw :1953-1955)
         std::lock_guard<std::mutex> g(mem_mu_);
-        uint8_t* d = mem(addr + off, n);
-        sticky_err_ |= run_reduce_lane(lane, d, data, d, n);
+        uint8_t* acc = mem(addr + off * d.eb(dst_c), n * d.eb(dst_c));
+        reduce_mixed(c, acc, dst_c, data, got_c, acc, dst_c, n);
         break;
       }
       case RecvMode::STREAM: {
-        stream_for(strm)->push(std::vector<uint8_t>(data, data + n));
+        // compute streams carry the uncompressed representation
+        std::vector<uint8_t> out(n * d.ub);
+        if (convert_elems(d, data, got_c, out.data(), false, n) == OK)
+          stream_for(strm)->push(std::move(out));
         break;
       }
     }
@@ -703,12 +888,22 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
 // rendezvous protocol primitives (fw :142-350; SURVEY §3.5)
 // ---------------------------------------------------------------------------
 void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
-                             uint32_t tag, uint64_t addr, uint64_t bytes) {
+                             uint32_t tag, uint64_t addr, uint64_t elems,
+                             bool dst_c) {
   CommTable& t = comms_[c.comm()];
+  Dom d = dom(c);
   if (p.pending()) {
+    // record the wire->landing conversion the depacketizer must apply
+    // when the peer's one-sided write arrives; both peers derive the
+    // wire representation from their own arithcfg + ETH flag
+    {
+      std::lock_guard<std::mutex> g(posted_mu_);
+      posted_[PostedKey{c.comm(), src, tag, addr}] =
+          PostedRndzv{elems, d.eth, dst_c && d.pair, d.comp_kind};
+    }
     // advertise our landing address to the sender (RNDZVS_INIT)
     Message msg;
-    msg.hdr.count = uint32_t(bytes);
+    msg.hdr.count = uint32_t(elems);
     msg.hdr.tag = tag;
     msg.hdr.src = t.local;
     msg.hdr.vaddr = addr;
@@ -734,14 +929,16 @@ void Engine::rndzv_wait_done(CallDesc& c, Progress& p, uint32_t src,
 }
 
 void Engine::rndzv_recv(CallDesc& c, Progress& p, uint32_t src, uint32_t tag,
-                        uint64_t addr, uint64_t bytes) {
-  rndzv_post_addr(c, p, src, tag, addr, bytes);
+                        uint64_t addr, uint64_t elems, bool dst_c) {
+  rndzv_post_addr(c, p, src, tag, addr, elems, dst_c);
   rndzv_wait_done(c, p, src, tag);
 }
 
 void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
-                        uint64_t addr, uint64_t bytes) {
+                        uint64_t addr, uint64_t elems, bool src_c) {
   CommTable& t = comms_[c.comm()];
+  Dom d = dom(c);
+  src_c = src_c && d.pair;
   if (p.pending()) {
     // step: match the receiver's advertised address, then issue the
     // one-sided write (single step so the INIT can't be consumed twice)
@@ -752,18 +949,30 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
         milliseconds(2));
     if (!a) throw NotReadyEx{c.current_step};
     Message msg;
-    msg.hdr.count = uint32_t(bytes);
     msg.hdr.tag = tag;
     msg.hdr.src = t.local;
     msg.hdr.vaddr = a->vaddr;
     msg.hdr.msg_type = uint8_t(MsgType::RndzvsMsg);
     msg.hdr.comm_id = c.comm();
     {
+      // convert the operand into OUR wire representation (own arithcfg +
+      // ETH flag, same rule as eager); the receiver's depacketizer
+      // applies its own wire->landing conversion on arrival — this is
+      // the ETH-compressed rendezvous the reference leaves as a TODO
       std::lock_guard<std::mutex> g(mem_mu_);
-      uint8_t* pdata = mem(addr, bytes);
-      msg.payload.assign(pdata, pdata + bytes);
+      uint8_t* pdata = mem(addr, elems * d.eb(src_c));
+      msg.payload.resize(elems * d.eb(d.eth));
+      // on conversion failure (unknown compressor lane) fall through to
+      // p.done() with the sticky error set and no wire message — an
+      // early return here would desynchronize the schedule's resume
+      // cursor after the RNDZVS_INIT was already consumed
+      convert_elems(d, pdata, src_c, msg.payload.data(), d.eth, elems);
+      msg.hdr.compressed = d.eth ? 1 : 0;
     }
-    send_out(t.rows[dst].session, std::move(msg));
+    if (sticky_err_ == 0) {
+      msg.hdr.count = uint32_t(msg.payload.size());
+      send_out(t.rows[dst].session, std::move(msg));
+    }
   }
   p.done();
 }
@@ -772,27 +981,29 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
 // collective schedules
 // ---------------------------------------------------------------------------
 void Engine::coll_send(CallDesc& c, Progress& p) {
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint64_t elems = c.count();
   uint32_t dst = c.root_src_dst();
+  uint32_t comp = c.compression();
   bool from_stream = c.stream_flags() & 0x1;  // OP0_STREAM
   uint32_t to_strm =
       (c.stream_flags() & 0x2) ? c.tag() : 0;  // RES_STREAM: remote stream
-  if (use_rendezvous(c, bytes)) {
-    rndzv_send(c, p, dst, c.tag(), c.addr0(), bytes);
+  if (use_rendezvous(c, elems)) {
+    rndzv_send(c, p, dst, c.tag(), c.addr0(), elems, comp & OP0_COMPRESSED);
   } else {
-    send_eager(c, dst, c.tag(), c.addr0(), bytes, from_stream, to_strm);
+    send_eager(c, dst, c.tag(), c.addr0(), elems, from_stream, to_strm, comp);
   }
 }
 
 void Engine::coll_recv(CallDesc& c, Progress& p) {
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint64_t elems = c.count();
   uint32_t src = c.root_src_dst();
-  if (use_rendezvous(c, bytes)) {
-    rndzv_recv(c, p, src, c.tag(), c.addr2(), bytes);
+  uint32_t comp = c.compression();
+  if (use_rendezvous(c, elems)) {
+    rndzv_recv(c, p, src, c.tag(), c.addr2(), elems, comp & RES_COMPRESSED);
   } else {
     RecvMode mode =
         (c.stream_flags() & 0x2) ? RecvMode::STREAM : RecvMode::COPY;
-    recv_eager(c, src, c.tag(), c.addr2(), bytes, mode, c.tag());
+    recv_eager(c, src, c.tag(), c.addr2(), elems, mode, c.tag(), comp);
   }
 }
 
@@ -801,26 +1012,31 @@ void Engine::coll_recv(CallDesc& c, Progress& p) {
 // (threshold = BCAST_FLAT_TREE_MAX_RANKS tuning register).
 void Engine::coll_bcast(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  uint64_t elems = c.count();
   uint32_t root = c.root_src_dst();
+  uint32_t comp = c.compression();
   if (t.size <= 1) return;
-  if (use_rendezvous(c, bytes)) {
+  if (use_rendezvous(c, elems)) {
     if (t.size > bcast_flat_max_ranks_) {
       tree_bcast(c, p, root, t.local == root ? c.addr0() : 0, c.addr2(),
-                 bytes);
+                 elems, comp & OP0_COMPRESSED, comp & RES_COMPRESSED);
     } else if (t.local == root) {
       for (uint32_t r = 0; r < t.size; ++r)
-        if (r != root) rndzv_send(c, p, r, c.tag(), c.addr0(), bytes);
+        if (r != root)
+          rndzv_send(c, p, r, c.tag(), c.addr0(), elems,
+                     comp & OP0_COMPRESSED);
     } else {
-      rndzv_recv(c, p, root, c.tag(), c.addr2(), bytes);
+      rndzv_recv(c, p, root, c.tag(), c.addr2(), elems,
+                 comp & RES_COMPRESSED);
     }
     return;
   }
   if (t.local == root) {
     for (uint32_t r = 0; r < t.size; ++r)
-      if (r != root) send_eager(c, r, c.tag(), c.addr0(), bytes, false, 0);
+      if (r != root)
+        send_eager(c, r, c.tag(), c.addr0(), elems, false, 0, comp);
   } else {
-    recv_eager(c, root, c.tag(), c.addr2(), bytes, RecvMode::COPY, 0);
+    recv_eager(c, root, c.tag(), c.addr2(), elems, RecvMode::COPY, 0, comp);
   }
 }
 
@@ -828,24 +1044,29 @@ void Engine::coll_bcast(CallDesc& c, Progress& p) {
 // MOVE_INCREMENT addressing, fw :1082-1124), local chunk copied in place.
 void Engine::coll_scatter(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  Dom d = dom(c);
+  uint64_t elems = c.count();
   uint32_t root = c.root_src_dst();
+  uint32_t comp = c.compression();
   if (t.local == root) {
+    // source slices stride in the OP0 representation (MOVE_INCREMENT
+    // addressing over the operand's own element width, fw :1082-1124)
+    uint64_t src_stride = elems * d.eb(d.op0);
     for (uint32_t r = 0; r < t.size; ++r) {
-      uint64_t src = c.addr0() + uint64_t(r) * bytes;
+      uint64_t src = c.addr0() + uint64_t(r) * src_stride;
       if (r == root) {
-        local_copy(src, c.addr2(), bytes);
-      } else if (use_rendezvous(c, bytes)) {
-        rndzv_send(c, p, r, c.tag(), src, bytes);
+        local_move(c, src, c.addr2(), elems, d.op0, d.res);
+      } else if (use_rendezvous(c, elems)) {
+        rndzv_send(c, p, r, c.tag(), src, elems, d.op0);
       } else {
-        send_eager(c, r, c.tag(), src, bytes, false, 0);
+        send_eager(c, r, c.tag(), src, elems, false, 0, comp);
       }
     }
   } else {
-    if (use_rendezvous(c, bytes))
-      rndzv_recv(c, p, root, c.tag(), c.addr2(), bytes);
+    if (use_rendezvous(c, elems))
+      rndzv_recv(c, p, root, c.tag(), c.addr2(), elems, d.res);
     else
-      recv_eager(c, root, c.tag(), c.addr2(), bytes, RecvMode::COPY, 0);
+      recv_eager(c, root, c.tag(), c.addr2(), elems, RecvMode::COPY, 0, comp);
   }
 }
 
@@ -855,77 +1076,99 @@ void Engine::coll_scatter(CallDesc& c, Progress& p) {
 // with the tuning milestone, fw :1163).
 void Engine::coll_gather(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  Dom d = dom(c);
+  uint64_t elems = c.count();
   uint32_t root = c.root_src_dst();
+  uint32_t comp = c.compression();
   uint32_t P = t.size;
+  uint64_t res_stride = elems * d.eb(d.res);
   if (P == 1) {
-    local_copy(c.addr0(), c.addr2(), bytes);
+    local_move(c, c.addr0(), c.addr2(), elems, d.op0, d.res);
     return;
   }
-  bool rndzv = use_rendezvous(c, bytes);
-  uint32_t d = (t.local + P - root) % P;  // distance to root along ring
+  bool rndzv = use_rendezvous(c, elems);
+  uint32_t dist = (t.local + P - root) % P;  // distance to root along ring
   if (rndzv) {
     // flat tree with out-of-order address arrival (fw :1011-1081 shape):
     // the root publishes landing addresses in windows of at most
     // GATHER_FLAT_TREE_MAX_FANIN (fw :1163) and collects completions in
     // whatever order the writes land
     if (t.local == root) {
-      local_copy(c.addr0(), c.addr2() + uint64_t(root) * bytes, bytes);
+      local_move(c, c.addr0(), c.addr2() + uint64_t(root) * res_stride,
+                 elems, d.op0, d.res);
       uint32_t i = 1;
       while (i < P) {
         uint32_t w = std::min(gather_flat_max_fanin_, P - i);
         for (uint32_t j = 0; j < w; ++j) {
           uint32_t r = (root + i + j) % P;
-          rndzv_post_addr(c, p, r, c.tag(), c.addr2() + uint64_t(r) * bytes,
-                          bytes);
+          rndzv_post_addr(c, p, r, c.tag(),
+                          c.addr2() + uint64_t(r) * res_stride, elems, d.res);
         }
         for (uint32_t j = 0; j < w; ++j)
           rndzv_wait_done(c, p, (root + i + j) % P, c.tag());
         i += w;
       }
     } else {
-      rndzv_send(c, p, root, c.tag(), c.addr0(), bytes);
+      rndzv_send(c, p, root, c.tag(), c.addr0(), elems, d.op0);
     }
     return;
   }
   if (t.local == root) {
-    local_copy(c.addr0(), c.addr2() + uint64_t(root) * bytes, bytes);
+    local_move(c, c.addr0(), c.addr2() + uint64_t(root) * res_stride, elems,
+               d.op0, d.res);
     uint32_t next = (t.local + 1) % P;
     for (uint32_t i = 0; i < P - 1; ++i) {
       uint32_t origin = (root + 1 + i) % P;
-      recv_eager(c, next, c.tag(), c.addr2() + uint64_t(origin) * bytes,
-                 bytes, RecvMode::COPY, 0);
+      recv_eager(c, next, c.tag(), c.addr2() + uint64_t(origin) * res_stride,
+                 elems, RecvMode::COPY, 0, comp);
     }
   } else {
     uint32_t prev = (t.local + P - 1) % P;
     uint32_t next = (t.local + 1) % P;
-    send_eager(c, prev, c.tag(), c.addr0(), bytes, false, 0);
-    // relay the blocks of everyone farther from the root through scratch
-    uint64_t tmp = alloc(bytes, 64);
-    for (uint32_t i = 0; i < P - 1 - d; ++i) {
-      recv_eager(c, next, c.tag(), tmp, bytes, RecvMode::COPY, 0);
-      send_eager(c, prev, c.tag(), tmp, bytes, false, 0);
+    send_eager(c, prev, c.tag(), c.addr0(), elems, false, 0, comp);
+    // relay the blocks of everyone farther from the root through an
+    // uncompressed scratch staging buffer (wire -> u -> wire)
+    uint64_t tmp = alloc(elems * d.ub, 64);
+    // the scratch is uncompressed on both sides of the relay, so only
+    // the wire bit survives the hop
+    uint32_t relay = comp & ETH_COMPRESSED;
+    for (uint32_t i = 0; i < P - 1 - dist; ++i) {
+      recv_eager(c, next, c.tag(), tmp, elems, RecvMode::COPY, 0,
+                 comp & ~uint32_t(RES_COMPRESSED));
+      send_eager(c, prev, c.tag(), tmp, elems, false, 0, relay);
     }
     free_addr(tmp);
   }
 }
 
 // All-gather: ring relay with a local self-copy first (fw :1404-1502).
+// The relay operates on result-buffer slices, so sends read the RES
+// representation (RES->OP0 relay algebra, fw :1408-1411).
 void Engine::coll_allgather(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  Dom d = dom(c);
+  uint64_t elems = c.count();
+  uint32_t comp = c.compression();
   uint32_t P = t.size;
-  local_copy(c.addr0(), c.addr2() + uint64_t(t.local) * bytes, bytes);
+  uint64_t res_stride = elems * d.eb(d.res);
+  local_move(c, c.addr0(), c.addr2() + uint64_t(t.local) * res_stride, elems,
+             d.op0, d.res);
   if (P == 1) return;
   uint32_t next = (t.local + 1) % P;
   uint32_t prev = (t.local + P - 1) % P;
+  // sends read result-buffer slices, so their OP0 domain is the call's
+  // RES bit (fw :1408-1411 relay algebra applied to the slice source)
+  uint32_t send_comp = (d.res ? uint32_t(OP0_COMPRESSED) : 0u)
+                       | (comp & ETH_COMPRESSED);
   for (uint32_t s = 0; s < P - 1; ++s) {
     uint32_t send_origin = (t.local + P - s) % P;
     uint32_t recv_origin = (t.local + P - 1 - s) % P;
-    send_eager(c, next, c.tag(), c.addr2() + uint64_t(send_origin) * bytes,
-               bytes, false, 0);
-    recv_eager(c, prev, c.tag(), c.addr2() + uint64_t(recv_origin) * bytes,
-               bytes, RecvMode::COPY, 0);
+    send_eager(c, next, c.tag(),
+               c.addr2() + uint64_t(send_origin) * res_stride, elems, false,
+               0, send_comp);
+    recv_eager(c, prev, c.tag(),
+               c.addr2() + uint64_t(recv_origin) * res_stride, elems,
+               RecvMode::COPY, 0, comp);
   }
 }
 
@@ -935,8 +1178,11 @@ void Engine::coll_allgather(CallDesc& c, Progress& p) {
 // (fw :1603-1728).
 void Engine::coll_reduce(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  Dom d = dom(c);
+  uint64_t elems = c.count();
+  uint64_t bytes = elems * d.ub;  // scratch/stream staging is uncompressed
   uint32_t root = c.root_src_dst();
+  uint32_t comp = c.compression();
   uint32_t P = t.size;
   // mem<->stream reduce variants (reference: test.cpp:813-910): a
   // streamed operand is materialized from the kernel stream into a
@@ -950,49 +1196,61 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   // scratch leases live in the descriptor so execute() frees them on
   // every exit path (stream-flagged calls never reach the rendezvous
   // schedules, which use the same lease slots)
+  // operand/result domains: scratch staging (streams) is uncompressed
+  bool op_c = d.op0;
+  bool res_c = d.res;
   if (op_stream) {
     if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
     if (!drain_krnl_to(c.scratch0, bytes)) return;
     op_addr = c.scratch0;
+    op_c = false;
   }
   if (res_stream && is_root) {
     if (!c.scratch1) c.scratch1 = alloc(bytes, 64);
     res_addr = c.scratch1;
+    res_c = false;
   }
   if (P == 1) {
-    local_copy(op_addr, res_addr, bytes);
+    local_move(c, op_addr, res_addr, elems, op_c, res_c);
     if (res_stream && is_root && sticky_err_ == 0)
       push_local_stream(c.tag(), res_addr, bytes);
     return;
   }
-  if (use_rendezvous(c, bytes)) {
-    const ArithCfgN& a = arith_for(c);
-    uint32_t lane = c.function() < a.lanes.size() ? a.lanes[c.function()]
-                                                  : uint32_t(NUM_LANES);
+  if (use_rendezvous(c, elems)) {
+    // stream-flagged calls never reach rendezvous (use_rendezvous forces
+    // eager for them), so the scratch slots are free for the schedules
     if (P <= reduce_flat_max_ranks_) {
       // flat: root accumulates every contribution through one scratchpad
       if (t.local == root) {
         if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
-        step_local(p, [&] { local_copy(c.addr0(), c.addr2(), bytes); });
+        step_local(p, [&] {
+          local_move(c, c.addr0(), c.addr2(), elems, d.op0, d.res);
+        });
         for (uint32_t i = 1; i < P; ++i) {
-          rndzv_recv(c, p, (root + i) % P, c.tag(), c.scratch0, bytes);
+          rndzv_recv(c, p, (root + i) % P, c.tag(), c.scratch0, elems, false);
           step_local(p, [&] {
-            local_reduce(lane, c.addr2(), c.scratch0, c.addr2(), bytes);
+            std::lock_guard<std::mutex> g(mem_mu_);
+            uint8_t* acc = mem(c.addr2(), elems * d.eb(d.res));
+            uint8_t* tmp = mem(c.scratch0, bytes);
+            reduce_mixed(c, acc, d.res, tmp, false, acc, d.res, elems);
           });
         }
       } else {
-        rndzv_send(c, p, root, c.tag(), c.addr0(), bytes);
+        rndzv_send(c, p, root, c.tag(), c.addr0(), elems, d.op0);
       }
     } else {
       // binomial tree: root accumulates in the result buffer, interior
-      // nodes in a scratch lease; every receiver needs a landing pad
+      // nodes in an uncompressed scratch lease; every receiver needs a
+      // landing pad
       uint64_t acc = t.local == root ? c.addr2() : 0;
+      bool acc_c = t.local == root ? d.res : false;
       if (t.local != root) {
         if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
         acc = c.scratch0;
       }
       if (!c.scratch1) c.scratch1 = alloc(bytes, 64);
-      tree_reduce(c, p, root, c.addr0(), acc, c.scratch1, bytes);
+      tree_reduce(c, p, root, c.addr0(), acc, c.scratch1, elems, d.op0,
+                  acc_c);
     }
     return;
   }
@@ -1001,18 +1259,23 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   uint32_t prev = (t.local + P - 1) % P;
   if (pos == 1) {
     // head of the chain: just forward our contribution
-    send_eager(c, next, c.tag(), op_addr, bytes, false, 0);
+    send_eager(c, next, c.tag(), op_addr, elems, false, 0,
+               (op_c ? uint32_t(OP0_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED));
   } else if (pos != 0) {
-    // interior: receive partial, fold our contribution, forward
+    // interior: receive partial, fold our contribution, forward through
+    // an uncompressed scratch accumulator
     uint64_t tmp = alloc(bytes, 64);
-    local_copy(op_addr, tmp, bytes);
-    recv_eager(c, prev, c.tag(), tmp, bytes, RecvMode::REDUCE, 0);
-    send_eager(c, next, c.tag(), tmp, bytes, false, 0);
+    local_move(c, op_addr, tmp, elems, op_c, false);
+    recv_eager(c, prev, c.tag(), tmp, elems, RecvMode::REDUCE, 0,
+               comp & ETH_COMPRESSED);
+    send_eager(c, next, c.tag(), tmp, elems, false, 0,
+               comp & ETH_COMPRESSED);
     free_addr(tmp);
   } else {
     // root: receive the chain's partial, fold our contribution into res
-    local_copy(op_addr, res_addr, bytes);
-    recv_eager(c, prev, c.tag(), res_addr, bytes, RecvMode::REDUCE, 0);
+    local_move(c, op_addr, res_addr, elems, op_c, res_c);
+    recv_eager(c, prev, c.tag(), res_addr, elems, RecvMode::REDUCE, 0,
+               (res_c ? uint32_t(RES_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED));
     // deliver to the compute stream only on success — a consumer must
     // not be handed a correctly-sized but partially-reduced payload
     if (res_stream && sticky_err_ == 0)
@@ -1028,94 +1291,118 @@ void Engine::ring_reduce_scatter(CallDesc& c, uint64_t src_base,
                                  const std::vector<uint64_t>& len,
                                  uint64_t own_dst) {
   const CommTable& t = comm_for(c);
+  Dom d = dom(c);
+  uint32_t comp = c.compression();
   uint32_t P = t.size;
   uint32_t r = t.local;
   uint32_t next = (r + 1) % P;
   uint32_t prev = (r + P - 1) % P;
   if (P == 1) {
-    local_copy(src_base + off[0], own_dst, len[0]);
+    local_move(c, src_base + off[0] * d.eb(d.op0), own_dst, len[0], d.op0,
+               d.res);
     return;
   }
   uint32_t first = (r + P - 1) % P;
-  send_eager(c, next, c.tag(), src_base + off[first], len[first], false, 0);
+  // per-step algebra (fw :1929-1955): sends keep OP0, replace RES by the
+  // wire bit; the fused recv-reduce takes the wire payload as OP1
+  send_eager(c, next, c.tag(), src_base + off[first] * d.eb(d.op0),
+             len[first], false, 0,
+             (d.op0 ? uint32_t(OP0_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED));
   uint64_t maxlen = *std::max_element(len.begin(), len.end());
-  uint64_t tmp = alloc(std::max<uint64_t>(maxlen, 64), 64);
+  uint64_t tmp = alloc(std::max<uint64_t>(maxlen * d.ub, 64), 64);
   for (uint32_t s = 1; s <= P - 1; ++s) {
     // chunk index arriving this step: (r - 1 - s) mod P
     uint32_t chunk =
         uint32_t(((int64_t(r) - 1 - int64_t(s)) % int64_t(P) + P) % P);
-    local_copy(src_base + off[chunk], tmp, len[chunk]);
-    recv_eager(c, prev, c.tag(), tmp, len[chunk], RecvMode::REDUCE, 0);
+    // stage our contribution uncompressed, fold the wire partial in
+    local_move(c, src_base + off[chunk] * d.eb(d.op0), tmp, len[chunk],
+               d.op0, false);
+    recv_eager(c, prev, c.tag(), tmp, len[chunk], RecvMode::REDUCE, 0,
+               comp & ETH_COMPRESSED);
     if (chunk == r) {
-      local_copy(tmp, own_dst, len[chunk]);
+      local_move(c, tmp, own_dst, len[chunk], false, d.res);
     } else {
-      send_eager(c, next, c.tag(), tmp, len[chunk], false, 0);
+      send_eager(c, next, c.tag(), tmp, len[chunk], false, 0,
+                 comp & ETH_COMPRESSED);
     }
   }
   free_addr(tmp);
 }
 
-// Ring all-gather over chunks already resident in dst (fw :1990-2066).
+// Ring all-gather over chunks already resident in dst (fw :1990-2066);
+// slices live in the RES representation throughout.
 void Engine::ring_allgather(CallDesc& c, uint64_t base,
                             const std::vector<uint64_t>& off,
                             const std::vector<uint64_t>& len) {
   const CommTable& t = comm_for(c);
+  Dom d = dom(c);
+  uint32_t comp = c.compression();
   uint32_t P = t.size;
   uint32_t r = t.local;
   if (P == 1) return;
   uint32_t next = (r + 1) % P;
   uint32_t prev = (r + P - 1) % P;
+  // slices live in the RES representation; sends treat that as OP0
+  uint32_t send_comp = (d.res ? uint32_t(OP0_COMPRESSED) : 0u)
+                       | (comp & ETH_COMPRESSED);
   for (uint32_t s = 0; s < P - 1; ++s) {
     uint32_t send_chunk = uint32_t(((int64_t(r) - int64_t(s)) % int64_t(P) + P) % P);
     uint32_t recv_chunk = uint32_t(((int64_t(r) - 1 - int64_t(s)) % int64_t(P) + P) % P);
-    send_eager(c, next, c.tag(), base + off[send_chunk], len[send_chunk],
-               false, 0);
-    recv_eager(c, prev, c.tag(), base + off[recv_chunk], len[recv_chunk],
-               RecvMode::COPY, 0);
+    send_eager(c, next, c.tag(), base + off[send_chunk] * d.eb(d.res),
+               len[send_chunk], false, 0, send_comp);
+    recv_eager(c, prev, c.tag(), base + off[recv_chunk] * d.eb(d.res),
+               len[recv_chunk], RecvMode::COPY, 0, comp);
   }
 }
 
 void Engine::coll_reduce_scatter(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);  // per-rank result
+  Dom d = dom(c);
+  uint64_t elems = c.count();  // per-rank result elements
   uint32_t P = t.size;
-  if (P > 1 && use_rendezvous(c, bytes * P)) {
-    // rendezvous: tree-reduce the whole vector to rank 0, then scatter
-    // the slices (fw :1768-1781 reduce-to-0 + scatter)
-    uint64_t total = bytes * P;
-    if (!c.scratch0) c.scratch0 = alloc(total, 64);
-    if (!c.scratch1) c.scratch1 = alloc(total, 64);
-    tree_reduce(c, p, 0, c.addr0(), c.scratch0, c.scratch1, total);
+  if (P > 1 && use_rendezvous(c, elems * P)) {
+    // rendezvous: tree-reduce the whole vector to rank 0 through
+    // uncompressed scratch, then scatter the slices
+    // (fw :1768-1781 reduce-to-0 + scatter)
+    uint64_t total_u = elems * P * d.ub;
+    if (!c.scratch0) c.scratch0 = alloc(total_u, 64);
+    if (!c.scratch1) c.scratch1 = alloc(total_u, 64);
+    tree_reduce(c, p, 0, c.addr0(), c.scratch0, c.scratch1, elems * P,
+                d.op0, false);
     if (t.local == 0) {
-      step_local(p, [&] { local_copy(c.scratch0, c.addr2(), bytes); });
+      step_local(p, [&] {
+        local_move(c, c.scratch0, c.addr2(), elems, false, d.res);
+      });
       for (uint32_t r = 1; r < P; ++r)
-        rndzv_send(c, p, r, c.tag(), c.scratch0 + uint64_t(r) * bytes, bytes);
+        rndzv_send(c, p, r, c.tag(), c.scratch0 + uint64_t(r) * elems * d.ub,
+                   elems, false);
     } else {
-      rndzv_recv(c, p, 0, c.tag(), c.addr2(), bytes);
+      rndzv_recv(c, p, 0, c.tag(), c.addr2(), elems, d.res);
     }
     return;
   }
-  std::vector<uint64_t> off(P), len(P, bytes);
-  for (uint32_t i = 0; i < P; ++i) off[i] = uint64_t(i) * bytes;
+  std::vector<uint64_t> off(P), len(P, elems);
+  for (uint32_t i = 0; i < P; ++i) off[i] = uint64_t(i) * elems;
   ring_reduce_scatter(c, c.addr0(), off, len, c.addr2());
 }
 
 void Engine::coll_allreduce(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
+  Dom d = dom(c);
   uint32_t P = t.size;
-  uint64_t eb = elem_bytes(c);
   uint64_t total = uint64_t(c.count());
   if (P == 1) {
-    local_copy(c.addr0(), c.addr2(), total * eb);
+    local_move(c, c.addr0(), c.addr2(), total, d.op0, d.res);
     return;
   }
-  if (use_rendezvous(c, total * eb)) {
+  if (use_rendezvous(c, total)) {
     // rendezvous: tree reduce to rank 0 accumulating directly in every
     // rank's result buffer, then tree broadcast the final value
     // (fw :1878-1887 reduce-then-bcast)
-    if (!c.scratch0) c.scratch0 = alloc(total * eb, 64);
-    tree_reduce(c, p, 0, c.addr0(), c.addr2(), c.scratch0, total * eb);
-    tree_bcast(c, p, 0, c.addr2(), c.addr2(), total * eb);
+    if (!c.scratch0) c.scratch0 = alloc(total * d.ub, 64);
+    tree_reduce(c, p, 0, c.addr0(), c.addr2(), c.scratch0, total, d.op0,
+                d.res);
+    tree_bcast(c, p, 0, c.addr2(), c.addr2(), total, d.res, d.res);
     return;
   }
   // chunk the element range across ranks (bulk/tail split for ragged
@@ -1124,11 +1411,12 @@ void Engine::coll_allreduce(CallDesc& c, Progress& p) {
   uint64_t base_elems = total / P, extra = total % P, cursor = 0;
   for (uint32_t i = 0; i < P; ++i) {
     uint64_t e = base_elems + (i < extra ? 1 : 0);
-    off[i] = cursor * eb;
-    len[i] = e * eb;
+    off[i] = cursor;
+    len[i] = e;
     cursor += e;
   }
-  ring_reduce_scatter(c, c.addr0(), off, len, c.addr2() + off[t.local]);
+  ring_reduce_scatter(c, c.addr0(), off, len,
+                      c.addr2() + off[t.local] * d.eb(d.res));
   ring_allgather(c, c.addr2(), off, len);
 }
 
@@ -1138,23 +1426,28 @@ void Engine::coll_allreduce(CallDesc& c, Progress& p) {
 // reference's fused simultaneous flat trees :2123-2218).
 void Engine::coll_alltoall(CallDesc& c, Progress& p) {
   const CommTable& t = comm_for(c);
-  uint64_t bytes = uint64_t(c.count()) * elem_bytes(c);
+  Dom d = dom(c);
+  uint64_t elems = c.count();
+  uint32_t comp = c.compression();
   uint32_t P = t.size;
-  local_copy(c.addr0() + uint64_t(t.local) * bytes,
-             c.addr2() + uint64_t(t.local) * bytes, bytes);
-  bool rndzv = use_rendezvous(c, bytes);
+  uint64_t op_stride = elems * d.eb(d.op0);
+  uint64_t res_stride = elems * d.eb(d.res);
+  local_move(c, c.addr0() + uint64_t(t.local) * op_stride,
+             c.addr2() + uint64_t(t.local) * res_stride, elems, d.op0, d.res);
+  bool rndzv = use_rendezvous(c, elems);
   if (rndzv) {
     // fused simultaneous flat trees (fw :2123-2218): publish all landing
     // addresses, write as peer addresses arrive (out of order), then
     // drain completions
     for (uint32_t i = 1; i < P; ++i) {
       uint32_t r = (t.local + P - i) % P;
-      rndzv_post_addr(c, p, r, c.tag(), c.addr2() + uint64_t(r) * bytes,
-                      bytes);
+      rndzv_post_addr(c, p, r, c.tag(),
+                      c.addr2() + uint64_t(r) * res_stride, elems, d.res);
     }
     for (uint32_t i = 1; i < P; ++i) {
       uint32_t r = (t.local + i) % P;
-      rndzv_send(c, p, r, c.tag(), c.addr0() + uint64_t(r) * bytes, bytes);
+      rndzv_send(c, p, r, c.tag(), c.addr0() + uint64_t(r) * op_stride,
+                 elems, d.op0);
     }
     for (uint32_t i = 1; i < P; ++i)
       rndzv_wait_done(c, p, (t.local + P - i) % P, c.tag());
@@ -1162,13 +1455,13 @@ void Engine::coll_alltoall(CallDesc& c, Progress& p) {
   }
   for (uint32_t i = 1; i < P; ++i) {
     uint32_t r = (t.local + i) % P;
-    send_eager(c, r, c.tag(), c.addr0() + uint64_t(r) * bytes, bytes, false,
-               0);
+    send_eager(c, r, c.tag(), c.addr0() + uint64_t(r) * op_stride, elems,
+               false, 0, comp);
   }
   for (uint32_t i = 1; i < P; ++i) {
     uint32_t r = (t.local + P - i) % P;
-    recv_eager(c, r, c.tag(), c.addr2() + uint64_t(r) * bytes, bytes,
-               RecvMode::COPY, 0);
+    recv_eager(c, r, c.tag(), c.addr2() + uint64_t(r) * res_stride, elems,
+               RecvMode::COPY, 0, comp);
   }
 }
 
@@ -1179,12 +1472,12 @@ void Engine::coll_barrier(CallDesc& c, Progress& p) {
   if (P == 1) return;
   if (t.local == 0) {
     for (uint32_t r = 1; r < P; ++r)
-      recv_eager(c, r, BARRIER_TAG, 0, 0, RecvMode::COPY, 0);
+      recv_eager(c, r, BARRIER_TAG, 0, 0, RecvMode::COPY, 0, 0);
     for (uint32_t r = 1; r < P; ++r)
-      send_eager(c, r, BARRIER_TAG, 0, 0, false, 0);
+      send_eager(c, r, BARRIER_TAG, 0, 0, false, 0, 0);
   } else {
-    send_eager(c, 0, BARRIER_TAG, 0, 0, false, 0);
-    recv_eager(c, 0, BARRIER_TAG, 0, 0, RecvMode::COPY, 0);
+    send_eager(c, 0, BARRIER_TAG, 0, 0, false, 0, 0);
+    recv_eager(c, 0, BARRIER_TAG, 0, 0, RecvMode::COPY, 0, 0);
   }
 }
 

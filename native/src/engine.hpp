@@ -43,7 +43,7 @@ struct ArithCfgN {
 struct RndzvAddr {
   uint32_t comm, src, tag;
   uint64_t vaddr;
-  uint64_t bytes;
+  uint64_t elems;
 };
 struct RndzvDone {
   uint32_t comm, src, tag;
@@ -118,28 +118,60 @@ class Engine {
   uint64_t elem_bytes(const CallDesc& c) const;
   std::chrono::nanoseconds timeout_budget() const;
 
-  // Eager segmented send of `bytes` from devicemem `addr` (or the kernel
-  // stream when from_stream), optionally fp16-compressing fp32 payloads
-  // on the wire (fw send :575-651).
+  // Per-call compression domains, decoded from the descriptor's
+  // compression flags + arithmetic config (the per-operand flag algebra
+  // of the reference, constants.hpp:320-325; per-step shifting
+  // ccl_offload_control.c:1408-1411, :1929-1955).  Every primitive below
+  // is element-based so each operand can carry its own representation.
+  struct Dom {
+    uint32_t ub = 4, cb = 4, ratio_log = 0;
+    uint32_t comp_kind = 0;       // compressor id (arithconfig.py)
+    bool pair = false;            // a real compressed representation exists
+    bool op0 = false, op1 = false, res = false, eth = false;
+    uint64_t eb(bool compressed) const { return compressed ? cb : ub; }
+  };
+  Dom dom(const CallDesc& c) const;
+
+  // Convert `elems` elements between representations (identity when the
+  // domains match); returns sticky error bits on unknown compressor.
+  uint32_t convert_elems(const Dom& d, const uint8_t* in, bool in_c,
+                         uint8_t* out, bool out_c, uint64_t elems);
+  // acc/op1/res each in their own domain; arithmetic runs in the domain
+  // selected by the arithcfg's arith_is_compressed (mixed-precision
+  // accumulate, reference arithconfig.hpp:106-119 {f32,f16} pair).
+  uint32_t reduce_mixed(const CallDesc& c, const uint8_t* a0, bool a0c,
+                        const uint8_t* a1, bool a1c, uint8_t* r, bool rc,
+                        uint64_t elems);
+
+  // Eager segmented send of `elems` elements from devicemem `addr` (or
+  // the kernel stream when from_stream).  comp bits: OP0_COMPRESSED =
+  // memory at addr holds the compressed representation; ETH_COMPRESSED =
+  // compress payloads on the wire (fw send :575-651).
   void send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
-                  uint64_t bytes, bool from_stream, uint32_t to_strm);
-  // Eager segmented receive into devicemem `addr`; mode selects plain
-  // copy, reduce-accumulate into dst (fused recv-reduce), or routing to a
-  // kernel stream (fw recv :655-712, fused_recv_reduce :718).
+                  uint64_t elems, bool from_stream, uint32_t to_strm,
+                  uint32_t comp);
+  // Eager segmented receive of `elems` elements into devicemem `addr`;
+  // mode selects plain copy, reduce-accumulate into dst (fused
+  // recv-reduce), or routing to a kernel stream.  comp bits:
+  // RES_COMPRESSED = the landing buffer (or accumulator) holds the
+  // compressed representation; ETH_COMPRESSED = segmentation follows the
+  // compressed wire width (fw recv :655-712, fused_recv_reduce :718).
   enum class RecvMode { COPY, REDUCE, STREAM };
   void recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
-                  uint64_t bytes, RecvMode mode, uint32_t strm);
+                  uint64_t elems, RecvMode mode, uint32_t strm, uint32_t comp);
 
-  // Rendezvous primitives (fw :142-350, rdma_sq_handler.cpp:53-130).
+  // Rendezvous primitives (fw :142-350, rdma_sq_handler.cpp:53-130),
+  // element-based: the receiver advertises its landing representation and
+  // the sender converts, so compressed operands ride rendezvous too.
   void rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src, uint32_t tag,
-                       uint64_t addr, uint64_t bytes);
+                       uint64_t addr, uint64_t elems, bool dst_c);
   void rndzv_wait_done(CallDesc& c, Progress& p, uint32_t src, uint32_t tag);
   void rndzv_recv(CallDesc& c, Progress& p, uint32_t src, uint32_t tag,
-                  uint64_t addr, uint64_t bytes);
+                  uint64_t addr, uint64_t elems, bool dst_c);
   void rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
-                  uint64_t addr, uint64_t bytes);
+                  uint64_t addr, uint64_t elems, bool src_c);
 
-  bool use_rendezvous(const CallDesc& c, uint64_t bytes);
+  bool use_rendezvous(const CallDesc& c, uint64_t elems);
 
   // Materialize a kernel-stream operand (OP0_STREAM) into device memory
   // so reduction schedules can treat it like a buffer operand.
@@ -149,8 +181,11 @@ class Engine {
   // Get-or-create the FIFO backing compute stream `strm`.
   std::shared_ptr<Fifo<std::vector<uint8_t>>> stream_for(uint32_t strm);
 
-  // local ops
+  // local ops — byte-based raw copy plus domain-aware element movers
+  // (the dma_mover's compressor/decompressor lane routing, SURVEY §2.4)
   uint32_t local_copy(uint64_t src, uint64_t dst, uint64_t bytes);
+  uint32_t local_move(const CallDesc& c, uint64_t src, uint64_t dst,
+                      uint64_t elems, bool src_c, bool dst_c);
   uint32_t local_reduce(uint32_t lane, uint64_t a, uint64_t b, uint64_t dst,
                         uint64_t bytes);
 
@@ -169,11 +204,14 @@ class Engine {
   void do_config(CallDesc& c);
 
   // binomial tree schedules for the rendezvous protocol (fw tree bcast
-  // :816-869, tree reduce :1603-1728); resume-safe via Progress
+  // :816-869, tree reduce :1603-1728); resume-safe via Progress.  Domain
+  // bits: src_c/dst_c/acc_c describe the representation of the caller's
+  // buffers (relays re-derive per the RES->OP0 algebra, fw :1408-1411).
   void tree_bcast(CallDesc& c, Progress& p, uint32_t root, uint64_t src_addr,
-                  uint64_t dst_addr, uint64_t bytes);
+                  uint64_t dst_addr, uint64_t elems, bool src_c, bool dst_c);
   void tree_reduce(CallDesc& c, Progress& p, uint32_t root, uint64_t src_addr,
-                   uint64_t acc_addr, uint64_t tmp_addr, uint64_t bytes);
+                   uint64_t acc_addr, uint64_t tmp_addr, uint64_t elems,
+                   bool src_c, bool acc_c);
   // a local op as one resumable step (local side effects must not replay
   // when a rendezvous retry re-enters the schedule)
   template <typename F>
@@ -182,7 +220,8 @@ class Engine {
     p.done();
   }
 
-  // ring schedule cores shared by reduce_scatter/allreduce (fw :1782-2071)
+  // ring schedule cores shared by reduce_scatter/allreduce (fw :1782-2071);
+  // off/len are in elements
   void ring_reduce_scatter(CallDesc& c, uint64_t src_base,
                            const std::vector<uint64_t>& off,
                            const std::vector<uint64_t>& len, uint64_t own_dst);
@@ -198,6 +237,23 @@ class Engine {
   std::map<uint64_t, uint64_t> free_spans_;   // addr -> size
   std::map<uint64_t, uint64_t> alloc_sizes_;  // addr -> size
   std::mutex mem_mu_;
+
+  // Landing-pad registry for one-sided writes: rndzv_post_addr records
+  // the conversion the depacketizer must apply when the peer's write
+  // lands (wire representation -> landing representation), keyed by
+  // (comm, src, tag, vaddr) so a stale entry from a failed transfer
+  // cannot be consumed by a later collective that reuses the address.
+  // Receiver-local state — the sender's header is never trusted for
+  // domain decisions, matching the eager path's own-flag-algebra
+  // discipline.
+  struct PostedRndzv {
+    uint64_t elems;
+    bool wire_c, lnd_c;
+    uint32_t comp_kind;
+  };
+  using PostedKey = std::tuple<uint32_t, uint32_t, uint32_t, uint64_t>;
+  std::map<PostedKey, PostedRndzv> posted_;
+  std::mutex posted_mu_;
 
   std::unique_ptr<Transport> transport_;
   //: pending one-shot egress fault (0 = none); see inject_fault()

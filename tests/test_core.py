@@ -70,13 +70,14 @@ def test_error_code_decode():
 
 def test_arithconfig_table_covers_reference_pairs():
     # identity pairs for the 5 reference dtypes + fp32-over-fp16
-    # compression (arithconfig.hpp:106-119), plus the bf16 identity pair
-    # (TPU extension)
+    # compression (arithconfig.hpp:106-119), plus the bf16 identity and
+    # fp32-over-bf16 compressed pairs (TPU extensions)
     pairs = set(DEFAULT_ARITH_CONFIG)
     assert (DataType.float32, DataType.float32) in pairs
     assert (DataType.float32, DataType.float16) in pairs
     assert (DataType.bfloat16, DataType.bfloat16) in pairs
-    assert len(pairs) == 7
+    assert (DataType.float32, DataType.bfloat16) in pairs
+    assert len(pairs) == 8
     cfg = DEFAULT_ARITH_CONFIG[(DataType.float32, DataType.float16)]
     assert cfg.compression_ratio == 2
     words = cfg.to_words()
