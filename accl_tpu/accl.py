@@ -670,7 +670,9 @@ class ACCL:
                 # config; ETH flag is set for descriptor fidelity but the
                 # ratio-0 config makes it a no-op in the engine
                 pair = (uncompressed, uncompressed)
-                arithcfg = self._arith_ids.get(pair, 0)
+                if pair not in self._arith_ids:
+                    raise ACCLError(f"unsupported dtype {uncompressed!r}")
+                arithcfg = self._arith_ids[pair]
                 compression = CompressionFlags.ETH_COMPRESSED
             else:
                 pair = (uncompressed, compress_dtype)

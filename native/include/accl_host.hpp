@@ -1,18 +1,21 @@
 // Native C++ host driver — the libaccl-equivalent API surface.
 //
 // Reference analog: class ACCL::ACCL and its buffer/communicator
-// surfaces (driver/xrt/include/accl.hpp:46-1148).  This facade drives
-// the native engine directly (no FFI), giving C++ applications the same
-// collectives the Python driver exposes; the Python layer is an
+// surfaces (driver/xrt/include/accl.hpp:46-1148, accl.cpp).  This facade
+// drives the native engine directly (no FFI), giving C++ applications
+// the same collectives the Python driver exposes: all 14 collectives +
+// nop, per-operand and wire compression (prepare_call flag algebra,
+// accl.cpp:1252-1372), compute-kernel streams, sub-communicators, and
+// async request handles.  The Python layer (accl_tpu/accl.py) is an
 // alternative binding over the same engine, not the implementation.
-//
-// Synchronous API: each call marshals the 15-word descriptor, starts it,
-// and blocks for the retcode (reference call_sync, accl.cpp:1404-1413).
 #pragma once
 
+#include <chrono>
 #include <cstring>
+#include <map>
 #include <memory>
 #include <stdexcept>
+#include <thread>
 #include <vector>
 
 #include "../src/engine.hpp"
@@ -22,11 +25,45 @@ namespace host {
 
 enum class Reduce : uint32_t { SUM = 0, MAX = 1 };
 
+// Wire/arithmetic datatypes (bit-compatible with accl_tpu/constants.py
+// DataType and the reference constants.hpp:254-262).
+enum class DType : uint32_t {
+  none = 0,
+  i8 = 1,
+  f16 = 2,
+  f32 = 3,
+  f64 = 4,
+  i32 = 5,
+  i64 = 6,
+  bf16 = 7,
+};
+
+inline uint32_t dtype_bits(DType d) {
+  switch (d) {
+    case DType::i8: return 8;
+    case DType::f16: case DType::bf16: return 16;
+    case DType::f32: case DType::i32: return 32;
+    case DType::f64: case DType::i64: return 64;
+    default: return 0;
+  }
+}
+
+template <typename T> struct dtype_of;
+template <> struct dtype_of<float> { static constexpr DType value = DType::f32; };
+template <> struct dtype_of<double> { static constexpr DType value = DType::f64; };
+template <> struct dtype_of<int32_t> { static constexpr DType value = DType::i32; };
+template <> struct dtype_of<int64_t> { static constexpr DType value = DType::i64; };
+// uint16_t carries raw fp16 bits (like the reference's half payloads)
+template <> struct dtype_of<uint16_t> { static constexpr DType value = DType::f16; };
+
 // Typed device buffer handle (reference: Buffer<T>, buffer.hpp:155).
+// The DType may differ from T's default when the host representation is
+// a bit-pattern carrier (e.g. Buffer<uint16_t> holding bf16).
 template <typename T>
 class Buffer {
  public:
-  Buffer(Engine* e, uint64_t n) : e_(e), n_(n) {
+  Buffer(Engine* e, uint64_t n, DType dt = dtype_of<T>::value)
+      : e_(e), n_(n), dtype_(dt) {
     addr_ = e_->alloc(n * sizeof(T), 64);
     if (!addr_) throw std::runtime_error("device memory exhausted");
     host_.resize(n);
@@ -42,6 +79,7 @@ class Buffer {
   T& operator[](size_t i) { return host_[i]; }
   uint64_t length() const { return n_; }
   uint64_t address() const { return addr_; }
+  DType dtype() const { return dtype_; }
 
   void sync_to_device() {
     e_->write_mem(addr_, host_.data(), n_ * sizeof(T));
@@ -53,8 +91,62 @@ class Buffer {
  private:
   Engine* e_;
   uint64_t n_, addr_ = 0;
+  DType dtype_;
   std::vector<T> host_;
 };
+
+// One operand of a call: address + dtype + presence (the triple the
+// reference's prepare_call consumes per operand, accl.cpp:1259-1281).
+struct Operand {
+  uint64_t addr = 0;
+  DType dtype = DType::none;
+  bool present = false;
+
+  Operand() = default;
+  template <typename T>
+  Operand(Buffer<T>& b) : addr(b.address()), dtype(b.dtype()), present(true) {}
+  // absent operand carrying only a dtype hint (data_type_io_*)
+  static Operand hint(DType d) {
+    Operand o;
+    o.dtype = d;
+    return o;
+  }
+};
+
+// Async request handle (reference: ACCLRequest, accl.hpp:60-75).
+class Request {
+ public:
+  Request(Engine* e, uint64_t id) : e_(e), id_(id) {}
+
+  // Blocks up to timeout; returns the engine retcode.
+  uint32_t wait(int timeout_ms = 60000) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    uint32_t ret = 0;
+    double dur = 0;
+    while (std::chrono::steady_clock::now() < deadline) {
+      if (e_->poll_call(id_, &ret, &dur)) {
+        duration_ns_ = dur;
+        done_ = true;
+        return ret;
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(100));
+    }
+    throw std::runtime_error("collective timed out");
+  }
+  bool done() const { return done_; }
+  double duration_ns() const { return duration_ns_; }
+
+ private:
+  Engine* e_;
+  uint64_t id_;
+  bool done_ = false;
+  double duration_ns_ = 0;
+};
+
+constexpr uint32_t STREAM_NONE = 0;
+constexpr uint32_t OP0_STREAM_F = 1;
+constexpr uint32_t RES_STREAM_F = 2;
 
 // One rank's driver handle.
 class ACCL {
@@ -62,74 +154,56 @@ class ACCL {
   explicit ACCL(Engine* engine) : e_(engine) {}
 
   // Bring-up (reference initialize(), accl.cpp:1082-1130): rx pool,
-  // communicator, fp32 arithmetic config, thresholds, enable.
+  // communicator, the full default arithcfg table (arithconfig.hpp:
+  // 106-119 + the TPU-native bf16 pair), thresholds, enable.
   void initialize(const std::vector<uint32_t>& sessions, uint32_t local_rank,
                   uint32_t n_rx_bufs = 16, uint64_t rx_buf_size = 1024,
-                  uint64_t max_eager = 0) {
+                  uint64_t max_eager = 0, uint64_t max_rndzv = 64ull << 20) {
     config(CfgFunc::ResetPeriph, 0);
     e_->cfg_rx_buffers(n_rx_bufs, rx_buf_size);
-    std::vector<uint32_t> words{uint32_t(sessions.size()), local_rank};
-    for (uint32_t s : sessions) {
-      words.push_back(0);                       // ip (unused in-proc)
-      words.push_back(0);                       // port
-      words.push_back(s);                       // session = global rank
-      words.push_back(uint32_t(rx_buf_size));   // max segment
-    }
-    comm_ = e_->set_comm(words.data(), int(words.size()));
-    // fp32 identity arithcfg: lanes[SUM, MAX] = {F32_SUM, F32_MAX}
-    std::vector<uint32_t> acfg{32, 32, 0, 0, 0, 0, 2, F32_SUM, F32_MAX};
-    arith_f32_ = e_->set_arithcfg(acfg.data(), int(acfg.size()));
+    comm_ = upload_comm(sessions, local_rank, rx_buf_size);
+    comm_sizes_[comm_] = uint32_t(sessions.size());
+    upload_default_arithcfgs();
     config(CfgFunc::SetTimeout, 1'000'000);
     config(CfgFunc::SetMaxEagerMsgSize,
            uint32_t(max_eager ? max_eager : rx_buf_size));
-    config(CfgFunc::SetMaxRendezvousMsgSize, 64u << 20);
+    config(CfgFunc::SetMaxRendezvousMsgSize, uint32_t(max_rndzv));
     config(CfgFunc::EnablePkt, 0);
     world_ = uint32_t(sessions.size());
     rank_ = local_rank;
+    rx_buf_size_ = rx_buf_size;
   }
 
   uint32_t rank() const { return rank_; }
   uint32_t world() const { return world_; }
   Engine* engine() { return e_; }
+  int global_comm() const { return comm_; }
+  uint32_t comm_size(int comm_id) const {
+    auto it = comm_sizes_.find(comm_id);
+    return it == comm_sizes_.end() ? 0 : it->second;
+  }
+
+  // Sub-communicator from global session ids (reference:
+  // accl.cpp:971-978); collective + order-sensitive across members.
+  int create_communicator(const std::vector<uint32_t>& members) {
+    uint32_t local = 0;
+    bool found = false;
+    for (uint32_t i = 0; i < members.size(); ++i)
+      if (members[i] == rank_) {
+        local = i;
+        found = true;
+      }
+    if (!found)
+      throw std::runtime_error("create_communicator: caller not a member");
+    int id = upload_comm(members, local, rx_buf_size_);
+    comm_sizes_[id] = uint32_t(members.size());
+    return id;
+  }
 
   template <typename T>
-  std::unique_ptr<Buffer<T>> create_buffer(uint64_t n) {
-    return std::make_unique<Buffer<T>>(e_, n);
-  }
-
-  // ---- collectives (reference accl.cpp entry points) ----
-  uint64_t start(Op op, uint32_t count, uint32_t root, uint32_t func,
-                 uint32_t tag, uint64_t a0, uint64_t a1, uint64_t a2) {
-    std::array<uint32_t, 15> w{};
-    w[0] = uint32_t(op);
-    w[1] = count;
-    w[2] = comm_;
-    w[3] = root;
-    w[4] = func;
-    w[5] = tag;
-    w[6] = arith_f32_;
-    w[9] = uint32_t(a0);
-    w[10] = uint32_t(a0 >> 32);
-    w[11] = uint32_t(a1);
-    w[12] = uint32_t(a1 >> 32);
-    w[13] = uint32_t(a2);
-    w[14] = uint32_t(a2 >> 32);
-    return e_->start_call(w.data());
-  }
-
-  uint32_t wait(uint64_t id, int timeout_ms = 60000) {
-    uint32_t ret = 0;
-    double dur = 0;
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(timeout_ms);
-    while (std::chrono::steady_clock::now() < deadline) {
-      if (e_->poll_call(id, &ret, &dur)) {
-        last_duration_ns_ = dur;
-        return ret;
-      }
-      std::this_thread::sleep_for(std::chrono::microseconds(100));
-    }
-    throw std::runtime_error("collective timed out");
+  std::unique_ptr<Buffer<T>> create_buffer(uint64_t n,
+                                           DType dt = dtype_of<T>::value) {
+    return std::make_unique<Buffer<T>>(e_, n, dt);
   }
 
   void check(uint32_t ret) {
@@ -138,59 +212,393 @@ class ACCL {
                                std::to_string(ret));
   }
 
-  double last_duration_ns() const { return last_duration_ns_; }
-
-  template <typename T>
-  uint64_t send_async(Buffer<T>& b, uint32_t count, uint32_t dst,
-                      uint32_t tag) {
-    b.sync_to_device();
-    return start(Op::Send, count, dst, 0, tag, b.address(), 0, 0);
+  // synchronous completion: wait, record the engine perf counter
+  // (reference get_duration, accl.cpp:1387), check the retcode
+  void run_sync(Request&& r) {
+    uint32_t ret = r.wait();
+    last_duration_ns_ = r.duration_ns();
+    check(ret);
   }
 
+  // ---- compute-kernel streams (PL-kernel ports) ----
+  void push_krnl(const void* data, uint64_t nbytes) {
+    e_->push_krnl(static_cast<const uint8_t*>(data), nbytes);
+  }
+  bool pop_stream(uint32_t strm, void* dst, uint64_t cap, uint64_t* got,
+                  int timeout_ms = 10000) {
+    return e_->pop_stream(strm, static_cast<uint8_t*>(dst), cap, got,
+                          timeout_ms);
+  }
+
+  // ---- collectives (reference accl.cpp entry points; each has a
+  //      synchronous form and an *_async form returning a Request) ----
+
+  Request send_async(Operand src, uint32_t count, uint32_t dst, uint32_t tag,
+                     int comm_id = -1, DType compress = DType::none,
+                     uint32_t stream = STREAM_NONE) {
+    return start(Op::Send, count, cid(comm_id), dst, 0, tag, src, {},
+                 Operand::hint(src.dtype), stream, compress);
+  }
   template <typename T>
-  void recv(Buffer<T>& b, uint32_t count, uint32_t src, uint32_t tag) {
-    check(wait(start(Op::Recv, count, src, 0, tag, 0, 0, b.address())));
+  void send(Buffer<T>& b, uint32_t count, uint32_t dst, uint32_t tag,
+            int comm_id = -1, DType compress = DType::none) {
+    b.sync_to_device();
+    run_sync(send_async(Operand(b), count, dst, tag, comm_id, compress));
+  }
+
+  Request recv_async(Operand dst_o, uint32_t count, uint32_t src,
+                     uint32_t tag, int comm_id = -1,
+                     DType compress = DType::none,
+                     uint32_t stream = STREAM_NONE) {
+    return start(Op::Recv, count, cid(comm_id), src, 0, tag,
+                 Operand::hint(dst_o.dtype), {}, dst_o, stream, compress);
+  }
+  template <typename T>
+  void recv(Buffer<T>& b, uint32_t count, uint32_t src, uint32_t tag,
+            int comm_id = -1, DType compress = DType::none) {
+    run_sync(recv_async(Operand(b), count, src, tag, comm_id, compress));
     b.sync_from_device();
   }
 
+  // send into a remote compute stream (reference stream_put,
+  // accl.cpp:191-250; stream ids < 9 are reserved, accl.cpp:197)
   template <typename T>
-  void allreduce(Buffer<T>& sendb, Buffer<T>& recvb, uint32_t count,
-                 Reduce fn = Reduce::SUM) {
-    sendb.sync_to_device();
-    check(wait(start(Op::Allreduce, count, 0, uint32_t(fn), TAG_ANY,
-                     sendb.address(), 0, recvb.address())));
-    recvb.sync_from_device();
+  void stream_put(Buffer<T>& b, uint32_t count, uint32_t dst,
+                  uint32_t stream_id, int comm_id = -1) {
+    if (stream_id < 9) throw std::runtime_error("stream ids < 9 reserved");
+    b.sync_to_device();
+    run_sync(start(Op::Send, count, cid(comm_id), dst, 0, stream_id, Operand(b),
+                {}, Operand::hint(b.dtype()), RES_STREAM_F, DType::none));
+  }
+
+  template <typename TS, typename TD>
+  void copy(Buffer<TS>& src, Buffer<TD>& dst, uint32_t count) {
+    src.sync_to_device();
+    run_sync(start(Op::Copy, count, comm_, 0, 0, TAG_ANY, Operand(src), {},
+                Operand(dst), STREAM_NONE, DType::none));
+    dst.sync_from_device();
   }
 
   template <typename T>
-  void bcast(Buffer<T>& b, uint32_t count, uint32_t root) {
-    if (rank_ == root) {
+  void copy_to_stream(Buffer<T>& src, uint32_t count, uint32_t stream_id) {
+    if (stream_id < 9) throw std::runtime_error("stream ids < 9 reserved");
+    src.sync_to_device();
+    run_sync(start(Op::Copy, count, comm_, 0, 0, stream_id, Operand(src), {},
+                Operand::hint(src.dtype()), RES_STREAM_F, DType::none));
+  }
+
+  template <typename T>
+  void copy_from_stream(Buffer<T>& dst, uint32_t count) {
+    run_sync(start(Op::Copy, count, comm_, 0, 0, TAG_ANY,
+                Operand::hint(dst.dtype()), {}, Operand(dst), OP0_STREAM_F,
+                DType::none));
+    dst.sync_from_device();
+  }
+
+  template <typename TA, typename TB, typename TR>
+  void combine(uint32_t count, Reduce fn, Buffer<TA>& a, Buffer<TB>& b,
+               Buffer<TR>& r) {
+    a.sync_to_device();
+    b.sync_to_device();
+    run_sync(start(Op::Combine, count, comm_, 0, uint32_t(fn), TAG_ANY,
+                Operand(a), Operand(b), Operand(r), STREAM_NONE, DType::none));
+    r.sync_from_device();
+  }
+
+  template <typename T>
+  void bcast(Buffer<T>& b, uint32_t count, uint32_t root, int comm_id = -1,
+             DType compress = DType::none) {
+    int cm = cid(comm_id);
+    if (local_rank(cm) == root) {
       b.sync_to_device();
-      check(wait(start(Op::Bcast, count, root, 0, TAG_ANY, b.address(), 0,
-                       b.address())));
+      run_sync(start(Op::Bcast, count, cm, root, 0, TAG_ANY, Operand(b), {},
+                  Operand::hint(b.dtype()), STREAM_NONE, compress));
     } else {
-      check(wait(start(Op::Bcast, count, root, 0, TAG_ANY, 0, 0,
-                       b.address())));
+      run_sync(start(Op::Bcast, count, cm, root, 0, TAG_ANY,
+                  Operand::hint(b.dtype()), {}, Operand(b), STREAM_NONE,
+                  compress));
       b.sync_from_device();
     }
   }
 
-  void barrier() {
-    check(wait(start(Op::Barrier, 0, 0, 0, TAG_ANY, 0, 0, 0)));
+  template <typename TS, typename TD>
+  void scatter(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+               uint32_t root, int comm_id = -1,
+               DType compress = DType::none) {
+    int cm = cid(comm_id);
+    bool is_root = local_rank(cm) == root;
+    if (is_root) sendb.sync_to_device();
+    run_sync(start(Op::Scatter, count, cm, root, 0, TAG_ANY,
+                is_root ? Operand(sendb) : Operand::hint(sendb.dtype()), {},
+                Operand(recvb), STREAM_NONE, compress));
+    recvb.sync_from_device();
+  }
+
+  template <typename TS, typename TD>
+  void gather(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+              uint32_t root, int comm_id = -1, DType compress = DType::none) {
+    int cm = cid(comm_id);
+    bool is_root = local_rank(cm) == root;
+    sendb.sync_to_device();
+    run_sync(start(Op::Gather, count, cm, root, 0, TAG_ANY, Operand(sendb), {},
+                is_root ? Operand(recvb) : Operand::hint(recvb.dtype()),
+                STREAM_NONE, compress));
+    if (is_root) recvb.sync_from_device();
+  }
+
+  template <typename TS, typename TD>
+  void allgather(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+                 int comm_id = -1, DType compress = DType::none) {
+    sendb.sync_to_device();
+    run_sync(start(Op::Allgather, count, cid(comm_id), 0, 0, TAG_ANY,
+                Operand(sendb), {}, Operand(recvb), STREAM_NONE, compress));
+    recvb.sync_from_device();
+  }
+
+  template <typename TS, typename TD>
+  void reduce(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+              uint32_t root, Reduce fn = Reduce::SUM, int comm_id = -1,
+              DType compress = DType::none) {
+    int cm = cid(comm_id);
+    bool is_root = local_rank(cm) == root;
+    sendb.sync_to_device();
+    run_sync(start(Op::Reduce, count, cm, root, uint32_t(fn), TAG_ANY,
+                Operand(sendb), {},
+                is_root ? Operand(recvb) : Operand::hint(recvb.dtype()),
+                STREAM_NONE, compress));
+    if (is_root) recvb.sync_from_device();
+  }
+
+  // streamed-operand reduce (reference test_reduce_stream2mem,
+  // test.cpp:813-843): feed `count` elements via push_krnl first
+  template <typename TD>
+  void reduce_stream2mem(Buffer<TD>& recvb, uint32_t count, uint32_t root,
+                         Reduce fn = Reduce::SUM, int comm_id = -1) {
+    int cm = cid(comm_id);
+    bool is_root = local_rank(cm) == root;
+    run_sync(start(Op::Reduce, count, cm, root, uint32_t(fn), TAG_ANY,
+                Operand::hint(recvb.dtype()), {},
+                is_root ? Operand(recvb) : Operand::hint(recvb.dtype()),
+                OP0_STREAM_F, DType::none));
+    if (is_root) recvb.sync_from_device();
+  }
+
+  // streamed-result reduce (reference test_reduce_mem2stream,
+  // test.cpp:844-876): root pops the result from stream `stream_id`
+  template <typename TS>
+  void reduce_mem2stream(Buffer<TS>& sendb, uint32_t count, uint32_t root,
+                         uint32_t stream_id, Reduce fn = Reduce::SUM,
+                         int comm_id = -1) {
+    if (stream_id < 9) throw std::runtime_error("stream ids < 9 reserved");
+    sendb.sync_to_device();
+    run_sync(start(Op::Reduce, count, cid(comm_id), root, uint32_t(fn),
+                stream_id, Operand(sendb), {},
+                Operand::hint(sendb.dtype()), RES_STREAM_F, DType::none));
+  }
+
+  template <typename TS, typename TD>
+  void allreduce(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+                 Reduce fn = Reduce::SUM, int comm_id = -1,
+                 DType compress = DType::none) {
+    sendb.sync_to_device();
+    run_sync(start(Op::Allreduce, count, cid(comm_id), 0, uint32_t(fn), TAG_ANY,
+                Operand(sendb), {}, Operand(recvb), STREAM_NONE, compress));
+    recvb.sync_from_device();
+  }
+
+  template <typename TS, typename TD>
+  void reduce_scatter(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+                      Reduce fn = Reduce::SUM, int comm_id = -1,
+                      DType compress = DType::none) {
+    sendb.sync_to_device();
+    run_sync(start(Op::ReduceScatter, count, cid(comm_id), 0, uint32_t(fn),
+                TAG_ANY, Operand(sendb), {}, Operand(recvb), STREAM_NONE,
+                compress));
+    recvb.sync_from_device();
+  }
+
+  template <typename TS, typename TD>
+  void alltoall(Buffer<TS>& sendb, Buffer<TD>& recvb, uint32_t count,
+                int comm_id = -1) {
+    sendb.sync_to_device();
+    run_sync(start(Op::Alltoall, count, cid(comm_id), 0, 0, TAG_ANY,
+                Operand(sendb), {}, Operand(recvb), STREAM_NONE, DType::none));
+    recvb.sync_from_device();
+  }
+
+  void barrier(int comm_id = -1) {
+    run_sync(start(Op::Barrier, 0, cid(comm_id), 0, 0, TAG_ANY, {}, {}, {},
+                STREAM_NONE, DType::none));
+  }
+
+  void nop() {
+    run_sync(start(Op::Nop, 0, comm_, 0, 0, TAG_ANY, {}, {}, {}, STREAM_NONE,
+                DType::none));
+  }
+
+  double last_duration_ns() const { return last_duration_ns_; }
+
+  // ---- call marshaling (reference prepare_call, accl.cpp:1252-1372) ----
+  Request start(Op op, uint32_t count, int comm_id, uint32_t root,
+                uint32_t func, uint32_t tag, Operand op0, Operand op1,
+                Operand res, uint32_t stream_flags, DType compress) {
+    // validate rooted calls against the communicator size (the engine
+    // would otherwise index past its rank table)
+    switch (op) {
+      case Op::Send: case Op::Recv: case Op::Bcast: case Op::Scatter:
+      case Op::Gather: case Op::Reduce: {
+        uint32_t sz = comm_size(comm_id);
+        if (sz && root >= sz)
+          throw std::runtime_error("root/peer out of range for communicator");
+        break;
+      }
+      default:
+        break;
+    }
+    // dtype set across operands (+ hints for absent ones)
+    DType dts[3] = {op0.dtype, op1.dtype, res.dtype};
+    DType a = DType::none, b = DType::none;
+    for (DType d : dts) {
+      if (d == DType::none) continue;
+      if (a == DType::none || d == a) {
+        a = d;
+      } else if (b == DType::none || d == b) {
+        b = d;
+      } else {
+        throw std::runtime_error("unsupported dtype combination");
+      }
+    }
+    if (a == DType::none) a = DType::f32;
+
+    uint32_t flags = 0;  // compression flags word
+    int arith = 0;
+    if (compress == DType::none) {
+      if (b == DType::none) {
+        arith = arith_id(a, a, op);
+      } else {
+        // operand compression: narrower dtype is the compressed side
+        DType u = dtype_bits(a) >= dtype_bits(b) ? a : b;
+        DType c = u == a ? b : a;
+        arith = arith_id(u, c, op);
+        flags = operand_flags(op0, op1, res, c);
+      }
+    } else {
+      DType u = a;
+      if (b != DType::none) {
+        if (a == compress) u = b;
+        else if (b == compress) u = a;
+        else throw std::runtime_error("unsupported dtype combination");
+      }
+      if (u == compress) {
+        arith = arith_id(u, u, op);
+        // ETH on an identity pair: ratio-0 no-op, kept for ABI fidelity
+        flags = ETH_COMPRESSED;
+      } else {
+        arith = arith_id(u, compress, op);
+        flags = ETH_COMPRESSED | operand_flags(op0, op1, res, compress);
+      }
+    }
+
+    std::array<uint32_t, 15> w{};
+    w[0] = uint32_t(op);
+    w[1] = count;
+    w[2] = uint32_t(comm_id);
+    w[3] = root;
+    w[4] = func;
+    w[5] = tag;
+    w[6] = uint32_t(arith);
+    w[7] = flags;
+    w[8] = stream_flags;
+    w[9] = uint32_t(op0.addr);
+    w[10] = uint32_t(op0.addr >> 32);
+    w[11] = uint32_t(op1.addr);
+    w[12] = uint32_t(op1.addr >> 32);
+    w[13] = uint32_t(res.addr);
+    w[14] = uint32_t(res.addr >> 32);
+    return Request(e_, e_->start_call(w.data()));
   }
 
  private:
+  int cid(int comm_id) const { return comm_id < 0 ? comm_ : comm_id; }
+
+  uint32_t local_rank(int comm_id) const {
+    auto it = comm_locals_.find(comm_id);
+    return it == comm_locals_.end() ? rank_ : it->second;
+  }
+
+  static uint32_t operand_flags(const Operand& op0, const Operand& op1,
+                                const Operand& res, DType compressed) {
+    uint32_t f = 0;
+    if (op0.present && op0.dtype == compressed) f |= OP0_COMPRESSED;
+    if (op1.present && op1.dtype == compressed) f |= OP1_COMPRESSED;
+    if (res.present && res.dtype == compressed) f |= RES_COMPRESSED;
+    return f;
+  }
+
+  int upload_comm(const std::vector<uint32_t>& sessions, uint32_t local,
+                  uint64_t rx_buf_size) {
+    std::vector<uint32_t> words{uint32_t(sessions.size()), local};
+    for (uint32_t s : sessions) {
+      words.push_back(0);                      // ip (unused in-proc)
+      words.push_back(0);                      // port
+      words.push_back(s);                      // session = global rank
+      words.push_back(uint32_t(rx_buf_size));  // max segment
+    }
+    int id = e_->set_comm(words.data(), int(words.size()));
+    comm_locals_[id] = local;
+    return id;
+  }
+
+  int arith_id(DType u, DType c, Op op) const {
+    auto it = arith_ids_.find({u, c});
+    if (it == arith_ids_.end()) {
+      if (op == Op::Barrier || op == Op::Nop) return 0;
+      throw std::runtime_error("no arithmetic config for dtype pair");
+    }
+    return it->second;
+  }
+
+  // mirror of accl_tpu/arithconfig.py DEFAULT_ARITH_CONFIG: identity
+  // pairs + the (f32,f16) mixed-precision pair (arith in the compressed
+  // domain, reference arithconfig.hpp:106-119) + TPU-native (f32,bf16)
+  void upload_default_arithcfgs() {
+    auto up = [&](DType u, DType c, uint32_t comp, uint32_t decomp,
+                  bool arith_comp, uint32_t lane_sum, uint32_t lane_max) {
+      uint32_t ratio = 0;
+      if (dtype_bits(c) && dtype_bits(u) > dtype_bits(c))
+        ratio = dtype_bits(u) / dtype_bits(c) == 2 ? 1 : 2;
+      std::vector<uint32_t> a{dtype_bits(u), dtype_bits(c), ratio, comp,
+                              decomp, uint32_t(arith_comp), 2, lane_sum,
+                              lane_max};
+      arith_ids_[{u, c}] = e_->set_arithcfg(a.data(), int(a.size()));
+    };
+    up(DType::f16, DType::f16, 0, 0, false, F16_SUM, F16_MAX);
+    up(DType::bf16, DType::bf16, 0, 0, false, BF16_SUM, BF16_MAX);
+    up(DType::f32, DType::f32, 0, 0, false, F32_SUM, F32_MAX);
+    up(DType::f64, DType::f64, 0, 0, false, F64_SUM, F64_MAX);
+    up(DType::i32, DType::i32, 0, 0, false, I32_SUM, I32_MAX);
+    up(DType::i64, DType::i64, 0, 0, false, I64_SUM, I64_MAX);
+    up(DType::f32, DType::f16, 0, 1, true, F16_SUM, F16_MAX);
+    up(DType::f32, DType::bf16, 2, 3, true, BF16_SUM, BF16_MAX);
+  }
+
   void config(CfgFunc f, uint32_t value) {
     std::array<uint32_t, 15> w{};
     w[0] = uint32_t(Op::Config);
     w[1] = value;
     w[4] = uint32_t(f);
-    check(wait(e_->start_call(w.data())));
+    Request r(e_, e_->start_call(w.data()));
+    uint32_t ret = r.wait();
+    check(ret);
   }
 
   Engine* e_;
-  uint32_t comm_ = 0, rank_ = 0, world_ = 1;
-  int arith_f32_ = 0;
+  int comm_ = 0;
+  uint32_t rank_ = 0, world_ = 1;
+  uint64_t rx_buf_size_ = 1024;
+  std::map<std::pair<DType, DType>, int> arith_ids_;
+  std::map<int, uint32_t> comm_sizes_;
+  std::map<int, uint32_t> comm_locals_;
   double last_duration_ns_ = 0;
 };
 

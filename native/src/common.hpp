@@ -83,6 +83,17 @@ enum class MsgType : uint8_t {
 constexpr uint32_t TAG_ANY = 0xFFFFFFFFu;
 constexpr uint32_t MAX_PACKETSIZE = 4096;  // transport write-chunk quantum
 
+// Compression flag bits of descriptor word 7 (reference:
+// constants.hpp:320-325; bit-compatible with accl_tpu/constants.py) —
+// shared by the engine's flag algebra and the C++ host driver's
+// prepare_call marshaling.
+enum CompFlag : uint32_t {
+  OP0_COMPRESSED = 1,
+  OP1_COMPRESSED = 2,
+  RES_COMPRESSED = 4,
+  ETH_COMPRESSED = 8,
+};
+
 // ---------------------------------------------------------------------------
 // Wire header: 64 bytes, self-describing, field set equivalent to the
 // reference's eth_header {count,tag,src,seqn,strm,dst,msg_type,host,vaddr}

@@ -17,15 +17,6 @@ static constexpr uint32_t BARRIER_TAG = 0xBA771E12u;
 // Stream ids >= 9 address compute-kernel streams (reference: accl.cpp:197).
 static constexpr uint32_t FIRST_KRNL_STREAM = 9;
 
-// Compression flag bits of descriptor word 7 (reference:
-// constants.hpp:320-325; bit-compatible with accl_tpu/constants.py).
-enum CompFlag : uint32_t {
-  OP0_COMPRESSED = 1,
-  OP1_COMPRESSED = 2,
-  RES_COMPRESSED = 4,
-  ETH_COMPRESSED = 8,
-};
-
 Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
                std::unique_ptr<Transport> transport)
     : global_rank_(global_rank),
@@ -273,11 +264,11 @@ void Engine::ingress(Message&& msg) {
         if (post && post->wire_c != post->lnd_c) {
           // clamp to what actually arrived: a short payload (divergent
           // arithcfg, stale posted entry) must not read past the wire
-          // buffer — all compressed pairs are 4 <-> 2 bytes/elem
-          uint64_t wire_eb = post->wire_c ? 2 : 4;
-          uint64_t elems =
-              std::min<uint64_t>(post->elems, msg.payload.size() / wire_eb);
-          uint64_t lnd_bytes = elems * (post->lnd_c ? 2 : 4);
+          // buffer
+          uint64_t wire_eb = post->wire_c ? post->cb : post->ub;
+          uint64_t elems = std::min<uint64_t>(
+              post->elems, msg.payload.size() / std::max<uint64_t>(1, wire_eb));
+          uint64_t lnd_bytes = elems * (post->lnd_c ? post->cb : post->ub);
           if (msg.hdr.vaddr + lnd_bytes <= devicemem_.size()) {
             if (post->wire_c)
               run_decompress_lane(post->comp_kind, msg.payload.data(),
@@ -899,7 +890,8 @@ void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
     {
       std::lock_guard<std::mutex> g(posted_mu_);
       posted_[PostedKey{c.comm(), src, tag, addr}] =
-          PostedRndzv{elems, d.eth, dst_c && d.pair, d.comp_kind};
+          PostedRndzv{elems, d.eth, dst_c && d.pair, d.comp_kind,
+                      uint32_t(d.ub), uint32_t(d.cb)};
     }
     // advertise our landing address to the sender (RNDZVS_INIT)
     Message msg;

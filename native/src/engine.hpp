@@ -250,6 +250,7 @@ class Engine {
     uint64_t elems;
     bool wire_c, lnd_c;
     uint32_t comp_kind;
+    uint32_t ub, cb;  // bytes/element in each representation
   };
   using PostedKey = std::tuple<uint32_t, uint32_t, uint32_t, uint64_t>;
   std::map<PostedKey, PostedRndzv> posted_;
