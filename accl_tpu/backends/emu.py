@@ -92,6 +92,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_world_create.argtypes = [i32, u64]
     lib.accl_world_create_tcp.restype = p
     lib.accl_world_create_tcp.argtypes = [i32, i32, i32, u64]
+    lib.accl_world_create_dgram.restype = p
+    lib.accl_world_create_dgram.argtypes = [i32, u64, u32, u32]
+    lib.accl_dgram_fault.argtypes = [p, u32]
     lib.accl_world_destroy.argtypes = [p]
     lib.accl_cfg_rx.argtypes = [p, i32, i32, u64]
     lib.accl_set_comm.argtypes = [p, i32, ctypes.POINTER(u32), i32]
@@ -282,21 +285,37 @@ class EmuRankTcp:
 
 
 class EmuWorld:
-    """N emulated ranks in one process (inproc transport).
+    """N emulated ranks in one process.
 
     The MPI-replacement test harness: `run(fn)` executes `fn(accl, rank)`
     for every rank concurrently, mirroring how the reference test suite
     runs one driver per MPI rank against one emulator each.
+
+    `transport` selects the wire rung: "inproc" (FIFO, synchronous hub)
+    or "dgram" (MTU fragmentation + deterministic out-of-order delivery +
+    interleaved reassembly — the reference's UDP POE + depacketizer +
+    rxbuf_session stack; see native/src/dgram.hpp).
     """
+
+    #: datagram fault kinds for inject_dgram_fault
+    DGRAM_DROP_NEXT = 1
+    DGRAM_DUP_NEXT = 2
 
     def __init__(self, nranks: int, devmem_bytes: int = 64 << 20,
                  n_egr_rx_bufs: int = 16, egr_rx_buf_size: int = 1024,
                  max_eager_size: Optional[int] = None,
                  max_rendezvous_size: Optional[int] = None,
-                 initialize: bool = True):
+                 initialize: bool = True, transport: str = "inproc",
+                 mtu: int = 256, reorder_window: int = 8):
         self._lib = _load_lib()
         self.nranks = nranks
-        self._handle = self._lib.accl_world_create(nranks, devmem_bytes)
+        if transport == "dgram":
+            self._handle = self._lib.accl_world_create_dgram(
+                nranks, devmem_bytes, mtu, reorder_window)
+        elif transport == "inproc":
+            self._handle = self._lib.accl_world_create(nranks, devmem_bytes)
+        else:
+            raise ACCLError(f"unknown transport {transport!r}")
         self.devices = [EmuDevice(self._handle, r, self._lib)
                         for r in range(nranks)]
         self.accls = [ACCL(d) for d in self.devices]
@@ -324,6 +343,14 @@ class EmuWorld:
             for r in range(self.nranks)
         ]
         return [f.result(timeout=120) for f in futures]
+
+    def inject_dgram_fault(self, kind: int) -> None:
+        """Arm a one-shot datagram-level fault on the shared hub (drop or
+        duplicate the NEXT fragment posted by any rank); only valid for
+        the "dgram" transport."""
+        rc = self._lib.accl_dgram_fault(self._handle, kind)
+        if rc != 0:
+            raise ACCLError("world has no datagram transport")
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

@@ -8,6 +8,7 @@
 #include <cstring>
 #include <thread>
 
+#include "dgram.hpp"
 #include "engine.hpp"
 
 using namespace accl;
@@ -17,6 +18,7 @@ namespace {
 struct World {
   std::vector<std::unique_ptr<Engine>> engines;
   std::shared_ptr<InprocHub> hub;
+  std::shared_ptr<DgramHub> dgram_hub;
   bool tcp = false;
 
   Engine* get(int rank) {
@@ -59,6 +61,30 @@ void* accl_world_create_tcp(int rank, int nranks, int base_port,
     return nullptr;
   }
   return w;
+}
+
+// Datagram world: N engines over the fragmenting/reordering datagram
+// rung (the reference's UDP POE + depacketizer + rxbuf_session stack).
+void* accl_world_create_dgram(int nranks, uint64_t devmem_bytes,
+                              uint32_t mtu, uint32_t reorder_window) {
+  auto* w = new World();
+  w->dgram_hub = std::make_shared<DgramHub>(nranks, mtu, reorder_window);
+  for (int r = 0; r < nranks; ++r) {
+    w->engines.push_back(std::make_unique<Engine>(
+        uint32_t(r), devmem_bytes,
+        std::make_unique<DatagramTransport>(w->dgram_hub, r)));
+    w->engines.back()->set_lossy_transport(true);
+  }
+  return w;
+}
+
+// One-shot datagram-level fault on the shared hub (1=drop next fragment,
+// 2=duplicate next fragment); -1 if this world has no datagram rung.
+int accl_dgram_fault(void* wp, uint32_t kind) {
+  auto* w = static_cast<World*>(wp);
+  if (!w->dgram_hub) return -1;
+  w->dgram_hub->inject_fault(kind);
+  return 0;
 }
 
 void accl_world_destroy(void* wp) { delete static_cast<World*>(wp); }

@@ -235,6 +235,12 @@ class Fifo {
     return false;
   }
 
+  // Non-destructive visit of every queued element.
+  void for_each(std::function<void(const T&)> fn) const {
+    std::lock_guard<std::mutex> g(m_);
+    for (const auto& v : q_) fn(v);
+  }
+
   size_t size() const {
     std::lock_guard<std::mutex> g(m_);
     return q_.size();

@@ -1,0 +1,269 @@
+// Datagram transport rung: MTU fragmentation, out-of-order delivery, and
+// interleaved per-session reassembly.
+//
+// Role of the reference's UDP protocol stack: the udp packetizer splits
+// segments into MTU-sized datagrams and the depacketizer + rxbuf_session
+// reassemble interleaved per-session fragments into rx-pool buffers
+// (kernels/cclo/hls/eth_intf/udp_depacketizer.cpp:30-180,
+// rxbuf_offload/rxbuf_session.cpp:1-202).  This rung is deliberately
+// adversarial: the hub delivers each batch of queued datagrams in
+// REVERSE order (deterministic worst-case reordering), so fragments of
+// concurrent messages interleave and arrive out of order — the protocol
+// layer above (seqn discipline, stream resequencing, reassembly table)
+// must recover.  One-shot drop/duplicate faults model datagram loss.
+#pragma once
+
+#include <set>
+#include <unordered_map>
+
+#include "transport.hpp"
+
+namespace accl {
+
+// One MTU-sized fragment of a wire message.  Every fragment carries the
+// original header (like the reference's STRIDE-offset rxbuf_session
+// commands carrying the session id) plus reassembly coordinates.
+struct Datagram {
+  WireHeader hdr;
+  uint32_t src_global = 0;  // sending endpoint (reassembly key half)
+  uint32_t msg_id = 0;      // per-sender message counter (key other half)
+  uint32_t frag_idx = 0, nfrags = 1;
+  uint32_t frag_off = 0;       // byte offset of chunk within the payload
+  uint32_t payload_bytes = 0;  // total message payload size (hdr.count is
+                               // NOT usable: rendezvous INITs carry an
+                               // element count with an empty payload)
+  std::vector<uint8_t> chunk;
+};
+
+enum DgramFault : uint32_t {
+  DGRAM_DROP_NEXT = 1,  // next datagram posted anywhere is lost
+  DGRAM_DUP_NEXT = 2,   // next datagram posted is delivered twice
+};
+
+// Shared hub: per-destination queue + delivery worker.  Each worker
+// drains up to `reorder_window` queued datagrams and delivers the batch
+// in reverse order.
+class DgramHub {
+ public:
+  using DgSink = std::function<void(Datagram&&)>;
+
+  DgramHub(int nranks, uint32_t mtu, uint32_t reorder_window)
+      : mtu_(mtu ? mtu : 256),
+        window_(reorder_window ? reorder_window : 1),
+        states_(nranks) {
+    for (int r = 0; r < nranks; ++r)
+      workers_.emplace_back([this, r] { worker(r); });
+  }
+
+  ~DgramHub() {
+    running_ = false;
+    for (auto& st : states_) st.cv.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  uint32_t mtu() const { return mtu_; }
+
+  void attach(int rank, DgSink sink) {
+    std::lock_guard<std::mutex> g(states_[rank].mu);
+    states_[rank].sink = std::move(sink);
+  }
+  void detach(int rank) {
+    // clear the sink AND wait out any in-flight delivery: a worker that
+    // already copied the sink may be mid-call into the engine, and the
+    // caller is about to destruct it (teardown use-after-free guard)
+    auto& st = states_[rank];
+    std::unique_lock<std::mutex> g(st.mu);
+    st.sink = nullptr;
+    st.cv.wait(g, [&] { return !st.delivering; });
+  }
+
+  void post(uint32_t dst, Datagram&& d) {
+    if (dst >= states_.size()) return;
+    switch (fault_.exchange(0)) {
+      case DGRAM_DROP_NEXT:
+        return;  // the fragment never reaches the wire
+      case DGRAM_DUP_NEXT: {
+        Datagram dup = d;
+        enqueue(dst, std::move(dup));
+        break;
+      }
+      default:
+        break;
+    }
+    enqueue(dst, std::move(d));
+  }
+
+  // Arm a one-shot datagram-level fault (test harness; the engine-level
+  // inject_fault drops whole messages — this drops single fragments).
+  void inject_fault(uint32_t kind) { fault_.store(kind); }
+
+ private:
+  struct DstState {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<Datagram> q;
+    DgSink sink;
+    bool delivering = false;  // a worker holds a copy of sink right now
+  };
+
+  void enqueue(uint32_t dst, Datagram&& d) {
+    auto& st = states_[dst];
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      st.q.push_back(std::move(d));
+    }
+    st.cv.notify_one();
+  }
+
+  void worker(int rank) {
+    auto& st = states_[rank];
+    while (running_) {
+      std::vector<Datagram> batch;
+      DgSink sink;
+      {
+        std::unique_lock<std::mutex> g(st.mu);
+        st.cv.wait_for(g, std::chrono::milliseconds(50),
+                       [&] { return !st.q.empty() || !running_; });
+        if (!running_ && st.q.empty()) return;
+        for (uint32_t i = 0; i < window_ && !st.q.empty(); ++i) {
+          batch.push_back(std::move(st.q.front()));
+          st.q.pop_front();
+        }
+        sink = st.sink;
+        if (sink) st.delivering = true;
+      }
+      if (!sink) continue;
+      // worst-case deterministic reordering: deliver the batch reversed
+      for (auto it = batch.rbegin(); it != batch.rend(); ++it)
+        sink(std::move(*it));
+      {
+        std::lock_guard<std::mutex> g(st.mu);
+        st.delivering = false;
+      }
+      st.cv.notify_all();
+    }
+  }
+
+  uint32_t mtu_, window_;
+  std::vector<DstState> states_;
+  std::vector<std::thread> workers_;
+  std::atomic<bool> running_{true};
+  std::atomic<uint32_t> fault_{0};
+};
+
+// Transport facade: fragments on egress, reassembles on ingress (the
+// packetizer / depacketizer + rxbuf_session pair).  The reassembly table
+// is bounded like the reference's session-buffer memory (rxbuf_session
+// mem[512]); when full, the oldest incomplete session is evicted — that
+// message is lost and the protocol layer's timeout/seqn machinery
+// reports it (fault-injection tests exercise exactly this).
+class DatagramTransport : public Transport {
+ public:
+  DatagramTransport(std::shared_ptr<DgramHub> hub, int rank,
+                    uint32_t max_sessions = 64)
+      : hub_(std::move(hub)), rank_(rank), max_sessions_(max_sessions) {}
+
+  void send(uint32_t dst, Message&& msg) override {
+    uint32_t mtu = hub_->mtu();
+    uint64_t total = msg.payload.size();
+    uint32_t nfrags = uint32_t(std::max<uint64_t>(1, (total + mtu - 1) / mtu));
+    uint32_t id = next_msg_id_++;
+    for (uint32_t f = 0; f < nfrags; ++f) {
+      Datagram d;
+      d.hdr = msg.hdr;
+      d.src_global = uint32_t(rank_);
+      d.msg_id = id;
+      d.frag_idx = f;
+      d.nfrags = nfrags;
+      d.frag_off = f * mtu;
+      d.payload_bytes = uint32_t(total);
+      uint64_t len = std::min<uint64_t>(mtu, total - uint64_t(f) * mtu);
+      d.chunk.assign(msg.payload.begin() + d.frag_off,
+                     msg.payload.begin() + d.frag_off + len);
+      hub_->post(dst, std::move(d));
+    }
+  }
+
+  void start(Sink sink) override {
+    sink_ = std::move(sink);
+    hub_->attach(rank_, [this](Datagram&& d) { reassemble(std::move(d)); });
+  }
+
+  void stop() override { hub_->detach(rank_); }
+
+ private:
+  struct Slot {
+    WireHeader hdr;
+    uint32_t nfrags = 0, got = 0;
+    uint64_t stamp = 0;  // insertion order for eviction
+    std::vector<uint8_t> buf;
+    std::vector<bool> seen;  // duplicate-fragment guard
+  };
+
+  void reassemble(Datagram&& d) {
+    Message out;
+    bool complete = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      uint64_t key = (uint64_t(d.src_global) << 32) | d.msg_id;
+      // duplicate of an already-delivered message (e.g. a duplicated
+      // single-fragment datagram): must not re-deliver — rendezvous
+      // traffic has no seqn dedup above this layer
+      auto& done = done_ids_[d.src_global];
+      if (done.count(d.msg_id)) return;
+      auto it = slots_.find(key);
+      if (it == slots_.end()) {
+        if (slots_.size() >= max_sessions_) evict_oldest_locked();
+        Slot s;
+        s.hdr = d.hdr;
+        s.nfrags = d.nfrags;
+        s.stamp = stamp_++;
+        s.buf.resize(d.payload_bytes);
+        s.seen.assign(d.nfrags, false);
+        it = slots_.emplace(key, std::move(s)).first;
+      }
+      Slot& s = it->second;
+      if (d.frag_idx < s.nfrags && !s.seen[d.frag_idx] &&
+          d.frag_off + d.chunk.size() <= s.buf.size()) {
+        std::memcpy(s.buf.data() + d.frag_off, d.chunk.data(),
+                    d.chunk.size());
+        s.seen[d.frag_idx] = true;
+        s.got++;
+      }
+      if (s.got == s.nfrags) {
+        out.hdr = s.hdr;
+        out.payload = std::move(s.buf);
+        slots_.erase(it);
+        complete = true;
+        // remember the id so late duplicates are dropped; ids are
+        // sequential per sender, so prune far-behind entries to bound
+        // the window
+        done.insert(d.msg_id);
+        while (!done.empty() && d.msg_id - *done.begin() > 512)
+          done.erase(done.begin());
+      }
+    }
+    if (complete && sink_) sink_(std::move(out));
+  }
+
+  void evict_oldest_locked() {
+    auto oldest = slots_.end();
+    for (auto it = slots_.begin(); it != slots_.end(); ++it)
+      if (oldest == slots_.end() || it->second.stamp < oldest->second.stamp)
+        oldest = it;
+    if (oldest != slots_.end()) slots_.erase(oldest);
+  }
+
+  std::shared_ptr<DgramHub> hub_;
+  int rank_;
+  uint32_t max_sessions_;
+  std::atomic<uint32_t> next_msg_id_{1};
+  Sink sink_;
+  std::mutex mu_;
+  std::unordered_map<uint64_t, Slot> slots_;
+  // per-sender ids already delivered (duplicate suppression window)
+  std::unordered_map<uint32_t, std::set<uint32_t>> done_ids_;
+  uint64_t stamp_ = 0;
+};
+
+}  // namespace accl

@@ -232,7 +232,51 @@ void Engine::ingress(Message&& msg) {
   switch (static_cast<MsgType>(msg.hdr.msg_type)) {
     case MsgType::EgrMsg:
       if (msg.hdr.strm >= FIRST_KRNL_STREAM) {
-        stream_for(msg.hdr.strm)->push(std::move(msg.payload));
+        // resequence per (comm, src, stream): non-FIFO transports (the
+        // datagram rung) may deliver stream messages out of order, and
+        // the stream FIFO has no other ordering discipline
+        std::lock_guard<std::mutex> g(strm_seq_mu_);
+        StrmKey key{msg.hdr.comm_id, msg.hdr.src, msg.hdr.strm};
+        uint32_t& expect = strm_in_seq_[key];
+        if (msg.hdr.seqn == expect) {
+          stream_for(msg.hdr.strm)->push(std::move(msg.payload));
+          ++expect;
+          for (auto it = strm_holdback_.find({key, expect});
+               it != strm_holdback_.end();
+               it = strm_holdback_.find({key, expect})) {
+            stream_for(msg.hdr.strm)->push(std::move(it->second));
+            strm_holdback_.erase(it);
+            ++expect;
+          }
+        } else if (msg.hdr.seqn > expect) {
+          strm_holdback_[{key, msg.hdr.seqn}] = std::move(msg.payload);
+          // loss recovery: a hole that parks too many successors means
+          // the expected message was lost on a lossy rung — resync to
+          // the oldest held seqn so the stream drains (bounded memory;
+          // the lost payload is simply absent from the FIFO)
+          size_t held = 0;
+          uint32_t oldest = 0;
+          bool have_oldest = false;
+          for (const auto& kv : strm_holdback_)
+            if (kv.first.first == key) {
+              ++held;
+              if (!have_oldest ||
+                  int32_t(kv.first.second - oldest) < 0) {
+                oldest = kv.first.second;
+                have_oldest = true;
+              }
+            }
+          if (lossy_transport_ && held > kStrmHoldbackLimit && have_oldest) {
+            expect = oldest;
+            for (auto it = strm_holdback_.find({key, expect});
+                 it != strm_holdback_.end();
+                 it = strm_holdback_.find({key, expect})) {
+              stream_for(msg.hdr.strm)->push(std::move(it->second));
+              strm_holdback_.erase(it);
+              ++expect;
+            }
+          }
+        }  // else: stale duplicate, drop
       } else {
         rx_.deposit(std::move(msg));
       }
@@ -520,6 +564,12 @@ void Engine::do_config(CallDesc& c) {
         std::lock_guard<std::mutex> g(posted_mu_);
         posted_.clear();
       }
+      {
+        std::lock_guard<std::mutex> g(strm_seq_mu_);
+        strm_in_seq_.clear();
+        strm_holdback_.clear();
+      }
+      strm_out_seq_.clear();
       for (auto& t : comms_) {
         std::fill(t.inbound_seq.begin(), t.inbound_seq.end(), 0);
         std::fill(t.outbound_seq.begin(), t.outbound_seq.end(), 0);
@@ -777,9 +827,14 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
     msg.hdr.src = t.local;
     // stream-destined messages bypass the rx pool on the receiver, so
     // they must not consume the eager sequence space (seqn discipline is
-    // per rx-pool stream; SURVEY §7 hard part (e))
+    // per rx-pool route); they carry their own per-(comm,dst,strm)
+    // sequence so ingress can resequence on non-FIFO transports
+    // outbound counter keyed per destination (the receiver resequences
+    // per source, so each src->dst stream route has its own space)
     msg.hdr.seqn =
-        to_strm >= FIRST_KRNL_STREAM ? 0 : t.outbound_seq[dst]++;
+        to_strm >= FIRST_KRNL_STREAM
+            ? strm_out_seq_[StrmKey{c.comm(), dst, to_strm}]++
+            : t.outbound_seq[dst]++;
     msg.hdr.strm = to_strm;
     msg.hdr.dst_session = uint16_t(t.rows[dst].session);
     msg.hdr.msg_type = uint8_t(MsgType::EgrMsg);
@@ -828,6 +883,25 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
       // for a possibly differently-ordered future recv
       if (mismatched && !rx_.has_idle())
         rx_.evict_route(c.comm(), src, tag);
+      // lossy-rung self-heal: the expected seqn never arrived within the
+      // timeout (fragment loss on the datagram rung) and will never
+      // arrive.  Advance the route cursor to the oldest queued survivor
+      // so FUTURE receives on the route proceed — but THIS call always
+      // fails: a queued same-tag successor is indistinguishable from
+      // this recv's own message, and silently splicing it in would
+      // substitute wrong data with no error (at-most-once delivery with
+      // an explicit error, never silent substitution).
+      // Guards: only lossy rungs resync (on reliable transports an
+      // absent expected seqn is corruption, kept as a hard error for the
+      // fault-injection contract); and a PRESENT expected seqn under a
+      // different tag is the documented misordered-recv case (PACK_SEQ
+      // error, entry kept for the correctly-ordered recv).
+      if (lossy_transport_ &&
+          !rx_.has_seqn(c.comm(), src, t.inbound_seq[src])) {
+        if (auto ahead =
+                rx_.min_ahead_seqn(c.comm(), src, t.inbound_seq[src]))
+          t.inbound_seq[src] = *ahead;
+      }
       sticky_err_ |= mismatched ? PACK_SEQ_NUMBER_ERROR
                                 : RECEIVE_TIMEOUT_ERROR;
       return;

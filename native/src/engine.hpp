@@ -91,6 +91,13 @@ class Engine {
   // segmentation edge tests probe its engines.
   void inject_fault(uint32_t kind) { fault_.store(kind); }
 
+  // Lossy-transport mode (set by datagram worlds): a seek timeout with
+  // the expected seqn absent but later seqns queued is treated as an
+  // unrecoverable loss hole and the route cursor resyncs.  On reliable
+  // FIFO rungs the same signature means corruption and stays a hard
+  // PACK_SEQ error (fault-injection contract).
+  void set_lossy_transport(bool on) { lossy_transport_ = on; }
+
  private:
   // engine loop
   void loop();
@@ -266,12 +273,27 @@ class Engine {
   Fifo<RndzvDone> completions_;
   std::map<uint32_t, std::shared_ptr<Fifo<std::vector<uint8_t>>>> streams_;
   std::mutex streams_mu_;
+
+  // Stream-destined messages bypass the rx pool, so they carry their own
+  // per-(comm, peer, stream) sequence space and ingress resequences them
+  // before pushing to the stream FIFO — FIFO transports never exercise
+  // this, but the datagram rung delivers out of order (closes the
+  // engine.cpp seqn exemption noted in round 2's review).
+  using StrmKey = std::tuple<uint32_t, uint32_t, uint32_t>;  // comm,peer,strm
+  //: max out-of-order stream messages parked per route before a lossy
+  //: rung declares the gap a loss hole and resyncs (bounds holdback)
+  static constexpr size_t kStrmHoldbackLimit = 64;
+  std::map<StrmKey, uint32_t> strm_out_seq_;  // engine loop thread only
+  std::map<StrmKey, uint32_t> strm_in_seq_;
+  std::map<std::pair<StrmKey, uint32_t>, std::vector<uint8_t>> strm_holdback_;
+  std::mutex strm_seq_mu_;
   Fifo<std::vector<uint8_t>> krnl_in_;
 
   std::vector<CommTable> comms_;
   std::vector<ArithCfgN> arithcfgs_;
   std::mutex cfg_mu_;
 
+  std::atomic<bool> lossy_transport_{false};
   uint64_t timeout_ = 1'000'000;  // in emulated cycles; 1 cycle = 1us here
   uint64_t max_eager_ = 32 * 1024;
   uint64_t max_rndzv_ = 32 * 1024;

@@ -105,6 +105,34 @@ class RxPool {
     }
   }
 
+  // Is a queued entry with exactly this seqn present on the route (any
+  // tag)?  Distinguishes a tag-mismatched seek (expected seqn present,
+  // documented PACK_SEQ semantics) from a genuine loss hole (seqn absent
+  // forever on a lossy rung) — only the latter may resync.
+  bool has_seqn(uint32_t comm, uint32_t src, uint32_t seqn) const {
+    return notif_.any([=](const RxNotification& x) {
+      return x.comm == comm && x.src == src && x.seqn == seqn;
+    });
+  }
+
+  // Oldest (wrap-aware smallest) seqn strictly ahead of `expected` among
+  // queued entries on the (comm, src) route, any tag.  After a seek
+  // timeout this is the lossy-rung resync point: the expected seqn was
+  // lost in flight (e.g. a dropped datagram fragment) and will never
+  // arrive, so the route cursor can advance to the oldest survivor
+  // instead of wedging every future receive on the route.
+  std::optional<uint32_t> min_ahead_seqn(uint32_t comm, uint32_t src,
+                                         uint32_t expected) const {
+    std::optional<uint32_t> best;
+    notif_.for_each([&](const RxNotification& x) {
+      if (x.comm == comm && x.src == src &&
+          int32_t(x.seqn - expected) > 0 &&
+          (!best || int32_t(x.seqn - *best) < 0))
+        best = x.seqn;
+    });
+    return best;
+  }
+
   // Is at least one buffer IDLE right now?  (pressure probe)
   bool has_idle() const {
     std::lock_guard<std::mutex> g(m_);

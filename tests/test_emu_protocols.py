@@ -138,8 +138,12 @@ def test_tcp_transport_allreduce():
                for r in range(nranks)]
     for t in threads:
         t.start()
+    # generous join budget: under full-suite load on a single core the
+    # connect/accept + allreduce round can take well over a minute
     for t in threads:
-        t.join(timeout=60)
+        t.join(timeout=180)
+    alive = [i for i, t in enumerate(threads) if t.is_alive()]
+    assert not alive, f"rank threads still running after join: {alive}"
     assert not errors, errors
     exp = _data(count, 0) + _data(count, 1)
     for r in range(nranks):
