@@ -74,6 +74,18 @@ class TpuBuffer(BaseBuffer):
 
     def set_dev_range(self, start: int, values) -> None:
         """Write `values` into device elements [start, start+len)."""
+        if start == 0 and values.shape[0] == self._dev.shape[0] \
+                and values.dtype == self._dev.dtype:
+            # full overwrite: adopt the array instead of dispatching a
+            # device scatter (the gang path's per-rank hot path); keep
+            # the buffer pinned to its rank's device — host-built values
+            # land on the default device otherwise
+            import jax
+
+            if getattr(values, "device", None) != self._jax_device:
+                values = jax.device_put(values, self._jax_device)
+            self._dev = values
+            return
         self._dev = self._dev.at[start:start + values.shape[0]].set(values)
 
     def sync_to_device(self) -> None:
@@ -454,6 +466,13 @@ class TpuEngine:
             buf, off = self.resolve(g, call.addr_0)
             if buf is None:
                 buf, off = self.resolve(g, call.addr_2)
+            # fast path: whole-buffer operand already on its device — no
+            # slice, no transfer, just an on-device reshape (the zero-copy
+            # call path, accl.cpp:796-839)
+            if off == 0 and buf.dev.shape[0] == in_len \
+                    and buf.dev.dtype == dtype:
+                shards.append(buf.dev.reshape(1, in_len))
+                continue
             shard = buf.dev[off:off + in_len]
             if shard.dtype != dtype:
                 shard = shard.astype(dtype)

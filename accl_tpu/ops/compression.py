@@ -16,7 +16,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_BLOCK_ROWS = 512
+_BLOCK_ROWS = 1024
 _LANES = 128
 
 

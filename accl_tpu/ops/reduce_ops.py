@@ -33,13 +33,15 @@ def _kernel_max(a_ref, b_ref, o_ref):
     o_ref[:] = jnp.maximum(a_ref[:], b_ref[:])
 
 
-@functools.partial(jax.jit, static_argnames=("is_max", "interpret"))
-def _pallas_combine_2d(a, b, is_max: bool = False, interpret: bool = False):
+@functools.partial(jax.jit,
+                   static_argnames=("is_max", "interpret", "block_rows"))
+def _pallas_combine_2d(a, b, is_max: bool = False, interpret: bool = False,
+                       block_rows: int = 0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     rows, cols = a.shape
-    block_rows = min(_BLOCK_ROWS, rows)
+    block_rows = min(block_rows or _BLOCK_ROWS, rows)
     grid = (pl.cdiv(rows, block_rows),)
     spec = pl.BlockSpec((block_rows, cols), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
@@ -64,14 +66,16 @@ def _to_tiles(x):
     return flat.reshape(rows, _LANES), n
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def pallas_add(a, b, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("interpret", "block_rows"))
+def pallas_add(a, b, interpret: bool = False, block_rows: int = 0):
     """Elementwise sum lane (reduce_ops TDEST 0/2/4/6/8).  Jitted end to
     end so the tiling reshapes are layout no-ops instead of device
-    copies."""
+    copies.  `block_rows` overrides the VMEM tile depth (bench autotune;
+    0 = default)."""
     a2, n = _to_tiles(a)
     b2, _ = _to_tiles(b)
-    out = _pallas_combine_2d(a2, b2, is_max=False, interpret=interpret)
+    out = _pallas_combine_2d(a2, b2, is_max=False, interpret=interpret,
+                             block_rows=block_rows)
     return out.reshape(-1)[:n].reshape(a.shape)
 
 

@@ -4,6 +4,7 @@
 
 #include <algorithm>
 #include <cassert>
+#include <cstdio>
 
 namespace accl {
 
@@ -30,6 +31,7 @@ Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
   arithcfgs_.reserve(64);
   transport_->start([this](Message&& m) { ingress(std::move(m)); });
   loop_thread_ = std::thread([this] { loop(); });
+  egress_thread_ = std::thread([this] { egress_loop(); });
 }
 
 Engine::~Engine() {
@@ -38,6 +40,16 @@ Engine::~Engine() {
   completions_.close();
   pending_addrs_.close();
   if (loop_thread_.joinable()) loop_thread_.join();
+  {
+    // drain staged segments so tail messages of completed calls are not
+    // lost, then stop the writer
+    std::unique_lock<std::mutex> g(egress_mu_);
+    egress_cv_.wait_for(g, std::chrono::seconds(2),
+                        [&] { return egress_q_.empty(); });
+    egress_running_ = false;
+  }
+  egress_cv_.notify_all();
+  if (egress_thread_.joinable()) egress_thread_.join();
   transport_->stop();
 }
 
@@ -210,7 +222,7 @@ void Engine::send_out(uint32_t session, Message&& msg) {
       Message dup;
       dup.hdr = msg.hdr;
       dup.payload = msg.payload;
-      transport_->send(session, std::move(dup));
+      stage_egress(session, std::move(dup));
       break;
     }
     case 3:  // corrupt the sequence number
@@ -219,7 +231,48 @@ void Engine::send_out(uint32_t session, Message&& msg) {
     default:
       break;
   }
-  transport_->send(session, std::move(msg));
+  stage_egress(session, std::move(msg));
+}
+
+// Stage one wire message into the bounded egress window; blocks while
+// `pipeline_depth_` segments are already outstanding (the end_move()
+// backpressure point of the reference's pipelined send).
+void Engine::stage_egress(uint32_t session, Message&& msg) {
+  {
+    std::unique_lock<std::mutex> g(egress_mu_);
+    egress_cv_.wait(g, [&] {
+      return egress_q_.size() < pipeline_depth_.load() || !egress_running_;
+    });
+    if (!egress_running_) return;
+    egress_q_.emplace_back(session, std::move(msg));
+  }
+  egress_cv_.notify_all();
+}
+
+void Engine::egress_loop() {
+  for (;;) {
+    std::pair<uint32_t, Message> item;
+    {
+      std::unique_lock<std::mutex> g(egress_mu_);
+      egress_cv_.wait(g, [&] { return !egress_q_.empty() || !egress_running_; });
+      if (egress_q_.empty()) {
+        if (!egress_running_) return;
+        continue;
+      }
+      item = std::move(egress_q_.front());
+      egress_q_.pop_front();
+    }
+    egress_cv_.notify_all();  // wake staging waiters + the drain in ~Engine
+    try {
+      transport_->send(item.first, std::move(item.second));
+    } catch (const std::exception& e) {
+      // a transport failure (connect refused, peer gone) must not
+      // escape this thread — std::terminate would kill the process.
+      // The message is dropped; the peer's receive timeout reports it.
+      std::fprintf(stderr, "[accl engine %u] egress send failed: %s\n",
+                   global_rank_, e.what());
+    }
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -385,6 +438,9 @@ void Engine::set_tuning(uint32_t key, uint32_t value) {
     case REDUCE_FLAT_TREE_MAX_RANKS: reduce_flat_max_ranks_ = value; break;
     case GATHER_FLAT_TREE_MAX_FANIN:
       gather_flat_max_fanin_ = value ? value : 1;
+      break;
+    case EGRESS_PIPELINE_DEPTH:
+      pipeline_depth_ = value ? value : 1;
       break;
   }
 }
@@ -858,6 +914,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
   uint64_t seg_elems = std::max<uint64_t>(1, seg_wire / d.eb(wire_c));
 
   uint64_t off = 0;
+  uint64_t consumed_chunks = 0;
   bool first = true;
   while (off < elems || (first && elems == 0)) {
     first = false;
@@ -898,9 +955,23 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
       // error, entry kept for the correctly-ordered recv).
       if (lossy_transport_ &&
           !rx_.has_seqn(c.comm(), src, t.inbound_seq[src])) {
-        if (auto ahead =
-                rx_.min_ahead_seqn(c.comm(), src, t.inbound_seq[src]))
-          t.inbound_seq[src] = *ahead;
+        // The hole sits inside THIS message, whose remaining segments
+        // occupy exactly the seqn window [expected, expected+remaining).
+        // Evict any survivors in that window (a stranded tail segment
+        // carries this recv's tag and a future same-tag seek would
+        // silently consume it as shifted data) and advance the cursor
+        // past the whole message — a queued FUTURE same-tag message
+        // starts after the window, survives untouched, and matches the
+        // next recv, which is exactly the in-order matching contract.
+        // Tradeoff: a recv that merely timed out waiting for a slow (not
+        // lost) sender also skips; its late segments arrive behind the
+        // cursor and are dropped as stale — loss semantics, by design,
+        // on the lossy rung only.
+        uint64_t total_chunks =
+            elems ? (elems + seg_elems - 1) / seg_elems : 1;
+        uint32_t remaining = uint32_t(total_chunks - consumed_chunks);
+        rx_.evict_window(c.comm(), src, tag, t.inbound_seq[src], remaining);
+        t.inbound_seq[src] += remaining;
       }
       sticky_err_ |= mismatched ? PACK_SEQ_NUMBER_ERROR
                                 : RECEIVE_TIMEOUT_ERROR;
@@ -946,6 +1017,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     // pin a pool buffer until some later timeout runs eviction
     rx_.drop_stale(c.comm(), src, tag, note->seqn);
     off += chunk;
+    ++consumed_chunks;
   }
 }
 
@@ -1524,8 +1596,12 @@ void Engine::coll_alltoall(CallDesc& c, Progress& p) {
     send_eager(c, r, c.tag(), c.addr0() + uint64_t(r) * op_stride, elems,
                false, 0, comp);
   }
+  // receive in the same relative order every peer sends (peer local+1
+  // sent to us first): consuming earliest arrivals first drains the rx
+  // pool instead of pinning it behind a not-yet-arrived route, which
+  // matters when (P-1) x segments approaches the pool size
   for (uint32_t i = 1; i < P; ++i) {
-    uint32_t r = (t.local + P - i) % P;
+    uint32_t r = (t.local + P - i) % P;  // peer for whom we are (their+i)
     recv_eager(c, r, c.tag(), c.addr2() + uint64_t(r) * res_stride, elems,
                RecvMode::COPY, 0, comp);
   }

@@ -268,6 +268,24 @@ class Engine {
   std::atomic<uint32_t> fault_{0};
   //: egress funnel applying any injected fault before the transport
   void send_out(uint32_t session, Message&& msg);
+
+  // ---- egress pipeline: bounded outstanding-segment window ----
+  // The engine loop stages each prepared segment here and immediately
+  // starts preparing the next (memory read + conversion of segment k+1
+  // overlaps wire transmission of segment k); a dedicated writer thread
+  // drains to the transport in FIFO order.  Staging blocks once
+  // `pipeline_depth_` segments are outstanding — the reference firmware's
+  // 2-3-deep eager software-pipelining discipline (its send keeps
+  // expected_ack_count <= 3 moves in flight and end_move()s beyond that,
+  // ccl_offload_control.c:628-649, :1981-1986).
+  void egress_loop();
+  void stage_egress(uint32_t session, Message&& msg);
+  std::deque<std::pair<uint32_t, Message>> egress_q_;
+  std::mutex egress_mu_;
+  std::condition_variable egress_cv_;
+  std::atomic<uint32_t> pipeline_depth_{3};
+  bool egress_running_ = true;  // guarded by egress_mu_
+  std::thread egress_thread_;
   RxPool rx_;
   Fifo<RndzvAddr> pending_addrs_;
   Fifo<RndzvDone> completions_;
@@ -307,6 +325,9 @@ class Engine {
     BCAST_FLAT_TREE_MAX_RANKS = 0,
     REDUCE_FLAT_TREE_MAX_RANKS = 1,
     GATHER_FLAT_TREE_MAX_FANIN = 2,
+    //: outstanding eager segments per engine (1 = strictly serial; the
+    //: reference pipelines 2-3 moves, fw :628-649)
+    EGRESS_PIPELINE_DEPTH = 3,
   };
   void set_tuning(uint32_t key, uint32_t value);
 

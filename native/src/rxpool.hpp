@@ -115,22 +115,26 @@ class RxPool {
     });
   }
 
-  // Oldest (wrap-aware smallest) seqn strictly ahead of `expected` among
-  // queued entries on the (comm, src) route, any tag.  After a seek
-  // timeout this is the lossy-rung resync point: the expected seqn was
-  // lost in flight (e.g. a dropped datagram fragment) and will never
-  // arrive, so the route cursor can advance to the oldest survivor
-  // instead of wedging every future receive on the route.
-  std::optional<uint32_t> min_ahead_seqn(uint32_t comm, uint32_t src,
-                                         uint32_t expected) const {
-    std::optional<uint32_t> best;
-    notif_.for_each([&](const RxNotification& x) {
-      if (x.comm == comm && x.src == src &&
-          int32_t(x.seqn - expected) > 0 &&
-          (!best || int32_t(x.seqn - *best) < 0))
-        best = x.seqn;
-    });
-    return best;
+  // Evict queued entries on (comm, src, tag) whose seqn lies in the
+  // wrap-aware window [from, from + count) — the surviving segments of
+  // a partially-lost message, which a future same-tag seek must never
+  // consume as its own data.  Returns the number evicted.
+  int evict_window(uint32_t comm, uint32_t src, uint32_t tag, uint32_t from,
+                   uint32_t count) {
+    int evicted = 0;
+    for (;;) {
+      auto n = notif_.pop_match(
+          [=](const RxNotification& x) {
+            return x.comm == comm && x.src == src &&
+                   (tag == TAG_ANY || x.tag == tag) &&
+                   int32_t(x.seqn - from) >= 0 &&
+                   uint32_t(x.seqn - from) < count;
+          },
+          std::chrono::nanoseconds(0));
+      if (!n) return evicted;
+      release(n->index);
+      ++evicted;
+    }
   }
 
   // Is at least one buffer IDLE right now?  (pressure probe)

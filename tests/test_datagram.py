@@ -199,10 +199,10 @@ def test_fragment_loss_detected_and_recovered(world):
     world_ref = world
     world.run(fn)
 
-    # recovery: the lost message left a hole in the route's sequence
-    # space.  The first recv behind the hole fails (at-most-once with an
-    # explicit error — never silent substitution) while resyncing the
-    # route cursor to the queued survivor; the re-issued recv succeeds.
+    # recovery: the failed recv reported an explicit error (at-most-once,
+    # never silent substitution) AND advanced the route cursor past the
+    # lost message's whole seqn window, evicting any stranded same-tag
+    # tail segments — so the next message on the route matches directly.
     def again(accl, rank):
         if rank >= 2:
             return
@@ -211,13 +211,7 @@ def test_fragment_loss_detected_and_recovered(world):
             accl.send(src, count, 1, tag=78)
         else:
             dst = accl.create_buffer(count, np.float32)
-            accl.set_timeout(500_000)
-            try:
-                with pytest.raises(Exception):
-                    accl.recv(dst, count, 0, tag=78)  # resyncs past hole
-                accl.recv(dst, count, 0, tag=78)      # survivor matches
-            finally:
-                accl.set_timeout(1_000_000)
+            accl.recv(dst, count, 0, tag=78)
             np.testing.assert_array_equal(dst.host, _data(count, 0, 8))
 
     world.run(again)

@@ -308,3 +308,30 @@ def test_sendrecv_segmentation(world, multiplier, offset):
         np.testing.assert_array_equal(res.host, _data(count, rank, salt=30 + offset))
 
     world.run(fn)
+
+
+# ---------------------------------------------------------------------------
+# eager egress pipelining (reference: the firmware keeps 2-3 moves in
+# flight per send and applies end_move() backpressure beyond that,
+# ccl_offload_control.c:628-649, :1981-1986; here TuningKey 3 =
+# EGRESS_PIPELINE_DEPTH bounds the outstanding-segment window)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_egress_pipeline_depths(depth):
+    count = 2000  # ~8 segments of 1 KB per message
+    with EmuWorld(2, max_eager_size=16384) as w:
+        def fn(accl, rank):
+            accl.set_tuning(3, depth)  # EGRESS_PIPELINE_DEPTH
+            nxt, prv = (rank + 1) % 2, (rank - 1) % 2
+            for round_ in range(3):
+                src = accl.create_buffer_like(_data(count, rank, salt=round_))
+                dst = accl.create_buffer(count, np.float32)
+                req = accl.send(src, count, nxt, tag=round_, run_async=True)
+                accl.recv(dst, count, prv, tag=round_)
+                assert req.wait(timeout=30.0)
+                req.check()
+                # FIFO order + integrity across the window
+                np.testing.assert_array_equal(
+                    dst.host, _data(count, prv, salt=round_))
+
+        w.run(fn)

@@ -334,15 +334,23 @@ def test_driver_allreduce_close_to_raw_psum():
         def fn(accl, rank):
             send = accl.create_buffer_like(np.zeros(n, np.float32))
             recv = accl.create_buffer(n, np.float32)
-            accl.allreduce(send, recv, n)  # warm the compile cache
+            send.sync_to_device()
+            # zero-copy call path (reference accl.cpp:796-839): device-
+            # resident operands, no host staging per call
+            accl.allreduce(send, recv, n, from_fpga=True, to_fpga=True)
             t0 = time.perf_counter()
             for _ in range(3):
-                accl.allreduce(send, recv, n)
+                accl.allreduce(send, recv, n, from_fpga=True, to_fpga=True)
             return (time.perf_counter() - t0) / 3
 
         drv_dt = max(w.run(fn))
+        on_tpu = jax.default_backend() not in ("cpu",)
     ratio = drv_dt / max(raw_dt, 1e-9)
-    # 2x is the hardware target; CPU-virtual-device CI gets headroom for
-    # the Python gang scheduler on a single core
-    assert ratio < 25, f"driver allreduce {drv_dt:.4f}s vs raw psum " \
-                       f"{raw_dt:.4f}s (ratio {ratio:.1f}x)"
+    # 2x is the hardware target (asserted when running on real TPU);
+    # the CPU virtual-device rung gets single-digit headroom for the
+    # Python gang scheduler sharing one core with the XLA runtime —
+    # a reintroduced per-call host round-trip or retrace blows this to
+    # 50-100x, which is the regression this guards
+    bound = 2.0 if on_tpu else 10.0
+    assert ratio < bound, f"driver allreduce {drv_dt:.4f}s vs raw psum " \
+                          f"{raw_dt:.4f}s (ratio {ratio:.1f}x, bound {bound}x)"
