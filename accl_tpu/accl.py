@@ -110,7 +110,18 @@ class ACCL:
         self.set_max_eager_msg_size(max_eager_size)
         self.set_max_rendezvous_msg_size(max_rendezvous_size)
 
-        # 6. enable transport engines (reference: accl.cpp:1122-1125)
+        # 6. flat-tree tuning registers (reference
+        #    configure_tuning_parameters, accl.cpp:1214-1224): gather
+        #    fan-in 2 above 32 KB, bcast flat <= 3 ranks, reduce flat
+        #    <= 4 ranks or <= min(rndzv/4, 32 KB)
+        self.set_tuning(self.GATHER_FLAT_TREE_MAX_FANIN, 2)
+        self.set_tuning(self.GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024)
+        self.set_tuning(self.BCAST_FLAT_TREE_MAX_RANKS, 3)
+        self.set_tuning(self.REDUCE_FLAT_TREE_MAX_RANKS, 4)
+        self.set_tuning(self.REDUCE_FLAT_TREE_MAX_COUNT,
+                        min(max_rendezvous_size // 4, 32 * 1024))
+
+        # 7. enable transport engines (reference: accl.cpp:1122-1125)
         self._config_call(CfgFunc.enable_pkt)
         self._initialized = True
 
@@ -169,6 +180,9 @@ class ACCL:
     BCAST_FLAT_TREE_MAX_RANKS = 0
     REDUCE_FLAT_TREE_MAX_RANKS = 1
     GATHER_FLAT_TREE_MAX_FANIN = 2
+    EGRESS_PIPELINE_DEPTH = 3
+    GATHER_FLAT_TREE_MAX_COUNT = 4
+    REDUCE_FLAT_TREE_MAX_COUNT = 5
 
     def set_tuning(self, key: int, value: int) -> None:
         setter = getattr(self._device, "set_tuning", None)
@@ -185,9 +199,20 @@ class ACCL:
     # ------------------------------------------------------------------
     # buffers
     # ------------------------------------------------------------------
-    def create_buffer(self, length: int, dtype=np.float32) -> BaseBuffer:
-        """Allocate a paired host+device buffer
-        (reference: accl.hpp:774-1004 create_buffer<T> family)."""
+    def create_buffer(self, length: int, dtype=np.float32,
+                      host_only: bool = False) -> BaseBuffer:
+        """Allocate a paired host+device buffer; with host_only=True the
+        device residence is the engine's host-memory region instead (the
+        reference's host-only buffers over the external_dma path,
+        accl.hpp:774-1004 create_buffer<T> family + buffer.hpp
+        is_host_only).  Backends without a distinct host region fall
+        back to a normal buffer."""
+        if host_only:
+            try:
+                return self._device.create_buffer(length, np.dtype(dtype),
+                                                  host_only=True)
+            except TypeError:
+                pass  # backend has no host region; plain buffer below
         return self._device.create_buffer(length, np.dtype(dtype))
 
     def create_buffer_like(self, data: np.ndarray) -> BaseBuffer:
@@ -682,6 +707,16 @@ class ACCL:
                 compression = (CompressionFlags.ETH_COMPRESSED
                                | flag_operands(compress_dtype))
 
+        # host-resident operand markers (reference prepare_call sets
+        # OP0/OP1/RES_HOST from Buffer::is_host_only, accl.cpp:1259-1283)
+        host_flags = HostFlags.NO_HOST
+        if not op0.is_dummy and op0.is_host_only:
+            host_flags |= HostFlags.OP0_HOST
+        if not op1.is_dummy and op1.is_host_only:
+            host_flags |= HostFlags.OP1_HOST
+        if not res.is_dummy and res.is_host_only:
+            host_flags |= HostFlags.RES_HOST
+
         return CCLOCall(
             scenario=scenario,
             count=count,
@@ -692,7 +727,7 @@ class ACCL:
             arithcfg=arithcfg,
             compression_flags=compression,
             stream_flags=stream_flags,
-            host_flags=HostFlags.NO_HOST,
+            host_flags=host_flags,
             addr_0=op0.address,
             addr_1=op1.address,
             addr_2=res.address,

@@ -102,6 +102,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_set_tuning.argtypes = [p, i32, u32, u32]
     lib.accl_alloc.restype = u64
     lib.accl_alloc.argtypes = [p, i32, u64, u64]
+    lib.accl_alloc_host.restype = u64
+    lib.accl_alloc_host.argtypes = [p, i32, u64, u64]
     lib.accl_free.argtypes = [p, i32, u64]
     lib.accl_read_mem.argtypes = [p, i32, u64, ctypes.c_void_p, u64]
     lib.accl_write_mem.argtypes = [p, i32, u64, ctypes.c_void_p, u64]
@@ -178,8 +180,15 @@ class EmuDevice(CCLODevice):
             raise ACCLError(f"write_mem({address:#x}, {len(data)}) out of range")
 
     # -- buffers ------------------------------------------------------
-    def create_buffer(self, length: int, dtype: np.dtype) -> BaseBuffer:
+    def create_buffer(self, length: int, dtype: np.dtype,
+                      host_only: bool = False) -> BaseBuffer:
         host = np.zeros(length, dtype=dtype)
+        if host_only:
+            addr = self._lib.accl_alloc_host(self._w, self._rank,
+                                             max(host.nbytes, 64), 64)
+            if addr == 0:
+                raise ACCLError("emulator host-buffer region exhausted")
+            return EmuBuffer(host, self, addr, host_only=True)
         addr = self.alloc_mem(max(host.nbytes, 64))
         return EmuBuffer(host, self, addr)
 

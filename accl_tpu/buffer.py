@@ -67,6 +67,14 @@ class BaseBuffer:
     def is_dummy(self) -> bool:
         return False
 
+    @property
+    def is_host_only(self) -> bool:
+        """True for buffers resident in host memory that the engine
+        reaches over the host path (reference: Buffer::is_host_only,
+        buffer.hpp; the external_dma / OP0_HOST..RES_HOST move flags,
+        ccl_offload_control.h:128-138)."""
+        return False
+
     # -- data movement ------------------------------------------------
     def sync_to_device(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -126,10 +134,16 @@ class EmuBuffer(BaseBuffer):
     SimBuffer's ZMQ mem read/write (simbuffer.hpp).
     """
 
-    def __init__(self, host: np.ndarray, device, address: int, owner: bool = True):
+    def __init__(self, host: np.ndarray, device, address: int, owner: bool = True,
+                 host_only: bool = False):
         super().__init__(host, address)
         self._device = device
         self._owner = owner
+        self._host_only = host_only
+
+    @property
+    def is_host_only(self) -> bool:
+        return self._host_only
 
     def sync_to_device(self) -> None:
         self._device.write_mem(self._address, self._host.tobytes())
@@ -145,6 +159,7 @@ class EmuBuffer(BaseBuffer):
             self._device,
             self._address + start * itemsize,
             owner=False,
+            host_only=self._host_only,
         )
 
     def free(self) -> None:

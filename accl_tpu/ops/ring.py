@@ -36,6 +36,39 @@ def _interp(interpret: bool):
         return True
 
 
+# ---------------------------------------------------------------------------
+# Flow-control window algebra — shared by the kernels below and by the
+# discrete-event replay in tests/test_ring_flowcontrol.py, which runs
+# the schedule under adversarial delivery and fails on any off-by-one
+# (double-buffer overrun, deadlock, or semaphore-ledger leak) BEFORE it
+# can deadlock real hardware.  The CPU interpreter serializes
+# rdma.start();rdma.wait() and can never provoke these races itself.
+#
+# All-gather: comm slot parity flips every step; the slot we will land
+# the NEXT incoming chunk in was last read by our own forwarding send
+# one step ago, so from step 1 on we must hold the left neighbor off
+# until we ACK, and we ACK a slot as soon as our send out of it
+# completes — except the last two steps, whose slots are never written
+# again (fw RAW hazard :1457-1460).
+def ag_waits_ack(step: int, P: int) -> bool:
+    return step >= 1
+
+
+def ag_signals_ack(step: int, P: int) -> bool:
+    return step <= P - 3
+
+
+# Reduce-scatter: the landing buffer (not the accumulator) is double-
+# buffered; a slot is reusable after the fold that consumed it, two
+# steps after it was written.
+def rs_waits_ack(step: int, P: int) -> bool:
+    return step >= 2
+
+
+def rs_signals_ack(step: int, P: int) -> bool:
+    return step <= P - 4
+
+
 def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
                            collective_id: int = 0):
     """All-gather over a ring: per-member [n, ...] → [P, n, ...].
@@ -82,7 +115,7 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
             # its consumption ACK so a fast ring segment can't overrun the
             # double buffer (the firmware's rx-buffer RAW hazard,
             # fw :1457-1460, solved with sequence windows there)
-            if step >= 1:
+            if ag_waits_ack(step, P):
                 pltpu.semaphore_wait(ack_sem.at[nxt], 1)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=comm_buf.at[slot],
@@ -96,7 +129,7 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
             rdma.wait()
             # our send of comm_buf[slot] is complete: that slot is free
             # for the left neighbor's next write into it
-            if step <= P - 3:
+            if ag_signals_ack(step, P):
                 pltpu.semaphore_signal(
                     ack_sem.at[slot], inc=1, device_id=left,
                     device_id_type=pltpu.DeviceIdType.LOGICAL)
@@ -164,7 +197,7 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
             # flow control: the landing slot we target was consumed by
             # the right neighbor's fold two steps ago — wait for its ACK
             # so ring skew can't overrun the double buffer
-            if step >= 2:
+            if rs_waits_ack(step, P):
                 pltpu.semaphore_wait(ack_sem.at[slot], 1)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=acc,
@@ -188,7 +221,7 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
                 acc[...] = acc[...] + landing[slot]
             # landing[slot] consumed: free it for the left neighbor's
             # write at its step (step + 2)
-            if step <= P - 4:
+            if rs_signals_ack(step, P):
                 pltpu.semaphore_signal(
                     ack_sem.at[slot], inc=1, device_id=left,
                     device_id_type=pltpu.DeviceIdType.LOGICAL)

@@ -62,9 +62,11 @@ template <> struct dtype_of<uint16_t> { static constexpr DType value = DType::f1
 template <typename T>
 class Buffer {
  public:
-  Buffer(Engine* e, uint64_t n, DType dt = dtype_of<T>::value)
-      : e_(e), n_(n), dtype_(dt) {
-    addr_ = e_->alloc(n * sizeof(T), 64);
+  Buffer(Engine* e, uint64_t n, DType dt = dtype_of<T>::value,
+         bool host_only = false)
+      : e_(e), n_(n), dtype_(dt), host_only_(host_only) {
+    addr_ = host_only ? e_->alloc_host(n * sizeof(T), 64)
+                      : e_->alloc(n * sizeof(T), 64);
     if (!addr_) throw std::runtime_error("device memory exhausted");
     host_.resize(n);
   }
@@ -80,6 +82,7 @@ class Buffer {
   uint64_t length() const { return n_; }
   uint64_t address() const { return addr_; }
   DType dtype() const { return dtype_; }
+  bool is_host_only() const { return host_only_; }
 
   void sync_to_device() {
     e_->write_mem(addr_, host_.data(), n_ * sizeof(T));
@@ -92,6 +95,7 @@ class Buffer {
   Engine* e_;
   uint64_t n_, addr_ = 0;
   DType dtype_;
+  bool host_only_ = false;
   std::vector<T> host_;
 };
 
@@ -101,10 +105,13 @@ struct Operand {
   uint64_t addr = 0;
   DType dtype = DType::none;
   bool present = false;
+  bool host = false;  // host-resident (OP0/OP1/RES_HOST flags)
 
   Operand() = default;
   template <typename T>
-  Operand(Buffer<T>& b) : addr(b.address()), dtype(b.dtype()), present(true) {}
+  Operand(Buffer<T>& b)
+      : addr(b.address()), dtype(b.dtype()), present(true),
+        host(b.is_host_only()) {}
   // absent operand carrying only a dtype hint (data_type_io_*)
   static Operand hint(DType d) {
     Operand o;
@@ -168,6 +175,14 @@ class ACCL {
     config(CfgFunc::SetMaxEagerMsgSize,
            uint32_t(max_eager ? max_eager : rx_buf_size));
     config(CfgFunc::SetMaxRendezvousMsgSize, uint32_t(max_rndzv));
+    // flat-tree tuning registers (reference configure_tuning_parameters,
+    // accl.cpp:1214-1224)
+    e_->set_tuning(Engine::GATHER_FLAT_TREE_MAX_FANIN, 2);
+    e_->set_tuning(Engine::GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024);
+    e_->set_tuning(Engine::BCAST_FLAT_TREE_MAX_RANKS, 3);
+    e_->set_tuning(Engine::REDUCE_FLAT_TREE_MAX_RANKS, 4);
+    e_->set_tuning(Engine::REDUCE_FLAT_TREE_MAX_COUNT,
+                   uint32_t(std::min<uint64_t>(max_rndzv / 4, 32 * 1024)));
     config(CfgFunc::EnablePkt, 0);
     world_ = uint32_t(sessions.size());
     rank_ = local_rank;
@@ -204,6 +219,14 @@ class ACCL {
   std::unique_ptr<Buffer<T>> create_buffer(uint64_t n,
                                            DType dt = dtype_of<T>::value) {
     return std::make_unique<Buffer<T>>(e_, n, dt);
+  }
+
+  // host-resident buffer (reference create_buffer host-only variants;
+  // the engine reaches it over the host path, external_dma analog)
+  template <typename T>
+  std::unique_ptr<Buffer<T>> create_buffer_host(
+      uint64_t n, DType dt = dtype_of<T>::value) {
+    return std::make_unique<Buffer<T>>(e_, n, dt, /*host_only=*/true);
   }
 
   void check(uint32_t ret) {
@@ -499,6 +522,9 @@ class ACCL {
       }
     }
 
+    uint32_t host_flags = (op0.present && op0.host ? 1u : 0u) |
+                          (op1.present && op1.host ? 2u : 0u) |
+                          (res.present && res.host ? 4u : 0u);
     std::array<uint32_t, 15> w{};
     w[0] = uint32_t(op);
     w[1] = count;
@@ -508,7 +534,7 @@ class ACCL {
     w[5] = tag;
     w[6] = uint32_t(arith);
     w[7] = flags;
-    w[8] = stream_flags;
+    w[8] = stream_flags | (host_flags << 8);
     w[9] = uint32_t(op0.addr);
     w[10] = uint32_t(op0.addr >> 32);
     w[11] = uint32_t(op1.addr);

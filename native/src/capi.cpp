@@ -125,6 +125,14 @@ uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
   return e ? e->alloc(nbytes, align) : 0;
 }
 
+// Host-resident buffer region (the reference's host-only buffers /
+// external_dma path); returned addresses carry the engine's host tag.
+uint64_t accl_alloc_host(void* wp, int rank, uint64_t nbytes,
+                         uint64_t align) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->alloc_host(nbytes, align) : 0;
+}
+
 void accl_free(void* wp, int rank, uint64_t addr) {
   Engine* e = static_cast<World*>(wp)->get(rank);
   if (e) e->free_addr(addr);

@@ -22,8 +22,10 @@ Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
                std::unique_ptr<Transport> transport)
     : global_rank_(global_rank),
       devicemem_(devmem_bytes),
+      hostmem_(devmem_bytes / 2),
       transport_(std::move(transport)) {
   free_spans_[0x1000] = devmem_bytes - 0x1000;
+  host_spans_[0x1000] = hostmem_.size() - 0x1000;
   // avoid vector reallocation races between the engine loop and host-side
   // configuration (the reference's exchange memory is likewise written
   // live while the firmware polls it)
@@ -100,23 +102,40 @@ int Engine::set_arithcfg(const uint32_t* words, int nwords) {
 // device memory (first-fit free-list allocator over the flat devicemem,
 // playing the role of the reference's per-bank XRT BO allocation)
 // ---------------------------------------------------------------------------
-uint64_t Engine::alloc(uint64_t nbytes, uint64_t align) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+// One first-fit body for both address spaces; `tag` is OR'd into the
+// recorded and returned address (0 for device, HOST_ADDR_BIT for host).
+static uint64_t alloc_first_fit(std::map<uint64_t, uint64_t>& spans,
+                                std::map<uint64_t, uint64_t>& sizes,
+                                uint64_t nbytes, uint64_t align,
+                                uint64_t tag) {
   if (align == 0) align = 64;
   if (nbytes == 0) nbytes = align;
-  for (auto it = free_spans_.begin(); it != free_spans_.end(); ++it) {
+  for (auto it = spans.begin(); it != spans.end(); ++it) {
     uint64_t base = it->first, size = it->second;
     uint64_t aligned = (base + align - 1) / align * align;
     uint64_t pad = aligned - base;
     if (size < pad + nbytes) continue;
-    free_spans_.erase(it);
-    if (pad) free_spans_[base] = pad;
+    spans.erase(it);
+    if (pad) spans[base] = pad;
     uint64_t rest = size - pad - nbytes;
-    if (rest) free_spans_[aligned + nbytes] = rest;
-    alloc_sizes_[aligned] = nbytes;
-    return aligned;
+    if (rest) spans[aligned + nbytes] = rest;
+    sizes[aligned | tag] = nbytes;
+    return aligned | tag;
   }
   return 0;  // OOM
+}
+
+uint64_t Engine::alloc(uint64_t nbytes, uint64_t align) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  return alloc_first_fit(free_spans_, alloc_sizes_, nbytes, align, 0);
+}
+
+// Host-region allocator: same first-fit discipline over the host span
+// map; returned addresses carry HOST_ADDR_BIT.
+uint64_t Engine::alloc_host(uint64_t nbytes, uint64_t align) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  return alloc_first_fit(host_spans_, alloc_sizes_, nbytes, align,
+                         HOST_ADDR_BIT);
 }
 
 void Engine::free_addr(uint64_t addr) {
@@ -125,42 +144,50 @@ void Engine::free_addr(uint64_t addr) {
   if (it == alloc_sizes_.end()) return;
   uint64_t size = it->second;
   alloc_sizes_.erase(it);
+  auto& spans = (addr & HOST_ADDR_BIT) ? host_spans_ : free_spans_;
+  addr &= ~HOST_ADDR_BIT;
   // insert + merge with neighbors
-  auto next = free_spans_.lower_bound(addr);
-  if (next != free_spans_.end() && addr + size == next->first) {
+  auto next = spans.lower_bound(addr);
+  if (next != spans.end() && addr + size == next->first) {
     size += next->second;
-    next = free_spans_.erase(next);
+    next = spans.erase(next);
   }
-  if (next != free_spans_.begin()) {
+  if (next != spans.begin()) {
     auto prev = std::prev(next);
     if (prev->first + prev->second == addr) {
       prev->second += size;
       return;
     }
   }
-  free_spans_[addr] = size;
+  spans[addr] = size;
 }
 
 bool Engine::read_mem(uint64_t addr, void* dst, uint64_t n) {
-  if (addr + n > devicemem_.size()) return false;
-  std::memcpy(dst, devicemem_.data() + addr, n);
+  auto& region = (addr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
+  addr &= ~HOST_ADDR_BIT;
+  if (addr + n > region.size()) return false;
+  std::memcpy(dst, region.data() + addr, n);
   return true;
 }
 
 bool Engine::write_mem(uint64_t addr, const void* src, uint64_t n) {
-  if (addr + n > devicemem_.size()) return false;
-  std::memcpy(devicemem_.data() + addr, src, n);
+  auto& region = (addr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
+  addr &= ~HOST_ADDR_BIT;
+  if (addr + n > region.size()) return false;
+  std::memcpy(region.data() + addr, src, n);
   return true;
 }
 
 uint8_t* Engine::mem(uint64_t addr, uint64_t n) {
-  if (addr + n > devicemem_.size() || (n > 0 && addr == 0)) {
+  auto& region = (addr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
+  addr &= ~HOST_ADDR_BIT;
+  if (addr + n > region.size() || (n > 0 && addr == 0)) {
     sticky_err_ |= DMA_SIZE_ERROR;
     static thread_local std::vector<uint8_t> bitbucket;
     bitbucket.assign(std::max<uint64_t>(n, 64), 0);
     return bitbucket.data();
   }
-  return devicemem_.data() + addr;
+  return region.data() + addr;
 }
 
 // ---------------------------------------------------------------------------
@@ -357,6 +384,11 @@ void Engine::ingress(Message&& msg) {
         }
       }
       {
+        // the landing address may be tagged host-resident (host-only
+        // rendezvous buffers); resolve the region like mem() does
+        auto& region =
+            (msg.hdr.vaddr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
+        uint64_t vaddr = msg.hdr.vaddr & ~HOST_ADDR_BIT;
         std::lock_guard<std::mutex> g(mem_mu_);
         if (post && post->wire_c != post->lnd_c) {
           // clamp to what actually arrived: a short payload (divergent
@@ -366,16 +398,16 @@ void Engine::ingress(Message&& msg) {
           uint64_t elems = std::min<uint64_t>(
               post->elems, msg.payload.size() / std::max<uint64_t>(1, wire_eb));
           uint64_t lnd_bytes = elems * (post->lnd_c ? post->cb : post->ub);
-          if (msg.hdr.vaddr + lnd_bytes <= devicemem_.size()) {
+          if (vaddr + lnd_bytes <= region.size()) {
             if (post->wire_c)
               run_decompress_lane(post->comp_kind, msg.payload.data(),
-                                  devicemem_.data() + msg.hdr.vaddr, elems);
+                                  region.data() + vaddr, elems);
             else
               run_compress_lane(post->comp_kind, msg.payload.data(),
-                                devicemem_.data() + msg.hdr.vaddr, elems);
+                                region.data() + vaddr, elems);
           }
-        } else if (msg.hdr.vaddr + msg.payload.size() <= devicemem_.size()) {
-          std::memcpy(devicemem_.data() + msg.hdr.vaddr, msg.payload.data(),
+        } else if (vaddr + msg.payload.size() <= region.size()) {
+          std::memcpy(region.data() + vaddr, msg.payload.data(),
                       msg.payload.size());
         }
       }
@@ -441,6 +473,12 @@ void Engine::set_tuning(uint32_t key, uint32_t value) {
       break;
     case EGRESS_PIPELINE_DEPTH:
       pipeline_depth_ = value ? value : 1;
+      break;
+    case GATHER_FLAT_TREE_MAX_COUNT:
+      gather_flat_max_count_ = value;
+      break;
+    case REDUCE_FLAT_TREE_MAX_COUNT:
+      reduce_flat_max_count_ = value;
       break;
   }
 }
@@ -1234,9 +1272,18 @@ void Engine::coll_gather(CallDesc& c, Progress& p) {
     if (t.local == root) {
       local_move(c, c.addr0(), c.addr2() + uint64_t(root) * res_stride,
                  elems, d.op0, d.res);
+      // count-based fan-in (fw :1163): small gathers publish every
+      // landing address at once; above GATHER_FLAT_TREE_MAX_COUNT bytes
+      // the fan-in window caps concurrent inbound writes
+      // root-only decision, so cross-rank divergence is impossible, but
+      // wire width keeps the threshold meaning consistent with reduce
+      uint32_t fanin = (elems * d.eb(d.eth) > gather_flat_max_count_)
+                           ? gather_flat_max_fanin_
+                           : P - 1;
+      fanin = std::max(1u, fanin);
       uint32_t i = 1;
       while (i < P) {
-        uint32_t w = std::min(gather_flat_max_fanin_, P - i);
+        uint32_t w = std::min(fanin, P - i);
         for (uint32_t j = 0; j < w; ++j) {
           uint32_t r = (root + i + j) % P;
           rndzv_post_addr(c, p, r, c.tag(),
@@ -1357,8 +1404,16 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   if (use_rendezvous(c, elems)) {
     // stream-flagged calls never reach rendezvous (use_rendezvous forces
     // eager for them), so the scratch slots are free for the schedules
-    if (P <= reduce_flat_max_ranks_) {
-      // flat: root accumulates every contribution through one scratchpad
+    // count threshold measured on WIRE bytes, like use_rendezvous: the
+    // rank-local uncompressed width diverges across directional arithcfg
+    // pairs and a schedule-selection split would wedge the rendezvous
+    // handshake (fw :1533 consults its own width, but its compression is
+    // symmetric by construction — ours is not)
+    uint64_t wire_bytes = elems * d.eb(d.eth);
+    if (P <= reduce_flat_max_ranks_ || wire_bytes <= reduce_flat_max_count_) {
+      // flat when the world is small OR the payload is small: tree setup
+      // overhead beats the flat fan-in only for large payloads on large
+      // worlds
       if (t.local == root) {
         if (!c.scratch0) c.scratch0 = alloc(bytes, 64);
         step_local(p, [&] {

@@ -72,6 +72,15 @@ class Engine {
   bool read_mem(uint64_t addr, void* dst, uint64_t n);
   bool write_mem(uint64_t addr, const void* src, uint64_t n);
 
+  // ---- host-resident memory (the reference's host-only buffers /
+  // external_dma path: the engine reaches into host memory when an
+  // operand carries OP0/OP1/RES_HOST, ccl_offload_control.h:128-138).
+  // Host addresses are tagged with HOST_ADDR_BIT and resolve into a
+  // separate host region; the same engine primitives move data to and
+  // from it transparently, like the reference's host-capable movers. ----
+  static constexpr uint64_t HOST_ADDR_BIT = 1ull << 62;
+  uint64_t alloc_host(uint64_t nbytes, uint64_t align);
+
   // ---- call path ----
   uint64_t start_call(const uint32_t* w15);
   bool poll_call(uint64_t id, uint32_t* retcode, double* duration_ns);
@@ -241,8 +250,10 @@ class Engine {
   // ---- state ----
   uint32_t global_rank_;
   std::vector<uint8_t> devicemem_;
+  std::vector<uint8_t> hostmem_;              // host-only buffer region
   std::map<uint64_t, uint64_t> free_spans_;   // addr -> size
-  std::map<uint64_t, uint64_t> alloc_sizes_;  // addr -> size
+  std::map<uint64_t, uint64_t> host_spans_;   // untagged addr -> size
+  std::map<uint64_t, uint64_t> alloc_sizes_;  // addr -> size (both spaces)
   std::mutex mem_mu_;
 
   // Landing-pad registry for one-sided writes: rndzv_post_addr records
@@ -328,6 +339,13 @@ class Engine {
     //: outstanding eager segments per engine (1 = strictly serial; the
     //: reference pipelines 2-3 moves, fw :628-649)
     EGRESS_PIPELINE_DEPTH = 3,
+    //: byte thresholds for the count-based schedule selection (the
+    //: reference's *_MAX_COUNT exchange-memory registers,
+    //: ccl_offload_control.h:86-90): gather caps its flat-tree fan-in
+    //: above this size (fw :1163); reduce prefers the flat tree at or
+    //: below it regardless of rank count (fw :1533)
+    GATHER_FLAT_TREE_MAX_COUNT = 4,
+    REDUCE_FLAT_TREE_MAX_COUNT = 5,
   };
   void set_tuning(uint32_t key, uint32_t value);
 
@@ -335,6 +353,8 @@ class Engine {
   uint32_t bcast_flat_max_ranks_ = 4;
   uint32_t reduce_flat_max_ranks_ = 4;
   uint32_t gather_flat_max_fanin_ = 64;
+  uint64_t gather_flat_max_count_ = 32 * 1024;  // bytes (accl.cpp:1216-1217)
+  uint64_t reduce_flat_max_count_ = 32 * 1024;  // bytes (accl.cpp:1222-1224)
 
   Fifo<CallDesc> cmd_q_;
   std::deque<CallDesc> retry_q_;  // firmware retry FIFO (fw :2460-2479)

@@ -445,6 +445,64 @@ static void test_multicomm(ACCL& a, int rank) {
   }
 }
 
+static void test_host_buffers(ACCL& a, int rank) {
+  // host-resident operands (reference host-only buffers / external_dma):
+  // allreduce with a host-only send and a host-only recv on every rank
+  const uint32_t N = 300;
+  auto s = a.create_buffer_host<float>(N);
+  auto r = a.create_buffer_host<float>(N);
+  auto v = fill(N, rank, 30);
+  std::memcpy(s->data(), v.data(), N * 4);
+  a.allreduce(*s, *r, N, Reduce::SUM);
+  for (uint32_t i = 0; i < N; ++i) {
+    float want = 0;
+    for (int k = 0; k < NRANKS; ++k) want += fill(N, k, 30)[i];
+    expect_close((*r)[i], want, 1e-4f, "host allreduce");
+  }
+  // mixed residency: device send, host recv over rendezvous sizes
+  const uint32_t M = MAX_EAGER / 4 + 128;
+  auto ds = a.create_buffer<float>(M);
+  auto hr = a.create_buffer_host<float>(M);
+  auto w2 = fill(M, rank, 31);
+  std::memcpy(ds->data(), w2.data(), M * 4);
+  a.allreduce(*ds, *hr, M, Reduce::SUM);
+  for (uint32_t i = 0; i < M; i += 101) {
+    float want = 0;
+    for (int k = 0; k < NRANKS; ++k) want += fill(M, k, 31)[i];
+    expect_close((*hr)[i], want, 1e-4f, "mixed-residency allreduce");
+  }
+}
+
+static void test_count_thresholds(ACCL& a, int rank) {
+  // REDUCE_FLAT_TREE_MAX_COUNT: flat schedule below the byte threshold
+  // regardless of rank count; tree above (fw :1533).  Both must produce
+  // identical results — this drives each side of the boundary.
+  const uint32_t N = MAX_EAGER / 4 + 64;  // rendezvous payload
+  a.engine()->set_tuning(Engine::REDUCE_FLAT_TREE_MAX_RANKS, 1);
+  for (uint32_t max_count : {0u, 1u << 30}) {
+    a.engine()->set_tuning(Engine::REDUCE_FLAT_TREE_MAX_COUNT, max_count);
+    auto s = a.create_buffer<float>(N);
+    auto r = a.create_buffer<float>(N);
+    auto v = fill(N, rank, 32 + int(max_count != 0));
+    std::memcpy(s->data(), v.data(), N * 4);
+    a.reduce(*s, *r, N, 0, Reduce::SUM);
+    if (rank == 0)
+      for (uint32_t i = 0; i < N; i += 97) {
+        float want = 0;
+        for (int k = 0; k < NRANKS; ++k)
+          want += fill(N, k, 32 + int(max_count != 0))[i];
+        expect_close((*r)[i], want, 1e-4f, "count-threshold reduce");
+      }
+    a.barrier();
+  }
+  // GATHER_FLAT_TREE_MAX_COUNT: fan-in capped above the threshold
+  a.engine()->set_tuning(Engine::GATHER_FLAT_TREE_MAX_COUNT, 0);
+  a.engine()->set_tuning(Engine::GATHER_FLAT_TREE_MAX_FANIN, 1);
+  gather_root(a, rank, 0, MAX_EAGER / 4 + 32, DType::none);
+  a.engine()->set_tuning(Engine::GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024);
+  a.engine()->set_tuning(Engine::GATHER_FLAT_TREE_MAX_FANIN, 2);
+}
+
 static void test_barrier_and_nop(ACCL& a, int rank) {
   a.nop();
   for (int i = 0; i < 3; ++i) a.barrier();
@@ -502,6 +560,8 @@ int main() {
       {"reduce_scatter", test_reduce_scatter},
       {"alltoall", test_alltoall},
       {"multicomm", test_multicomm},
+      {"host_buffers", test_host_buffers},
+      {"count_thresholds", test_count_thresholds},
       {"barrier_and_nop", test_barrier_and_nop},
   };
 

@@ -1,0 +1,90 @@
+"""CI perf gate: run bench.py and assert it did not regress.
+
+Compares the fresh bench.py JSON line against the last recorded round
+artifact (BENCH_r*.json, written by the round driver).  Policy:
+
+- same platform (tpu vs tpu): fail below (1 - tolerance) x recorded value;
+- platform downgrade (recorded tpu, now cpu/numpy fallback): the gate is
+  SKIPPED with a warning — CI runners have no TPU, and a fallback number
+  is not comparable to a hardware number;
+- no recorded artifact: record-only mode, always passes.
+
+Usage: python scripts/check_bench_delta.py [--tolerance 0.5]
+(the tolerance is deliberately loose: the bench chip is shared and the
+best-of-trials methodology still moves run to run).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def last_recorded() -> dict | None:
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    for path in reversed(paths):
+        try:
+            doc = json.loads(open(path).read())
+        except json.JSONDecodeError:
+            # the driver concatenates {...}{...} across attempts; take
+            # the last well-formed object
+            raw = open(path).read()
+            idx = raw.rfind('{"n"')
+            if idx < 0:
+                continue
+            try:
+                doc = json.loads(raw[idx:])
+            except json.JSONDecodeError:
+                continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if parsed and parsed.get("value"):
+            parsed["_source"] = os.path.basename(path)
+            return parsed
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tolerance", type=float, default=0.5)
+    args = ap.parse_args()
+
+    proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                          capture_output=True, text=True, timeout=1200)
+    line = next((ln for ln in reversed(proc.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        print(f"perf gate: bench.py failed rc={proc.returncode}",
+              file=sys.stderr)
+        return 1
+    now = json.loads(line)
+    print(f"perf gate: fresh  {now['value']} {now['unit']} "
+          f"({now.get('platform')})")
+
+    ref = last_recorded()
+    if ref is None:
+        print("perf gate: no recorded BENCH_r*.json — record-only pass")
+        return 0
+    print(f"perf gate: recorded {ref['value']} {ref['unit']} "
+          f"({ref.get('platform')}, {ref['_source']})")
+
+    if now.get("platform") != ref.get("platform"):
+        print("perf gate: platform differs (no TPU on this runner?) — "
+              "SKIPPED", file=sys.stderr)
+        return 0
+    floor = ref["value"] * (1.0 - args.tolerance)
+    if now["value"] < floor:
+        print(f"perf gate: REGRESSION — {now['value']} < floor "
+              f"{floor:.1f} ({args.tolerance:.0%} below recorded)",
+              file=sys.stderr)
+        return 1
+    print("perf gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+"""Host-resident buffers + count-based schedule thresholds.
+
+Reference analogs: host-only buffers reached over the external_dma path
+(OP0/OP1/RES_HOST move flags, ccl_offload_control.h:128-138;
+kernels/plugins/external_dma) and the *_MAX_COUNT exchange-memory tuning
+registers consulted by the gather/reduce schedules
+(ccl_offload_control.h:86-90, fw :1163 and :1533, driver defaults
+accl.cpp:1214-1224).
+"""
+import numpy as np
+import pytest
+
+from accl_tpu import ReduceFunction
+from accl_tpu.backends.emu import EmuWorld
+from accl_tpu.constants import HostFlags
+
+NRANKS = 4
+
+
+@pytest.fixture(scope="module")
+def world():
+    with EmuWorld(NRANKS, max_eager_size=4096,
+                  max_rendezvous_size=1 << 20) as w:
+        yield w
+
+
+def _data(count, rank, salt=0):
+    rng = np.random.default_rng(900 + rank + salt * 131)
+    return rng.standard_normal(count).astype(np.float32)
+
+
+def test_host_flags_marshalled(world):
+    # the descriptor must carry OP0/RES_HOST for host-only operands
+    # (prepare_call, accl.cpp:1259-1283)
+    accl = world.accls[0]
+    hb = accl.create_buffer(16, np.float32, host_only=True)
+    db = accl.create_buffer(16, np.float32)
+    assert hb.is_host_only and not db.is_host_only
+    call = accl._build(  # noqa: SLF001 — marshaling contract test
+        __import__("accl_tpu").constants.Operation.allreduce, 16, 0,
+        op0=hb, res=db)
+    assert call.host_flags == HostFlags.OP0_HOST
+    call = accl._build(
+        __import__("accl_tpu").constants.Operation.allreduce, 16, 0,
+        op0=db, res=hb)
+    assert call.host_flags == HostFlags.RES_HOST
+    # slices inherit residency
+    assert hb.slice(2, 8).is_host_only
+
+
+@pytest.mark.parametrize("count", [64, 2048],
+                         ids=["eager", "rendezvous"])
+def test_host_resident_allreduce(world, count):
+    def fn(accl, rank):
+        send = accl.create_buffer(count, np.float32, host_only=True)
+        recv = accl.create_buffer(count, np.float32, host_only=True)
+        send.host[:] = _data(count, rank, 1)
+        accl.allreduce(send, recv, count, ReduceFunction.SUM)
+        want = sum(_data(count, r, 1) for r in range(NRANKS))
+        np.testing.assert_allclose(recv.host, want, rtol=1e-5, atol=1e-5)
+
+    world.run(fn)
+
+
+def test_mixed_residency_sendrecv(world):
+    count = 1500  # multi-segment eager
+
+    def fn(accl, rank):
+        nxt, prv = (rank + 1) % NRANKS, (rank - 1) % NRANKS
+        src = accl.create_buffer(count, np.float32)  # device
+        dst = accl.create_buffer(count, np.float32, host_only=True)
+        src.host[:] = _data(count, rank, 2)
+        req = accl.send(src, count, nxt, tag=3, run_async=True)
+        accl.recv(dst, count, prv, tag=3)
+        assert req.wait(timeout=30.0)
+        req.check()
+        np.testing.assert_array_equal(dst.host, _data(count, prv, 2))
+
+    world.run(fn)
+
+
+def test_reduce_count_threshold_boundary(world):
+    # REDUCE_FLAT_TREE_MAX_COUNT (fw :1533): flat at/below the byte
+    # threshold even when the world exceeds MAX_RANKS; binomial tree
+    # above.  Results must agree on both sides of the boundary.
+    count = 2048  # 8 KB rendezvous payload
+
+    def fn(accl, rank):
+        accl.set_tuning(accl.REDUCE_FLAT_TREE_MAX_RANKS, 1)
+        for max_count, salt in ((0, 4), (1 << 30, 5)):
+            accl.set_tuning(accl.REDUCE_FLAT_TREE_MAX_COUNT, max_count)
+            send = accl.create_buffer(count, np.float32)
+            recv = accl.create_buffer(count, np.float32)
+            send.host[:] = _data(count, rank, salt)
+            accl.reduce(send, recv, count, 0, ReduceFunction.SUM)
+            if rank == 0:
+                want = sum(_data(count, r, salt) for r in range(NRANKS))
+                np.testing.assert_allclose(recv.host, want, rtol=1e-4,
+                                           atol=1e-4)
+            accl.barrier()
+        # restore driver defaults for the module world
+        accl.set_tuning(accl.REDUCE_FLAT_TREE_MAX_RANKS, 4)
+        accl.set_tuning(accl.REDUCE_FLAT_TREE_MAX_COUNT, 32 * 1024)
+
+    world.run(fn)
+
+
+def test_gather_count_threshold_fanin(world):
+    # GATHER_FLAT_TREE_MAX_COUNT (fw :1163): above the byte threshold the
+    # root publishes landing addresses in fan-in-bounded windows
+    count = 2048
+
+    def fn(accl, rank):
+        accl.set_tuning(accl.GATHER_FLAT_TREE_MAX_COUNT, 0)  # always cap
+        accl.set_tuning(accl.GATHER_FLAT_TREE_MAX_FANIN, 1)  # serial
+        send = accl.create_buffer(count, np.float32)
+        recv = accl.create_buffer(count * NRANKS, np.float32)
+        send.host[:] = _data(count, rank, 6)
+        accl.gather(send, recv, count, 0)
+        if rank == 0:
+            want = np.concatenate(
+                [_data(count, r, 6) for r in range(NRANKS)])
+            np.testing.assert_array_equal(recv.host, want)
+        accl.barrier()
+        accl.set_tuning(accl.GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024)
+        accl.set_tuning(accl.GATHER_FLAT_TREE_MAX_FANIN, 2)
+
+    world.run(fn)

@@ -1,0 +1,294 @@
+"""Flow-control property tests for the Pallas ring kernels.
+
+The ring kernels' ack-semaphore windows (ops/ring.py ag_*/rs_* window
+algebra) exist to stop a fast neighbor overrunning the double-buffered
+communication slots — a race the CPU interpreter, which serializes
+`rdma.start(); rdma.wait()`, can never provoke.  These tests replay the
+EXACT schedule (driven by the same shared predicates the kernels
+compile) in a discrete-event model under adversarial timing:
+
+- remote writes land the instant they are issued (worst case for
+  double-buffer overrun),
+- devices are stepped in every relative order the scheduler allows
+  (worst case for deadlock),
+
+and assert three properties for P = 2..8:
+  1. no landing slot is overwritten while its payload is still unread,
+  2. every device completes (no deadlock),
+  3. the ack-semaphore ledger balances (no counts leak across segments,
+     which would poison the next collective reusing the semaphores).
+
+An off-by-one in any window predicate fails here instead of deadlocking
+or corrupting real hardware (the firmware's RAW-hazard discipline,
+ccl_offload_control.c:1457-1460).  A soak over P x segments x ragged
+tails through the real interpret-mode kernels complements the model.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from accl_tpu.ops.ring import (
+    ag_signals_ack,
+    ag_waits_ack,
+    rs_signals_ack,
+    rs_waits_ack,
+)
+
+
+class Device:
+    """One ring member executing the kernel schedule as a coroutine of
+    (op, args) steps; blocked ops return False and are retried."""
+
+    def __init__(self, idx, P, program):
+        self.idx = idx
+        self.P = P
+        self.pc = 0
+        self.program = program  # list of (op, payload)
+        self.done = False
+
+
+def _run_schedule(P, make_program, n_slots):
+    """Adversarial scheduler: eager delivery + every round-robin offset.
+
+    State per device: slot payloads with read-counts, ack semaphore
+    counts.  Returns the violation list (empty = pass).
+    """
+    violations = []
+    for rotation in range(P):  # vary which device runs first each round
+        # slots[d][s] = payload dict or None; a payload tracks the reads
+        # it still owes before the slot may be overwritten
+        slots = [[None] * n_slots for _ in range(P)]
+        acks = [[0] * n_slots for _ in range(P)]
+        devs = [Device(i, P, make_program(i, P)) for i in range(P)]
+
+        def try_step(d):
+            if d.pc >= len(d.program):
+                d.done = True
+                return False
+            op, a = d.program[d.pc]
+            if op == "wait_ack":
+                if acks[d.idx][a["slot"]] < 1:
+                    return False
+                acks[d.idx][a["slot"]] -= 1
+            elif op == "send":
+                # eager delivery: the write lands NOW on the right
+                # neighbor; overrun if the landing slot still owes reads
+                dst = (d.idx + 1) % P
+                tgt = slots[dst][a["slot"]]
+                if tgt is not None and tgt["reads_left"] > 0:
+                    violations.append(
+                        f"P={P} rot={rotation}: dev {d.idx} step "
+                        f"{a['step']} overran dev {dst} slot {a['slot']} "
+                        f"(payload still owes {tgt['reads_left']} reads)")
+                slots[dst][a["slot"]] = {
+                    "reads_left": a["lands_reads"],
+                    "from_step": a["step"],
+                }
+            elif op == "recv":
+                # rdma.wait(): block until the incoming payload landed
+                tgt = slots[d.idx][a["slot"]]
+                if tgt is None or tgt["from_step"] != a["step"]:
+                    return False
+            elif op == "read":
+                tgt = slots[d.idx][a["slot"]]
+                if tgt is not None and tgt["reads_left"] > 0:
+                    tgt["reads_left"] -= 1
+            elif op == "signal_ack":
+                left = (d.idx - 1) % P
+                acks[left][a["slot"]] += 1
+            d.pc += 1
+            return True
+
+        # round-robin from a rotated start until quiescent
+        for _ in range(10_000):
+            progressed = False
+            for k in range(P):
+                d = devs[(k + rotation) % P]
+                while try_step(d):
+                    progressed = True
+            if all(dv.pc >= len(dv.program) for dv in devs):
+                break
+            if not progressed:
+                stuck = [(d.idx, d.pc, d.program[d.pc][0])
+                         for d in devs if d.pc < len(d.program)]
+                violations.append(f"P={P} rot={rotation}: DEADLOCK at "
+                                  f"{stuck}")
+                return violations
+        # ledger balance: leftover ack counts poison the next segment
+        for d in range(P):
+            for s in range(n_slots):
+                if acks[d][s] != 0:
+                    violations.append(
+                        f"P={P} rot={rotation}: ack ledger leak at dev "
+                        f"{d} slot {s}: {acks[d][s]}")
+    return violations
+
+
+def _ag_program(i, P):
+    """The all-gather kernel's per-device schedule, driven by the SAME
+    window predicates the kernel compiles (ops/ring.py).  The initial
+    local fill of comm slot 0 needs no modeling: reads of an empty slot
+    are no-ops and carry no hazard."""
+    ops = []
+    for step in range(P - 1):
+        slot = step % 2
+        nxt = (step + 1) % 2
+        if ag_waits_ack(step, P):
+            ops.append(("wait_ack", {"slot": nxt}))
+        # send reads comm_buf[slot] once
+        ops.append(("read", {"slot": slot}))
+        # the payload landing at the right neighbor will be read by: the
+        # put (1) + the forwarding send at the neighbor's next step
+        # (1), except the neighbor's last landing which is only put
+        lands_reads = 1 if step == P - 2 else 2
+        ops.append(("send", {"slot": nxt, "step": step,
+                             "lands_reads": lands_reads}))
+        ops.append(("recv", {"slot": nxt, "step": step}))
+        if ag_signals_ack(step, P):
+            ops.append(("signal_ack", {"slot": slot}))
+        # put: read the landed chunk into out
+        ops.append(("read", {"slot": nxt}))
+    return ops
+
+
+def _rs_program(i, P):
+    """The reduce-scatter kernel's per-device schedule: acc sends into
+    the neighbor's double-buffered landing slots; the fold is the single
+    read of a landed payload."""
+    ops = []
+    for step in range(P - 1):
+        slot = step % 2
+        if rs_waits_ack(step, P):
+            ops.append(("wait_ack", {"slot": slot}))
+        # send the acc; the landing payload is read exactly once (fold)
+        ops.append(("send", {"slot": slot, "step": step, "lands_reads": 1}))
+        ops.append(("recv", {"slot": slot, "step": step}))
+        # fold consumes the landing
+        ops.append(("read", {"slot": slot}))
+        if rs_signals_ack(step, P):
+            ops.append(("signal_ack", {"slot": slot}))
+    return ops
+
+
+@pytest.mark.parametrize("P", range(2, 9))
+def test_allgather_window_properties(P):
+    violations = _run_schedule(P, lambda i, p: _ag_program(i, p), n_slots=2)
+    assert not violations, "\n".join(violations[:5])
+
+
+@pytest.mark.parametrize("P", range(2, 9))
+def test_reduce_scatter_window_properties(P):
+    violations = _run_schedule(P, lambda i, p: _rs_program(i, p), n_slots=2)
+    assert not violations, "\n".join(violations[:5])
+
+
+@pytest.mark.parametrize("P,delta", itertools.product(
+    (2, 4, 8), ("wait_late", "signal_extra")))
+def test_window_mutations_are_caught(P, delta, monkeypatch):
+    """Meta-test: a deliberately broken window must trip the model —
+    otherwise the properties above prove nothing."""
+    import accl_tpu.ops.ring as ring
+
+    if delta == "wait_late":
+        # never wait: a fast neighbor may overrun the double buffer
+        monkeypatch.setattr(ring, "ag_waits_ack", lambda s, p: False)
+    else:
+        # signal one step too many: the ledger leaks a count
+        monkeypatch.setattr(ring, "ag_signals_ack", lambda s, p: s <= p - 2)
+
+    def prog(i, p):
+        ops = []
+        for step in range(p - 1):
+            slot = step % 2
+            nxt = (step + 1) % 2
+            if ring.ag_waits_ack(step, p):
+                ops.append(("wait_ack", {"slot": nxt}))
+            ops.append(("read", {"slot": slot}))
+            lands = 1 if step == p - 2 else 2
+            ops.append(("send", {"slot": nxt, "step": step,
+                                 "lands_reads": lands}))
+            ops.append(("recv", {"slot": nxt, "step": step}))
+            if ring.ag_signals_ack(step, p):
+                ops.append(("signal_ack", {"slot": slot}))
+            ops.append(("read", {"slot": nxt}))
+        return ops
+
+    violations = _run_schedule(P, prog, n_slots=2)
+    if delta == "wait_late" and P <= 2:
+        return  # 2-rank ring has no overrun window to violate
+    assert violations, f"broken window {delta} went undetected at P={P}"
+
+
+# ---------------------------------------------------------------------------
+# soak: the real interpret-mode kernels across P x segments x ragged
+# tails (numerical correctness through many segment/parity transitions)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("P", (2, 3, 5, 8))
+def test_segmented_allreduce_soak(P):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as Pspec
+
+    from accl_tpu.ops.ring import ring_all_reduce_segmented
+
+    devs = jax.devices()[:P]
+    if len(devs) < P:
+        pytest.skip(f"need {P} devices")
+    mesh = Mesh(np.array(devs), ("r",))
+    # ragged: not a multiple of P, and seg_elems tiny so many segments
+    # exercise the alternating collective_id parity
+    N = 7 * P + 3
+    xs = np.random.default_rng(P).standard_normal((P, N)).astype(np.float32)
+
+    fn = jax.jit(jax.shard_map(
+        lambda v: ring_all_reduce_segmented(
+            v[0], "r", seg_elems=2 * P, interpret=True)[None],
+        mesh=mesh, in_specs=Pspec("r"), out_specs=Pspec("r"),
+        check_vma=False))
+    out = np.asarray(fn(jnp.asarray(xs)))
+    want = xs.sum(axis=0)
+    for r in range(P):
+        np.testing.assert_allclose(out[r], want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("P", (2, 4, 8))
+def test_segmented_gather_scatter_soak(P):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as Pspec
+
+    from accl_tpu.ops.ring import (
+        ring_all_gather_segmented,
+        ring_reduce_scatter_segmented,
+    )
+
+    devs = jax.devices()[:P]
+    if len(devs) < P:
+        pytest.skip(f"need {P} devices")
+    mesh = Mesh(np.array(devs), ("r",))
+    n = 11  # per-member elements, ragged vs seg_elems=4
+    xs = np.random.default_rng(P + 50).standard_normal(
+        (P, n)).astype(np.float32)
+
+    ag = jax.jit(jax.shard_map(
+        lambda v: ring_all_gather_segmented(
+            v[0], "r", seg_elems=4, interpret=True)[None],
+        mesh=mesh, in_specs=Pspec("r"), out_specs=Pspec("r"),
+        check_vma=False))
+    got = np.asarray(ag(jnp.asarray(xs)))
+    want = xs.reshape(-1)
+    for r in range(P):
+        np.testing.assert_allclose(got[r], want, rtol=1e-6)
+
+    xs2 = np.random.default_rng(P + 80).standard_normal(
+        (P, P * n)).astype(np.float32)
+    rs = jax.jit(jax.shard_map(
+        lambda v: ring_reduce_scatter_segmented(
+            v[0], "r", seg_elems=4, interpret=True)[None],
+        mesh=mesh, in_specs=Pspec("r"), out_specs=Pspec("r"),
+        check_vma=False))
+    got2 = np.asarray(rs(jnp.asarray(xs2)))
+    full = xs2.sum(axis=0).reshape(P, n)
+    for r in range(P):
+        np.testing.assert_allclose(got2[r], full[r], rtol=1e-5, atol=1e-5)
