@@ -22,10 +22,11 @@ Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
                std::unique_ptr<Transport> transport)
     : global_rank_(global_rank),
       devicemem_(devmem_bytes),
-      hostmem_(devmem_bytes / 2),
+      host_region_bytes_(devmem_bytes / 2),
       transport_(std::move(transport)) {
   free_spans_[0x1000] = devmem_bytes - 0x1000;
-  host_spans_[0x1000] = hostmem_.size() - 0x1000;
+  // hostmem_ is committed lazily on first alloc_host: most worlds never
+  // use host-only buffers and should not pay half a devmem of RSS
   // avoid vector reallocation races between the engine loop and host-side
   // configuration (the reference's exchange memory is likewise written
   // live while the firmware polls it)
@@ -134,6 +135,10 @@ uint64_t Engine::alloc(uint64_t nbytes, uint64_t align) {
 // map; returned addresses carry HOST_ADDR_BIT.
 uint64_t Engine::alloc_host(uint64_t nbytes, uint64_t align) {
   std::lock_guard<std::mutex> g(mem_mu_);
+  if (hostmem_.empty()) {
+    hostmem_.resize(host_region_bytes_);
+    host_spans_[0x1000] = hostmem_.size() - 0x1000;
+  }
   return alloc_first_fit(host_spans_, alloc_sizes_, nbytes, align,
                          HOST_ADDR_BIT);
 }
@@ -180,9 +185,20 @@ bool Engine::write_mem(uint64_t addr, const void* src, uint64_t n) {
 
 uint8_t* Engine::mem(uint64_t addr, uint64_t n) {
   auto& region = (addr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
+  bool host = addr & HOST_ADDR_BIT;
   addr &= ~HOST_ADDR_BIT;
   if (addr + n > region.size() || (n > 0 && addr == 0)) {
+    // schedule addressing bug: flag it AND make it loud — the sticky
+    // error alone surfaces at retcode-decode distance, far from the
+    // faulting schedule step (round-2 review weak #6/#7).  Writes land
+    // in a thread-local bitbucket so the engine stays memory-safe.
     sticky_err_ |= DMA_SIZE_ERROR;
+    std::fprintf(stderr,
+                 "[accl engine %u] OOB %s-mem access addr=%#llx n=%llu "
+                 "(region %llu bytes) — DMA_SIZE_ERROR\n",
+                 global_rank_, host ? "host" : "device",
+                 (unsigned long long)addr, (unsigned long long)n,
+                 (unsigned long long)region.size());
     static thread_local std::vector<uint8_t> bitbucket;
     bitbucket.assign(std::max<uint64_t>(n, 64), 0);
     return bitbucket.data();

@@ -250,7 +250,8 @@ class Engine {
   // ---- state ----
   uint32_t global_rank_;
   std::vector<uint8_t> devicemem_;
-  std::vector<uint8_t> hostmem_;              // host-only buffer region
+  std::vector<uint8_t> hostmem_;        // host-only region, lazily committed
+  uint64_t host_region_bytes_ = 0;      // capacity reserved for hostmem_
   std::map<uint64_t, uint64_t> free_spans_;   // addr -> size
   std::map<uint64_t, uint64_t> host_spans_;   // untagged addr -> size
   std::map<uint64_t, uint64_t> alloc_sizes_;  // addr -> size (both spaces)

@@ -25,26 +25,36 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _objects(raw: str):
+    """Walk concatenated (possibly pretty-printed) JSON objects."""
+    dec = json.JSONDecoder()
+    idx = 0
+    while idx < len(raw):
+        while idx < len(raw) and raw[idx] not in "{[":
+            idx += 1
+        if idx >= len(raw):
+            return
+        try:
+            obj, end = dec.raw_decode(raw, idx)
+        except json.JSONDecodeError:
+            return
+        yield obj
+        idx = end
+
+
 def last_recorded() -> dict | None:
     paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
     for path in reversed(paths):
-        try:
-            doc = json.loads(open(path).read())
-        except json.JSONDecodeError:
-            # the driver concatenates {...}{...} across attempts; take
-            # the last well-formed object
-            raw = open(path).read()
-            idx = raw.rfind('{"n"')
-            if idx < 0:
-                continue
-            try:
-                doc = json.loads(raw[idx:])
-            except json.JSONDecodeError:
-                continue
-        parsed = doc.get("parsed") if isinstance(doc, dict) else None
-        if parsed and parsed.get("value"):
-            parsed["_source"] = os.path.basename(path)
-            return parsed
+        # the driver may concatenate {...}{...} across attempts; take
+        # the LAST object carrying a parsed value
+        best = None
+        for doc in _objects(open(path).read()):
+            parsed = doc.get("parsed") if isinstance(doc, dict) else None
+            if parsed and parsed.get("value"):
+                best = parsed
+        if best:
+            best["_source"] = os.path.basename(path)
+            return best
     return None
 
 
@@ -53,8 +63,15 @@ def main() -> int:
     ap.add_argument("--tolerance", type=float, default=0.5)
     args = ap.parse_args()
 
-    proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
-                          capture_output=True, text=True, timeout=1200)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            capture_output=True, text=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        print("perf gate: bench.py hung past 1200s (TPU claim on a "
+              "runner without hardware access?) — failing with context",
+              file=sys.stderr)
+        return 1
     line = next((ln for ln in reversed(proc.stdout.strip().splitlines())
                  if ln.startswith("{")), None)
     if proc.returncode != 0 or line is None:
