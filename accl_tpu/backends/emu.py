@@ -95,6 +95,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_world_create_dgram.restype = p
     lib.accl_world_create_dgram.argtypes = [i32, u64, u32, u32]
     lib.accl_dgram_fault.argtypes = [p, u32]
+    lib.accl_world_create_rdma.restype = p
+    lib.accl_world_create_rdma.argtypes = [i32, u64]
+    lib.accl_dump_qps.argtypes = [p, i32, ctypes.c_char_p, i32]
     lib.accl_world_destroy.argtypes = [p]
     lib.accl_cfg_rx.argtypes = [p, i32, i32, u64]
     lib.accl_set_comm.argtypes = [p, i32, ctypes.POINTER(u32), i32]
@@ -300,10 +303,13 @@ class EmuWorld:
     for every rank concurrently, mirroring how the reference test suite
     runs one driver per MPI rank against one emulator each.
 
-    `transport` selects the wire rung: "inproc" (FIFO, synchronous hub)
-    or "dgram" (MTU fragmentation + deterministic out-of-order delivery +
+    `transport` selects the wire rung: "inproc" (FIFO, synchronous hub),
+    "dgram" (MTU fragmentation + deterministic out-of-order delivery +
     interleaved reassembly — the reference's UDP POE + depacketizer +
-    rxbuf_session stack; see native/src/dgram.hpp).
+    rxbuf_session stack; see native/src/dgram.hpp), or "rdma" (queue
+    pairs with an ordered control plane and a separate one-sided memory
+    plane for rendezvous WRITEs — the CoyoteDevice rung; see
+    native/src/rdma.hpp).
     """
 
     #: datagram fault kinds for inject_dgram_fault
@@ -321,6 +327,9 @@ class EmuWorld:
         if transport == "dgram":
             self._handle = self._lib.accl_world_create_dgram(
                 nranks, devmem_bytes, mtu, reorder_window)
+        elif transport == "rdma":
+            self._handle = self._lib.accl_world_create_rdma(
+                nranks, devmem_bytes)
         elif transport == "inproc":
             self._handle = self._lib.accl_world_create(nranks, devmem_bytes)
         else:
@@ -352,6 +361,15 @@ class EmuWorld:
             for r in range(self.nranks)
         ]
         return [f.result(timeout=120) for f in futures]
+
+    def dump_qps(self, rank: int) -> str:
+        """Queue-pair counters for one rank (RDMA rung observability,
+        the CoyoteDevice dump analog)."""
+        out = ctypes.create_string_buffer(8192)
+        n = self._lib.accl_dump_qps(self._handle, rank, out, 8192)
+        if n < 0:
+            raise ACCLError("world has no RDMA transport")
+        return out.value.decode()
 
     def inject_dgram_fault(self, kind: int) -> None:
         """Arm a one-shot datagram-level fault on the shared hub (drop or

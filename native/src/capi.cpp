@@ -9,6 +9,7 @@
 #include <thread>
 
 #include "dgram.hpp"
+#include "rdma.hpp"
 #include "engine.hpp"
 
 using namespace accl;
@@ -19,6 +20,8 @@ struct World {
   std::vector<std::unique_ptr<Engine>> engines;
   std::shared_ptr<InprocHub> hub;
   std::shared_ptr<DgramHub> dgram_hub;
+  std::shared_ptr<RdmaHub> rdma_hub;
+  std::vector<RdmaTransport*> rdma_transports;  // borrowed, engine-owned
   bool tcp = false;
 
   Engine* get(int rank) {
@@ -76,6 +79,33 @@ void* accl_world_create_dgram(int nranks, uint64_t devmem_bytes,
     w->engines.back()->set_lossy_transport(true);
   }
   return w;
+}
+
+// RDMA world: N engines over the queue-pair transport (the reference's
+// CoyoteDevice rung) — ordered message plane for control/eager, a
+// separate one-sided memory plane for rendezvous WRITEs.
+void* accl_world_create_rdma(int nranks, uint64_t devmem_bytes) {
+  auto* w = new World();
+  w->rdma_hub = std::make_shared<RdmaHub>(nranks);
+  for (int r = 0; r < nranks; ++r) {
+    auto t = std::make_unique<RdmaTransport>(w->rdma_hub, r, nranks);
+    w->rdma_transports.push_back(t.get());
+    w->engines.push_back(std::make_unique<Engine>(
+        uint32_t(r), devmem_bytes, std::move(t)));
+  }
+  return w;
+}
+
+// Queue-pair observability (dump_communicator analog for the RDMA rung).
+int accl_dump_qps(void* wp, int rank, char* out, int cap) {
+  auto* w = static_cast<World*>(wp);
+  if (cap <= 0) return -1;
+  if (rank < 0 || rank >= int(w->rdma_transports.size())) return -1;
+  std::string s = w->rdma_transports[rank]->dump_qps();
+  int n = int(std::min<size_t>(s.size(), size_t(cap) - 1));
+  std::memcpy(out, s.data(), size_t(n));
+  out[n] = 0;
+  return n;
 }
 
 // One-shot datagram-level fault on the shared hub (1=drop next fragment,
@@ -189,7 +219,7 @@ int accl_pop_stream(void* wp, int rank, uint32_t strm, void* dst, uint64_t cap,
 
 int accl_dump_rx(void* wp, int rank, char* out, int cap) {
   Engine* e = static_cast<World*>(wp)->get(rank);
-  if (!e) return -1;
+  if (!e || cap <= 0) return -1;
   std::string s = e->dump_rx();
   int n = int(std::min<size_t>(s.size(), size_t(cap) - 1));
   std::memcpy(out, s.data(), size_t(n));

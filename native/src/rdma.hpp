@@ -1,0 +1,187 @@
+// RDMA transport rung: queue pairs, a one-sided memory plane, and an
+// ordered message plane.
+//
+// Role of the reference's Coyote RDMA backend + cyt_adapter
+// (driver/xrt CoyoteDevice; cclo cyt_adapter glue): session setup
+// exchanges queue pairs, control traffic (eager messages, RNDZVS_INIT
+// address advertisements) flows on an ordered send/recv plane, and
+// rendezvous payloads move as one-sided RDMA WRITEs on a SEPARATE
+// memory plane with send-queue/completion-queue accounting.
+//
+// The split is behaviorally meaningful, not cosmetic: memory-plane
+// writes are delivered by their own worker and can overtake the ordered
+// plane, exactly like RDMA WRITEs bypassing a TCP byte stream — the
+// engine's out-of-order WR_DONE matching (pop_match on the completion
+// queue) is what keeps the protocol correct, and this rung exercises
+// it on every rendezvous transfer.
+#pragma once
+
+#include "transport.hpp"
+
+namespace accl {
+
+// Per-destination queue pair bookkeeping (reference: Coyote ibvQpConn;
+// observability analog of dump_communicator for the RDMA backend).
+struct QueuePair {
+  uint32_t local = 0, peer = 0;
+  uint64_t sq_posted = 0;    // WRITE work requests posted
+  uint64_t cq_completed = 0; // local send completions
+  uint64_t bytes_written = 0;
+};
+
+class RdmaHub {
+ public:
+  explicit RdmaHub(int nranks)
+      : msg_sinks_(nranks), mem_states_(nranks) {
+    for (int r = 0; r < nranks; ++r)
+      mem_workers_.emplace_back([this, r] { mem_worker(r); });
+  }
+
+  ~RdmaHub() {
+    running_ = false;
+    for (auto& st : mem_states_) st.cv.notify_all();
+    for (auto& t : mem_workers_) t.join();
+  }
+
+  // ordered message plane (control + eager)
+  void attach(int rank, Transport::Sink sink) {
+    std::lock_guard<std::mutex> g(mu_);
+    msg_sinks_[rank] = std::move(sink);
+  }
+  void detach(int rank) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      msg_sinks_[rank] = nullptr;
+    }
+    auto& st = mem_states_[rank];
+    std::unique_lock<std::mutex> g(st.mu);
+    st.sink = nullptr;
+    st.cv.wait(g, [&] { return !st.delivering; });
+  }
+  void attach_mem(int rank, Transport::Sink sink) {
+    auto& st = mem_states_[rank];
+    std::lock_guard<std::mutex> g(st.mu);
+    st.sink = std::move(sink);
+  }
+
+  void deliver_msg(uint32_t dst, Message&& msg) {
+    Transport::Sink sink;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (dst < msg_sinks_.size()) sink = msg_sinks_[dst];
+    }
+    if (sink) sink(std::move(msg));
+  }
+
+  // memory plane: queue the WRITE for the destination's worker
+  void post_write(uint32_t dst, Message&& msg) {
+    if (dst >= mem_states_.size()) return;
+    auto& st = mem_states_[dst];
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      st.q.push_back(std::move(msg));
+    }
+    st.cv.notify_one();
+  }
+
+ private:
+  struct MemState {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<Message> q;
+    Transport::Sink sink;
+    bool delivering = false;
+  };
+
+  void mem_worker(int rank) {
+    auto& st = mem_states_[rank];
+    while (running_) {
+      Message msg;
+      Transport::Sink sink;
+      {
+        std::unique_lock<std::mutex> g(st.mu);
+        st.cv.wait_for(g, std::chrono::milliseconds(50),
+                       [&] { return !st.q.empty() || !running_; });
+        if (st.q.empty()) {
+          if (!running_) return;
+          continue;
+        }
+        msg = std::move(st.q.front());
+        st.q.pop_front();
+        sink = st.sink;
+        if (sink) st.delivering = true;
+      }
+      if (!sink) continue;
+      sink(std::move(msg));
+      {
+        std::lock_guard<std::mutex> g(st.mu);
+        st.delivering = false;
+      }
+      st.cv.notify_all();
+    }
+  }
+
+  std::mutex mu_;
+  std::vector<Transport::Sink> msg_sinks_;
+  std::vector<MemState> mem_states_;
+  std::vector<std::thread> mem_workers_;
+  std::atomic<bool> running_{true};
+};
+
+class RdmaTransport : public Transport {
+ public:
+  RdmaTransport(std::shared_ptr<RdmaHub> hub, int rank, int nranks)
+      : hub_(std::move(hub)), rank_(rank) {
+    // session setup: one queue pair per peer (Coyote exchanges these
+    // out-of-band at configure time)
+    qps_.resize(nranks);
+    for (int p = 0; p < nranks; ++p)
+      qps_[p] = QueuePair{uint32_t(rank), uint32_t(p)};
+  }
+
+  void send(uint32_t dst, Message&& msg) override {
+    if (msg.hdr.msg_type == uint8_t(MsgType::RndzvsMsg)) {
+      // one-sided WRITE on the memory plane: SQ/CQ accounting, then
+      // out-of-band delivery that may overtake ordered traffic
+      {
+        std::lock_guard<std::mutex> g(qp_mu_);
+        auto& qp = qps_[dst];
+        qp.sq_posted++;
+        qp.bytes_written += msg.payload.size();
+        qp.cq_completed++;  // local completion: buffer ownership returns
+      }
+      hub_->post_write(dst, std::move(msg));
+      return;
+    }
+    hub_->deliver_msg(dst, std::move(msg));
+  }
+
+  void start(Sink sink) override {
+    // both planes land in the same engine ingress; the engine's demux
+    // routes RndzvsMsg to the depacketizer landing path
+    hub_->attach(rank_, sink);
+    hub_->attach_mem(rank_, std::move(sink));
+  }
+
+  void stop() override { hub_->detach(rank_); }
+
+  std::string dump_qps() const {
+    std::lock_guard<std::mutex> g(qp_mu_);
+    std::string out = "queue pairs (rank " + std::to_string(rank_) + "):\n";
+    for (const auto& qp : qps_) {
+      out += "  -> " + std::to_string(qp.peer) +
+             ": sq=" + std::to_string(qp.sq_posted) +
+             " cq=" + std::to_string(qp.cq_completed) +
+             " bytes=" + std::to_string(qp.bytes_written) + "\n";
+    }
+    return out;
+  }
+
+ private:
+  std::shared_ptr<RdmaHub> hub_;
+  int rank_;
+  mutable std::mutex qp_mu_;
+  std::vector<QueuePair> qps_;
+};
+
+}  // namespace accl

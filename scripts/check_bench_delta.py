@@ -37,7 +37,8 @@ def _objects(raw: str):
         try:
             obj, end = dec.raw_decode(raw, idx)
         except json.JSONDecodeError:
-            return
+            idx += 1  # skip a corrupt/truncated object, keep scanning
+            continue
         yield obj
         idx = end
 

@@ -1,0 +1,130 @@
+"""Render the busbw-vs-size comparison plots from the captured sweeps.
+
+The reference ships MPI-comparison plots from its Coyote cluster bench
+(test/host/Coyote notebooks + parse_bench_results.py); this renders the
+equivalent artifacts from bench/results/*.csv:
+
+  busbw_rungs_r{N}.svg    allreduce busbw vs size per transport rung
+                          (emu inproc, datagram, TPU-backend gang) with
+                          the reference's CCLO datapath anchor line
+  collectives_r{N}.svg    per-collective busbw vs size on the emulator
+  pipeline_ab_r{N}.svg    egress pipelining depth 1 vs 3 latency
+
+CPU-rung numbers are emulator numbers, clearly labeled — the plots show
+SHAPE (linearity, protocol switchover) and deltas, not hardware rates.
+
+Usage: python scripts/plot_sweeps.py [--round 3]
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+from collections import defaultdict
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CCLO_ANCHOR_GBPS = 16.0  # reference CCLO datapath ceiling (BASELINE.md)
+
+
+def load(path):
+    rows = defaultdict(lambda: defaultdict(list))  # coll -> bytes -> busbw
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            rows[row["collective"]][int(row["bytes"])].append(
+                float(row["busbw_GBps"]))
+    return {
+        coll: sorted((b, max(v)) for b, v in by_size.items())
+        for coll, by_size in rows.items()
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=3)
+    args = ap.parse_args()
+    tag = f"r{args.round:02d}"
+    outdir = os.path.join(ROOT, "bench", "results")
+
+    rungs = {
+        "emulator (inproc)": f"sweep_emu_{tag}.csv",
+        "datagram rung (MTU 512 + reorder)": f"sweep_dgram_{tag}.csv",
+        "TPU backend gang (8 virtual devices)": f"sweep_tpu8_{tag}.csv",
+    }
+
+    # 1. allreduce busbw per rung
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for label, fname in rungs.items():
+        path = os.path.join(outdir, fname)
+        if not os.path.exists(path):
+            continue
+        data = load(path).get("allreduce", [])
+        if data:
+            xs, ys = zip(*data)
+            ax.plot(xs, ys, marker="o", ms=3, label=label)
+    ax.axhline(CCLO_ANCHOR_GBPS, ls="--", c="gray", lw=1,
+               label="reference CCLO datapath (16 GB/s)")
+    ax.set_xscale("log", base=2)
+    ax.set_yscale("log")
+    ax.set_xlabel("message size (bytes)")
+    ax.set_ylabel("busbw (GB/s, nccl convention)")
+    ax.set_title(f"allreduce busbw vs size per rung (round {args.round}; "
+                 "CPU-rung numbers are emulator rates)")
+    ax.grid(True, which="both", alpha=0.3)
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    p = os.path.join(outdir, f"busbw_rungs_{tag}.svg")
+    fig.savefig(p)
+    print(f"wrote {p}")
+
+    # 2. per-collective busbw on the emulator rung
+    emu_path = os.path.join(outdir, f"sweep_emu_{tag}.csv")
+    emu = load(emu_path) if os.path.exists(emu_path) else {}
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for coll, data in sorted(emu.items()):
+        xs, ys = zip(*data)
+        ax.plot(xs, ys, marker="o", ms=2, lw=1, label=coll)
+    ax.set_xscale("log", base=2)
+    ax.set_yscale("log")
+    ax.set_xlabel("message size (bytes)")
+    ax.set_ylabel("busbw (GB/s)")
+    ax.set_title(f"per-collective busbw, emulator rung (round {args.round})")
+    ax.grid(True, which="both", alpha=0.3)
+    ax.legend(fontsize=7, ncol=2)
+    fig.tight_layout()
+    p = os.path.join(outdir, f"collectives_{tag}.svg")
+    fig.savefig(p)
+    print(f"wrote {p}")
+
+    # 3. pipelining A/B
+    path = os.path.join(outdir, f"pipeline_ab_{tag}.csv")
+    if os.path.exists(path):
+        by_depth = defaultdict(list)
+        with open(path) as f:
+            for row in csv.DictReader(f):
+                by_depth[row["depth"]].append(
+                    (int(row["bytes"]), float(row["mean_us"])))
+        fig, ax = plt.subplots(figsize=(7, 4))
+        for depth, data in sorted(by_depth.items()):
+            xs, ys = zip(*sorted(data))
+            ax.plot(xs, ys, marker="o", ms=3,
+                    label=f"egress window depth {depth}")
+        ax.set_xscale("log", base=2)
+        ax.set_yscale("log")
+        ax.set_xlabel("message size (bytes)")
+        ax.set_ylabel("sendrecv round latency (us, mean)")
+        ax.set_title("eager egress pipelining A/B (emulator, 1 core)")
+        ax.grid(True, which="both", alpha=0.3)
+        ax.legend(fontsize=8)
+        fig.tight_layout()
+        p = os.path.join(outdir, f"pipeline_ab_{tag}.svg")
+        fig.savefig(p)
+        print(f"wrote {p}")
+
+
+if __name__ == "__main__":
+    main()
