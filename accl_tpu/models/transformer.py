@@ -118,9 +118,12 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             attn = ring_attention(q, k, v, axis=sp_axis, causal=True)
         elif cfg.attn == "flash":
             from ..ops.flash import flash_attention
+            # MXU input format follows the model's activation dtype:
+            # bf16 activations get the fast native-rate matmuls, f32
+            # configs keep exact f32 numerics (dense-parity contract)
+            mxu_dt = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)                 else jnp.float32
             attn = flash_attention(q, k, v, causal=True,
-                                   block_q=min(128, q.shape[1]),
-                                   block_k=min(128, q.shape[1]),
+                                   mxu_dtype=mxu_dt,
                                    interpret=jax.default_backend() != "tpu")
         else:
             attn = _dense_attention(q, k, v, causal=True)

@@ -32,7 +32,7 @@ struct QueuePair {
 class RdmaHub {
  public:
   explicit RdmaHub(int nranks)
-      : msg_sinks_(nranks), mem_states_(nranks) {
+      : msg_plane_(nranks), mem_states_(nranks) {
     for (int r = 0; r < nranks; ++r)
       mem_workers_.emplace_back([this, r] { mem_worker(r); });
   }
@@ -43,16 +43,13 @@ class RdmaHub {
     for (auto& t : mem_workers_) t.join();
   }
 
-  // ordered message plane (control + eager)
+  // ordered message plane (control + eager): composed InprocHub, so
+  // its delivery/teardown semantics stay in one place
   void attach(int rank, Transport::Sink sink) {
-    std::lock_guard<std::mutex> g(mu_);
-    msg_sinks_[rank] = std::move(sink);
+    msg_plane_.attach(rank, std::move(sink));
   }
   void detach(int rank) {
-    {
-      std::lock_guard<std::mutex> g(mu_);
-      msg_sinks_[rank] = nullptr;
-    }
+    msg_plane_.detach(rank);
     auto& st = mem_states_[rank];
     std::unique_lock<std::mutex> g(st.mu);
     st.sink = nullptr;
@@ -65,12 +62,7 @@ class RdmaHub {
   }
 
   void deliver_msg(uint32_t dst, Message&& msg) {
-    Transport::Sink sink;
-    {
-      std::lock_guard<std::mutex> g(mu_);
-      if (dst < msg_sinks_.size()) sink = msg_sinks_[dst];
-    }
-    if (sink) sink(std::move(msg));
+    msg_plane_.deliver(dst, std::move(msg));
   }
 
   // memory plane: queue the WRITE for the destination's worker
@@ -121,8 +113,7 @@ class RdmaHub {
     }
   }
 
-  std::mutex mu_;
-  std::vector<Transport::Sink> msg_sinks_;
+  InprocHub msg_plane_;
   std::vector<MemState> mem_states_;
   std::vector<std::thread> mem_workers_;
   std::atomic<bool> running_{true};
@@ -140,6 +131,7 @@ class RdmaTransport : public Transport {
   }
 
   void send(uint32_t dst, Message&& msg) override {
+    if (dst >= qps_.size()) return;  // bad session id: drop, like the hubs
     if (msg.hdr.msg_type == uint8_t(MsgType::RndzvsMsg)) {
       // one-sided WRITE on the memory plane: SQ/CQ accounting, then
       // out-of-band delivery that may overtake ordered traffic

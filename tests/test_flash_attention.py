@@ -23,6 +23,7 @@ def _qkv(B, T, H, D, seed=0):
 def test_flash_matches_dense(causal):
     q, k, v = _qkv(2, 256, 2, 64)
     got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                          mxu_dtype=jnp.float32,
                           interpret=True)
     ref = _dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -33,6 +34,7 @@ def test_flash_uneven_blocks():
     # bq != bk, and T equal to one block on the q side
     q, k, v = _qkv(1, 128, 1, 32, seed=1)
     got = flash_attention(q, k, v, causal=True, block_q=128, block_k=32,
+                          mxu_dtype=jnp.float32,
                           interpret=True)
     ref = _dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -64,8 +66,24 @@ def test_transformer_flash_matches_dense():
         np.random.default_rng(1).integers(0, cfg.vocab, (2, 64)))
     dense = forward(params, tokens, cfg)
     flash = forward(params, tokens, replace(cfg, attn="flash"))
+    # the model derives the MXU input format from its activation dtype:
+    # an f32 config keeps exact f32 matmuls, so the parity stays tight
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bf16_default_accuracy():
+    # the fast default (bf16 MXU inputs, f32 accumulate) must stay
+    # within 16-bit-mantissa distance of the exact computation
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 128, 2, 32)),
+                           jnp.float32) for _ in range(3))
+    exact = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                            mxu_dtype=jnp.float32, interpret=True)
+    fast = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(exact),
+                               rtol=2e-2, atol=2e-2)
 
 
 def test_flash_with_sp_rejected():
