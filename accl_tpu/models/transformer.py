@@ -121,7 +121,8 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             # MXU input format follows the model's activation dtype:
             # bf16 activations get the fast native-rate matmuls, f32
             # configs keep exact f32 numerics (dense-parity contract)
-            mxu_dt = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)                 else jnp.float32
+            mxu_dt = (q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)
+                      else jnp.float32)
             attn = flash_attention(q, k, v, causal=True,
                                    mxu_dtype=mxu_dt,
                                    interpret=jax.default_backend() != "tpu")

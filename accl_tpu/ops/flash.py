@@ -27,6 +27,44 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+
+def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, scale: float, mask,
+                  mxu_dtype):
+    """One online-softmax block fold shared by BOTH kernel schedules —
+    the numerically delicate part (shift clamp so fully-masked rows
+    don't produce exp(+big), masked-p zeroing, alpha rescale of the
+    running state) lives exactly once.
+
+    q: [bq, D] (mxu dtype), kb/vb: [bk, D] (mxu dtype); acc/m/l are f32
+    running state.  `mask` is None or (row0, col0) block offsets for the
+    causal row >= col test.  Returns (acc', m', l')."""
+    block_q, block_k = q.shape[0], kb.shape[0]
+    s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    masked = mask is not None
+    if masked:
+        row0, col0 = mask
+        rows = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = col0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_blk)
+    # fully-masked block rows keep m at NEG_INF; exp(s - NEG_INF) would
+    # be exp(+big) — guard by clamping the shift
+    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - shift)                          # [bq, bk]
+    if masked:
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
+                      jnp.exp(m_prev - shift))      # rescale of old state
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jax.lax.dot_general(
+        p.astype(mxu_dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
                   *, scale: float, causal: bool, block_q: int,
                   block_k: int, nk: int, mxu_dtype):
@@ -51,36 +89,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
 
     def body(masked: bool):
         # matmuls run on the MXU in its native 16-bit input format with
-        # f32 accumulation (standard flash practice); softmax state
-        # stays f32 on the VPU
-        q = q_ref[0].astype(mxu_dtype)              # [bq, D]
-        k = k_ref[0].astype(mxu_dtype)              # [bk, D]
-        v = v_ref[0].astype(mxu_dtype)              # [bk, D]
-
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if masked:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-
-        m_prev = m_s[:]                             # [bq, 1]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
-        # fully-masked block rows keep m at NEG_INF; exp(s - NEG_INF)
-        # would be exp(+big) — guard by clamping the shift
-        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - shift)                      # [bq, bk]
-        if masked:
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
-                          jnp.exp(m_prev - shift))  # rescale of old state
-        l_new = alpha * l_s[:] + jnp.sum(p, axis=-1, keepdims=True)
-        acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p.astype(mxu_dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        # f32 accumulation; softmax state stays f32 on the VPU
+        mask = (iq * block_q, ik * block_k) if masked else None
+        acc_new, m_new, l_new = _softmax_fold(
+            q_ref[0].astype(mxu_dtype), k_ref[0].astype(mxu_dtype),
+            v_ref[0].astype(mxu_dtype), acc[:], m_s[:], l_s[:],
+            scale=scale, mask=mask, mxu_dtype=mxu_dtype)
+        acc[:] = acc_new
         m_s[:] = m_new
         l_s[:] = l_new
 
@@ -101,18 +116,73 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
         o_ref[0] = (acc[:] / denom).astype(o_ref.dtype)
 
 
+def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale: float,
+                           causal: bool, block_q: int, block_k: int,
+                           T: int, mxu_dtype):
+    """K/V-resident variant: the whole K/V row for this batch-head sits
+    in VMEM (fetched ONCE — the grid variant refetches it per q-block,
+    which is the streaming bound at small-to-medium T).  The k loop runs
+    inside the kernel over dynamic slices, split into an unmasked bulk
+    over fully-past blocks and a masked epilogue over the diagonal."""
+    from jax import lax as jlax
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(mxu_dtype)                  # [bq, D]
+    D = q.shape[-1]
+    nk_total = T // block_k
+
+    def step(j, carry, masked):
+        acc, m_prev, l_prev = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
+        mask = (iq * block_q, j * block_k) if masked else None
+        return _softmax_fold(q, kb, vb, acc, m_prev, l_prev, scale=scale,
+                             mask=mask, mxu_dtype=mxu_dtype)
+
+    carry = (jnp.zeros((block_q, D), jnp.float32),
+             jnp.full((block_q, 1), NEG_INF, jnp.float32),
+             jnp.zeros((block_q, 1), jnp.float32))
+    if causal:
+        # blocks fully in this q-block's past: unmasked bulk
+        n_past = (iq * block_q) // block_k
+        # blocks overlapping [iq*bq, iq*bq + bq): masked epilogue
+        n_live = (iq * block_q + block_q + block_k - 1) // block_k
+        n_live = jnp.minimum(n_live, nk_total)
+        carry = jlax.fori_loop(0, n_past,
+                               lambda j, c: step(j, c, masked=False), carry)
+        carry = jlax.fori_loop(n_past, n_live,
+                               lambda j, c: step(j, c, masked=True), carry)
+    else:
+        carry = jlax.fori_loop(0, nk_total,
+                               lambda j, c: step(j, c, masked=False), carry)
+    acc, _m, l = carry
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / denom).astype(o_ref.dtype)
+
+
+#: K/V rows larger than this stay on the streaming (grid) kernel; below
+#: it both rows fit VMEM comfortably alongside the double-buffered q/o
+#: blocks (~16 MB/core)
+_RESIDENT_KV_BYTES = 6 << 20
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "mxu_dtype"))
+                                    "interpret", "mxu_dtype", "kernel"))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
-                    mxu_dtype=jnp.bfloat16):
+                    mxu_dtype=jnp.bfloat16, kernel: str = "auto"):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
     causal mask).  T must be divisible by the block sizes.
 
     `mxu_dtype` is the matmul input format (bf16 default — the MXU's
     native rate; accumulation is always f32).  Pass jnp.float32 for
-    reference-exact numerics at ~1/4 the throughput."""
+    reference-exact numerics at ~1/4 the throughput.
+
+    `kernel` selects the schedule: "resident" pins the whole K/V row in
+    VMEM per batch-head (fetched once; best while it fits), "grid"
+    streams K/V blocks per q-block (any T), "auto" picks by K/V size."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -136,6 +206,36 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
 
     qp, kp, vp = pack(q), pack(k), pack(v)
     scale = 1.0 / float(D) ** 0.5
+
+    kv_bytes = 2 * T * D * q.dtype.itemsize
+    use_resident = (kernel == "resident"
+                    or (kernel == "auto" and kv_bytes <= _RESIDENT_KV_BYTES
+                        and T % bk == 0))
+    if use_resident:
+        # K/V-resident schedule: grid (bh, q_block) with the whole K/V
+        # row pinned in VMEM for all of a batch-head's q blocks (the
+        # block index map is constant in i, so the pipeline fetches it
+        # once per bh) — eliminates the per-q-block K/V refetch that
+        # bounds the grid variant
+        grid = (B * H, nq)
+        q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+                              memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0),
+                               memory_space=pltpu.VMEM)
+        o_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+                              memory_space=pltpu.VMEM)
+        kernel = functools.partial(
+            _flash_kernel_resident, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, T=T, mxu_dtype=jnp.dtype(mxu_dtype))
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=o_spec,
+            interpret=interpret,
+        )(qp, kp, vp)
+        return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
     grid = (B * H, nq, nk)
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),

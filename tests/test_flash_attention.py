@@ -19,11 +19,12 @@ def _qkv(B, T, H, D, seed=0):
     return mk(), mk(), mk()
 
 
+@pytest.mark.parametrize("kernel", ["resident", "grid"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_matches_dense(causal):
+def test_flash_matches_dense(causal, kernel):
     q, k, v = _qkv(2, 256, 2, 64)
     got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
-                          mxu_dtype=jnp.float32,
+                          mxu_dtype=jnp.float32, kernel=kernel,
                           interpret=True)
     ref = _dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -34,7 +35,7 @@ def test_flash_uneven_blocks():
     # bq != bk, and T equal to one block on the q side
     q, k, v = _qkv(1, 128, 1, 32, seed=1)
     got = flash_attention(q, k, v, causal=True, block_q=128, block_k=32,
-                          mxu_dtype=jnp.float32,
+                          mxu_dtype=jnp.float32, kernel="grid",
                           interpret=True)
     ref = _dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
