@@ -7,6 +7,7 @@ test/host/xrt/src/bench.cpp:25-61 + parse_bench_results.py):
   sweep_emu_r{N}.csv       driver busbw over the native engine (4 ranks,
                            inproc transport)
   sweep_dgram_r{N}.csv     same matrix over the adversarial datagram rung
+  sweep_rdma_r{N}.csv      same matrix over the queue-pair RDMA rung
   sweep_tpu8_r{N}.csv      driver busbw over the TPU backend gang
                            scheduler on the 8-virtual-device CPU mesh
   pipeline_ab_r{N}.csv     eager egress pipelining A/B (depth 1 vs 3)
@@ -74,6 +75,14 @@ def main() -> None:
     with EmuWorld(4, transport="dgram", mtu=512, reorder_window=8,
                   n_egr_rx_bufs=64, max_eager_size=16384,
                   max_rendezvous_size=1 << 22) as w, \
+            open(path, "w", newline="") as f:
+        run_sweep(raise_timeouts(w), cfg, writer=f)
+    print(f"wrote {path}")
+
+    # 2b. RDMA rung (queue pairs; one-sided memory plane for rendezvous)
+    path = os.path.join(args.outdir, f"sweep_rdma_{tag}.csv")
+    with EmuWorld(4, transport="rdma", n_egr_rx_bufs=64,
+                  max_eager_size=16384, max_rendezvous_size=1 << 22) as w, \
             open(path, "w", newline="") as f:
         run_sweep(raise_timeouts(w), cfg, writer=f)
     print(f"wrote {path}")

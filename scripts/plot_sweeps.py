@@ -5,7 +5,8 @@ The reference ships MPI-comparison plots from its Coyote cluster bench
 equivalent artifacts from bench/results/*.csv:
 
   busbw_rungs_r{N}.svg    allreduce busbw vs size per transport rung
-                          (emu inproc, datagram, TPU-backend gang) with
+                          (emu inproc, datagram, RDMA queue pairs,
+                          TPU-backend gang) with
                           the reference's CCLO datapath anchor line
   collectives_r{N}.svg    per-collective busbw vs size on the emulator
   pipeline_ab_r{N}.svg    egress pipelining depth 1 vs 3 latency
@@ -53,6 +54,7 @@ def main() -> None:
     rungs = {
         "emulator (inproc)": f"sweep_emu_{tag}.csv",
         "datagram rung (MTU 512 + reorder)": f"sweep_dgram_{tag}.csv",
+        "RDMA rung (queue pairs)": f"sweep_rdma_{tag}.csv",
         "TPU backend gang (8 virtual devices)": f"sweep_tpu8_{tag}.csv",
     }
 
