@@ -2,20 +2,22 @@
 
 The MXU-resident attention block for the model families: tiled
 QK^T -> online-softmax -> PV with the running (max, denominator)
-carried in VMEM scratch across K-block grid steps, so the [Tq, Tk]
-score matrix never materializes in HBM.
+carried across K blocks, so the [Tq, Tk] score matrix never
+materializes in HBM.  Both schedules also emit log-sum-exp statistics,
+which is what lets distributed callers fold partial attentions.
 
 This is the local-compute half of the long-context story: ring
 attention (accl_tpu.parallel.ring_attention) rotates K/V shards around
 the ICI ring — the reference's fused recv-reduce-send ring schedule
 (ccl_offload_control.c:1404-1502, :718) — and each arriving block is
-consumed by exactly this kernel's math.  The streaming-softmax update
-here is the same log-sum-exp fold the ring layer applies across shards.
+consumed by exactly this kernel's math, with the shard-level merge
+using the lse outputs.
 
-Layout: grid (batch*heads, q_blocks, k_blocks); k is the innermost
-(sequential) axis, so the VMEM scratch accumulator is valid across the
-k steps of one (bh, q_block) cell.  Causal masking is blockwise via
-row/col iota comparison.
+Two schedules share one online-softmax fold and one wrapper:
+- resident: the whole K/V row pinned in VMEM per batch-head (fetched
+  once; fastest while it fits),
+- grid: K/V streamed per (q-block, k-block) grid cell (any T).
+The wrapper auto-switches on K/V size; `kernel=` forces either.
 """
 from __future__ import annotations
 
@@ -25,7 +27,6 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
-
 
 
 def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, scale: float, mask,
@@ -65,9 +66,24 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, scale: float, mask,
         preferred_element_type=jnp.float32)
     return acc_new, m_new, l_new
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
-                  *, scale: float, causal: bool, block_q: int,
-                  block_k: int, nk: int, mxu_dtype):
+
+def _finalize(acc, m, l, o_ref, lse_ref):
+    """Write the normalized output and the lse statistics (shared by
+    both schedules so the denom/dead-row guards stay identical)."""
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / denom).astype(o_ref.dtype)
+    dead = m <= NEG_INF / 2
+    lse = jnp.where(dead, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-38)))
+    lse_ref[0] = lse  # [bq, 1] — the trailing unit dim keeps the block
+    # tile-aligned for Mosaic (second-minor bq % 8 == 0, minor == full)
+
+
+def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
+                       *, scale: float, causal: bool, block_q: int,
+                       block_k: int, nk: int, mxu_dtype):
+    """Streaming schedule: grid (bh, q_block, k_block); K/V blocks
+    arrive per grid cell; the accumulator lives in VMEM scratch across
+    the sequential k steps of one (bh, q_block) cell."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -88,8 +104,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
         if causal else False
 
     def body(masked: bool):
-        # matmuls run on the MXU in its native 16-bit input format with
-        # f32 accumulation; softmax state stays f32 on the VPU
         mask = (iq * block_q, ik * block_k) if masked else None
         acc_new, m_new, l_new = _softmax_fold(
             q_ref[0].astype(mxu_dtype), k_ref[0].astype(mxu_dtype),
@@ -111,15 +125,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_s, l_s,
         body(masked=False)
 
     @pl.when(ik == nk - 1)
-    def _finalize():
-        denom = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
-        o_ref[0] = (acc[:] / denom).astype(o_ref.dtype)
+    def _fin():
+        _finalize(acc[:], m_s[:], l_s[:], o_ref, lse_ref)
 
 
-def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale: float,
-                           causal: bool, block_q: int, block_k: int,
-                           T: int, mxu_dtype):
-    """K/V-resident variant: the whole K/V row for this batch-head sits
+def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                           scale: float, causal: bool, block_q: int,
+                           block_k: int, T: int, mxu_dtype):
+    """K/V-resident schedule: the whole K/V row for this batch-head sits
     in VMEM (fetched ONCE — the grid variant refetches it per q-block,
     which is the streaming bound at small-to-medium T).  The k loop runs
     inside the kernel over dynamic slices, split into an unmasked bulk
@@ -156,15 +169,124 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale: float,
     else:
         carry = jlax.fori_loop(0, nk_total,
                                lambda j, c: step(j, c, masked=False), carry)
-    acc, _m, l = carry
-    denom = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / denom).astype(o_ref.dtype)
+    acc, m, l = carry
+    _finalize(acc, m, l, o_ref, lse_ref)
+
+
+def _vma_of(*xs):
+    """Join of the inputs' device-variance sets when tracing inside
+    shard_map (None outside); pallas_call out_shapes must carry it."""
+    vma = None
+    for x in xs:
+        v = getattr(getattr(x, "aval", None), "vma", None)
+        if v:
+            vma = v if vma is None else (vma | v)
+    return vma
+
+
+def _sds(shape, dtype, vma):
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 #: K/V rows larger than this stay on the streaming (grid) kernel; below
 #: it both rows fit VMEM comfortably alongside the double-buffered q/o
 #: blocks (~16 MB/core)
 _RESIDENT_KV_BYTES = 6 << 20
+
+
+def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
+                kernel):
+    """Shared setup for both public wrappers: block shrink, packing,
+    schedule selection, pallas_call.  Returns (out [B,T,H,D],
+    lse [B,H,T] f32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    if k.shape != v.shape or k.shape[0] != B or k.shape[2:] != (H, D):
+        raise ValueError(f"k/v shape {k.shape}/{v.shape} incompatible "
+                         f"with q {q.shape}")
+    if causal and Tk != T:
+        raise ValueError("causal masking requires Tq == Tk "
+                         "(cross-length attention has no diagonal)")
+    # shrink blocks (by halving, down to the 8-row f32 tile floor) until
+    # they divide their sequence length, so defaults keep working for
+    # any T smaller defaults accepted
+    bq, bk = min(block_q, T), min(block_k, Tk)
+    while T % bq != 0 and bq > 8:
+        bq //= 2
+    while Tk % bk != 0 and bk > 8:
+        bk //= 2
+    if T % bq != 0 or Tk % bk != 0:
+        raise ValueError(
+            f"sequence lengths {T}/{Tk} not divisible by blocks ({bq}, {bk})")
+    nq, nk = T // bq, Tk // bk
+
+    def pack(x):  # [B, t, H, D] -> [B*H, t, D]
+        t = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, t, D)
+
+    qp, kp, vp = pack(q), pack(k), pack(v)
+    scale = 1.0 / float(D) ** 0.5
+    vma = _vma_of(q, k, v)
+    mxu_dtype = jnp.dtype(mxu_dtype)
+
+    kv_bytes = 2 * Tk * D * q.dtype.itemsize
+    if kernel == "auto":
+        kernel = ("resident" if kv_bytes <= _RESIDENT_KV_BYTES else "grid")
+    if kernel not in ("resident", "grid"):
+        raise ValueError(f"unknown flash kernel {kernel!r}")
+
+    q_spec3 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    out_shapes = (_sds((B * H, T, D), q.dtype, vma),
+                  _sds((B * H, T, 1), jnp.float32, vma))
+
+    if kernel == "resident":
+        grid = (B * H, nq)
+        q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+                              memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0),
+                               memory_space=pltpu.VMEM)
+        o_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+                              memory_space=pltpu.VMEM)
+        lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0),
+                                memory_space=pltpu.VMEM)
+        kfn = functools.partial(
+            _flash_kernel_resident, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, T=Tk, mxu_dtype=mxu_dtype)
+        out, lse = pl.pallas_call(
+            kfn, out_shape=out_shapes, grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=(o_spec, lse_spec),
+            interpret=interpret,
+        )(qp, kp, vp)
+    else:
+        grid = (B * H, nq, nk)
+        kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                               memory_space=pltpu.VMEM)
+        lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                                memory_space=pltpu.VMEM)
+        kfn = functools.partial(
+            _flash_kernel_grid, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, nk=nk, mxu_dtype=mxu_dtype)
+        out, lse = pl.pallas_call(
+            kfn, out_shape=out_shapes, grid=grid,
+            in_specs=[q_spec3, kv_spec, kv_spec],
+            out_specs=(q_spec3, lse_spec),
+            scratch_shapes=[
+                pltpu.VMEM((bq, D), jnp.float32),   # acc
+                pltpu.VMEM((bq, 1), jnp.float32),   # running max
+                pltpu.VMEM((bq, 1), jnp.float32),   # running denom
+            ],
+            interpret=interpret,
+        )(qp, kp, vp)
+
+    return (out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
+            lse.reshape(B, H, T))
 
 
 @functools.partial(jax.jit,
@@ -174,7 +296,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
                     mxu_dtype=jnp.bfloat16, kernel: str = "auto"):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
-    causal mask).  T must be divisible by the block sizes.
+    causal mask).  T must be divisible by the (auto-shrunk) block sizes.
 
     `mxu_dtype` is the matmul input format (bf16 default — the MXU's
     native rate; accumulation is always f32).  Pass jnp.float32 for
@@ -183,82 +305,20 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     `kernel` selects the schedule: "resident" pins the whole K/V row in
     VMEM per batch-head (fetched once; best while it fits), "grid"
     streams K/V blocks per q-block (any T), "auto" picks by K/V size."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
+                            mxu_dtype, kernel)
+    return out
 
-    B, T, H, D = q.shape
-    # shrink blocks (by halving, down to the 8-row f32 tile floor) until
-    # they divide T, so defaults keep working for any T the previous
-    # smaller defaults accepted
-    bq, bk = min(block_q, T), min(block_k, T)
-    while T % bq != 0 and bq > 8:
-        bq //= 2
-    while T % bk != 0 and bk > 8:
-        bk //= 2
-    if T % bq != 0 or T % bk != 0:
-        raise ValueError(
-            f"sequence length {T} not divisible by blocks ({bq}, {bk})")
-    nq, nk = T // bq, T // bk
 
-    # [B, T, H, D] -> [B*H, T, D]
-    def pack(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-
-    qp, kp, vp = pack(q), pack(k), pack(v)
-    scale = 1.0 / float(D) ** 0.5
-
-    kv_bytes = 2 * T * D * q.dtype.itemsize
-    use_resident = (kernel == "resident"
-                    or (kernel == "auto" and kv_bytes <= _RESIDENT_KV_BYTES
-                        and T % bk == 0))
-    if use_resident:
-        # K/V-resident schedule: grid (bh, q_block) with the whole K/V
-        # row pinned in VMEM for all of a batch-head's q blocks (the
-        # block index map is constant in i, so the pipeline fetches it
-        # once per bh) — eliminates the per-q-block K/V refetch that
-        # bounds the grid variant
-        grid = (B * H, nq)
-        q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
-                              memory_space=pltpu.VMEM)
-        kv_spec = pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0),
-                               memory_space=pltpu.VMEM)
-        o_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
-                              memory_space=pltpu.VMEM)
-        kernel = functools.partial(
-            _flash_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, T=T, mxu_dtype=jnp.dtype(mxu_dtype))
-        out = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            grid=grid,
-            in_specs=[q_spec, kv_spec, kv_spec],
-            out_specs=o_spec,
-            interpret=interpret,
-        )(qp, kp, vp)
-        return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
-
-    grid = (B * H, nq, nk)
-    q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
-                          memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
-                           memory_space=pltpu.VMEM)
-    o_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
-                          memory_space=pltpu.VMEM)
-
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, nk=nk,
-                               mxu_dtype=jnp.dtype(mxu_dtype))
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=o_spec,
-        scratch_shapes=[
-            pltpu.VMEM((bq, D), jnp.float32),   # acc
-            pltpu.VMEM((bq, 1), jnp.float32),   # running max
-            pltpu.VMEM((bq, 1), jnp.float32),   # running denom
-        ],
-        interpret=interpret,
-    )(qp, kp, vp)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "mxu_dtype", "kernel"))
+def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
+                        block_k: int = 512, interpret: bool = False,
+                        mxu_dtype=jnp.bfloat16, kernel: str = "auto"):
+    """Like :func:`flash_attention` but also returns the log-sum-exp
+    statistics: (out [B, T, H, D], lse [B, H, T] fp32).  Partial results
+    over different K/V shards combine exactly via lse weighting — the
+    cross-shard fold ring attention applies around the ICI ring."""
+    return _flash_call(q, k, v, causal, block_q, block_k, interpret,
+                       mxu_dtype, kernel)

@@ -49,13 +49,29 @@ def _block_attn(q, k, v, bias):
     return m_blk, p, pv
 
 
-def ring_attention(q, k, v, axis: str = "sp", causal: bool = False):
+def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                   impl: str | None = None):
     """Exact attention over the full (ring-distributed) sequence.
 
     Per-member shapes [B, T_local, H, D]; the global sequence is the
     rank-major concatenation of shards.  Numerics accumulate in fp32
     regardless of input dtype.
+
+    `impl="flash"` computes each hop's local block with the Pallas flash
+    kernel (no [Tl, Tl] score matrix in HBM; MXU-format matmuls follow
+    the input dtype) and folds shards by log-sum-exp weighting;
+    `impl="dense"` is the jnp reference path.  Default: flash on TPU,
+    dense on the CPU rung (the Pallas HLO interpreter can't run inside
+    shard_map with check_vma=True — jax#vma dynamic_slice limitation;
+    flash-ring CPU tests pass check_vma=False explicitly).
     """
+    if impl is None:
+        import jax as _jax
+        impl = "flash" if _jax.default_backend() == "tpu" else "dense"
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis, causal)
+    if impl != "dense":
+        raise ValueError(f"unknown ring_attention impl {impl!r}")
     P = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
@@ -100,6 +116,91 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False):
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis: str, causal: bool):
+    """Flash-backed ring schedule: each hop runs the K/V-resident flash
+    kernel on the local (Q shard, arriving K/V shard) pair and the
+    results merge by lse weighting — the streaming-softmax fold lifted
+    one level, from k-blocks within a shard to shards around the ring.
+
+    Causality is blockwise by construction: an arriving shard is either
+    fully in the past (unmasked flash), the diagonal shard (causal
+    flash), or fully in the future (contributes nothing) — so the kernel
+    itself only ever needs its LOCAL causal mask.
+    """
+    import jax as _jax
+
+    from ..ops.flash import NEG_INF as _NI
+    from ..ops.flash import flash_attention_lse
+
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    interpret = _jax.default_backend() != "tpu"
+    # MXU format follows the activation dtype (f32 in -> exact f32)
+    mxu_dt = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16) \
+        else jnp.float32
+
+    def hop_full(kv):
+        kc, vc = kv
+        return flash_attention_lse(q, kc, vc, causal=False,
+                                   interpret=interpret, mxu_dtype=mxu_dt)
+
+    def hop_diag(kv):
+        kc, vc = kv
+        return flash_attention_lse(q, kc, vc, causal=True,
+                                   interpret=interpret, mxu_dtype=mxu_dt)
+
+    def hop_dead(kv):
+        # zeros derived from q AND the rotating k/v so this branch's
+        # outputs carry the same device-variance (vma) as the flash
+        # branches — lax.switch requires matching output types
+        kc, vc = kv
+        zkv = (jnp.sum(kc).astype(jnp.float32)
+               + jnp.sum(vc).astype(jnp.float32)) * 0.0
+        o_z = (q.astype(jnp.float32) * 0.0 + zkv).astype(q.dtype)
+        lse_z = jnp.transpose(
+            jnp.sum(o_z.astype(jnp.float32), axis=-1), (0, 2, 1)) + _NI
+        return o_z, lse_z
+
+    def step(s, carry):
+        o, lse, kc, vc = carry
+        src = (idx - s) % P
+        if causal:
+            branch = jnp.where(src == idx, 1,
+                               jnp.where(src < idx, 0, 2))
+            o_i, lse_i = lax.switch(branch, (hop_full, hop_diag, hop_dead),
+                                    (kc, vc))
+        else:
+            o_i, lse_i = hop_full((kc, vc))
+        # lse-weighted merge of normalized partials (exact; dead shards
+        # carry lse = -inf and weight 0)
+        m_new = jnp.maximum(lse, lse_i)
+        safe = jnp.where(m_new <= _NI / 2, 0.0, m_new)
+        w_r = jnp.where(lse <= _NI / 2, 0.0, jnp.exp(lse - safe))
+        w_i = jnp.where(lse_i <= _NI / 2, 0.0, jnp.exp(lse_i - safe))
+        tot = jnp.maximum(w_r + w_i, 1e-38)
+        wr4 = (w_r / tot).transpose(0, 2, 1)[..., None]  # [B, Tl, H, 1]
+        wi4 = (w_i / tot).transpose(0, 2, 1)[..., None]
+        # the running output carry stays fp32 for the whole ring (one
+        # downcast after the loop), matching the dense path's contract
+        o_new = o * wr4 + o_i.astype(jnp.float32) * wi4
+        lse_new = jnp.where((w_r + w_i) == 0.0, jnp.full_like(m_new, _NI),
+                            safe + jnp.log(tot))
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return o_new, lse_new, kc, vc
+
+    # carry zeros derive from q/k/v so the device-variance types match
+    # under any mesh composition (see the dense path's note)
+    zkv = (jnp.sum(k).astype(jnp.float32)
+           + jnp.sum(v).astype(jnp.float32)) * 0.0
+    o0 = q.astype(jnp.float32) * 0.0 + zkv
+    lse0 = jnp.transpose(jnp.sum(o0, axis=-1), (0, 2, 1)) + NEG_INF
+    o, _lse, _, _ = lax.fori_loop(0, P, step, (o0, lse0, k, v))
+    return o.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,

@@ -203,3 +203,31 @@ def test_moe_dispatch_combine():
     out = np.asarray(jax.jit(f)(jnp.asarray(xs), jnp.asarray(assign)))
     exp = xs * scales[assign][..., None]
     np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_impl_matches_dense(causal):
+    # the flash-backed ring schedule (lse-weighted shard fold over the
+    # Pallas kernel) must agree with the dense-ring reference; the CPU
+    # rung needs check_vma=False for the Pallas HLO interpreter inside
+    # shard_map (jax vma/dynamic_slice limitation)
+    import jax
+
+    from accl_tpu.parallel.mesh import make_mesh
+
+    P_sp = 4
+    mesh = make_mesh(sp=P_sp)
+    B, Tl, H, D = 2, 16, 2, 16
+    rng = np.random.default_rng(11)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, P_sp * Tl, H, D)),
+                           jnp.float32) for _ in range(3))
+
+    spec = P(None, "sp", None, None)
+    fn = jax.jit(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis="sp", causal=causal,
+                                       impl="flash"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False))
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(_dense_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
