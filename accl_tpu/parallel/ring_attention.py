@@ -28,6 +28,20 @@ from jax import lax
 NEG_INF = -1e30
 
 
+def _flash_defaults(q):
+    """Backend-resolved defaults for the SP paths' flash usage: whether
+    this process should run the Pallas kernels at all (TPU only — the
+    HLO interpreter can't run inside shard_map with check_vma=True), and
+    the MXU input format (16-bit activations keep their format, f32
+    stays exact)."""
+    import jax as _jax
+
+    on_tpu = _jax.default_backend() == "tpu"
+    mxu_dt = (q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)
+              else jnp.float32)
+    return on_tpu, mxu_dt
+
+
 def _block_attn(q, k, v, bias):
     """Scores + masked streaming-softmax contributions for one K/V block.
 
@@ -66,8 +80,7 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     flash-ring CPU tests pass check_vma=False explicitly).
     """
     if impl is None:
-        import jax as _jax
-        impl = "flash" if _jax.default_backend() == "tpu" else "dense"
+        impl = "flash" if _flash_defaults(q)[0] else "dense"
     if impl == "flash":
         return _ring_attention_flash(q, k, v, axis, causal)
     if impl != "dense":
@@ -138,10 +151,8 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool):
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     perm = [(i, (i + 1) % P) for i in range(P)]
-    interpret = _jax.default_backend() != "tpu"
-    # MXU format follows the activation dtype (f32 in -> exact f32)
-    mxu_dt = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16) \
-        else jnp.float32
+    on_tpu, mxu_dt = _flash_defaults(q)
+    interpret = not on_tpu
 
     def hop_full(kv):
         kc, vc = kv
@@ -230,7 +241,17 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if attn_fn is None:
-        attn_fn = functools.partial(_dense_attention, causal=causal)
+        import jax as _jax
+        if _jax.default_backend() == "tpu":
+            # full-sequence local attention on the head subset runs the
+            # flash kernel (same backend-resolved default as ring)
+            from ..ops.flash import flash_attention
+
+            mxu_dt = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)                 else jnp.float32
+            attn_fn = functools.partial(flash_attention, causal=causal,
+                                        mxu_dtype=mxu_dt)
+        else:
+            attn_fn = functools.partial(_dense_attention, causal=causal)
     og = attn_fn(qg, kg, vg)
     return heads_to_seq(og)
 

@@ -231,3 +231,40 @@ def test_ring_attention_flash_impl_matches_dense(causal):
     got = np.asarray(fn(q, k, v))
     want = np.asarray(_dense_attention(q, k, v, causal=causal))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_flash_attn_fn_matches_dense():
+    # the flash kernel as ulysses' inner attention (the TPU default)
+    # must match the dense inner attention; exercised explicitly on the
+    # CPU rung via attn_fn with interpret mode
+    import functools
+
+    import jax
+
+    from accl_tpu.ops.flash import flash_attention
+    from accl_tpu.parallel.mesh import make_mesh
+    from accl_tpu.parallel.ring_attention import ulysses_attention
+
+    P_sp = 4
+    mesh = make_mesh(sp=P_sp)
+    B, Tl, H, D = 2, 16, 4, 16
+    rng = np.random.default_rng(13)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, P_sp * Tl, H, D)),
+                           jnp.float32) for _ in range(3))
+    spec = P(None, "sp", None, None)
+
+    def run(attn_fn):
+        fn = jax.jit(jax.shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c, axis="sp",
+                                              causal=True, attn_fn=attn_fn),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False))
+        return np.asarray(fn(q, k, v))
+
+    flash_fn = functools.partial(flash_attention, causal=True,
+                                 mxu_dtype=jnp.float32, interpret=True)
+    got = run(flash_fn)
+    # explicit dense baseline: run(None) would resolve to flash on a
+    # TPU host and compare flash against itself
+    want = run(functools.partial(_dense_attention, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
