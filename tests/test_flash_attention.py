@@ -99,14 +99,17 @@ def test_flash_with_sp_rejected():
         forward(params, tokens, cfg, sp_axis="sp")
 
 
-def test_flash_cross_length():
+@pytest.mark.parametrize("kernel", ["resident", "grid"])
+def test_flash_cross_length(kernel):
     # Tk != Tq (cross-attention shapes): used by lse-merge callers that
-    # attend one query shard over differently-sized K/V segments
+    # attend one query shard over differently-sized K/V segments; both
+    # schedules have distinct cross-length index math, so both run
     rng = np.random.default_rng(21)
     q = jnp.asarray(rng.standard_normal((2, 128, 2, 16)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((2, 64, 2, 16)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((2, 64, 2, 16)), jnp.float32)
-    got = flash_attention(q, k, v, mxu_dtype=jnp.float32, interpret=True)
+    got = flash_attention(q, k, v, mxu_dtype=jnp.float32, kernel=kernel,
+                          interpret=True)
     ref = _dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
