@@ -171,10 +171,9 @@ class ACCL {
     comm_ = upload_comm(sessions, local_rank, rx_buf_size);
     comm_sizes_[comm_] = uint32_t(sessions.size());
     upload_default_arithcfgs();
-    config(CfgFunc::SetTimeout, 1'000'000);
-    config(CfgFunc::SetMaxEagerMsgSize,
-           uint32_t(max_eager ? max_eager : rx_buf_size));
-    config(CfgFunc::SetMaxRendezvousMsgSize, uint32_t(max_rndzv));
+    set_timeout(1'000'000);
+    set_max_eager_msg_size(uint32_t(max_eager ? max_eager : rx_buf_size));
+    set_max_rendezvous_msg_size(uint32_t(max_rndzv));
     // flat-tree tuning registers (reference configure_tuning_parameters,
     // accl.cpp:1214-1224)
     e_->set_tuning(Engine::GATHER_FLAT_TREE_MAX_FANIN, 2);
@@ -542,6 +541,17 @@ class ACCL {
     w[13] = uint32_t(res.addr);
     w[14] = uint32_t(res.addr >> 32);
     return Request(e_, e_->start_call(w.data()));
+  }
+
+  // Runtime config knobs (reference set_timeout / set_max_eager_msg_size /
+  // set_max_rendezvous_msg_size, accl.cpp:1112-1120, :1415-1433 — note the
+  // reference's rendezvous setter bugs are NOT reproduced here).
+  void set_timeout(uint32_t cycles) { config(CfgFunc::SetTimeout, cycles); }
+  void set_max_eager_msg_size(uint32_t bytes) {
+    config(CfgFunc::SetMaxEagerMsgSize, bytes);
+  }
+  void set_max_rendezvous_msg_size(uint32_t bytes) {
+    config(CfgFunc::SetMaxRendezvousMsgSize, bytes);
   }
 
  private:

@@ -512,22 +512,34 @@ static void test_barrier_and_nop(ACCL& a, int rank) {
 // ---------------------------------------------------------------------------
 // harness
 // ---------------------------------------------------------------------------
-int main() {
-  auto hub = std::make_shared<InprocHub>(NRANKS);
+// One world per case: a load-induced timeout in one case must not leave
+// stale segments that cascade into seqn/BTT errors in later cases (the
+// reference boots one fixture per gtest process; this is the same
+// isolation in-proc).
+struct World {
+  std::shared_ptr<InprocHub> hub;
   std::vector<std::unique_ptr<Engine>> engines;
-  for (int r = 0; r < NRANKS; ++r)
-    engines.push_back(std::make_unique<Engine>(
-        uint32_t(r), 64ull << 20,
-        std::make_unique<InprocTransport>(hub, r)));
-
   std::vector<std::unique_ptr<ACCL>> accls;
-  for (int r = 0; r < NRANKS; ++r) {
-    accls.push_back(std::make_unique<ACCL>(engines[r].get()));
-    std::vector<uint32_t> sessions;
-    for (int i = 0; i < NRANKS; ++i) sessions.push_back(uint32_t(i));
-    accls[r]->initialize(sessions, uint32_t(r), 16, RX_BUF, MAX_EAGER);
-  }
 
+  World() : hub(std::make_shared<InprocHub>(NRANKS)) {
+    for (int r = 0; r < NRANKS; ++r)
+      engines.push_back(std::make_unique<Engine>(
+          uint32_t(r), 64ull << 20,
+          std::make_unique<InprocTransport>(hub, r)));
+    for (int r = 0; r < NRANKS; ++r) {
+      accls.push_back(std::make_unique<ACCL>(engines[r].get()));
+      std::vector<uint32_t> sessions;
+      for (int i = 0; i < NRANKS; ++i) sessions.push_back(uint32_t(i));
+      accls[r]->initialize(sessions, uint32_t(r), 16, RX_BUF, MAX_EAGER);
+      // bring-up default is 1s (reference accl.cpp:1112); CI boxes run
+      // this corpus alongside other jobs on few cores, where a 1s
+      // receive budget fires spuriously — widen it for the corpus
+      accls[r]->set_timeout(30'000'000);  // 30 s
+    }
+  }
+};
+
+int main() {
   struct Case {
     const char* name;
     TestFn fn;
@@ -567,6 +579,7 @@ int main() {
 
   int failed_cases = 0;
   for (auto& c : cases) {
+    World w;
     std::atomic<int> failures{0};
     std::string first_err;
     std::mutex err_mu;
@@ -574,8 +587,8 @@ int main() {
     for (int r = 0; r < NRANKS; ++r)
       threads.emplace_back([&, r] {
         try {
-          c.fn(*accls[r], r);
-          accls[r]->barrier();  // lockstep between cases
+          c.fn(*w.accls[r], r);
+          w.accls[r]->barrier();  // lockstep before teardown
         } catch (const std::exception& ex) {
           failures.fetch_add(1);
           std::lock_guard<std::mutex> g(err_mu);
@@ -592,7 +605,6 @@ int main() {
     }
   }
 
-  engines.clear();
   if (failed_cases) {
     std::printf("native driver corpus: %d/%zu cases FAILED\n", failed_cases,
                 cases.size());

@@ -8,6 +8,10 @@ run-everything-against-the-CPU-emulator strategy (SURVEY §4).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Loaded CI hosts can stall a rank long enough for the 1 s reference
+# receive budget to fire spuriously; widen the *default* engine timeout
+# for tests (tests exercising timeout behavior pass explicit values).
+os.environ.setdefault("ACCL_DEFAULT_TIMEOUT", "30000000")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

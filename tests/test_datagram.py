@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 
 from accl_tpu import DataType, ReduceFunction, StreamFlags
+from accl_tpu.accl import default_timeout
 from accl_tpu.backends.emu import EmuWorld
 
 NRANKS = 4
@@ -194,7 +195,7 @@ def test_fragment_loss_detected_and_recovered(world):
                 with pytest.raises(Exception):
                     accl.recv(dst, count, 0, tag=77)
             finally:
-                accl.set_timeout(1_000_000)
+                accl.set_timeout(default_timeout())  # module-scoped world
 
     world_ref = world
     world.run(fn)

@@ -8,6 +8,7 @@ import numpy as np
 import pytest
 
 from accl_tpu import ACCLError, DataType, ReduceFunction
+from accl_tpu.accl import default_timeout
 from accl_tpu.backends.emu import EmuWorld
 
 NRANKS = 4
@@ -172,10 +173,12 @@ def test_timeout_surfaces_as_error(world):
         if rank != 0:
             return
         accl.set_timeout(30_000)  # 30ms emulated
-        dst = accl.create_buffer(8, np.float32)
-        with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT_ERROR"):
-            accl.recv(dst, 8, 1, tag=12345)
-        accl.set_timeout(1_000_000)
+        try:
+            dst = accl.create_buffer(8, np.float32)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT_ERROR"):
+                accl.recv(dst, 8, 1, tag=12345)
+        finally:
+            accl.set_timeout(default_timeout())  # module-scoped world
 
     world.run(fn)
 
