@@ -264,7 +264,8 @@ class EmuRankTcp:
     def __init__(self, rank: int, nranks: int, base_port: int,
                  devmem_bytes: int = 64 << 20, n_egr_rx_bufs: int = 16,
                  egr_rx_buf_size: int = 1024,
-                 max_eager_size: Optional[int] = None):
+                 max_eager_size: Optional[int] = None,
+                 call_timeout_s: float = 60.0):
         self._lib = _load_lib()
         self.rank = rank
         self.nranks = nranks
@@ -273,8 +274,13 @@ class EmuRankTcp:
         if not self._handle:
             raise ACCLError(f"TCP emulator rank {rank} failed to start "
                             f"(port {base_port + rank} busy?)")
-        self.device = EmuDevice(self._handle, rank, self._lib)
+        self.device = EmuDevice(self._handle, rank, self._lib,
+                                call_timeout_s=call_timeout_s)
         self.accl = ACCL(self.device)
+        # the driver-level sync wait gates the same calls; keep the two
+        # host-side budgets aligned so the engine's receive timeout (set
+        # below it) is always the first to fire
+        self.accl.call_timeout_s = call_timeout_s
         ranks = [Rank(ip="127.0.0.1", port=base_port + r, session=r,
                       max_segment_size=egr_rx_buf_size)
                  for r in range(nranks)]

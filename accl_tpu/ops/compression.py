@@ -16,8 +16,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_BLOCK_ROWS = 1024
-_LANES = 128
+# On-chip sweep (scripts/kernel_tune.py compress, 16 Mi f32 roundtrip,
+# interleaved-window methodology): 512-lane rows with 256-row blocks beat
+# both the old (1024, 128) shape (~2x) and the plain XLA convert pair in
+# shared contention windows; 512 KB input blocks keep the DMA pipeline
+# full without starving double-buffering.
+_BLOCK_ROWS = 256
+_LANES = 512
 
 
 def _cast_kernel(dtype):
@@ -29,9 +34,13 @@ def _cast_kernel(dtype):
 
 def _stochastic_kernel(dtype):
     def kernel(seed_ref, x_ref, o_ref):
+        from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
-        pltpu.prng_seed(seed_ref[0])
+        # fold the grid position into the seed: every block would
+        # otherwise draw the SAME bit pattern and the rounding noise
+        # would correlate block-to-block instead of averaging out
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
         bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
         o_ref[:] = pltpu.stochastic_round(x_ref[:], bits, target_dtype=dtype)
 
@@ -50,16 +59,24 @@ def _cast_2d(x, seed, dtype, stochastic: bool, interpret: bool):
     spec = pl.BlockSpec((block_rows, cols), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct(x.shape, dtype)
+    # every block is independent: parallel semantics let Mosaic overlap
+    # the next block's DMA with the current cast
+    params = pltpu.CompilerParams(dimension_semantics=("parallel",))
     if stochastic:
+        # scalar-prefetch index maps receive the prefetch ref as a
+        # trailing argument — the specs need their own index lambdas
+        pspec = pl.BlockSpec((block_rows, cols), lambda i, *_: (i, 0),
+                             memory_space=pltpu.VMEM)
         return pl.pallas_call(
             _stochastic_kernel(dtype),
             out_shape=out_shape,
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1,
                 grid=grid,
-                in_specs=[spec],
-                out_specs=spec,
+                in_specs=[pspec],
+                out_specs=pspec,
             ),
+            compiler_params=params,
             interpret=interpret,
         )(seed, x)
     return pl.pallas_call(
@@ -68,6 +85,7 @@ def _cast_2d(x, seed, dtype, stochastic: bool, interpret: bool):
         grid=grid,
         in_specs=[spec],
         out_specs=spec,
+        compiler_params=params,
         interpret=interpret,
     )(x)
 
@@ -82,18 +100,27 @@ def _tiles(x):
     return flat.reshape(rows, _LANES), n
 
 
+# The public lanes are jitted whole — pad/reshape/kernel/unpad fuse into
+# ONE dispatch.  Unjitted, each call costs ~4 extra host round-trips for
+# the reshapes, which dominates on remote-tunneled devices (measured
+# 31 GB/s vs ~700 GB/s for the same kernel, scripts/kernel_tune.py).
+@functools.partial(jax.jit,
+                   static_argnames=("dtype", "stochastic", "interpret"))
 def compress_cast(x, dtype=jnp.bfloat16, stochastic: bool = False,
                   seed: int = 0, interpret: bool = False):
     """Compress lane (hp_compression TDEST 0): fp32 → fp16/bf16.
 
     `stochastic=True` rounds with PRNG bits instead of
-    round-to-nearest-even (TPU-only; requires the Mosaic PRNG)."""
+    round-to-nearest-even (TPU-only; requires the Mosaic PRNG).  `seed`
+    is traced, so stepping it per call (to decorrelate ring hops) does
+    NOT retrace."""
     x2, n = _tiles(x)
-    seed_arr = jnp.array([seed], jnp.int32)
+    seed_arr = jnp.reshape(jnp.asarray(seed, jnp.int32), (1,))
     out = _cast_2d(x2, seed_arr, jnp.dtype(dtype), stochastic, interpret)
     return out.reshape(-1)[:n].reshape(x.shape)
 
 
+@functools.partial(jax.jit, static_argnames=("dtype", "interpret"))
 def decompress_cast(x, dtype=jnp.float32, interpret: bool = False):
     """Decompress lane (hp_compression TDEST 1): fp16/bf16 → fp32."""
     x2, n = _tiles(x)

@@ -29,19 +29,21 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, scale: float, mask,
-                  mxu_dtype):
+def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
     """One online-softmax block fold shared by BOTH kernel schedules —
     the numerically delicate part (shift clamp so fully-masked rows
     don't produce exp(+big), masked-p zeroing, alpha rescale of the
     running state) lives exactly once.
 
-    q: [bq, D] (mxu dtype), kb/vb: [bk, D] (mxu dtype); acc/m/l are f32
-    running state.  `mask` is None or (row0, col0) block offsets for the
-    causal row >= col test.  Returns (acc', m', l')."""
+    q: [bq, D] (mxu dtype, PRE-SCALED by 1/sqrt(D) — scaling the [bq, D]
+    q block once replaces a full [bq, bk] VPU pass per fold; the kernel
+    is VPU-bound at D=64, so score-matrix passes are the budget),
+    kb/vb: [bk, D] (mxu dtype); acc/m/l are f32 running state.  `mask`
+    is None or (row0, col0) block offsets for the causal row >= col
+    test.  Returns (acc', m', l')."""
     block_q, block_k = q.shape[0], kb.shape[0]
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32)
     masked = mask is not None
     if masked:
         row0, col0 = mask
@@ -103,12 +105,14 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
     diag = ((ik * block_k + block_k - 1 > iq * block_q) & live) \
         if causal else False
 
+    q = (q_ref[0] * scale).astype(mxu_dtype)  # pre-scale once per block
+
     def body(masked: bool):
         mask = (iq * block_q, ik * block_k) if masked else None
         acc_new, m_new, l_new = _softmax_fold(
-            q_ref[0].astype(mxu_dtype), k_ref[0].astype(mxu_dtype),
+            q, k_ref[0].astype(mxu_dtype),
             v_ref[0].astype(mxu_dtype), acc[:], m_s[:], l_s[:],
-            scale=scale, mask=mask, mxu_dtype=mxu_dtype)
+            mask=mask, mxu_dtype=mxu_dtype)
         acc[:] = acc_new
         m_s[:] = m_new
         l_s[:] = l_new
@@ -141,7 +145,7 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    q = q_ref[0].astype(mxu_dtype)                  # [bq, D]
+    q = (q_ref[0] * scale).astype(mxu_dtype)        # [bq, D], pre-scaled
     D = q.shape[-1]
     nk_total = T // block_k
 
@@ -150,7 +154,7 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
         mask = (iq * block_q, j * block_k) if masked else None
-        return _softmax_fold(q, kb, vb, acc, m_prev, l_prev, scale=scale,
+        return _softmax_fold(q, kb, vb, acc, m_prev, l_prev,
                              mask=mask, mxu_dtype=mxu_dtype)
 
     carry = (jnp.zeros((block_q, D), jnp.float32),
@@ -262,6 +266,10 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=(o_spec, lse_spec),
+            # every (bh, q-block) cell is independent: parallel semantics
+            # let Mosaic overlap the next cell's q/o DMA with compute
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(qp, kp, vp)
     else:
@@ -282,6 +290,10 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
                 pltpu.VMEM((bq, 1), jnp.float32),   # running max
                 pltpu.VMEM((bq, 1), jnp.float32),   # running denom
             ],
+            # the k dimension carries the accumulator (sequential); the
+            # bh/q-block dims are independent
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(qp, kp, vp)
 

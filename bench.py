@@ -219,9 +219,13 @@ def _secondary_kernels(jax, jnp, probe, timed_chain, timed_chain_ab) -> dict:
         float(probe(mm(ma).reshape(-1).astype(jnp.float32)))
 
         # interleave manually (timed_chain_ab shares one input; the two
-        # workloads here have different operand shapes)
+        # workloads here have different operand shapes).  10 rounds:
+        # observed contention windows on this shared chip last minutes
+        # and depress identical kernels 30x (matmul 19 vs 557 TFLOPs),
+        # so the best-window estimator needs enough rounds to straddle
+        # a window boundary.
         best_fa, best_mm = None, None
-        for _ in range(5):
+        for _ in range(10):
             d1 = timed_chain(fa, q, iters=10, trials=1)
             d2 = timed_chain(mm, ma, iters=10, trials=1)
             best_fa = d1 if best_fa is None else min(best_fa, d1)
@@ -258,7 +262,7 @@ def _secondary_kernels(jax, jnp, probe, timed_chain, timed_chain_ab) -> dict:
         xla_rt = lambda v: xla_up(xla_down(v))
         float(probe(xla_rt(x)))
         dts = timed_chain_ab({"pallas": roundtrip, "xla": xla_rt}, x,
-                             iters=8)
+                             iters=8, trials=8)
         # bytes per roundtrip: read 4B + write 2B + read 2B + write 4B
         nbytes = x.size * 12
         detail["compression_gbps"] = round(nbytes / dts["pallas"] / 1e9, 2)

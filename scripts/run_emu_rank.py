@@ -39,9 +39,13 @@ def main() -> int:
         rng = np.random.default_rng(900 + rank + salt * 100)
         return rng.standard_normal(n).astype(np.float32)
 
-    with EmuRankTcp(r, P, args.port) as node:
+    # Timeout layering: the engine's receive budget (120s, process
+    # startup skew) must be the FIRST to fire — host-side call waits sit
+    # above it so a stall surfaces as the engine's RECEIVE_TIMEOUT_ERROR
+    # diagnosis, not an opaque host-side DMA_TIMEOUT_ERROR.
+    with EmuRankTcp(r, P, args.port, call_timeout_s=180.0) as node:
         accl = node.accl
-        accl.set_timeout(120_000_000)  # generous: process startup skew
+        accl.set_timeout(120_000_000)
 
         if args.workload in ("allreduce", "all"):
             send = accl.create_buffer_like(data(r))
