@@ -63,8 +63,45 @@ def _block_attn(q, k, v, bias):
     return m_blk, p, pv
 
 
+def zigzag_indices(T: int, P: int):
+    """Global sequence permutation for the zigzag causal layout: rank i
+    holds chunk i and its mirror chunk 2P-1-i (each T/(2P) long), so
+    every rank's total causal work — and, with the zigzag ring schedule,
+    its work on EVERY hop — is identical.  The rank-major contiguous
+    layout gives rank 0 one live shard and rank P-1 all P, so the ring's
+    lockstep hops wait on the heaviest rank; zigzag removes that 2x
+    wall-clock loss.
+
+    Returns an int32 index array `perm` such that `x[:, perm]` reorders
+    a [B, T, ...] global sequence into zigzag order (shard the result on
+    the sequence axis as usual).  Apply the inverse
+    (`zigzag_indices_inverse`) to outputs to return to natural order.
+    """
+    if T % (2 * P) != 0:
+        raise ValueError(f"T={T} not divisible by 2*P={2 * P}")
+    C = T // (2 * P)
+    import numpy as _np
+
+    chunks = []
+    for i in range(P):
+        chunks.append(_np.arange(i * C, (i + 1) * C))
+        j = 2 * P - 1 - i
+        chunks.append(_np.arange(j * C, (j + 1) * C))
+    return jnp.asarray(_np.concatenate(chunks), jnp.int32)
+
+
+def zigzag_indices_inverse(T: int, P: int):
+    """Inverse of :func:`zigzag_indices` (natural <- zigzag)."""
+    import numpy as _np
+
+    perm = _np.asarray(zigzag_indices(T, P))
+    inv = _np.empty_like(perm)
+    inv[perm] = _np.arange(T, dtype=perm.dtype)
+    return jnp.asarray(inv, jnp.int32)
+
+
 def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
-                   impl: str | None = None):
+                   impl: str | None = None, schedule: str = "contiguous"):
     """Exact attention over the full (ring-distributed) sequence.
 
     Per-member shapes [B, T_local, H, D]; the global sequence is the
@@ -78,13 +115,49 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     dense on the CPU rung (the Pallas HLO interpreter can't run inside
     shard_map with check_vma=True — jax#vma dynamic_slice limitation;
     flash-ring CPU tests pass check_vma=False explicitly).
+
+    `schedule="zigzag"` (causal only) expects the global sequence
+    permuted by :func:`zigzag_indices` before sharding, and balances the
+    causal work exactly across ranks on every hop (each rank computes
+    precisely two live half-chunk pairs per hop); the output is in the
+    same zigzag order.  `schedule="contiguous"` is the natural layout.
     """
+    if schedule not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring_attention schedule {schedule!r}")
     if impl is None:
         impl = "flash" if _flash_defaults(q)[0] else "dense"
+    if schedule == "zigzag":
+        if not causal:
+            raise ValueError("zigzag schedule only makes sense for causal "
+                             "attention (non-causal hops are already "
+                             "balanced)")
+        if impl == "flash":
+            return _ring_attention_flash_zigzag(q, k, v, axis)
+        if impl != "dense":
+            raise ValueError(f"unknown ring_attention impl {impl!r}")
+        return _ring_attention_dense_zigzag(q, k, v, axis)
     if impl == "flash":
         return _ring_attention_flash(q, k, v, axis, causal)
     if impl != "dense":
         raise ValueError(f"unknown ring_attention impl {impl!r}")
+    if causal:
+        Tl = q.shape[1]
+
+        def bias_fn(idx, src):
+            qpos = idx * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
+            kpos = src * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
+            return jnp.where(qpos >= kpos, 0.0, NEG_INF).astype(jnp.float32)
+    else:
+        bias_fn = None
+    return _dense_ring_loop(q, k, v, axis, bias_fn)
+
+
+def _dense_ring_loop(q, k, v, axis: str, bias_fn):
+    """The dense (jnp) ring schedule shared by the contiguous and zigzag
+    layouts: rotate K/V around the ring, fold each arriving shard with a
+    streaming-softmax accumulator.  `bias_fn(idx, src) -> [Tl, Tl]`
+    computes the additive causal mask for the shard that originated at
+    rank `src` (None = unmasked)."""
     P = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
@@ -96,12 +169,8 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
         o, m, l, kc, vc = carry
         # current block originated at rank (idx - s) mod P
         src = (idx - s) % P
-        if causal:
-            qpos = idx * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 0)
-            kpos = src * Tl + lax.broadcasted_iota(jnp.int32, (Tl, Tl), 1)
-            bias = jnp.where(qpos >= kpos, 0.0, NEG_INF).astype(jnp.float32)
-        else:
-            bias = jnp.zeros((Tl, Tl), jnp.float32)
+        bias = (bias_fn(idx, src) if bias_fn is not None
+                else jnp.zeros((Tl, Tl), jnp.float32))
         m_blk, p, pv = _block_attn(qf, kc.astype(jnp.float32),
                                    vc.astype(jnp.float32), bias)
         m_new = jnp.maximum(m, m_blk)
@@ -129,6 +198,139 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _lse_merge(o, lse, o_i, lse_i, _NI=NEG_INF):
+    """lse-weighted merge of normalized partial attentions (exact; dead
+    partials carry lse = -inf and weight 0).  o/o_i: [B, T, H, D] (o is
+    the fp32 running carry), lse/lse_i: [B, H, T].  Returns (o', lse')."""
+    m_new = jnp.maximum(lse, lse_i)
+    safe = jnp.where(m_new <= _NI / 2, 0.0, m_new)
+    w_r = jnp.where(lse <= _NI / 2, 0.0, jnp.exp(lse - safe))
+    w_i = jnp.where(lse_i <= _NI / 2, 0.0, jnp.exp(lse_i - safe))
+    tot = jnp.maximum(w_r + w_i, 1e-38)
+    wr4 = (w_r / tot).transpose(0, 2, 1)[..., None]  # [B, T, H, 1]
+    wi4 = (w_i / tot).transpose(0, 2, 1)[..., None]
+    o_new = o * wr4 + o_i.astype(jnp.float32) * wi4
+    lse_new = jnp.where((w_r + w_i) == 0.0, jnp.full_like(m_new, _NI),
+                        safe + jnp.log(tot))
+    return o_new, lse_new
+
+
+def _ring_attention_dense_zigzag(q, k, v, axis: str):
+    """Dense (jnp) zigzag schedule: the shared ring loop with the causal
+    bias computed from the zigzag GLOBAL positions of the local rows
+    (chunk idx and its mirror 2P-1-idx) instead of a contiguous
+    offset."""
+    P = lax.axis_size(axis)
+    Tl = q.shape[1]
+    if Tl % 2 != 0:
+        raise ValueError(f"zigzag needs an even local length, got {Tl}")
+    C = Tl // 2
+
+    def positions(r):
+        ar = lax.iota(jnp.int32, C)
+        return jnp.concatenate([r * C + ar, (2 * P - 1 - r) * C + ar])
+
+    def bias_fn(idx, src):
+        qpos, kpos = positions(idx), positions(src)
+        return jnp.where(qpos[:, None] >= kpos[None, :], 0.0,
+                         NEG_INF).astype(jnp.float32)
+
+    return _dense_ring_loop(q, k, v, axis, bias_fn)
+
+
+def _ring_attention_flash_zigzag(q, k, v, axis: str):
+    """Flash-backed zigzag causal ring schedule — exact per-hop load
+    balance.
+
+    Each rank's local row holds chunks (idx, 2P-1-idx), each C = Tl/2
+    long.  With arriving chunks (a, 2P-1-a), a = (idx - s) mod P, the
+    chunk-pair liveness works out to EXACTLY two live half-chunk flash
+    calls per rank per hop (three half-size ones on the diagonal hop,
+    simultaneously for all ranks):
+
+      (qh, kl): always live, full          [kl = chunk a, qh = 2P-1-idx]
+      a < idx:  (ql, kl) full              [ql's past]
+      a == idx: (ql, kl) + (qh, kh) causal [the diagonal hop, s = 0]
+      a > idx:  (qh, kh) full              [kh = 2P-1-a <= 2P-1-idx]
+      (ql, kh): never live                 [kh >= P > ql's chunk]
+
+    so the lockstep ppermute never waits on a heavier neighbor — the
+    contiguous causal schedule degrades to the heaviest rank (P live
+    shards) while the average is P/2."""
+    from ..ops.flash import NEG_INF as _NI
+    from ..ops.flash import flash_attention_lse
+
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    if Tl % 2 != 0:
+        raise ValueError(f"zigzag needs an even local length, got {Tl}")
+    C = Tl // 2
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    on_tpu, mxu_dt = _flash_defaults(q)
+    interpret = not on_tpu
+
+    ql, qh = q[:, :C], q[:, C:]
+
+    def flash(qx, kx, vx, causal):
+        return flash_attention_lse(qx, kx, vx, causal=causal,
+                                   interpret=interpret, mxu_dtype=mxu_dt)
+
+    def dead(kx, vx):
+        # zeros carrying the same device-variance as the live branches
+        zkv = (jnp.sum(kx).astype(jnp.float32)
+               + jnp.sum(vx).astype(jnp.float32)) * 0.0
+        o_z = (ql.astype(jnp.float32) * 0.0 + zkv).astype(q.dtype)
+        lse_z = jnp.transpose(
+            jnp.sum(o_z.astype(jnp.float32), axis=-1), (0, 2, 1)) + _NI
+        return o_z, lse_z
+
+    def step(s, carry):
+        o_lo, lse_lo, o_hi, lse_hi, kc, vc = carry
+        src = (idx - s) % P
+        kl, kh = kc[:, :C], kc[:, C:]
+        vl, vh = vc[:, :C], vc[:, C:]
+
+        # always-live pair: qh attends the arriving low chunk fully
+        o_hb, lse_hb = flash(qh, kl, vl, causal=False)
+
+        # branch on the arriving low chunk's position vs ours
+        def past(_):   # a < idx: ql's past arrived
+            o1, s1 = flash(ql, kl, vl, causal=False)
+            o2, s2 = dead(kh, vh)
+            return o1, s1, o2, s2
+
+        def diag(_):   # a == idx: both diagonals (hop 0)
+            o1, s1 = flash(ql, kl, vl, causal=True)
+            o2, s2 = flash(qh, kh, vh, causal=True)
+            return o1, s1, o2, s2
+
+        def future(_):  # a > idx: qh's mirror-past arrived
+            o1, s1 = dead(kl, vl)
+            o2, s2 = flash(qh, kh, vh, causal=False)
+            return o1, s1, o2, s2
+
+        branch = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+        o_li, lse_li, o_he, lse_he = lax.switch(
+            branch, (past, diag, future), None)
+
+        o_lo, lse_lo = _lse_merge(o_lo, lse_lo, o_li, lse_li, _NI)
+        o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_hb, lse_hb, _NI)
+        o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_he, lse_he, _NI)
+
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return o_lo, lse_lo, o_hi, lse_hi, kc, vc
+
+    zkv = (jnp.sum(k).astype(jnp.float32)
+           + jnp.sum(v).astype(jnp.float32)) * 0.0
+    o0 = ql.astype(jnp.float32) * 0.0 + zkv
+    lse0 = jnp.transpose(jnp.sum(o0, axis=-1), (0, 2, 1)) + NEG_INF
+    o_lo, _sl, o_hi, _sh, _, _ = lax.fori_loop(
+        0, P, step, (o0, lse0, o0, lse0, k, v))
+    return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
 
 
 def _ring_attention_flash(q, k, v, axis: str, causal: bool):
@@ -186,20 +388,9 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool):
                                     (kc, vc))
         else:
             o_i, lse_i = hop_full((kc, vc))
-        # lse-weighted merge of normalized partials (exact; dead shards
-        # carry lse = -inf and weight 0)
-        m_new = jnp.maximum(lse, lse_i)
-        safe = jnp.where(m_new <= _NI / 2, 0.0, m_new)
-        w_r = jnp.where(lse <= _NI / 2, 0.0, jnp.exp(lse - safe))
-        w_i = jnp.where(lse_i <= _NI / 2, 0.0, jnp.exp(lse_i - safe))
-        tot = jnp.maximum(w_r + w_i, 1e-38)
-        wr4 = (w_r / tot).transpose(0, 2, 1)[..., None]  # [B, Tl, H, 1]
-        wi4 = (w_i / tot).transpose(0, 2, 1)[..., None]
         # the running output carry stays fp32 for the whole ring (one
         # downcast after the loop), matching the dense path's contract
-        o_new = o * wr4 + o_i.astype(jnp.float32) * wi4
-        lse_new = jnp.where((w_r + w_i) == 0.0, jnp.full_like(m_new, _NI),
-                            safe + jnp.log(tot))
+        o_new, lse_new = _lse_merge(o, lse, o_i, lse_i, _NI)
         kc = lax.ppermute(kc, axis, perm)
         vc = lax.ppermute(vc, axis, perm)
         return o_new, lse_new, kc, vc

@@ -131,6 +131,21 @@ struct CallDesc {
   // scratch device-memory leases that persist across retries (the role of
   // the reference's SPARE1-3 rendezvous scratch buffers, accl.cpp:1190)
   uint64_t scratch0 = 0, scratch1 = 0;
+  // first time this call was attempted at its CURRENT resume step (ns
+  // since steady epoch; 0 = not yet tried; reset whenever current_step
+  // advances so the budget is per-receive, like the blocking eager
+  // path's seek, not per-call).  The retry queue expires calls against
+  // the engine's receive budget — the reference retries NOT_READY
+  // forever (fw :2460-2479), which turns a dead peer into an opaque
+  // host-side hang; here the same timeout register that bounds blocking
+  // receives bounds the cooperative retry loop, so a stuck rendezvous
+  // finalizes with RECEIVE_TIMEOUT_ERROR.
+  uint64_t first_try_ns = 0;
+  // (comm, src, tag, vaddr) landing records this call advertised
+  // (receiver role); torn down if the call expires so a late one-sided
+  // write cannot land into reused memory and a late completion cannot
+  // satisfy a future call.
+  std::vector<std::array<uint64_t, 4>> rndzv_posts;
 
   Op scenario() const { return static_cast<Op>(w[0]); }
   uint32_t count() const { return w[1]; }

@@ -399,6 +399,12 @@ void Engine::ingress(Message&& msg) {
           posted_.erase(it);
         }
       }
+      // Landing REQUIRES our own posted record: every legitimate write
+      // answers an RNDZVS_INIT we sent, so a write with no record is a
+      // stale arrival for an expired call — dropping it (and emitting no
+      // completion) is what keeps reused memory safe after retry-queue
+      // expiry tears the record down.
+      if (!post) break;
       {
         // the landing address may be tagged host-resident (host-only
         // rendezvous buffers); resolve the region like mem() does
@@ -406,7 +412,7 @@ void Engine::ingress(Message&& msg) {
             (msg.hdr.vaddr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
         uint64_t vaddr = msg.hdr.vaddr & ~HOST_ADDR_BIT;
         std::lock_guard<std::mutex> g(mem_mu_);
-        if (post && post->wire_c != post->lnd_c) {
+        if (post->wire_c != post->lnd_c) {
           // clamp to what actually arrived: a short payload (divergent
           // arithcfg, stale posted entry) must not read past the wire
           // buffer
@@ -458,6 +464,10 @@ void Engine::loop() {
     if (!have) continue;
 
     auto t0 = steady_clock::now();
+    if (c.first_try_ns == 0)
+      c.first_try_ns =
+          uint64_t(duration_cast<nanoseconds>(t0.time_since_epoch()).count());
+    uint32_t step_before = c.current_step;
     sticky_err_ = 0;
     bool retry = false;
     try {
@@ -472,10 +482,53 @@ void Engine::loop() {
       retry = true;
     }
     if (retry) {
-      retry_q_.push_back(c);
-      // cooperative pacing so retries don't spin hot (the firmware's
-      // round-robin between host cmd stream and retry FIFO)
-      std::this_thread::sleep_for(microseconds(200));
+      // the budget is PER RECEIVE, like the blocking eager seek: any
+      // step progress restarts the clock
+      if (c.current_step != step_before)
+        c.first_try_ns = uint64_t(
+            duration_cast<nanoseconds>(steady_clock::now().time_since_epoch())
+                .count());
+      // expire stalled calls against the receive budget (see CallDesc
+      // .first_try_ns): a peer that never arrives must surface as the
+      // engine's own RECEIVE_TIMEOUT_ERROR, not as a host-side hang
+      auto waited = duration_cast<nanoseconds>(
+                        steady_clock::now().time_since_epoch())
+                        .count() -
+                    int64_t(c.first_try_ns);
+      if (waited > timeout_budget().count()) {
+        // tear down the call's rendezvous protocol state: erase the
+        // landing records it advertised (a late one-sided write must
+        // NOT land into memory about to be reused) and drain any
+        // completions already surfaced for them (a future call with the
+        // same (comm, src, tag) must not see a stale success)
+        {
+          std::lock_guard<std::mutex> g(posted_mu_);
+          for (const auto& k : c.rndzv_posts)
+            posted_.erase(PostedKey{uint32_t(k[0]), uint32_t(k[1]),
+                                    uint32_t(k[2]), k[3]});
+        }
+        for (const auto& k : c.rndzv_posts)
+          while (completions_.pop_match(
+              [&](const RndzvDone& d) {
+                return d.comm == uint32_t(k[0]) && d.src == uint32_t(k[1]) &&
+                       d.tag == uint32_t(k[2]);
+              },
+              nanoseconds(0))) {
+          }
+        // release scratch leases the retries kept alive
+        if (c.scratch0) { free_addr(c.scratch0); c.scratch0 = 0; }
+        if (c.scratch1) { free_addr(c.scratch1); c.scratch1 = 0; }
+        std::lock_guard<std::mutex> g(results_mu_);
+        auto& r = results_[c.id];
+        r.retcode = sticky_err_ | RECEIVE_TIMEOUT_ERROR;
+        r.duration_ns = double(waited);
+        r.done = true;
+      } else {
+        retry_q_.push_back(c);
+        // cooperative pacing so retries don't spin hot (the firmware's
+        // round-robin between host cmd stream and retry FIFO)
+        std::this_thread::sleep_for(microseconds(200));
+      }
     }
   }
 }
@@ -1093,6 +1146,7 @@ void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
           PostedRndzv{elems, d.eth, dst_c && d.pair, d.comp_kind,
                       uint32_t(d.ub), uint32_t(d.cb)};
     }
+    c.rndzv_posts.push_back({c.comm(), src, tag, addr});
     // advertise our landing address to the sender (RNDZVS_INIT)
     Message msg;
     msg.hdr.count = uint32_t(elems);

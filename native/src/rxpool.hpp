@@ -34,6 +34,19 @@ class RxPool {
     bufs_.assign(nbufs, std::vector<uint8_t>(bufsize));
     status_.assign(nbufs, Status::IDLE);
     bufsize_ = bufsize;
+    // The transport (and ingress) is live from engine construction, so a
+    // peer racing ahead through bring-up can deliver BEFORE this pool is
+    // configured; those deposits staged against zero buffers and — with
+    // no reserved buffer ever consumed — release() would never drain
+    // them: a silent permanent loss that deadlocks the first collective
+    // (both sides retry forever).  Install them now.
+    while (!staging_.empty()) {
+      int idx = find_idle_locked();
+      if (idx < 0) break;
+      Message msg = std::move(staging_.front());
+      staging_.pop_front();
+      install_locked(uint32_t(idx), msg);
+    }
   }
 
   uint64_t buf_size() const { return bufsize_; }

@@ -39,13 +39,23 @@ def main() -> int:
         rng = np.random.default_rng(900 + rank + salt * 100)
         return rng.standard_normal(n).astype(np.float32)
 
-    # Timeout layering: the engine's receive budget (120s, process
-    # startup skew) must be the FIRST to fire — host-side call waits sit
-    # above it so a stall surfaces as the engine's RECEIVE_TIMEOUT_ERROR
-    # diagnosis, not an opaque host-side DMA_TIMEOUT_ERROR.
-    with EmuRankTcp(r, P, args.port, call_timeout_s=180.0) as node:
+    # Timeout layering: the engine's receive budget must be the FIRST to
+    # fire — host-side call waits sit above it so a stall surfaces as the
+    # engine's RECEIVE_TIMEOUT_ERROR diagnosis, not an opaque host-side
+    # DMA_TIMEOUT_ERROR.
+    with EmuRankTcp(r, P, args.port, call_timeout_s=540.0) as node:
         accl = node.accl
+        # Startup-skew absorber: peer PROCESSES can lag by minutes on an
+        # oversubscribed CI host (python+numpy import under load), and
+        # that wait belongs to bring-up, not to any collective's budget.
+        # Barrier under a long budget first, then tighten for the
+        # workload proper.
+        accl.set_timeout(480_000_000)
+        accl.barrier()
+        # workload proper: engine 120s < driver sync wait 180s < the
+        # device waiter thread (540s) and the pytest harness ceiling
         accl.set_timeout(120_000_000)
+        accl.call_timeout_s = 180.0
 
         if args.workload in ("allreduce", "all"):
             send = accl.create_buffer_like(data(r))

@@ -168,6 +168,52 @@ def test_rendezvous_retry_queue(world):
 # ---------------------------------------------------------------------------
 # fault surfacing: engine timeout -> RECEIVE_TIMEOUT_ERROR retcode
 # ---------------------------------------------------------------------------
+def test_preconfig_delivery_survives_bringup():
+    # Bring-up race (the historical TCP-rung flake): the transport and
+    # ingress are live from engine construction, so a peer racing ahead
+    # can deliver an eager message BEFORE the receiver's rx pool is
+    # configured.  Those deposits stage against zero buffers and must be
+    # installed when configure() runs — silent loss here deadlocks the
+    # first collective on both sides.
+    from accl_tpu.communicator import Rank
+
+    with EmuWorld(2, initialize=False) as w:
+        ranks = [Rank(ip="127.0.0.1", port=0, session=r,
+                      max_segment_size=1024) for r in range(2)]
+        w.accls[1].initialize(ranks, 1)
+        data = np.arange(64, dtype=np.float32)
+        src = w.accls[1].create_buffer_like(data)
+        req = w.accls[1].send(src, 64, 0, tag=7, run_async=True)
+        time.sleep(0.3)  # let the message land while rank 0 is unconfigured
+        w.accls[0].initialize(ranks, 0)
+        dst = w.accls[0].create_buffer(64, np.float32)
+        w.accls[0].recv(dst, 64, 1, tag=7)
+        assert req.wait(timeout=30)
+        req.check()
+        np.testing.assert_array_equal(dst.host, data)
+
+
+def test_rendezvous_retry_deadline(world):
+    # a rendezvous recv whose sender NEVER arrives must finalize with the
+    # engine's own RECEIVE_TIMEOUT_ERROR once the receive budget expires
+    # — the reference retries NOT_READY forever (fw :2460-2479), which
+    # turns a dead peer into an opaque host-side hang
+    def fn(accl, rank):
+        if rank != 0:
+            return
+        accl.set_timeout(300_000)  # 300 ms budget
+        try:
+            dst = accl.create_buffer(4096, np.float32)  # > eager: rndzv
+            t0 = time.time()
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT_ERROR"):
+                accl.recv(dst, 4096, 1, tag=54321)
+            assert time.time() - t0 < 30, "retry loop failed to expire"
+        finally:
+            accl.set_timeout(default_timeout())  # module-scoped world
+
+    world.run(fn)
+
+
 def test_timeout_surfaces_as_error(world):
     def fn(accl, rank):
         if rank != 0:

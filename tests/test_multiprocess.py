@@ -32,7 +32,11 @@ def test_multiprocess_tcp_world(nranks):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            # generous ceiling: on an oversubscribed 1-core CI host the
+            # peer processes' python+numpy imports alone can lag minutes;
+            # run_emu_rank absorbs that skew in a long-budget barrier and
+            # normal runs finish in seconds
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
     finally:
         for p in procs:
