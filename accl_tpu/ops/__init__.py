@@ -27,3 +27,10 @@ from .ring import (  # noqa: F401
     ring_reduce_scatter_pallas,
 )
 from .fused import fused_matmul_allreduce  # noqa: F401
+from .quantized import (  # noqa: F401
+    dequantize_blockwise,
+    quantize_blockwise,
+    quantized_all_reduce,
+    quantized_ring_all_gather,
+    quantized_ring_reduce_scatter,
+)

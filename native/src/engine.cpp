@@ -389,9 +389,16 @@ void Engine::ingress(Message&& msg) {
       // landing representation using OUR OWN posted-address record (the
       // eager path's own-flag-algebra discipline; the sender's header is
       // advisory only) — this is the ETH-compressed rendezvous path.
+      //
+      // The whole consume-write-complete sequence holds posted_mu_:
+      // retry-queue expiry tears records down under the same lock, so a
+      // concurrent landing either fully completes BEFORE the teardown
+      // (its completion is then drained) or finds no record and drops —
+      // there is no window where a write lands or a completion surfaces
+      // after the teardown decided the call is dead.
+      std::lock_guard<std::mutex> pg(posted_mu_);
       std::optional<PostedRndzv> post;
       {
-        std::lock_guard<std::mutex> g(posted_mu_);
         auto it = posted_.find(PostedKey{msg.hdr.comm_id, msg.hdr.src,
                                          msg.hdr.tag, msg.hdr.vaddr});
         if (it != posted_.end()) {
@@ -433,11 +440,13 @@ void Engine::ingress(Message&& msg) {
                       msg.payload.size());
         }
       }
-      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag});
+      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
+                                  msg.hdr.vaddr});
       break;
     }
     case MsgType::RndzvsWrDone:
-      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag});
+      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
+                                  msg.hdr.vaddr});
       break;
   }
 }
@@ -499,22 +508,28 @@ void Engine::loop() {
         // tear down the call's rendezvous protocol state: erase the
         // landing records it advertised (a late one-sided write must
         // NOT land into memory about to be reused) and drain any
-        // completions already surfaced for them (a future call with the
-        // same (comm, src, tag) must not see a stale success)
+        // completions already surfaced for them (a future call reusing
+        // the address must not see a stale success).  posted_mu_ is held
+        // across BOTH so a landing racing with expiry either completes
+        // fully before the drain (ingress holds the same lock through
+        // consume-write-complete) or finds no record and drops; the
+        // drain matches the exact posted vaddr so a concurrent healthy
+        // call's completion on the same (comm, src, tag) survives.
         {
           std::lock_guard<std::mutex> g(posted_mu_);
-          for (const auto& k : c.rndzv_posts)
+          for (const auto& k : c.rndzv_posts) {
             posted_.erase(PostedKey{uint32_t(k[0]), uint32_t(k[1]),
                                     uint32_t(k[2]), k[3]});
-        }
-        for (const auto& k : c.rndzv_posts)
-          while (completions_.pop_match(
-              [&](const RndzvDone& d) {
-                return d.comm == uint32_t(k[0]) && d.src == uint32_t(k[1]) &&
-                       d.tag == uint32_t(k[2]);
-              },
-              nanoseconds(0))) {
+            while (completions_.pop_match(
+                [&](const RndzvDone& d) {
+                  return d.comm == uint32_t(k[0]) &&
+                         d.src == uint32_t(k[1]) &&
+                         d.tag == uint32_t(k[2]) && d.vaddr == k[3];
+                },
+                nanoseconds(0))) {
+            }
           }
+        }
         // release scratch leases the retries kept alive
         if (c.scratch0) { free_addr(c.scratch0); c.scratch0 = 0; }
         if (c.scratch1) { free_addr(c.scratch1); c.scratch1 = 0; }
@@ -1163,10 +1178,18 @@ void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
 void Engine::rndzv_wait_done(CallDesc& c, Progress& p, uint32_t src,
                              uint32_t tag) {
   if (p.pending()) {
-    // wait for the write-done completion; not ready -> re-queue the call
+    // wait for the write-done completion — matched against the address
+    // THIS call advertised for (src, tag), so concurrent calls sharing
+    // (comm, src, tag) can only consume their own completions
     auto done = completions_.pop_match(
         [&](const RndzvDone& d) {
-          return d.comm == c.comm() && d.src == src && d.tag == tag;
+          if (d.comm != c.comm() || d.src != src || d.tag != tag)
+            return false;
+          for (const auto& k : c.rndzv_posts)
+            if (uint32_t(k[0]) == c.comm() && uint32_t(k[1]) == src &&
+                uint32_t(k[2]) == tag && k[3] == d.vaddr)
+              return true;
+          return c.rndzv_posts.empty();  // no record: legacy tag match
         },
         milliseconds(2));
     if (!done) throw NotReadyEx{c.current_step};

@@ -47,6 +47,11 @@ struct RndzvAddr {
 };
 struct RndzvDone {
   uint32_t comm, src, tag;
+  // the landing address of the write this completion reports: lets a
+  // wait match exactly ITS OWN posted address, so concurrent calls with
+  // the same (comm, src, tag) can't consume each other's completions
+  // and retry-expiry teardown can't drain a healthy call's success
+  uint64_t vaddr = 0;
 };
 
 struct CallResult {

@@ -326,10 +326,17 @@ def test_driver_allreduce_close_to_raw_psum():
             np.zeros((NRANKS, n), np.float32),
             NamedSharding(mesh, P("rank", None)))
         jax.block_until_ready(raw(xs))
-        t0 = time.perf_counter()
-        for _ in range(3):
-            jax.block_until_ready(raw(xs))
-        raw_dt = (time.perf_counter() - t0) / 3
+
+        def measure_raw():
+            # best-of: a capability estimator, like bench.py — a single
+            # scheduler hiccup on this 1-core box must not fail the guard
+            best = None
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(raw(xs))
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best
 
         def fn(accl, rank):
             send = accl.create_buffer_like(np.zeros(n, np.float32))
@@ -338,19 +345,27 @@ def test_driver_allreduce_close_to_raw_psum():
             # zero-copy call path (reference accl.cpp:796-839): device-
             # resident operands, no host staging per call
             accl.allreduce(send, recv, n, from_fpga=True, to_fpga=True)
-            t0 = time.perf_counter()
-            for _ in range(3):
+            best = None
+            for _ in range(5):
+                t0 = time.perf_counter()
                 accl.allreduce(send, recv, n, from_fpga=True, to_fpga=True)
-            return (time.perf_counter() - t0) / 3
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best
 
-        drv_dt = max(w.run(fn))
         on_tpu = jax.default_backend() not in ("cpu",)
-    ratio = drv_dt / max(raw_dt, 1e-9)
-    # 2x is the hardware target (asserted when running on real TPU);
-    # the CPU virtual-device rung gets single-digit headroom for the
-    # Python gang scheduler sharing one core with the XLA runtime —
-    # a reintroduced per-call host round-trip or retrace blows this to
-    # 50-100x, which is the regression this guards
-    bound = 2.0 if on_tpu else 10.0
+        # 2x is the hardware target (asserted when running on real TPU);
+        # the CPU virtual-device rung gets single-digit headroom for the
+        # Python gang scheduler sharing one core with the XLA runtime —
+        # a reintroduced per-call host round-trip or retrace blows this
+        # to 50-100x, which is the regression this guards
+        bound = 2.0 if on_tpu else 10.0
+        ratio = None
+        for _attempt in range(2):  # one re-measure absorbs load spikes
+            raw_dt = measure_raw()
+            drv_dt = max(w.run(fn))
+            ratio = drv_dt / max(raw_dt, 1e-9)
+            if ratio < bound:
+                break
     assert ratio < bound, f"driver allreduce {drv_dt:.4f}s vs raw psum " \
                           f"{raw_dt:.4f}s (ratio {ratio:.1f}x, bound {bound}x)"
