@@ -34,9 +34,10 @@ def _kernel_max(a_ref, b_ref, o_ref):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("is_max", "interpret", "block_rows"))
+                   static_argnames=("is_max", "interpret", "block_rows",
+                                    "donate"))
 def _pallas_combine_2d(a, b, is_max: bool = False, interpret: bool = False,
-                       block_rows: int = 0):
+                       block_rows: int = 0, donate: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -51,6 +52,13 @@ def _pallas_combine_2d(a, b, is_max: bool = False, interpret: bool = False,
         grid=grid,
         in_specs=[spec, spec],
         out_specs=spec,
+        # donate=True: the result may reuse operand 0's buffer — free
+        # when the op is INLINED in a larger jit and the operand dies
+        # there (the chained accumulate pattern); as a STANDALONE call
+        # the operand is a non-donatable jit parameter and XLA would
+        # satisfy the must-alias with a full copy instead, so the alias
+        # is opt-in
+        input_output_aliases={0: 0} if donate else {},
         interpret=interpret,
     )(a, b)
 
@@ -66,16 +74,20 @@ def _to_tiles(x):
     return flat.reshape(rows, _LANES), n
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block_rows"))
-def pallas_add(a, b, interpret: bool = False, block_rows: int = 0):
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "block_rows", "donate"))
+def pallas_add(a, b, interpret: bool = False, block_rows: int = 0,
+               donate: bool = False):
     """Elementwise sum lane (reduce_ops TDEST 0/2/4/6/8).  Jitted end to
     end so the tiling reshapes are layout no-ops instead of device
     copies.  `block_rows` overrides the VMEM tile depth (bench autotune;
-    0 = default)."""
+    0 = default).  `donate=True` lets the result alias operand 0 — use
+    when calling inlined in a larger jit where `a` dies (the accumulate
+    pattern); see _pallas_combine_2d."""
     a2, n = _to_tiles(a)
     b2, _ = _to_tiles(b)
     out = _pallas_combine_2d(a2, b2, is_max=False, interpret=interpret,
-                             block_rows=block_rows)
+                             block_rows=block_rows, donate=donate)
     return out.reshape(-1)[:n].reshape(a.shape)
 
 

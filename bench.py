@@ -21,11 +21,19 @@ Robustness contract (this file's one job is to ALWAYS land a number):
 
 Methodology notes (important on remote-tunneled devices, where
 `block_until_ready` can return at enqueue-ack rather than completion):
-- iterations are CHAINED (out feeds the next call) so no caching or
-  cross-call elision is possible;
+- iterations are CHAINED INSIDE ONE COMPILED PROGRAM (lax.fori_loop; the
+  carry feeds forward so no elision is possible) — one dispatch per
+  trial regardless of iteration count.  Host-side per-call chaining is
+  wrong on a tunneled device in BOTH directions: with few iterations
+  the device time is smaller than the RTT being subtracted and the
+  residue is noise (observed: a 12 B/elem cast pair "measuring" 3x the
+  chip's HBM roofline), with many the dispatch stream is the bottleneck
+  and the kernel is underestimated (round 2's 0.007-TFLOPs flash);
 - completion is forced by a scalar device->host readback, which cannot
-  resolve before the producing op finishes;
-- the readback round-trip cost is measured separately and subtracted;
+  resolve before the producing loop finishes;
+- the readback round-trip cost is measured separately and subtracted
+  (with in-jit chaining the iteration count can be made large enough
+  that device time dominates the RTT jitter);
 - the reported value is the best of several interleaved trials (the chip
   is shared; the fastest window estimates hardware capability, and
   ratioed quantities are measured A/B-interleaved in shared windows).
@@ -77,17 +85,24 @@ def _measure(platform: str) -> dict:
           f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
     on_tpu = backend not in ("cpu",)
 
-    # 64 Mi elements = 256 MB per operand on TPU; small on CPU fallback
+    # 64 Mi elements = 256 MB per operand on TPU; small on CPU fallback.
+    # Operands are laid out 2D (rows, 128) — the kernels' native tile
+    # shape — because a 1D loop carry has a different physical layout
+    # (T(1024) vs T(8,128)) and XLA then inserts a full-array relayout
+    # copy per chained iteration in front of the pallas call (observed:
+    # +2 HBM streams, a phantom 0.6x on the pallas side of the A/B).
     n = (64 << 20) if on_tpu else (1 << 20)
 
     from accl_tpu.ops.reduce_ops import pallas_add
 
-    a = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.float32)
+    a = jax.random.normal(jax.random.PRNGKey(0), (n // 128, 128),
+                          jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n // 128, 128),
+                          jnp.float32)
 
     interpret = not on_tpu
 
-    probe = jax.jit(lambda x: x[-1])
+    probe = jax.jit(lambda x: x.reshape(-1)[-1])
 
     # measure the sync round-trip alone so it can be subtracted
     float(probe(a))  # compile the probe
@@ -98,23 +113,37 @@ def _measure(platform: str) -> dict:
         syncs.append(time.perf_counter() - t0)
     sync_s = statistics.median(syncs)
 
-    def timed_chain(fn, x0, iters, trials=5):
-        """BEST (minimum) per-iteration seconds of a chained-call loop
-        (output feeds the next call; completion forced by scalar
-        readback; sync RTT subtracted).  fn must be warm already.
+    from jax import lax
+
+    chain_cache: dict = {}
+
+    def timed_chain(fn, x0, iters, trials=5, consts=()):
+        """BEST (minimum) per-iteration seconds of an IN-JIT chained
+        loop: `fori_loop(0, iters, lambda _, v: fn(v, *consts), x0)`
+        compiled once — a single dispatch covers all iterations, so the
+        measured window is device time + one RTT (subtracted), not the
+        dispatch stream.  fn must be shape/dtype-preserving in its first
+        argument; fixed operands go in `consts` as traced ARGUMENTS (a
+        closure would bake them into the program as constants — the
+        remote compile tunnel rejects a 256 MB proto with HTTP 413).
 
         Minimum, not median: the chip is shared behind a tunnel and
         run-to-run contention swings measured bandwidth by >10x (observed
         716 -> 10 GB/s for the same XLA add minutes apart).  The fastest
         window estimates the hardware capability; a median would report
         the neighbors' workload."""
+        key = (id(fn), iters)
+        chained = chain_cache.get(key)
+        if chained is None:
+            chained = jax.jit(lambda x, *cs: lax.fori_loop(
+                0, iters, lambda _, v: fn(v, *cs), x))
+            float(probe(chained(x0, *consts)))  # compile + warm
+            chain_cache[key] = chained
         vals = []
         for _ in range(trials):
-            out = x0
             t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(out)
-            float(probe(out.reshape(-1)))  # true completion barrier
+            out = chained(x0, *consts)
+            float(probe(out))  # true completion barrier
             elapsed = time.perf_counter() - t0
             # RTT jitter can push elapsed below the pre-measured sync
             # median; fall back to the unsubtracted time, never negative
@@ -122,7 +151,7 @@ def _measure(platform: str) -> dict:
             vals.append(net / iters)
         return min(vals)
 
-    def timed_chain_ab(fns: dict, x0, iters, trials=5) -> dict:
+    def timed_chain_ab(fns: dict, x0, iters, trials=5, consts=()) -> dict:
         """Interleaved A/B timing: one trial of each fn per round, best
         window per fn.  Quantities that will be RATIOED against each
         other must share contention windows — measured minutes apart on
@@ -130,7 +159,7 @@ def _measure(platform: str) -> dict:
         best = {k: None for k in fns}
         for _ in range(trials):
             for k, fn in fns.items():
-                dt = timed_chain(fn, x0, iters, trials=1)
+                dt = timed_chain(fn, x0, iters, trials=1, consts=consts)
                 if best[k] is None or dt < best[k]:
                     best[k] = dt
         return best
@@ -140,33 +169,28 @@ def _measure(platform: str) -> dict:
     best_dt, best_rows = None, 0
     iters = 30 if on_tpu else 3
     for rows in ((256, 512, 1024, 2048) if on_tpu else (512,)):
-        fn = lambda x, r=rows: pallas_add(x, b, interpret=interpret,
-                                          block_rows=r)
-        out = fn(a)  # warm / compile
-        float(probe(out))
-        dt_r = timed_chain(fn, a, max(4, iters // 4), trials=2)
+        fn = lambda x, bb, r=rows: pallas_add(x, bb, interpret=interpret,
+                                              block_rows=r, donate=True)
+        dt_r = timed_chain(fn, a, max(4, iters // 4), trials=2, consts=(b,))
         if best_dt is None or dt_r < best_dt:
             best_dt, best_rows = dt_r, rows
     print(f"[bench worker] pallas_add autotune -> block_rows={best_rows}",
           file=sys.stderr)
 
-    run = lambda x: pallas_add(x, b, interpret=interpret,
-                               block_rows=best_rows)
+    run = lambda x, bb: pallas_add(x, bb, interpret=interpret,
+                                   block_rows=best_rows, donate=True)
     nbytes = 3 * n * 4  # read a, read b, write out
 
     if on_tpu:
         # headline + roofline measured interleaved: the same 3-stream add
         # through plain XLA is the practical HBM ceiling on this chip, so
-        # the headline number carries its own context.  b must be a
-        # traced ARGUMENT: a closure would bake 256 MB of constants into
-        # the program (the remote compile tunnel rejects it, HTTP 413).
-        xla_add2 = jax.jit(lambda x, y: x + y)
-        xla_add = lambda x: xla_add2(x, b)
-        float(probe(xla_add(a)))
-        dts = timed_chain_ab({"pallas": run, "xla": xla_add}, a, iters)
+        # the headline number carries its own context
+        xla_add = lambda x, bb: x + bb
+        dts = timed_chain_ab({"pallas": run, "xla": xla_add}, a, iters,
+                             consts=(b,))
         dt = dts["pallas"]
     else:
-        dt = timed_chain(run, a, iters, trials=3)
+        dt = timed_chain(run, a, iters, trials=3, consts=(b,))
         dts = {}
 
     gbps = nbytes / dt / 1e9
@@ -179,8 +203,7 @@ def _measure(platform: str) -> dict:
         "platform": backend,
     }
     if on_tpu:
-        detail = _secondary_kernels(jax, jnp, probe, timed_chain,
-                                    timed_chain_ab)
+        detail = _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab)
         detail["xla_add_gbps"] = round(nbytes / dts["xla"] / 1e9, 2)
         detail["roofline_frac"] = round(dts["xla"] / dt, 3)
         detail["pallas_block_rows"] = best_rows
@@ -188,7 +211,7 @@ def _measure(platform: str) -> dict:
     return result
 
 
-def _secondary_kernels(jax, jnp, probe, timed_chain, timed_chain_ab) -> dict:
+def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
     """Compiled-on-TPU runs of the flash-attention and compression
     kernels, measured with the SAME chained-iteration + sync-subtraction
     methodology as the headline metric (round 2 recorded single-call
@@ -203,31 +226,28 @@ def _secondary_kernels(jax, jnp, probe, timed_chain, timed_chain_ab) -> dict:
         k = jax.random.normal(k2, (B, T, H, D), jnp.float32)
         v = jax.random.normal(k3, (B, T, H, D), jnp.float32)
 
-        def fa(x):  # chained: output feeds the next call's queries
-            return flash_attention(x, k, v, causal=True, interpret=False)
+        def fa(x, kk, vv):  # chained: output feeds the next queries
+            return flash_attention(x, kk, vv, causal=True, interpret=False)
 
-        o = fa(q)
-        float(probe(o.reshape(-1)))
         # MXU-peak context, interleaved: a big bf16 matmul is the
         # practical ceiling of this chip's systolic array
         mm_n = 4096
         ka, kb = jax.random.split(jax.random.PRNGKey(7))
         ma = jax.random.normal(ka, (mm_n, mm_n), jnp.bfloat16)
         mb = jax.random.normal(kb, (mm_n, mm_n), jnp.bfloat16)
-        mm2 = jax.jit(lambda x, y: (x @ y).astype(jnp.bfloat16))
-        mm = lambda x: mm2(x, mb)
-        float(probe(mm(ma).reshape(-1).astype(jnp.float32)))
+        mm = lambda x, y: (x @ y).astype(jnp.bfloat16)
 
         # interleave manually (timed_chain_ab shares one input; the two
         # workloads here have different operand shapes).  10 rounds:
         # observed contention windows on this shared chip last minutes
         # and depress identical kernels 30x (matmul 19 vs 557 TFLOPs),
         # so the best-window estimator needs enough rounds to straddle
-        # a window boundary.
+        # a window boundary.  Iteration counts put >= ~10 ms of device
+        # work in one dispatch so the RTT jitter is amortized away.
         best_fa, best_mm = None, None
         for _ in range(10):
-            d1 = timed_chain(fa, q, iters=10, trials=1)
-            d2 = timed_chain(mm, ma, iters=10, trials=1)
+            d1 = timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
+            d2 = timed_chain(mm, ma, iters=48, trials=1, consts=(mb,))
             best_fa = d1 if best_fa is None else min(best_fa, d1)
             best_mm = d2 if best_mm is None else min(best_mm, d2)
         # causal: ~half of the 4*B*H*T^2*D matmul flops
@@ -241,28 +261,37 @@ def _secondary_kernels(jax, jnp, probe, timed_chain, timed_chain_ab) -> dict:
         detail["flash_attention_error"] = f"{type(e).__name__}: {e}"
     try:
         from accl_tpu.ops.compression import compress_cast
-        x = jax.random.normal(jax.random.PRNGKey(3), (16 << 20,), jnp.float32)
+        # 256 MB fp32: larger than any on-chip scratch (observed: at
+        # 64 MB XLA pins the whole chained cast loop in S(1) memory and
+        # "measures" >100 TB/s — on-chip bandwidth, not the HBM-streaming
+        # ceiling a wire-compression lane actually faces).  2D layout for
+        # the same copy-free-carry reason as the headline operands.
+        x = jax.random.normal(jax.random.PRNGKey(3), ((64 << 20) // 512, 512),
+                              jnp.float32)
 
         from accl_tpu.ops.compression import decompress_cast
+
+        import jax.lax as _lax
 
         def roundtrip(v):  # chained compress -> decompress
             return decompress_cast(compress_cast(v, jnp.bfloat16,
                                                  interpret=False),
                                    jnp.float32, interpret=False)
 
-        y = roundtrip(x)
-        float(probe(y))
         # context measured INTERLEAVED: the same roundtrip as plain XLA
-        # casts is the practical ceiling for this access pattern.  Two
-        # SEPARATE jits so the bf16 intermediate actually lands in HBM —
-        # a single jit fuses the casts into one 8 B/elem kernel and the
-        # 12 B/elem accounting would overstate the ceiling by 1.5x.
-        xla_down = jax.jit(lambda v: v.astype(jnp.bfloat16))
-        xla_up = jax.jit(lambda v: v.astype(jnp.float32))
-        xla_rt = lambda v: xla_up(xla_down(v))
-        float(probe(xla_rt(x)))
+        # casts is the practical ceiling for this access pattern.
+        # BOTH halves sit behind optimization_barriers: one barrier only
+        # pins the bf16 intermediate, and across chained iterations the
+        # simplifier then folds convert(convert(x)) to x, eliding every
+        # roundtrip but the first (observed as an impossible 7.4 TB/s);
+        # the second barrier pins the f32 output so each iteration's
+        # traffic is real.
+        def xla_rt(v):
+            h = _lax.optimization_barrier(v.astype(jnp.bfloat16))
+            return _lax.optimization_barrier(h.astype(jnp.float32))
+
         dts = timed_chain_ab({"pallas": roundtrip, "xla": xla_rt}, x,
-                             iters=8, trials=8)
+                             iters=24, trials=8)
         # bytes per roundtrip: read 4B + write 2B + read 2B + write 4B
         nbytes = x.size * 12
         detail["compression_gbps"] = round(nbytes / dts["pallas"] / 1e9, 2)
