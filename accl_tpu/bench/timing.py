@@ -1,0 +1,82 @@
+"""Chained-timing harness for remote-tunneled devices — shared by
+bench.py (the metric of record) and scripts/kernel_tune.py.
+
+Methodology (why this shape):
+- iterations are CHAINED INSIDE ONE COMPILED PROGRAM (lax.fori_loop;
+  the carry feeds forward so no elision is possible) — one dispatch per
+  trial regardless of iteration count.  Host-side per-call chaining is
+  wrong on a tunneled device in BOTH directions: with few iterations
+  the device time is smaller than the RTT being subtracted and the
+  residue is noise (observed: a 12 B/elem cast pair "measuring" 3x the
+  chip's HBM roofline), with many the dispatch stream is the bottleneck
+  and the kernel is underestimated;
+- fixed operands ride as traced ARGUMENTS via `consts` (a closure
+  would bake them into the program as constants — the remote compile
+  tunnel rejects a 256 MB proto with HTTP 413);
+- completion is forced by a scalar device->host readback (cannot
+  resolve before the producing loop finishes); its round-trip cost is
+  measured up front and subtracted;
+- minimum over trials, not median: the tunnel lands on different (and
+  differently-loaded) chips across windows, swinging identical kernels
+  >10x — the fastest window estimates hardware capability; a median
+  would report the neighbors' workload.  Quantities that will be
+  RATIOED must share windows (interleave via `timed_chain_ab`).
+"""
+from __future__ import annotations
+
+import statistics
+import time
+
+
+def make_harness(jax, jnp):
+    """Returns (probe, timed_chain, timed_chain_ab, sync_s)."""
+    from jax import lax
+
+    probe = jax.jit(lambda x: x.reshape(-1)[-1])
+
+    warm = jnp.zeros((1024,), jnp.float32)
+    float(probe(warm))  # compile the probe
+    syncs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(probe(warm))
+        syncs.append(time.perf_counter() - t0)
+    sync_s = statistics.median(syncs)
+
+    chain_cache: dict = {}
+
+    def timed_chain(fn, x0, iters, trials=5, consts=()):
+        """BEST (minimum) per-iteration seconds of the in-jit chained
+        loop `fori_loop(0, iters, lambda _, v: fn(v, *consts), x0)`.
+        fn must be shape/dtype-preserving in its first argument."""
+        key = (id(fn), iters)
+        chained = chain_cache.get(key)
+        if chained is None:
+            chained = jax.jit(lambda x, *cs: lax.fori_loop(
+                0, iters, lambda _, v: fn(v, *cs), x))
+            float(probe(chained(x0, *consts)))  # compile + warm
+            chain_cache[key] = chained
+        vals = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            out = chained(x0, *consts)
+            float(probe(out))  # true completion barrier
+            elapsed = time.perf_counter() - t0
+            # RTT jitter can push elapsed below the pre-measured sync
+            # median; fall back to the unsubtracted time, never negative
+            net = elapsed - sync_s if elapsed > sync_s else elapsed
+            vals.append(net / iters)
+        return min(vals)
+
+    def timed_chain_ab(fns: dict, x0, iters, trials=5, consts=()) -> dict:
+        """Interleaved A/B timing: one trial of each fn per round, best
+        window per fn — ratioed quantities must share windows."""
+        best = {k: None for k in fns}
+        for _ in range(trials):
+            for k, fn in fns.items():
+                dt = timed_chain(fn, x0, iters, trials=1, consts=consts)
+                if best[k] is None or dt < best[k]:
+                    best[k] = dt
+        return best
+
+    return probe, timed_chain, timed_chain_ab, sync_s

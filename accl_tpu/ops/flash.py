@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+_LOG2E = 1.4426950408889634  # log2(e)
+_LN2 = 0.6931471805599453    # ln(2)
 
 
 def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
@@ -35,9 +37,14 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
     don't produce exp(+big), masked-p zeroing, alpha rescale of the
     running state) lives exactly once.
 
-    q: [bq, D] (mxu dtype, PRE-SCALED by 1/sqrt(D) — scaling the [bq, D]
-    q block once replaces a full [bq, bk] VPU pass per fold; the kernel
-    is VPU-bound at D=64, so score-matrix passes are the budget),
+    The fold runs in the LOG2 domain: q arrives PRE-SCALED by
+    log2(e)/sqrt(D) (one [bq, D] multiply replaces a [bq, bk] VPU pass
+    per fold — the kernel is VPU-bound at D=64, so score-matrix passes
+    are the budget), so scores are log2-scaled logits, probabilities are
+    exp2(s - m), and the TRUE log-sum-exp is m*ln2 + ln(l) — `_finalize`
+    converts.  `p` values are identical to the natural-base fold
+    (exp2(log2e*(x - m_nat)) == exp(x - m_nat)), so acc/l match exactly.
+
     kb/vb: [bk, D] (mxu dtype); acc/m/l are f32 running state.  `mask`
     is None or (row0, col0) block offsets for the causal row >= col
     test.  Returns (acc', m', l')."""
@@ -54,14 +61,14 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
         s = jnp.where(rows >= cols, s, NEG_INF)
     m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_blk)
-    # fully-masked block rows keep m at NEG_INF; exp(s - NEG_INF) would
-    # be exp(+big) — guard by clamping the shift
+    # fully-masked block rows keep m at NEG_INF; exp2(s - NEG_INF) would
+    # be exp2(+big) — guard by clamping the shift
     shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-    p = jnp.exp(s - shift)                          # [bq, bk]
+    p = jnp.exp2(s - shift)                         # [bq, bk]
     if masked:
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
     alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
-                      jnp.exp(m_prev - shift))      # rescale of old state
+                      jnp.exp2(m_prev - shift))     # rescale of old state
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
     acc_new = acc * alpha + jax.lax.dot_general(
         p.astype(mxu_dtype), vb, (((1,), (0,)), ((), ())),
@@ -71,11 +78,14 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
 
 def _finalize(acc, m, l, o_ref, lse_ref):
     """Write the normalized output and the lse statistics (shared by
-    both schedules so the denom/dead-row guards stay identical)."""
+    both schedules so the denom/dead-row guards stay identical).  `m` is
+    a log2-domain running max (see _softmax_fold); the emitted lse is in
+    NATURAL log units — the cross-shard merge contract."""
     denom = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / denom).astype(o_ref.dtype)
     dead = m <= NEG_INF / 2
-    lse = jnp.where(dead, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-38)))
+    lse = jnp.where(dead, NEG_INF,
+                    m * _LN2 + jnp.log(jnp.maximum(l, 1e-38)))
     lse_ref[0] = lse  # [bq, 1] — the trailing unit dim keeps the block
     # tile-aligned for Mosaic (second-minor bq % 8 == 0, minor == full)
 
@@ -234,7 +244,9 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
         return x.transpose(0, 2, 1, 3).reshape(B * H, t, D)
 
     qp, kp, vp = pack(q), pack(k), pack(v)
-    scale = 1.0 / float(D) ** 0.5
+    # log2(e) folds into the q prescale so the fold's exponentials are
+    # native exp2 with no per-score multiply (see _softmax_fold)
+    scale = _LOG2E / float(D) ** 0.5
     vma = _vma_of(q, k, v)
     mxu_dtype = jnp.dtype(mxu_dtype)
 

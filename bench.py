@@ -48,7 +48,6 @@ from __future__ import annotations
 
 import json
 import os
-import statistics
 import subprocess
 import sys
 import time
@@ -102,67 +101,12 @@ def _measure(platform: str) -> dict:
 
     interpret = not on_tpu
 
-    probe = jax.jit(lambda x: x.reshape(-1)[-1])
+    # shared chained-timing harness (in-jit fori_loop chains, sync RTT
+    # subtraction, best-of-interleaved-windows; see its module docstring
+    # for the full methodology rationale)
+    from accl_tpu.bench.timing import make_harness
 
-    # measure the sync round-trip alone so it can be subtracted
-    float(probe(a))  # compile the probe
-    syncs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(probe(a))
-        syncs.append(time.perf_counter() - t0)
-    sync_s = statistics.median(syncs)
-
-    from jax import lax
-
-    chain_cache: dict = {}
-
-    def timed_chain(fn, x0, iters, trials=5, consts=()):
-        """BEST (minimum) per-iteration seconds of an IN-JIT chained
-        loop: `fori_loop(0, iters, lambda _, v: fn(v, *consts), x0)`
-        compiled once — a single dispatch covers all iterations, so the
-        measured window is device time + one RTT (subtracted), not the
-        dispatch stream.  fn must be shape/dtype-preserving in its first
-        argument; fixed operands go in `consts` as traced ARGUMENTS (a
-        closure would bake them into the program as constants — the
-        remote compile tunnel rejects a 256 MB proto with HTTP 413).
-
-        Minimum, not median: the chip is shared behind a tunnel and
-        run-to-run contention swings measured bandwidth by >10x (observed
-        716 -> 10 GB/s for the same XLA add minutes apart).  The fastest
-        window estimates the hardware capability; a median would report
-        the neighbors' workload."""
-        key = (id(fn), iters)
-        chained = chain_cache.get(key)
-        if chained is None:
-            chained = jax.jit(lambda x, *cs: lax.fori_loop(
-                0, iters, lambda _, v: fn(v, *cs), x))
-            float(probe(chained(x0, *consts)))  # compile + warm
-            chain_cache[key] = chained
-        vals = []
-        for _ in range(trials):
-            t0 = time.perf_counter()
-            out = chained(x0, *consts)
-            float(probe(out))  # true completion barrier
-            elapsed = time.perf_counter() - t0
-            # RTT jitter can push elapsed below the pre-measured sync
-            # median; fall back to the unsubtracted time, never negative
-            net = elapsed - sync_s if elapsed > sync_s else elapsed
-            vals.append(net / iters)
-        return min(vals)
-
-    def timed_chain_ab(fns: dict, x0, iters, trials=5, consts=()) -> dict:
-        """Interleaved A/B timing: one trial of each fn per round, best
-        window per fn.  Quantities that will be RATIOED against each
-        other must share contention windows — measured minutes apart on
-        this shared chip, identical kernels differ by >25x."""
-        best = {k: None for k in fns}
-        for _ in range(trials):
-            for k, fn in fns.items():
-                dt = timed_chain(fn, x0, iters, trials=1, consts=consts)
-                if best[k] is None or dt < best[k]:
-                    best[k] = dt
-        return best
+    probe, timed_chain, timed_chain_ab, _sync_s = make_harness(jax, jnp)
 
     # autotune the VMEM tile depth: dispatch-bound at small blocks,
     # pipeline-starved at huge ones; pick the best of a short ladder

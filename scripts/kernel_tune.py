@@ -13,7 +13,6 @@ hardware capability).
 from __future__ import annotations
 
 import os
-import statistics
 import sys
 import time
 
@@ -21,34 +20,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 
 def _setup():
+    """The shared chained-timing harness (accl_tpu.bench.timing) — the
+    same methodology as bench.py by construction."""
     import jax
     import jax.numpy as jnp
 
+    from accl_tpu.bench.timing import make_harness
+
     print(f"[tune] backend={jax.default_backend()}", file=sys.stderr)
-
-    probe = jax.jit(lambda x: x[-1])
-    a = jnp.zeros((1024,), jnp.float32)
-    float(probe(a))
-    syncs = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(probe(a))
-        syncs.append(time.perf_counter() - t0)
-    sync_s = statistics.median(syncs)
-
-    def timed_chain(fn, x0, iters, trials=3):
-        vals = []
-        for _ in range(trials):
-            out = x0
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(out)
-            float(probe(out.reshape(-1)))
-            elapsed = time.perf_counter() - t0
-            net = elapsed - sync_s if elapsed > sync_s else elapsed
-            vals.append(net / iters)
-        return min(vals)
-
+    probe, timed_chain, _ab, _sync = make_harness(jax, jnp)
     return jax, jnp, probe, timed_chain
 
 
@@ -72,21 +52,22 @@ def tune_flash():
     results = {}
     fns = {}
     for kernel, bq, bk in combos:
-        def fa(x, kernel=kernel, bq=bq, bk=bk):
-            return flash_attention(x, k, v, causal=True, block_q=bq,
+        def fa(x, kk, vv, kernel=kernel, bq=bq, bk=bk):
+            return flash_attention(x, kk, vv, causal=True, block_q=bq,
                                    block_k=bk, kernel=kernel)
         try:
-            o = fa(q)
-            float(probe(o.reshape(-1)))
+            # viability probe at the TIMING iteration count so the
+            # compiled chain is the one the timing rounds reuse
+            timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
             fns[(kernel, bq, bk)] = fa
         except Exception as e:
             print(f"[tune] {kernel} bq={bq} bk={bk}: {type(e).__name__}: "
                   f"{str(e)[:120]}", file=sys.stderr)
 
-    # interleaved best-window: one short trial of each per round
-    for _ in range(4):
+    # interleaved best-window: one trial of each per round
+    for _ in range(6):
         for key, fa in fns.items():
-            dt = timed_chain(fa, q, iters=8, trials=1)
+            dt = timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
             if key not in results or dt < results[key]:
                 results[key] = dt
 
