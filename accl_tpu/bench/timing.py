@@ -49,7 +49,11 @@ def make_harness(jax, jnp):
         """BEST (minimum) per-iteration seconds of the in-jit chained
         loop `fori_loop(0, iters, lambda _, v: fn(v, *consts), x0)`.
         fn must be shape/dtype-preserving in its first argument."""
-        key = (id(fn), iters)
+        # key includes operand shapes/dtypes: the same fn re-timed on a
+        # different shape must pay its compile+warm OUTSIDE the timed
+        # trials (jax.jit would otherwise retrace inside the first one)
+        sig = tuple((v.shape, str(v.dtype)) for v in (x0, *consts))
+        key = (id(fn), iters, sig)
         chained = chain_cache.get(key)
         if chained is None:
             chained = jax.jit(lambda x, *cs: lax.fori_loop(
