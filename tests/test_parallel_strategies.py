@@ -251,9 +251,12 @@ def test_zigzag_indices_roundtrip():
         np.testing.assert_array_equal(shard[C:], np.arange(j * C, (j + 1) * C))
 
 
-@pytest.mark.parametrize("impl", ["dense", "flash"])
-def test_ring_attention_zigzag_matches_dense(impl):
-    # the load-balanced causal schedule must be EXACTLY the same math:
+@pytest.mark.parametrize("impl,P_sp", [("dense", 2), ("dense", 4),
+                                       ("dense", 8), ("flash", 2),
+                                       ("flash", 4), ("flash", 8)])
+def test_ring_attention_zigzag_matches_dense(impl, P_sp):
+    # the load-balanced causal schedule must be EXACTLY the same math
+    # at every ring size (the chunk-liveness algebra is P-dependent):
     # permute the global sequence into zigzag order, run the zigzag
     # ring, un-permute, compare to global dense causal attention
     import jax
@@ -262,7 +265,6 @@ def test_ring_attention_zigzag_matches_dense(impl):
     from accl_tpu.parallel.ring_attention import (zigzag_indices,
                                                   zigzag_indices_inverse)
 
-    P_sp = 4
     mesh = make_mesh(sp=P_sp)
     B, Tl, H, D = 2, 16, 2, 16
     T = P_sp * Tl

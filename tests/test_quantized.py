@@ -107,21 +107,27 @@ def test_quantized_ring_all_gather_matches_all_gather():
         assert err.max() <= (np.abs(xs).max() / 127) * 0.5 + 1e-6
 
 
-def test_quantized_all_reduce_matches_psum():
+@pytest.mark.parametrize("nranks", [2, 4, 8])
+def test_quantized_all_reduce_matches_psum(nranks):
     n = 256
-    mesh = _mesh()
-    xs = np.stack([_rand(NR * n, seed=20 + r) for r in range(NR)])
+    mesh = make_mesh(dp=nranks)
+    xs = np.stack([_rand(nranks * n, seed=20 + r) for r in range(nranks)])
 
     out = _shard_map(
         lambda x: quantized_all_reduce(x.reshape(-1), axis="dp")
-        .reshape(1, -1), mesh)(jnp.asarray(xs).reshape(NR, NR * n))
+        .reshape(1, -1), mesh)(jnp.asarray(xs).reshape(nranks, nranks * n))
     got = np.asarray(out)
     exact = xs.sum(axis=0)
-    for r in range(NR):
-        np.testing.assert_allclose(got[r], exact, atol=0.5)
+    for r in range(nranks):
+        # per-hop requantization error: P-1 hops, each within half a
+        # quantization step of a partial whose magnitude grows ~sqrt(P)
+        # (values ~N(0,1), block absmax <~ 5 sigma) — same bound as the
+        # reduce-scatter test
+        atol = nranks * (2 * 5 * np.sqrt(nranks) / 127)
+        np.testing.assert_allclose(got[r], exact, atol=atol)
         assert np.mean(np.abs(got[r] - exact)) < 0.05 * np.std(exact)
     # all members agree bit-exactly (same wire data relayed)
-    for r in range(1, NR):
+    for r in range(1, nranks):
         np.testing.assert_array_equal(got[r], got[0])
 
 
