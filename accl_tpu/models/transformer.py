@@ -37,10 +37,17 @@ class ModelConfig:
     #: "flash" (the Pallas tiled online-softmax kernel, ops/flash.py).
     #: flash requires the local sequence length to divide its blocks.
     attn: str = "dense"
+    #: causal SP ring schedule: "contiguous" (natural shards) or
+    #: "zigzag" (rank i holds chunk i + mirror 2P-1-i; exact per-hop
+    #: load balance — feed tokens permuted by
+    #: parallel.ring_attention.zigzag_indices)
+    sp_schedule: str = "contiguous"
 
     def __post_init__(self):
         if self.attn not in ("dense", "flash"):
             raise ValueError(f"unknown attn implementation {self.attn!r}")
+        if self.sp_schedule not in ("contiguous", "zigzag"):
+            raise ValueError(f"unknown sp schedule {self.sp_schedule!r}")
 
     @property
     def jdtype(self):
@@ -103,6 +110,12 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
     psum after attention-out and MLP-down), `sp_axis` marks sequence
     shards (ring attention).  Outside shard_map pass None for both.
     """
+    if cfg.sp_schedule == "zigzag" and sp_axis is None:
+        # the zigzag layout is only meaningful under sequence
+        # parallelism; without it the dense causal mask would silently
+        # treat the permuted sequence as natural order
+        raise ValueError("sp_schedule='zigzag' requires an sp axis "
+                         "(tokens are in zigzag order)")
     x = params["embed"][tokens].astype(cfg.jdtype)  # [B, Tl, D]
     for blk in params["blocks"]:
         h = _rmsnorm(x, blk["ln1"])
@@ -115,7 +128,8 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
                     "attn='flash' is the single-shard attention kernel; "
                     "with sequence parallelism the ring layer owns the "
                     "attention schedule — use attn='dense' when sp is on")
-            attn = ring_attention(q, k, v, axis=sp_axis, causal=True)
+            attn = ring_attention(q, k, v, axis=sp_axis, causal=True,
+                                  schedule=cfg.sp_schedule)
         elif cfg.attn == "flash":
             from ..ops.flash import flash_attention
             # MXU input format follows the model's activation dtype:
@@ -154,7 +168,27 @@ def loss_fn(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
     the device."""
     B, Tl = tokens.shape
     logits = forward(params, tokens, cfg, tp_axis, sp_axis).astype(jnp.float32)
-    if sp_axis is not None:
+    if sp_axis is not None and cfg.sp_schedule == "zigzag":
+        # zigzag layout: the local row is [chunk idx ; chunk 2P-1-idx].
+        # Each chunk's last label is its GLOBAL successor's first token:
+        #   lo chunk idx    -> chunk idx+1   = rank idx+1's lo-first,
+        #                      except idx==P-1 whose successor (chunk P)
+        #                      is its OWN hi chunk's first token;
+        #   hi chunk 2P-1-idx -> chunk 2P-idx = rank idx-1's hi-first,
+        #                      except idx==0 (the global end, masked).
+        Pn = lax.axis_size(sp_axis)
+        idx = lax.axis_index(sp_axis)
+        C = Tl // 2
+        lo, hi = tokens[:, :C], tokens[:, C:]
+        from_next_lo = lax.ppermute(  # rank i receives rank i+1's lo[0]
+            lo[:, :1], sp_axis, [(i, (i - 1) % Pn) for i in range(Pn)])
+        from_prev_hi = lax.ppermute(  # rank i receives rank i-1's hi[0]
+            hi[:, :1], sp_axis, [(i, (i + 1) % Pn) for i in range(Pn)])
+        lo_end = jnp.where(idx == Pn - 1, hi[:, :1], from_next_lo)
+        labels = jnp.concatenate(
+            [lo[:, 1:], lo_end, hi[:, 1:], from_prev_hi], axis=1)
+        valid = jnp.ones((B, Tl), bool).at[:, -1].set(idx != 0)
+    elif sp_axis is not None:
         Pn = lax.axis_size(sp_axis)
         idx = lax.axis_index(sp_axis)
         nxt_first = lax.ppermute(tokens[:, :1], sp_axis,
@@ -215,6 +249,10 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
     dp = dp if dp in axes else None
     tp = tp if tp in axes else None
     sp = sp if sp in axes else None
+    if cfg.sp_schedule == "zigzag" and sp is None:
+        raise ValueError("ModelConfig(sp_schedule='zigzag') needs an 'sp' "
+                         "axis in the mesh — zigzag-ordered tokens train "
+                         "on wrong labels without the zigzag ring")
 
     specs = param_specs(cfg, tp)
     tok_spec = P(dp, sp)

@@ -37,24 +37,36 @@ def _single_device_step(params, tokens, lr=1e-3):
     return new_params, loss_sum / count
 
 
-@pytest.mark.parametrize("axes", [
-    dict(dp=2), dict(tp=2), dict(sp=2), dict(dp=2, tp=2, sp=2),
+@pytest.mark.parametrize("axes,schedule", [
+    (dict(dp=2), "contiguous"), (dict(tp=2), "contiguous"),
+    (dict(sp=2), "contiguous"), (dict(dp=2, tp=2, sp=2), "contiguous"),
+    # zigzag is the SAME global computation on a permuted layout — the
+    # labels' cross-shard successor fetch included
+    (dict(sp=2), "zigzag"), (dict(sp=4), "zigzag"),
 ])
-def test_parallel_train_step_matches_single(axes):
+def test_parallel_train_step_matches_single(axes, schedule):
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    from accl_tpu.parallel.ring_attention import zigzag_indices
+
     B, T = 4, 16
     mesh = make_mesh(**axes)
+    cfg = dataclasses.replace(CFG, sp_schedule=schedule)
     rng = np.random.default_rng(1)
     params = init_params(rng, CFG)
     tokens = _tokens(B, T, seed=2)
 
-    # reference: one dense step on one device
+    # reference: one dense step on one device (natural token order)
     ref_params, ref_loss = jax.jit(_single_device_step)(
         params, jnp.asarray(tokens))
 
-    step, (specs, tok_spec) = make_train_step(mesh, CFG)
+    step, (specs, tok_spec) = make_train_step(mesh, cfg)
     p_sharded = shard_params(params, mesh, CFG)
-    from jax.sharding import NamedSharding
-
+    if schedule == "zigzag":
+        perm = np.asarray(zigzag_indices(T, axes["sp"]))
+        tokens = tokens[:, perm]
     tok_dev = jax.device_put(jnp.asarray(tokens),
                              NamedSharding(mesh, tok_spec))
     new_params, loss = step(p_sharded, tok_dev)
@@ -66,6 +78,14 @@ def test_parallel_train_step_matches_single(axes):
     for got, exp in zip(flat_new, flat_ref):
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    rtol=5e-4, atol=5e-5)
+
+
+def test_zigzag_requires_sp_axis():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="zigzag"):
+        make_train_step(make_mesh(dp=2),
+                        dataclasses.replace(CFG, sp_schedule="zigzag"))
 
 
 def test_forward_shapes():
