@@ -16,12 +16,12 @@ import functools
 import jax
 import jax.numpy as jnp
 
-# On-chip sweep (scripts/kernel_tune.py compress, 16 Mi f32 roundtrip,
-# interleaved-window methodology): 512-lane rows with 256-row blocks beat
-# both the old (1024, 128) shape (~2x) and the plain XLA convert pair in
-# shared contention windows; 512 KB input blocks keep the DMA pipeline
-# full without starving double-buffering.
-_BLOCK_ROWS = 256
+# On-chip sweep (scripts/kernel_tune.py compress, 64 Mi f32 roundtrip,
+# in-jit chained interleaved-window methodology): 512-lane rows dominate
+# every other width by >2x, and 1024-row (2 MB) blocks edge out 256-row
+# in shared windows, landing at/above the barriered XLA convert-pair
+# ceiling measured in the same run.
+_BLOCK_ROWS = 1024
 _LANES = 512
 
 

@@ -32,7 +32,7 @@ def _setup():
 
 
 def tune_flash():
-    jax, jnp, probe, timed_chain = _setup()
+    jax, jnp, _probe, timed_chain = _setup()
     from accl_tpu.ops.flash import flash_attention
 
     B, T, H, D = 4, 2048, 8, 64
@@ -77,14 +77,19 @@ def tune_flash():
 
 
 def tune_compress():
-    jax, jnp, probe, timed_chain = _setup()
+    jax, jnp, _probe, timed_chain = _setup()
     import functools
 
+    from jax import lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n = 16 << 20
-    x = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
+    # 256 MB: larger than on-chip scratch (a smaller chained loop gets
+    # pinned in S(1) memory and measures on-chip, not HBM, bandwidth).
+    # 2D carry so chained iterations don't pay relayout copies.
+    n = 64 << 20
+    x = jax.random.normal(jax.random.PRNGKey(3), (n // 512, 512),
+                          jnp.float32)
 
     @functools.partial(jax.jit, static_argnames=("dtype", "cols",
                                                  "block_rows"))
@@ -103,7 +108,7 @@ def tune_compress():
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel",)),
         )(v2)
-        return out.reshape(-1)
+        return out.reshape(v.shape)
 
     nbytes = n * 12  # 4+2 down, 2+4 up
 
@@ -118,22 +123,23 @@ def tune_compress():
                 return cast2d(cast2d(v, jnp.bfloat16, cols, br),
                               jnp.float32, cols, br)
             try:
-                y = rt(x)
-                float(probe(y))
+                timed_chain(rt, x, iters=24, trials=1)  # compile + warm
                 fns[(cols, br)] = rt
             except Exception as e:
                 print(f"[tune] cols={cols} br={br}: {type(e).__name__}: "
                       f"{str(e)[:120]}", file=sys.stderr)
 
-    # XLA ceiling, interleaved with the rest
-    xla_down = jax.jit(lambda v: v.astype(jnp.bfloat16))
-    xla_up = jax.jit(lambda v: v.astype(jnp.float32))
-    fns[("xla", 0)] = lambda v: xla_up(xla_down(v))
-    float(probe(fns[("xla", 0)](x)))
+    # XLA ceiling, interleaved with the rest (both casts barriered so
+    # the simplifier can't fold convert(convert(x)) across iterations)
+    def xla_rt(v):
+        h = lax.optimization_barrier(v.astype(jnp.bfloat16))
+        return lax.optimization_barrier(h.astype(jnp.float32))
 
-    for _ in range(4):
+    fns[("xla", 0)] = xla_rt
+
+    for _ in range(6):
         for key, fn in fns.items():
-            dt = timed_chain(fn, x, iters=6, trials=1)
+            dt = timed_chain(fn, x, iters=24, trials=1)
             if key not in results or dt < results[key]:
                 results[key] = dt
 
