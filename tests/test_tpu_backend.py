@@ -360,11 +360,15 @@ def test_driver_allreduce_close_to_raw_psum():
         # a reintroduced per-call host round-trip or retrace blows this
         # to 50-100x, which is the regression this guards
         bound = 2.0 if on_tpu else 10.0
+        # best ratio across attempts: the guard targets a STRUCTURAL
+        # regression (50-100x, fails every attempt); a starved thread on
+        # a loaded 1-core CI box spoils single attempts ~30% of the time
         ratio = None
-        for _attempt in range(2):  # one re-measure absorbs load spikes
+        for _attempt in range(3):
             raw_dt = measure_raw()
             drv_dt = max(w.run(fn))
-            ratio = drv_dt / max(raw_dt, 1e-9)
+            r = drv_dt / max(raw_dt, 1e-9)
+            ratio = r if ratio is None else min(ratio, r)
             if ratio < bound:
                 break
     assert ratio < bound, f"driver allreduce {drv_dt:.4f}s vs raw psum " \
