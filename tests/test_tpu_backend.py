@@ -363,13 +363,15 @@ def test_driver_allreduce_close_to_raw_psum():
         # best ratio across attempts: the guard targets a STRUCTURAL
         # regression (50-100x, fails every attempt); a starved thread on
         # a loaded 1-core CI box spoils single attempts ~30% of the time
-        ratio = None
+        ratio, best_pair = None, (0.0, 0.0)
         for _attempt in range(3):
             raw_dt = measure_raw()
             drv_dt = max(w.run(fn))
             r = drv_dt / max(raw_dt, 1e-9)
-            ratio = r if ratio is None else min(ratio, r)
+            if ratio is None or r < ratio:
+                ratio, best_pair = r, (drv_dt, raw_dt)
             if ratio < bound:
                 break
-    assert ratio < bound, f"driver allreduce {drv_dt:.4f}s vs raw psum " \
-                          f"{raw_dt:.4f}s (ratio {ratio:.1f}x, bound {bound}x)"
+    assert ratio < bound, \
+        f"driver allreduce {best_pair[0]:.4f}s vs raw psum " \
+        f"{best_pair[1]:.4f}s (best ratio {ratio:.1f}x, bound {bound}x)"

@@ -1,0 +1,73 @@
+"""Aux-subsystem utilities (SURVEY §5): timer, profiling hooks,
+topology/capability probe (the hwid parse analog), debug logging."""
+import numpy as np
+
+
+def test_timer_shape():
+    import time
+
+    from accl_tpu.utils.timing import Timer
+
+    t = Timer()
+    t.start()
+    time.sleep(0.01)
+    t.end()
+    us = t.durationUs()
+    assert 5_000 <= us <= 5_000_000
+    assert abs(t.duration_ns() - us * 1000) < 1e3
+    with Timer() as t2:
+        time.sleep(0.002)
+    assert t2.durationUs() >= 1_000
+
+
+def test_profiling_timed_and_time_fn():
+    import jax.numpy as jnp
+
+    from accl_tpu.utils.profiling import time_fn, timed
+
+    results = {}
+    with timed("block", results):
+        sum(range(1000))
+    assert len(results["block"]) == 1 and results["block"][0] > 0
+
+    import jax
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    dt = time_fn(f, jnp.ones(128), iters=3, warmup=1)
+    assert dt > 0
+
+
+def test_topology_probe_and_hwid():
+    from accl_tpu.utils.topology import dump, probe
+
+    cap = probe()
+    assert cap.num_devices == 8  # conftest's virtual CPU mesh
+    word = cap.hwid()
+    # bit layout: platform (cpu=0), arith bit 4, compression bit 5,
+    # remote-dma bit 6, device count at bits 8+
+    assert word & 0xF == 0
+    assert (word >> 4) & 1 == 1
+    assert (word >> 5) & 1 == 1
+    assert (word >> 8) & 0xFFFF == 8
+    text = dump()
+    assert "platform=cpu" in text and "n=8" in text
+
+
+def test_debug_logging_env(capsys, monkeypatch):
+    import importlib
+    import logging as stdlog
+
+    from accl_tpu.utils import logging as alog
+
+    monkeypatch.setenv("ACCL_DEBUG", "1")
+    # reset the module's one-shot configuration so the env is honored
+    importlib.reload(alog)
+    stdlog.getLogger("accl_tpu").handlers.clear()
+    log = alog.get_logger(rank=3)
+    log.debug("hello-debug")
+    err = capsys.readouterr().err
+    assert "hello-debug" in err and "rank3" in err
+    # restore: unconfigured module state for later tests
+    monkeypatch.delenv("ACCL_DEBUG")
+    stdlog.getLogger("accl_tpu").handlers.clear()
+    importlib.reload(alog)
