@@ -53,7 +53,11 @@ def make_harness(jax, jnp):
         # different shape must pay its compile+warm OUTSIDE the timed
         # trials (jax.jit would otherwise retrace inside the first one)
         sig = tuple((v.shape, str(v.dtype)) for v in (x0, *consts))
-        key = (id(fn), iters, sig)
+        # key on the fn OBJECT (functions/partials are hashable): keying
+        # on id(fn) would only be correct while the cached closure keeps
+        # fn alive, a lifetime coupling one refactor away from returning
+        # a stale compiled chain for a recycled id
+        key = (fn, iters, sig)
         chained = chain_cache.get(key)
         if chained is None:
             chained = jax.jit(lambda x, *cs: lax.fori_loop(

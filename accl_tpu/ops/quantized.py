@@ -59,6 +59,11 @@ def _ring_reduce_scatter_q(x, axis: str, block: int):
     round at the seam."""
     size = lax.axis_size(axis)
     idx = lax.axis_index(axis)
+    if x.shape[0] % size != 0:
+        raise ValueError(
+            f"quantized ring reduce-scatter needs x.shape[0] ({x.shape[0]}) "
+            f"divisible by the '{axis}' axis size ({size}); pad the input "
+            "(sync_gradients pads via _pad_to_multiple)")
     n = x.shape[0] // size
     chunks = x.astype(jnp.float32).reshape(size, n)
 
