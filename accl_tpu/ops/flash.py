@@ -92,10 +92,13 @@ def _finalize(acc, m, l, o_ref, lse_ref):
 
 def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                        *, scale: float, causal: bool, block_q: int,
-                       block_k: int, nk: int, mxu_dtype):
+                       block_k: int, chunk_k: int, nk: int, mxu_dtype):
     """Streaming schedule: grid (bh, q_block, k_block); K/V blocks
     arrive per grid cell; the accumulator lives in VMEM scratch across
-    the sequential k steps of one (bh, q_block) cell."""
+    the sequential k steps of one (bh, q_block) cell.  Each arriving
+    block is folded as an unrolled run of chunk_k sub-folds so the MXU
+    stays busy while the VPU runs the previous chunk's softmax (same
+    pipelining rationale as the resident kernel)."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -118,14 +121,16 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
     q = (q_ref[0] * scale).astype(mxu_dtype)  # pre-scale once per block
 
     def body(masked: bool):
-        mask = (iq * block_q, ik * block_k) if masked else None
-        acc_new, m_new, l_new = _softmax_fold(
-            q, k_ref[0].astype(mxu_dtype),
-            v_ref[0].astype(mxu_dtype), acc[:], m_s[:], l_s[:],
-            mask=mask, mxu_dtype=mxu_dtype)
-        acc[:] = acc_new
-        m_s[:] = m_new
-        l_s[:] = l_new
+        carry = (acc[:], m_s[:], l_s[:])
+        for c in range(block_k // chunk_k):
+            a, m_prev, l_prev = carry
+            off = ik * block_k + c * chunk_k
+            kb = k_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
+            vb = v_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
+            mask = (iq * block_q, off) if masked else None
+            carry = _softmax_fold(q, kb, vb, a, m_prev, l_prev,
+                                  mask=mask, mxu_dtype=mxu_dtype)
+        acc[:], m_s[:], l_s[:] = carry
 
     if causal:
         @pl.when(diag)
@@ -143,14 +148,22 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         _finalize(acc[:], m_s[:], l_s[:], o_ref, lse_ref)
 
 
-def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
                            scale: float, causal: bool, block_q: int,
-                           block_k: int, T: int, mxu_dtype):
+                           block_k: int, chunk_k: int, T: int, mxu_dtype):
     """K/V-resident schedule: the whole K/V row for this batch-head sits
     in VMEM (fetched ONCE — the grid variant refetches it per q-block,
-    which is the streaming bound at small-to-medium T).  The k loop runs
-    inside the kernel over dynamic slices, split into an unmasked bulk
-    over fully-past blocks and a masked epilogue over the diagonal."""
+    which is the streaming bound at small-to-medium T).
+
+    Two throughput tricks beyond the plain fold:
+    - when the input dtype differs from the MXU dtype, K/V are cast ONCE
+      per batch-head into VMEM scratch at the first q-block (the naive
+      per-fold cast re-converts the same rows nq times — measured as a
+      double-digit share of kernel time at D=128);
+    - each block_k fold is an UNROLLED run of chunk_k sub-folds, so
+      Mosaic can issue chunk c+1's independent QK^T matmul while the VPU
+      works on chunk c's softmax — without this the MXU idles during
+      every max/exp2/sum pass and the kernel tops out near 50% MXU."""
     from jax import lax as jlax
     from jax.experimental import pallas as pl
 
@@ -158,14 +171,37 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     q = (q_ref[0] * scale).astype(mxu_dtype)        # [bq, D], pre-scaled
     D = q.shape[-1]
     nk_total = T // block_k
+    n_chunks = block_k // chunk_k
+
+    if scratch:
+        kb_s, vb_s = scratch
+        # grid order within one batch-head is sequential (the iq
+        # dimension is marked "arbitrary"), so the cast done at the
+        # first q-block is visible to the rest
+        @pl.when(iq == 0)
+        def _cast_kv():
+            kb_s[:] = k_ref[0].astype(mxu_dtype)
+            vb_s[:] = v_ref[0].astype(mxu_dtype)
+
+        def kv_chunk(off):
+            return (kb_s[pl.ds(off, chunk_k), :],
+                    vb_s[pl.ds(off, chunk_k), :])
+    else:  # input already in MXU dtype — read the block refs directly
+        def kv_chunk(off):
+            return (k_ref[0, pl.ds(off, chunk_k), :],
+                    v_ref[0, pl.ds(off, chunk_k), :])
 
     def step(j, carry, masked):
-        acc, m_prev, l_prev = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
-        mask = (iq * block_q, j * block_k) if masked else None
-        return _softmax_fold(q, kb, vb, acc, m_prev, l_prev,
-                             mask=mask, mxu_dtype=mxu_dtype)
+        # unrolled chunk run — `for c in range(...)` is static, letting
+        # the compiler software-pipeline MXU against VPU across chunks
+        for c in range(n_chunks):
+            acc, m_prev, l_prev = carry
+            off = j * block_k + c * chunk_k
+            kb, vb = kv_chunk(off)
+            mask = (iq * block_q, off) if masked else None
+            carry = _softmax_fold(q, kb, vb, acc, m_prev, l_prev,
+                                  mask=mask, mxu_dtype=mxu_dtype)
+        return carry
 
     carry = (jnp.zeros((block_q, D), jnp.float32),
              jnp.full((block_q, 1), NEG_INF, jnp.float32),
@@ -210,19 +246,21 @@ def _sds(shape, dtype, vma):
 _RESIDENT_KV_BYTES = 6 << 20
 
 
-def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
-                kernel):
-    """Shared setup for both public wrappers: block shrink, packing,
-    schedule selection, pallas_call.  Returns (out [B,T,H,D],
-    lse [B,H,T] f32)."""
+def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
+                       mxu_dtype, kernel, chunk_k=None):
+    """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
+    flattened — the splash-attention layout).  This is the zero-copy
+    path: no transposes touch HBM; callers that keep activations packed
+    (the model families do) pay only the kernel itself.
+    Returns (out [N, T, D], lse [N, T] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    B, T, H, D = q.shape
-    Tk = k.shape[1]
-    if k.shape != v.shape or k.shape[0] != B or k.shape[2:] != (H, D):
-        raise ValueError(f"k/v shape {k.shape}/{v.shape} incompatible "
-                         f"with q {q.shape}")
+    N, T, D = qp.shape
+    Tk = kp.shape[1]
+    if kp.shape != vp.shape or kp.shape[0] != N or kp.shape[2] != D:
+        raise ValueError(f"k/v shape {kp.shape}/{vp.shape} incompatible "
+                         f"with q {qp.shape}")
     if causal and Tk != T:
         raise ValueError("causal masking requires Tq == Tk "
                          "(cross-length attention has no diagonal)")
@@ -238,19 +276,25 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
         raise ValueError(
             f"sequence lengths {T}/{Tk} not divisible by blocks ({bq}, {bk})")
     nq, nk = T // bq, Tk // bk
+    # sub-fold chunk (None = whole block): smaller chunks give the
+    # compiler MXU/VPU pipelining slack at the price of smaller matmuls.
+    # Snap to the largest divisor of bk at or below the request, never
+    # under the 8-row tile floor (halving alone can decay 12->3->1)
+    if chunk_k is None:
+        ck = bk
+    else:
+        ck = next((d for d in range(min(chunk_k, bk), 7, -1)
+                   if bk % d == 0), bk)
 
-    def pack(x):  # [B, t, H, D] -> [B*H, t, D]
-        t = x.shape[1]
-        return x.transpose(0, 2, 1, 3).reshape(B * H, t, D)
-
-    qp, kp, vp = pack(q), pack(k), pack(v)
     # log2(e) folds into the q prescale so the fold's exponentials are
     # native exp2 with no per-score multiply (see _softmax_fold)
     scale = _LOG2E / float(D) ** 0.5
-    vma = _vma_of(q, k, v)
+    vma = _vma_of(qp, kp, vp)
     mxu_dtype = jnp.dtype(mxu_dtype)
+    needs_cast = qp.dtype != mxu_dtype
 
-    kv_bytes = 2 * Tk * D * q.dtype.itemsize
+    kv_bytes = 2 * Tk * D * (qp.dtype.itemsize
+                             + (mxu_dtype.itemsize if needs_cast else 0))
     if kernel == "auto":
         kernel = ("resident" if kv_bytes <= _RESIDENT_KV_BYTES else "grid")
     if kernel not in ("resident", "grid"):
@@ -258,11 +302,11 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
 
     q_spec3 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)
-    out_shapes = (_sds((B * H, T, D), q.dtype, vma),
-                  _sds((B * H, T, 1), jnp.float32, vma))
+    out_shapes = (_sds((N, T, D), qp.dtype, vma),
+                  _sds((N, T, 1), jnp.float32, vma))
 
     if kernel == "resident":
-        grid = (B * H, nq)
+        grid = (N, nq)
         q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
                               memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0),
@@ -271,28 +315,36 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
                               memory_space=pltpu.VMEM)
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0),
                                 memory_space=pltpu.VMEM)
+        # one-time K/V cast scratch (see kernel docstring) — only when
+        # the input is not already in MXU format
+        scratch = ([pltpu.VMEM((Tk, D), mxu_dtype),
+                    pltpu.VMEM((Tk, D), mxu_dtype)] if needs_cast else [])
         kfn = functools.partial(
             _flash_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, T=Tk, mxu_dtype=mxu_dtype)
+            block_k=bk, chunk_k=ck, T=Tk, mxu_dtype=mxu_dtype)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=(o_spec, lse_spec),
-            # every (bh, q-block) cell is independent: parallel semantics
-            # let Mosaic overlap the next cell's q/o DMA with compute
+            scratch_shapes=scratch,
+            # with cast scratch the q-blocks of one batch-head must run
+            # in-order ("arbitrary") so the iq==0 cast is visible to the
+            # rest; without it every cell is independent ("parallel")
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel")),
+                dimension_semantics=(
+                    ("parallel", "arbitrary") if needs_cast
+                    else ("parallel", "parallel"))),
             interpret=interpret,
         )(qp, kp, vp)
     else:
-        grid = (B * H, nq, nk)
+        grid = (N, nq, nk)
         kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
                                memory_space=pltpu.VMEM)
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)
         kfn = functools.partial(
             _flash_kernel_grid, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, nk=nk, mxu_dtype=mxu_dtype)
+            block_k=bk, chunk_k=ck, nk=nk, mxu_dtype=mxu_dtype)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec3, kv_spec, kv_spec],
@@ -309,6 +361,24 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
             interpret=interpret,
         )(qp, kp, vp)
 
+    return out, lse.reshape(N, T)
+
+
+def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
+                kernel):
+    """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the core
+    call (two HBM transposes per operand direction — callers on the hot
+    path should use the packed entry points).  Returns (out [B,T,H,D],
+    lse [B,H,T] f32)."""
+    B, T, H, D = q.shape
+
+    def pack(x):  # [B, t, H, D] -> [B*H, t, D]
+        t = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, t, D)
+
+    out, lse = _flash_call_packed(pack(q), pack(k), pack(v), causal,
+                                  block_q, block_k, interpret, mxu_dtype,
+                                  kernel)
     return (out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
             lse.reshape(B, H, T))
 
@@ -346,3 +416,39 @@ def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
     cross-shard fold ring attention applies around the ICI ring."""
     return _flash_call(q, k, v, causal, block_q, block_k, interpret,
                        mxu_dtype, kernel)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "mxu_dtype", "kernel",
+                                    "chunk_k"))
+def flash_attention_packed(q, k, v, causal: bool = False,
+                           block_q: int = 256, block_k: int = 512,
+                           interpret: bool = False,
+                           mxu_dtype=jnp.bfloat16, kernel: str = "auto",
+                           chunk_k: int | None = None):
+    """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
+    with N = batch x heads flattened (the splash-attention layout).
+    Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
+    kernel — callers that keep activations packed (the transformer
+    family does between its projections) get the kernel at full rate.
+    Returns out [N, T, D]."""
+    out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
+                                   interpret, mxu_dtype, kernel, chunk_k)
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "mxu_dtype", "kernel",
+                                    "chunk_k"))
+def flash_attention_packed_lse(q, k, v, causal: bool = False,
+                               block_q: int = 256, block_k: int = 512,
+                               interpret: bool = False,
+                               mxu_dtype=jnp.bfloat16, kernel: str = "auto",
+                               chunk_k: int | None = None):
+    """Head-packed [N, T, D] variant returning (out [N, T, D],
+    lse [N, T] fp32) — the distributed callers' entry (ring attention
+    folds shard partials via the lse)."""
+    return _flash_call_packed(q, k, v, causal, block_q, block_k,
+                              interpret, mxu_dtype, kernel, chunk_k)

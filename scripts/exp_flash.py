@@ -1,0 +1,125 @@
+"""Flash-attention tuning experiments (run on the real TPU chip).
+
+Decomposes the gap between flash_d128_mxu_frac and the matmul roofline:
+times the current kernel, a packed (no-transpose) entry, bf16 operands,
+and jax's bundled splash kernel as an achievability calibration.
+
+Usage: python scripts/exp_flash.py [variant ...]
+Variants: base d64 packed bf16 splash mm
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    which = set(sys.argv[1:]) or {"base", "packed", "bf16", "splash", "mm"}
+    from accl_tpu.bench.timing import make_harness
+    _probe, timed_chain, _ab, sync_s = make_harness(jax, jnp)
+    print(f"sync_s={sync_s*1e3:.2f}ms backend={jax.default_backend()}",
+          file=sys.stderr)
+
+    B, T, H, D = 4, 2048, 4, 128
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(k1, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(k2, (B, T, H, D), jnp.float32)
+    v = jax.random.normal(k3, (B, T, H, D), jnp.float32)
+    flops = 4 * B * H * T * T * D / 2  # causal
+
+    results = {}
+
+    def run(name, fn, x, consts, iters=64, rounds=6, fl=flops):
+        best = None
+        for _ in range(rounds):
+            dt = timed_chain(fn, x, iters=iters, trials=1, consts=consts)
+            best = dt if best is None else min(best, dt)
+        tf = fl / best / 1e12
+        results[name] = tf
+        print(f"{name:24s} {best*1e6:9.1f} us  {tf:7.2f} TFLOPs", flush=True)
+
+    if "mm" in which:
+        mm_n = 4096
+        ka, kb = jax.random.split(jax.random.PRNGKey(7))
+        ma = jax.random.normal(ka, (mm_n, mm_n), jnp.bfloat16)
+        mb = jax.random.normal(kb, (mm_n, mm_n), jnp.bfloat16)
+        run("matmul_bf16", lambda x, y: (x @ y).astype(jnp.bfloat16),
+            ma, (mb,), iters=48, fl=2 * mm_n**3)
+
+    if "base" in which:
+        from accl_tpu.ops.flash import flash_attention
+        run("base_resident", lambda x, kk, vv: flash_attention(
+            x, kk, vv, causal=True), q, (k, v))
+        run("base_grid", lambda x, kk, vv: flash_attention(
+            x, kk, vv, causal=True, kernel="grid"), q, (k, v))
+
+    if "d64" in which:
+        from accl_tpu.ops.flash import flash_attention
+        H2, D2 = 8, 64
+        q4 = jax.random.normal(k1, (B, T, H2, D2), jnp.float32)
+        k4 = jax.random.normal(k2, (B, T, H2, D2), jnp.float32)
+        v4 = jax.random.normal(k3, (B, T, H2, D2), jnp.float32)
+        run("base_d64", lambda x, kk, vv: flash_attention(
+            x, kk, vv, causal=True), q4, (k4, v4))
+
+    if "packed" in which:
+        # operands already in [B*H, T, D] — isolates the pack/unpack
+        # transpose cost from the kernel itself
+        from accl_tpu.ops.flash import flash_attention_packed as fap
+        qp = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        kp = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        vp = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        run("packed_f32_scratch", lambda x, kk, vv: fap(x, kk, vv, causal=True),
+            qp, (kp, vp))
+        if "bf16" in which:
+            qb, kb, vb = (qp.astype(jnp.bfloat16), kp.astype(jnp.bfloat16),
+                          vp.astype(jnp.bfloat16))
+            for ck in (None, 256, 128):
+                run(f"packed_bf16_ck{ck}",
+                    lambda x, kk, vv, c=ck: fap(x, kk, vv, causal=True,
+                                                chunk_k=c),
+                    qb, (kb, vb))
+
+    if "splash" in which:
+        # calibration: jax's bundled splash kernel, [H, T, D] layout,
+        # vmapped over batch
+        try:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (
+                splash_attention_kernel as sk,
+                splash_attention_mask as sm)
+            mask = sm.MultiHeadMask(
+                [sm.CausalMask((T, T)) for _ in range(H)])
+            kernel = sk.make_splash_mha(
+                mask, head_shards=1, q_seq_shards=1)
+            qs = q.transpose(0, 2, 1, 3)  # [B, H, T, D]
+            ks = k.transpose(0, 2, 1, 3)
+            vs = v.transpose(0, 2, 1, 3)
+            vk = jax.jit(jax.vmap(kernel))
+
+            def splash_fn(x, kk, vv):
+                return vk(x, kk, vv)
+
+            run("splash_bhtd", splash_fn, qs, (ks, vs))
+            run("splash_bf16", splash_fn, qs.astype(jnp.bfloat16),
+                (ks.astype(jnp.bfloat16), vs.astype(jnp.bfloat16)))
+        except Exception as e:  # noqa: BLE001
+            print(f"splash failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    if "mm" in which and "base" in which:
+        mmtf = results.get("matmul_bf16")
+        if mmtf:
+            for n, tf in results.items():
+                if n != "matmul_bf16":
+                    print(f"frac {n:24s} {tf/mmtf:.3f}")
+
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    main()
+    print(f"total {time.perf_counter()-t0:.0f}s", file=sys.stderr)

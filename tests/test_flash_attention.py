@@ -42,6 +42,104 @@ def test_flash_uneven_blocks():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("kernel", ["resident", "grid"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_packed_matches_bthd(causal, kernel):
+    # the head-packed [N, T, D] entry is the same kernel minus the
+    # layout transposes — identical numerics, including the one-shot
+    # K/V cast scratch the resident schedule uses for non-MXU dtypes
+    from accl_tpu.ops.flash import (flash_attention_lse,
+                                    flash_attention_packed_lse)
+    B, T, H, D = 2, 256, 2, 64
+    q, k, v = _qkv(B, T, H, D, seed=3)
+    pack = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    got, lse_p = flash_attention_packed_lse(
+        pack(q), pack(k), pack(v), causal=causal, block_q=64, block_k=64,
+        mxu_dtype=jnp.float32, kernel=kernel, interpret=True)
+    ref, lse = flash_attention_lse(
+        q, k, v, causal=causal, block_q=64, block_k=64,
+        mxu_dtype=jnp.float32, kernel=kernel, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(got).reshape(B, H, T, D).transpose(0, 2, 1, 3),
+        np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(lse_p).reshape(B, H, T),
+                                  np.asarray(lse))
+
+
+@pytest.mark.parametrize("kernel", ["resident", "grid"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_chunked_subfolds_match(causal, kernel):
+    # chunk_k < block_k runs each block as an unrolled run of sub-folds
+    # (the MXU/VPU pipelining path) — identical math to the unchunked
+    # fold, including causal mask offsets inside a straddling block
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(13)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=128,
+              mxu_dtype=jnp.float32, kernel=kernel, interpret=True)
+    got, lse_c = flash_attention_packed_lse(q, k, v, chunk_k=32, **kw)
+    ref, lse = flash_attention_packed_lse(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lse_c), np.asarray(lse),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_flash_chunk_snaps_to_divisor():
+    # chunk snapping: 12 does not divide 64 -> largest divisor <= 12 and
+    # >= 8 rows; must not decay below the tile floor (12->3->1 bug)
+    from accl_tpu.ops.flash import flash_attention_packed
+    N, T, D = 1, 64, 32
+    rng = np.random.default_rng(14)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention_packed(q, k, v, block_q=64, block_k=64,
+                                 chunk_k=12, mxu_dtype=jnp.float32,
+                                 kernel="resident", interpret=True)
+    ref = flash_attention_packed(q, k, v, block_q=64, block_k=64,
+                                 mxu_dtype=jnp.float32,
+                                 kernel="resident", interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_resident_cast_scratch(causal):
+    # exercises the resident kernel's needs_cast path: input dtype
+    # (bf16) differs from mxu_dtype (f32), so K/V are cast ONCE into
+    # VMEM scratch at iq==0 and all q-blocks read the scratch (grid
+    # order made sequential via "arbitrary" semantics).  Must match the
+    # same math applied per-fold without scratch (the grid kernel).
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(9)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)),
+                             jnp.float32).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    got, lse_r = flash_attention_packed_lse(
+        q, k, v, causal=causal, block_q=64, block_k=128,
+        mxu_dtype=jnp.float32, kernel="resident", interpret=True)
+    ref, lse_g = flash_attention_packed_lse(
+        q, k, v, causal=causal, block_q=64, block_k=128,
+        mxu_dtype=jnp.float32, kernel="grid", interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(lse_r), np.asarray(lse_g),
+                               rtol=1e-5, atol=1e-5)
+    # and against the dense reference on the bf16-rounded operands
+    from accl_tpu.parallel.ring_attention import _dense_attention
+    dense = _dense_attention(
+        q.astype(jnp.float32)[:, :, None, :],
+        k.astype(jnp.float32)[:, :, None, :],
+        v.astype(jnp.float32)[:, :, None, :], causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32),
+        np.asarray(dense)[:, :, 0, :], rtol=3e-2, atol=3e-2)
+
+
 def test_flash_rejects_ragged():
     q, k, v = _qkv(1, 100, 1, 32)
     with pytest.raises(ValueError):
