@@ -1,0 +1,114 @@
+"""Driver call-rate / small-message latency benchmark.
+
+Measures how many collective CALLS per second the TPU-backend driver
+path sustains (descriptor -> gang scheduler -> compiled SPMD
+collective -> scatter-back) against the raw-shard_map ceiling on the
+same mesh — the host-side dispatch overhead the reference pays through
+its hostctrl MMIO fast path (driver/xrt/src/fpgadevice.cpp:46-180;
+per-call work is the FPGAQueue + 8-10 register writes).
+
+Raw ceiling: a jitted shard_map psum on an identical global array,
+called in the same loop — everything above that rate is driver
+overhead (gang assembly, buffer resolution, scatter-back).
+
+Usage: python -m accl_tpu.bench.callrate [--ranks N] [--count N]
+       [--iters N] [--json out.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run(nranks: int = 4, count: int = 1024, iters: int = 300,
+        platform: str = "cpu") -> dict:
+    import numpy as np
+
+    import jax
+
+    if platform:
+        # runtime config update, NOT the env var: site hooks may have
+        # pinned a hardware platform at interpreter start and the claim
+        # can hang when the chip is busy (same discipline as bench.py
+        # workers / tests/conftest.py)
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.backends.tpu import TpuWorld
+
+    out: dict = {"nranks": nranks, "count": count, "iters": iters}
+
+    with TpuWorld(nranks) as w:
+        def worker(accl, rank):
+            rng = np.random.default_rng(rank)
+            s = accl.create_buffer_like(
+                rng.standard_normal(count).astype(np.float32))
+            r = accl.create_buffer(count, np.float32)
+            # warm the compile cache + gang path
+            for _ in range(3):
+                accl.allreduce(s, r, count, ReduceFunction.SUM)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                accl.allreduce(s, r, count, ReduceFunction.SUM)
+            dt_staged = time.perf_counter() - t0
+            # device-resident path (reference zero-copy call path,
+            # accl.cpp:796-839 with FPGA-resident buffers): no host
+            # staging per call — the training-loop call rate
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                accl.allreduce(s, r, count, ReduceFunction.SUM,
+                               from_fpga=True, to_fpga=True)
+            dt_res = time.perf_counter() - t0
+            return dt_staged, dt_res
+
+        dts = w.run(worker)
+        # ranks run concurrently; wall time is the slowest member
+        wall = max(d[0] for d in dts)
+        wall_res = max(d[1] for d in dts)
+        out["driver_calls_per_s"] = round(iters / wall, 1)
+        out["driver_latency_us"] = round(wall / iters * 1e6, 1)
+        out["driver_resident_calls_per_s"] = round(iters / wall_res, 1)
+        out["driver_resident_latency_us"] = round(wall_res / iters * 1e6, 1)
+
+    # raw shard_map ceiling on the same device set / payload
+    devs = jax.devices()[:nranks]
+    mesh = Mesh(np.array(devs), ("rank",))
+    x = jnp.zeros((nranks, count), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("rank", None)))
+    fn = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, "rank"), mesh=mesh,
+        in_specs=P("rank", None), out_specs=P("rank", None)))
+    jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = fn(x)
+    jax.block_until_ready(y)
+    dt = time.perf_counter() - t0
+    out["raw_shardmap_calls_per_s"] = round(iters / dt, 1)
+    out["raw_latency_us"] = round(dt / iters * 1e6, 1)
+    out["driver_overhead_x"] = round(
+        out["raw_shardmap_calls_per_s"] / out["driver_calls_per_s"], 2)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--count", type=int, default=1024)
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--json", type=str, default="")
+    ap.add_argument("--platform", type=str, default="cpu")
+    args = ap.parse_args()
+    res = run(args.ranks, args.count, args.iters, args.platform)
+    line = json.dumps(res)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,33 @@ def test_sendrecv_rendezvous(world):
     world.run(fn)
 
 
+def test_sendrecv_tag_any_and_mixed_ordering(world):
+    # SAME scenario as the TPU backend's wildcard tests — the two rungs
+    # must provably share matching semantics (rxpool seek,
+    # native/src/rxpool.hpp:67-78; reference rxbuf_seek.cpp:19-78): the
+    # per-src seqn counter is shared across tags, so in-order tagged
+    # recvs match their sends and a wildcard drains whatever is oldest
+    def fn(accl, rank):
+        if rank == 0:
+            a, _ = _fill(accl, COUNT, np.float32, 0, salt=21)
+            b, _ = _fill(accl, COUNT, np.float32, 0, salt=22)
+            accl.send(a, COUNT, 1, tag=5)
+            accl.send(b, COUNT, 1, tag=7)
+        elif rank == 1:
+            import time
+            time.sleep(0.2)  # both sends pending before any recv posts
+            d5 = accl.create_buffer(COUNT, np.float32)
+            dany = accl.create_buffer(COUNT, np.float32)
+            accl.recv(d5, COUNT, 0, tag=5)
+            accl.recv(dany, COUNT, 0, tag=TAG_ANY)
+            np.testing.assert_array_equal(
+                d5.host, _fill_data(COUNT, np.float32, 0, salt=21))
+            np.testing.assert_array_equal(
+                dany.host, _fill_data(COUNT, np.float32, 0, salt=22))
+
+    world.run(fn)
+
+
 def test_fifo_exhaustion(world):
     # more in-flight eager messages than rx buffers (reference
     # test_sendrcv_fifo_exhaustion): staging backpressure must absorb

@@ -55,6 +55,75 @@ def test_sendrecv(world):
     world.run(fn)
 
 
+def test_sendrecv_tag_any(world):
+    # tagged send + wildcard recv must pair (rxpool seek semantics,
+    # reference rxbuf_seek.cpp:19-78) — this used to deadlock on the
+    # TPU backend because the gang key baked in the exact tag
+    from accl_tpu.constants import TAG_ANY
+
+    def fn(accl, rank):
+        nxt, prv = (rank + 1) % NRANKS, (rank - 1) % NRANKS
+        src = accl.create_buffer_like(_data(COUNT, rank, salt=11))
+        dst = accl.create_buffer(COUNT, np.float32)
+        sreq = accl.send(src, COUNT, nxt, tag=42, run_async=True)
+        accl.recv(dst, COUNT, prv, tag=TAG_ANY)
+        assert sreq.wait(30)
+        sreq.check()
+        np.testing.assert_array_equal(dst.host, _data(COUNT, prv, salt=11))
+
+    world.run(fn)
+
+
+def test_sendrecv_mixed_tag_ordering(world):
+    # the per-src sequence counter is shared across tags (rxpool.hpp
+    # seqn discipline; reference dma_mover.cpp:579-611): in-order tagged
+    # recvs match their sends, and a wildcard drains whatever is oldest
+    from accl_tpu.constants import TAG_ANY
+
+    def fn(accl, rank):
+        nxt, prv = (rank + 1) % NRANKS, (rank - 1) % NRANKS
+        a = accl.create_buffer_like(_data(COUNT, rank, salt=21))
+        b = accl.create_buffer_like(_data(COUNT, rank, salt=22))
+        ra = accl.send(a, COUNT, nxt, tag=5, run_async=True)
+        rb = accl.send(b, COUNT, nxt, tag=7, run_async=True)
+        d5 = accl.create_buffer(COUNT, np.float32)
+        dany = accl.create_buffer(COUNT, np.float32)
+        accl.recv(d5, COUNT, prv, tag=5)
+        accl.recv(dany, COUNT, prv, tag=TAG_ANY)  # drains the tag-7 send
+        for r in (ra, rb):
+            assert r.wait(30)
+            r.check()
+        np.testing.assert_array_equal(d5.host, _data(COUNT, prv, salt=21))
+        np.testing.assert_array_equal(dany.host, _data(COUNT, prv, salt=22))
+
+    world.run(fn)
+
+
+def test_sendrecv_tag_mismatch_is_seq_error(world):
+    # a recv whose tag does not match the head-of-stream send is a
+    # sequence-discipline violation, SAME retcode as the emulator rung
+    # classifies after its seek times out (PACK_SEQ_NUMBER_ERROR) — the
+    # stream may not be reordered by tag
+    from accl_tpu.constants import ACCLError, ErrorCode, TAG_ANY
+
+    def fn(accl, rank):
+        nxt, prv = (rank + 1) % NRANKS, (rank - 1) % NRANKS
+        a = accl.create_buffer_like(_data(COUNT, rank, salt=31))
+        ra = accl.send(a, COUNT, nxt, tag=5, run_async=True)
+        bad = accl.create_buffer(COUNT, np.float32)
+        with pytest.raises(ACCLError) as ei:
+            accl.recv(bad, COUNT, prv, tag=9)
+        assert ei.value.code & int(ErrorCode.PACK_SEQ_NUMBER_ERROR)
+        # the mismatched send stays queued — a wildcard recv drains it
+        dany = accl.create_buffer(COUNT, np.float32)
+        accl.recv(dany, COUNT, prv, tag=TAG_ANY)
+        assert ra.wait(30)
+        ra.check()
+        np.testing.assert_array_equal(dany.host, _data(COUNT, prv, salt=31))
+
+    world.run(fn)
+
+
 @pytest.mark.parametrize("root", [0, 2])
 def test_bcast(world, root):
     def fn(accl, rank):
