@@ -216,6 +216,42 @@ class ACCL:
         return req.duration_ns if req else 0.0
 
     # ------------------------------------------------------------------
+    # session lifecycle (reference: open_port/open_con/close_con,
+    # accl.hpp:1069-1083, backed by the tcp_session_handler plugin)
+    # ------------------------------------------------------------------
+    def open_port(self) -> None:
+        """Verify the inbound endpoint is live (reference open_port).
+        Connectionless backends (inproc, datagram, TPU/ICI) succeed as
+        no-ops — as in the reference, where only the TCP design ships
+        the session handler."""
+        fn = getattr(self._device, "open_port", None)
+        if fn is not None and fn() != 0:
+            raise ACCLError("open_port failed: transport not listening")
+
+    def open_con(self, comm_id: int = GLOBAL_COMM) -> None:
+        """Explicitly open sessions to every peer of a communicator,
+        surfacing connection failures as a distinct setup error instead
+        of a mid-collective hang (reference open_con)."""
+        fn = getattr(self._device, "open_con", None)
+        if fn is None:
+            return  # connectionless backend
+        rc = fn(comm_id)
+        if rc > 0:
+            raise ACCLError(
+                f"open_con failed: no session to peer {rc - 1} "
+                f"(comm {comm_id})")
+        if rc < 0:
+            raise ACCLError(f"open_con: unknown communicator {comm_id}")
+
+    def close_con(self, comm_id: int = GLOBAL_COMM) -> None:
+        """Tear down the sessions of a communicator (reference
+        close_con).  A later call lazily reconnects on session
+        transports."""
+        fn = getattr(self._device, "close_con", None)
+        if fn is not None and fn(comm_id) < 0:
+            raise ACCLError(f"close_con: unknown communicator {comm_id}")
+
+    # ------------------------------------------------------------------
     # buffers
     # ------------------------------------------------------------------
     def create_buffer(self, length: int, dtype=np.float32,

@@ -22,9 +22,9 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from ..accl import ACCL
+from ..accl import ACCL, default_timeout
 from ..arithconfig import ArithConfig
-from ..buffer import BaseBuffer, EmuBuffer
+from ..buffer import BaseBuffer, EmuBuffer, EmuBufferP2P
 from ..communicator import Communicator, Rank
 from ..constants import ACCLError, CCLOCall
 from ..request import Request
@@ -107,6 +107,19 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_alloc.argtypes = [p, i32, u64, u64]
     lib.accl_alloc_host.restype = u64
     lib.accl_alloc_host.argtypes = [p, i32, u64, u64]
+    lib.accl_alloc_p2p.restype = u64
+    lib.accl_alloc_p2p.argtypes = [p, i32, u64, u64]
+    lib.accl_free_p2p.argtypes = [p, i32, u64]
+    lib.accl_mem_ptr.restype = ctypes.c_void_p
+    lib.accl_mem_ptr.argtypes = [p, i32, u64, u64]
+    lib.accl_tx_stats.argtypes = [p, i32, ctypes.POINTER(u64),
+                                  ctypes.POINTER(u64)]
+    lib.accl_open_port.restype = i32
+    lib.accl_open_port.argtypes = [p, i32]
+    lib.accl_open_con.restype = i32
+    lib.accl_open_con.argtypes = [p, i32, i32]
+    lib.accl_close_con.restype = i32
+    lib.accl_close_con.argtypes = [p, i32, i32]
     lib.accl_free.argtypes = [p, i32, u64]
     lib.accl_read_mem.argtypes = [p, i32, u64, ctypes.c_void_p, u64]
     lib.accl_write_mem.argtypes = [p, i32, u64, ctypes.c_void_p, u64]
@@ -169,6 +182,9 @@ class EmuDevice(CCLODevice):
     def free_mem(self, address: int) -> None:
         self._lib.accl_free(self._w, self._rank, address)
 
+    def free_mem_p2p(self, address: int) -> None:
+        self._lib.accl_free_p2p(self._w, self._rank, address)
+
     def read_mem(self, address: int, nbytes: int) -> bytes:
         buf = ctypes.create_string_buffer(nbytes)
         rc = self._lib.accl_read_mem(self._w, self._rank, address, buf, nbytes)
@@ -194,6 +210,45 @@ class EmuDevice(CCLODevice):
             return EmuBuffer(host, self, addr, host_only=True)
         addr = self.alloc_mem(max(host.nbytes, 64))
         return EmuBuffer(host, self, addr)
+
+    def create_buffer_p2p(self, length: int, dtype: np.dtype) -> BaseBuffer:
+        """Peer-addressable buffer (reference FPGABufferP2P): the host
+        view is a direct MAPPING of engine devicemem (zero-copy, no
+        sync), and the span is registered peer-writable — an in-process
+        peer's rendezvous one-sided write lands in it by direct memcpy,
+        bypassing the wire (native/src/engine.cpp rndzv_send fast
+        path)."""
+        nbytes = max(int(np.dtype(dtype).itemsize) * length, 64)
+        addr = self._lib.accl_alloc_p2p(self._w, self._rank, nbytes, 64)
+        if addr == 0:
+            raise ACCLError("emulator device memory exhausted (p2p)")
+        ptr = self._lib.accl_mem_ptr(self._w, self._rank, addr, nbytes)
+        if not ptr:
+            raise ACCLError("p2p mapping failed")
+        raw = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        host = np.frombuffer(raw, dtype=dtype, count=length)
+        return EmuBufferP2P(host, self, addr)
+
+    # -- session lifecycle (reference open_port/open_con/close_con over
+    # tcp_session_handler; accl.hpp:1069-1083).  TCP worlds really
+    # connect/tear down; inproc/datagram transports succeed as no-ops. --
+    def open_port(self) -> int:
+        return int(self._lib.accl_open_port(self._w, self._rank))
+
+    def open_con(self, comm_id: int) -> int:
+        return int(self._lib.accl_open_con(self._w, self._rank, comm_id))
+
+    def close_con(self, comm_id: int) -> int:
+        return int(self._lib.accl_close_con(self._w, self._rank, comm_id))
+
+    def tx_stats(self) -> tuple:
+        """Egress (messages, payload_bytes) handed to the transport —
+        the observable that proves the p2p path bypassed the wire."""
+        msgs = ctypes.c_uint64(0)
+        pay = ctypes.c_uint64(0)
+        self._lib.accl_tx_stats(self._w, self._rank, ctypes.byref(msgs),
+                                ctypes.byref(pay))
+        return int(msgs.value), int(pay.value)
 
     # -- configuration ------------------------------------------------
     def setup_rx_buffers(self, n_bufs: int, buf_size: int) -> None:
@@ -274,12 +329,15 @@ class EmuRankTcp:
         if not self._handle:
             raise ACCLError(f"TCP emulator rank {rank} failed to start "
                             f"(port {base_port + rank} busy?)")
+        # the driver-level sync wait gates the same calls as the engine's
+        # receive timeout; the engine budget (ACCL_DEFAULT_TIMEOUT, µs)
+        # must always fire FIRST so a stall surfaces as a decodable
+        # RECEIVE_TIMEOUT_ERROR rather than an opaque driver wait failure
+        # — clamp the driver budget above it
+        call_timeout_s = max(call_timeout_s, default_timeout() / 1e6 + 5.0)
         self.device = EmuDevice(self._handle, rank, self._lib,
                                 call_timeout_s=call_timeout_s)
         self.accl = ACCL(self.device)
-        # the driver-level sync wait gates the same calls; keep the two
-        # host-side budgets aligned so the engine's receive timeout (set
-        # below it) is always the first to fire
         self.accl.call_timeout_s = call_timeout_s
         ranks = [Rank(ip="127.0.0.1", port=base_port + r, session=r,
                       max_segment_size=egr_rx_buf_size)

@@ -165,3 +165,31 @@ class EmuBuffer(BaseBuffer):
     def free(self) -> None:
         if self._owner:
             self._device.free_mem(self._address)
+
+
+class EmuBufferP2P(EmuBuffer):
+    """Peer-addressable emulator buffer (reference: FPGABufferP2P,
+    fpgabufferp2p.hpp — a p2p BO whose host pointer IS device memory via
+    bo.map).  `host` here is a numpy view directly over the engine's
+    devicemem span, so syncs are no-ops; the span is registered
+    peer-writable and an in-process peer's rendezvous write lands in it
+    bypassing the wire (native engine rndzv_send fast path)."""
+
+    def sync_to_device(self) -> None:
+        pass  # the host view IS the device memory
+
+    def sync_from_device(self) -> None:
+        pass
+
+    def slice(self, start: int, end: int) -> "EmuBufferP2P":
+        itemsize = self._host.itemsize
+        return EmuBufferP2P(
+            self._host[start:end],
+            self._device,
+            self._address + start * itemsize,
+            owner=False,
+        )
+
+    def free(self) -> None:
+        if self._owner:
+            self._device.free_mem_p2p(self._address)

@@ -63,32 +63,47 @@ template <typename T>
 class Buffer {
  public:
   Buffer(Engine* e, uint64_t n, DType dt = dtype_of<T>::value,
-         bool host_only = false)
-      : e_(e), n_(n), dtype_(dt), host_only_(host_only) {
+         bool host_only = false, bool p2p = false)
+      : e_(e), n_(n), dtype_(dt), host_only_(host_only), p2p_(p2p) {
     addr_ = host_only ? e_->alloc_host(n * sizeof(T), 64)
                       : e_->alloc(n * sizeof(T), 64);
     if (!addr_) throw std::runtime_error("device memory exhausted");
-    host_.resize(n);
+    if (p2p_) {
+      // FPGABufferP2P analog (fpgabufferp2p.hpp): the buffer is a
+      // registered peer-writable window and the host view is a direct
+      // MAPPING of devicemem (bo.map) — no staging vector, syncs are
+      // no-ops, and an in-process peer's rendezvous write lands in it
+      // by direct memcpy, bypassing the wire.
+      e_->register_p2p(addr_, n * sizeof(T));
+      mapped_ = reinterpret_cast<T*>(e_->raw_mem(addr_, n * sizeof(T)));
+      if (!mapped_) throw std::runtime_error("p2p mapping failed");
+    } else {
+      host_.resize(n);
+    }
   }
   ~Buffer() {
-    if (addr_) e_->free_addr(addr_);
+    if (addr_) {
+      if (p2p_) e_->unregister_p2p(addr_);
+      e_->free_addr(addr_);
+    }
   }
   Buffer(const Buffer&) = delete;
   Buffer& operator=(const Buffer&) = delete;
 
-  T* data() { return host_.data(); }
-  const T* data() const { return host_.data(); }
-  T& operator[](size_t i) { return host_[i]; }
+  T* data() { return p2p_ ? mapped_ : host_.data(); }
+  const T* data() const { return p2p_ ? mapped_ : host_.data(); }
+  T& operator[](size_t i) { return data()[i]; }
   uint64_t length() const { return n_; }
   uint64_t address() const { return addr_; }
   DType dtype() const { return dtype_; }
   bool is_host_only() const { return host_only_; }
+  bool is_p2p() const { return p2p_; }
 
   void sync_to_device() {
-    e_->write_mem(addr_, host_.data(), n_ * sizeof(T));
+    if (!p2p_) e_->write_mem(addr_, host_.data(), n_ * sizeof(T));
   }
   void sync_from_device() {
-    e_->read_mem(addr_, host_.data(), n_ * sizeof(T));
+    if (!p2p_) e_->read_mem(addr_, host_.data(), n_ * sizeof(T));
   }
 
  private:
@@ -96,6 +111,8 @@ class Buffer {
   uint64_t n_, addr_ = 0;
   DType dtype_;
   bool host_only_ = false;
+  bool p2p_ = false;
+  T* mapped_ = nullptr;
   std::vector<T> host_;
 };
 
@@ -226,6 +243,37 @@ class ACCL {
   std::unique_ptr<Buffer<T>> create_buffer_host(
       uint64_t n, DType dt = dtype_of<T>::value) {
     return std::make_unique<Buffer<T>>(e_, n, dt, /*host_only=*/true);
+  }
+
+  // p2p buffer (reference create_buffer_p2p, accl.hpp + fpgabufferp2p
+  // .hpp): zero-copy host mapping + peer-writable window — a peer's
+  // rendezvous one-sided write bypasses the wire in shared-address
+  // worlds
+  template <typename T>
+  std::unique_ptr<Buffer<T>> create_buffer_p2p(
+      uint64_t n, DType dt = dtype_of<T>::value) {
+    return std::make_unique<Buffer<T>>(e_, n, dt, /*host_only=*/false,
+                                       /*p2p=*/true);
+  }
+
+  // ---- explicit session lifecycle (reference open_port/open_con/
+  // close_con, accl.hpp:1069-1083 over tcp_session_handler): session
+  // transports really connect/tear down with surfaced errors;
+  // connectionless rungs succeed as no-ops. ----
+  void open_port() {
+    if (e_->open_port() != 0)
+      throw std::runtime_error("open_port failed: transport not listening");
+  }
+  void open_con(int comm_id = -1) {
+    int rc = e_->open_con(uint32_t(comm_id < 0 ? comm_ : comm_id));
+    if (rc > 0)
+      throw std::runtime_error("open_con failed: no session to peer " +
+                               std::to_string(rc - 1));
+    if (rc < 0) throw std::runtime_error("open_con: unknown communicator");
+  }
+  void close_con(int comm_id = -1) {
+    if (e_->close_con(uint32_t(comm_id < 0 ? comm_ : comm_id)) < 0)
+      throw std::runtime_error("close_con: unknown communicator");
   }
 
   void check(uint32_t ret) {

@@ -45,6 +45,13 @@ void* accl_world_create(int nranks, uint64_t devmem_bytes) {
         uint32_t(r), devmem_bytes,
         std::make_unique<InprocTransport>(w->hub, r)));
   }
+  // shared address space: enable the direct p2p landing path (session
+  // ids are rank ids in inproc worlds)
+  for (auto& e : w->engines)
+    e->set_peer_hook([w](uint32_t session) -> Engine* {
+      return session < w->engines.size() ? w->engines[session].get()
+                                         : nullptr;
+    });
   return w;
 }
 
@@ -161,6 +168,59 @@ uint64_t accl_alloc_host(void* wp, int rank, uint64_t nbytes,
                          uint64_t align) {
   Engine* e = static_cast<World*>(wp)->get(rank);
   return e ? e->alloc_host(nbytes, align) : 0;
+}
+
+// P2P buffer: a devicemem allocation registered as a peer-writable
+// window (FPGABufferP2P analog) — in shared-address-space worlds a
+// peer's rendezvous write lands by direct memcpy, bypassing the wire.
+uint64_t accl_alloc_p2p(void* wp, int rank, uint64_t nbytes,
+                        uint64_t align) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return 0;
+  uint64_t addr = e->alloc(nbytes, align);
+  if (addr) e->register_p2p(addr, nbytes);
+  return addr;
+}
+
+void accl_free_p2p(void* wp, int rank, uint64_t addr) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return;
+  e->unregister_p2p(addr);
+  e->free_addr(addr);
+}
+
+// Zero-copy host mapping of a devicemem span (the reference's
+// bo.map<dtype*>() on a p2p BO).  Valid for the world's lifetime;
+// nullptr when out of range.
+void* accl_mem_ptr(void* wp, int rank, uint64_t addr, uint64_t nbytes) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->raw_mem(addr, nbytes) : nullptr;
+}
+
+// Egress traffic counters (see Engine::tx_stats) — lets tests assert
+// the p2p path moved no payload over the transport.
+void accl_tx_stats(void* wp, int rank, uint64_t* msgs,
+                   uint64_t* payload_bytes) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (e) e->tx_stats(msgs, payload_bytes);
+}
+
+// Explicit session lifecycle (reference open_port/open_con/close_con
+// over the tcp_session_handler; see Engine).  open/close return 0 on
+// success or (1 + peer_local_rank) / -1 on failure.
+int accl_open_port(void* wp, int rank) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->open_port() : -1;
+}
+
+int accl_open_con(void* wp, int rank, int comm_id) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->open_con(uint32_t(comm_id)) : -1;
+}
+
+int accl_close_con(void* wp, int rank, int comm_id) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->close_con(uint32_t(comm_id)) : -1;
 }
 
 void accl_free(void* wp, int rank, uint64_t addr) {

@@ -258,6 +258,10 @@ bool Engine::pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap,
 // against the detection machinery (SURVEY §5 failure detection)
 // ---------------------------------------------------------------------------
 void Engine::send_out(uint32_t session, Message&& msg) {
+  // egress accounting (tx_stats): proves in tests whether a payload
+  // actually crossed the wire (the p2p direct path must not add here)
+  tx_msgs_.fetch_add(1);
+  tx_payload_bytes_.fetch_add(msg.payload.size());
   switch (fault_.exchange(0)) {
     case 1:  // drop: the message never reaches the wire
       return;
@@ -381,74 +385,135 @@ void Engine::ingress(Message&& msg) {
       pending_addrs_.push(RndzvAddr{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
                                     msg.hdr.vaddr, msg.hdr.count});
       break;
-    case MsgType::RndzvsMsg: {
-      // one-sided write into our device memory (the RDMA WRITE landing),
-      // then surface a local completion (the WR_DONE the reference's
-      // depacketizer routes up to the firmware notification stream).
-      // The depacketizer converts the wire representation into the
-      // landing representation using OUR OWN posted-address record (the
-      // eager path's own-flag-algebra discipline; the sender's header is
-      // advisory only) — this is the ETH-compressed rendezvous path.
-      //
-      // The whole consume-write-complete sequence holds posted_mu_:
-      // retry-queue expiry tears records down under the same lock, so a
-      // concurrent landing either fully completes BEFORE the teardown
-      // (its completion is then drained) or finds no record and drops —
-      // there is no window where a write lands or a completion surfaces
-      // after the teardown decided the call is dead.
-      std::lock_guard<std::mutex> pg(posted_mu_);
-      std::optional<PostedRndzv> post;
-      {
-        auto it = posted_.find(PostedKey{msg.hdr.comm_id, msg.hdr.src,
-                                         msg.hdr.tag, msg.hdr.vaddr});
-        if (it != posted_.end()) {
-          post = it->second;
-          posted_.erase(it);
-        }
-      }
-      // Landing REQUIRES our own posted record: every legitimate write
-      // answers an RNDZVS_INIT we sent, so a write with no record is a
-      // stale arrival for an expired call — dropping it (and emitting no
-      // completion) is what keeps reused memory safe after retry-queue
-      // expiry tears the record down.
-      if (!post) break;
-      {
-        // the landing address may be tagged host-resident (host-only
-        // rendezvous buffers); resolve the region like mem() does
-        auto& region =
-            (msg.hdr.vaddr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
-        uint64_t vaddr = msg.hdr.vaddr & ~HOST_ADDR_BIT;
-        std::lock_guard<std::mutex> g(mem_mu_);
-        if (post->wire_c != post->lnd_c) {
-          // clamp to what actually arrived: a short payload (divergent
-          // arithcfg, stale posted entry) must not read past the wire
-          // buffer
-          uint64_t wire_eb = post->wire_c ? post->cb : post->ub;
-          uint64_t elems = std::min<uint64_t>(
-              post->elems, msg.payload.size() / std::max<uint64_t>(1, wire_eb));
-          uint64_t lnd_bytes = elems * (post->lnd_c ? post->cb : post->ub);
-          if (vaddr + lnd_bytes <= region.size()) {
-            if (post->wire_c)
-              run_decompress_lane(post->comp_kind, msg.payload.data(),
-                                  region.data() + vaddr, elems);
-            else
-              run_compress_lane(post->comp_kind, msg.payload.data(),
-                                region.data() + vaddr, elems);
-          }
-        } else if (vaddr + msg.payload.size() <= region.size()) {
-          std::memcpy(region.data() + vaddr, msg.payload.data(),
-                      msg.payload.size());
-        }
-      }
-      completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
-                                  msg.hdr.vaddr});
+    case MsgType::RndzvsMsg:
+      // one-sided write into our device memory (the RDMA WRITE landing);
+      // the shared land_one_sided applies the consume-write-complete
+      // discipline (also run by the direct p2p path)
+      land_one_sided(msg.hdr, msg.payload.data(), msg.payload.size());
       break;
-    }
     case MsgType::RndzvsWrDone:
       completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
                                   msg.hdr.vaddr});
       break;
   }
+}
+
+// Shared landing for one-sided writes (wire ingress AND direct p2p).
+//
+// The depacketizer converts the wire representation into the landing
+// representation using OUR OWN posted-address record (the eager path's
+// own-flag-algebra discipline; the sender's header is advisory only) —
+// this is the ETH-compressed rendezvous path.
+//
+// The whole consume-write-complete sequence holds posted_mu_:
+// retry-queue expiry tears records down under the same lock, so a
+// concurrent landing either fully completes BEFORE the teardown (its
+// completion is then drained) or finds no record and drops — there is
+// no window where a write lands or a completion surfaces after the
+// teardown decided the call is dead.
+void Engine::land_one_sided(const WireHeader& hdr, const uint8_t* payload,
+                            uint64_t payload_bytes) {
+  std::lock_guard<std::mutex> pg(posted_mu_);
+  std::optional<PostedRndzv> post;
+  {
+    auto it =
+        posted_.find(PostedKey{hdr.comm_id, hdr.src, hdr.tag, hdr.vaddr});
+    if (it != posted_.end()) {
+      post = it->second;
+      posted_.erase(it);
+    }
+  }
+  // Landing REQUIRES our own posted record: every legitimate write
+  // answers an RNDZVS_INIT we sent, so a write with no record is a
+  // stale arrival for an expired call — dropping it (and emitting no
+  // completion) is what keeps reused memory safe after retry-queue
+  // expiry tears the record down.
+  if (!post) return;
+  {
+    // the landing address may be tagged host-resident (host-only
+    // rendezvous buffers); resolve the region like mem() does
+    auto& region = (hdr.vaddr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
+    uint64_t vaddr = hdr.vaddr & ~HOST_ADDR_BIT;
+    std::lock_guard<std::mutex> g(mem_mu_);
+    if (post->wire_c != post->lnd_c) {
+      // clamp to what actually arrived: a short payload (divergent
+      // arithcfg, stale posted entry) must not read past the wire
+      // buffer
+      uint64_t wire_eb = post->wire_c ? post->cb : post->ub;
+      uint64_t elems = std::min<uint64_t>(
+          post->elems, payload_bytes / std::max<uint64_t>(1, wire_eb));
+      uint64_t lnd_bytes = elems * (post->lnd_c ? post->cb : post->ub);
+      if (vaddr + lnd_bytes <= region.size()) {
+        if (post->wire_c)
+          run_decompress_lane(post->comp_kind, payload,
+                              region.data() + vaddr, elems);
+        else
+          run_compress_lane(post->comp_kind, payload,
+                            region.data() + vaddr, elems);
+      }
+    } else if (vaddr + payload_bytes <= region.size()) {
+      std::memcpy(region.data() + vaddr, payload, payload_bytes);
+    }
+  }
+  completions_.push(RndzvDone{hdr.comm_id, hdr.src, hdr.tag, hdr.vaddr});
+}
+
+// ---------------------------------------------------------------------------
+// explicit session lifecycle (reference tcp_session_handler; see engine.hpp)
+// ---------------------------------------------------------------------------
+int Engine::open_con(uint32_t comm_id) {
+  if (comm_id >= comms_.size() || comms_[comm_id].rows.empty()) return -1;
+  const CommTable& t = comms_[comm_id];
+  for (uint32_t i = 0; i < t.rows.size(); ++i) {
+    if (i == t.local) continue;
+    if (transport_->open_session(t.rows[i].session) != 0) return int(i) + 1;
+  }
+  return 0;
+}
+
+int Engine::close_con(uint32_t comm_id) {
+  if (comm_id >= comms_.size() || comms_[comm_id].rows.empty()) return -1;
+  const CommTable& t = comms_[comm_id];
+  for (uint32_t i = 0; i < t.rows.size(); ++i) {
+    if (i == t.local) continue;
+    // closing a never-opened session is not a failure of the teardown
+    // sweep (the lazy path may simply never have connected yet)
+    transport_->close_session(t.rows[i].session);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// p2p buffer windows (FPGABufferP2P analog — see engine.hpp)
+// ---------------------------------------------------------------------------
+void Engine::register_p2p(uint64_t addr, uint64_t bytes) {
+  std::lock_guard<std::mutex> g(p2p_mu_);
+  p2p_spans_[addr] = bytes;
+}
+
+void Engine::unregister_p2p(uint64_t addr) {
+  std::lock_guard<std::mutex> g(p2p_mu_);
+  p2p_spans_.erase(addr);
+}
+
+bool Engine::p2p_covers(uint64_t addr, uint64_t bytes) const {
+  std::lock_guard<std::mutex> g(p2p_mu_);
+  auto it = p2p_spans_.upper_bound(addr);
+  if (it == p2p_spans_.begin()) return false;
+  --it;
+  return addr >= it->first && addr + bytes <= it->first + it->second;
+}
+
+uint8_t* Engine::raw_mem(uint64_t addr, uint64_t bytes) {
+  std::lock_guard<std::mutex> g(mem_mu_);
+  if (addr & HOST_ADDR_BIT) return nullptr;  // p2p windows are devicemem
+  if (addr == 0 || addr + bytes > devicemem_.size()) return nullptr;
+  return devicemem_.data() + addr;
+}
+
+void Engine::land_p2p(const WireHeader& hdr, const uint8_t* payload,
+                      uint64_t payload_bytes) {
+  land_one_sided(hdr, payload, payload_bytes);
 }
 
 // ---------------------------------------------------------------------------
@@ -472,6 +537,9 @@ void Engine::loop() {
     }
     if (!have) continue;
 
+    if (c.first_try_ns == 0)
+      retry_idle_sweeps_ = 0;  // new call admitted: reset retry pacing
+
     auto t0 = steady_clock::now();
     if (c.first_try_ns == 0)
       c.first_try_ns =
@@ -481,6 +549,7 @@ void Engine::loop() {
     bool retry = false;
     try {
       uint32_t ret = execute(c);
+      retry_idle_sweeps_ = 0;  // a call completed: the world moved
       auto dt = duration_cast<nanoseconds>(steady_clock::now() - t0).count();
       std::lock_guard<std::mutex> g(results_mu_);
       auto& r = results_[c.id];
@@ -540,9 +609,21 @@ void Engine::loop() {
         r.done = true;
       } else {
         retry_q_.push_back(c);
-        // cooperative pacing so retries don't spin hot (the firmware's
-        // round-robin between host cmd stream and retry FIFO)
-        std::this_thread::sleep_for(microseconds(200));
+        // cooperative pacing: the firmware round-robins between the
+        // host cmd stream and the retry FIFO with no sleep at all
+        // (fw :2264-2288).  A fixed sleep here puts a latency floor
+        // under every contended rendezvous, so pace adaptively —
+        // yield while the queue is freshly unproductive (the peer is
+        // usually microseconds away), escalate to a growing bounded
+        // sleep only when sweeps keep coming back empty-handed.
+        if (c.current_step != step_before) {
+          retry_idle_sweeps_ = 0;  // step progress: stay hot
+        } else if (++retry_idle_sweeps_ <= 64) {
+          std::this_thread::yield();
+        } else {
+          std::this_thread::sleep_for(microseconds(
+              std::min<uint32_t>(200, retry_idle_sweeps_ - 64)));
+        }
       }
     }
   }
@@ -1217,6 +1298,46 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
         },
         milliseconds(2));
     if (!a) throw NotReadyEx{c.current_step};
+    // Direct p2p fast path (FPGABufferP2P role): when the receiver's
+    // advertised landing address lies inside a peer-registered p2p
+    // window of an engine we can reach in-process, write the payload
+    // straight into the peer's devicemem — no wire message, no framing
+    // copy.  Restricted to the plain domain on the SENDER side (no ETH
+    // compression, uncompressed source, devicemem operand) so the
+    // single copy below is the whole data movement; the receiver's own
+    // posted-record conversion still runs inside land_p2p, identical
+    // to the wire path.  Own mem_mu_ is NOT held across the peer call
+    // (two engines direct-writing at each other would deadlock on
+    // crossed mem locks); devicemem_ never reallocates, so the raw
+    // pointer stays valid.
+    // an armed one-shot egress fault must not be skipped (or left armed
+    // for an unrelated later message) by the wire bypass — faulted sends
+    // take the wire path where send_out applies the injection
+    if (peer_hook_ && !d.eth && !src_c && !(addr & HOST_ADDR_BIT) &&
+        fault_.load() == 0) {
+      Engine* peer = peer_hook_(t.rows[dst].session);
+      uint64_t nbytes = elems * d.ub;
+      if (peer && peer != this && peer->p2p_covers(a->vaddr, nbytes)) {
+        uint8_t* pdata;
+        {
+          std::lock_guard<std::mutex> g(mem_mu_);
+          pdata = mem(addr, nbytes);
+        }
+        if (sticky_err_ == 0) {
+          WireHeader hdr;
+          hdr.count = uint32_t(nbytes);
+          hdr.tag = tag;
+          hdr.src = t.local;
+          hdr.vaddr = a->vaddr;
+          hdr.msg_type = uint8_t(MsgType::RndzvsMsg);
+          hdr.comm_id = c.comm();
+          hdr.compressed = 0;
+          peer->land_p2p(hdr, pdata, nbytes);
+          p.done();
+          return;
+        }
+      }
+    }
     Message msg;
     msg.hdr.tag = tag;
     msg.hdr.src = t.local;

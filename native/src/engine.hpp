@@ -112,6 +112,48 @@ class Engine {
   // PACK_SEQ error (fault-injection contract).
   void set_lossy_transport(bool on) { lossy_transport_ = on; }
 
+  // ---- explicit session lifecycle (reference open_port/open_con/
+  // close_con, accl.hpp:1069-1083, backed by the tcp_session_handler
+  // plugin).  Connection state lives in the transport; these surface
+  // bring-up/teardown per communicator with a distinct error (the
+  // index of the first peer whose session failed), so a dead peer is a
+  // decodable setup failure instead of a mid-collective hang. ----
+  // open_port: is the inbound endpoint live?  0 ok, -1 not listening.
+  int open_port() const { return transport_ && transport_->listening() ? 0 : -1; }
+  // open_con / close_con over every peer of a communicator.
+  // Returns 0 on success, or (1 + peer_local_rank) of the first failure.
+  int open_con(uint32_t comm_id);
+  int close_con(uint32_t comm_id);
+
+  // ---- peer-to-peer buffer windows (FPGABufferP2P analog,
+  // driver/xrt/include/accl/fpgabufferp2p.hpp: a device buffer directly
+  // addressable by peers without staging).  A registered span lets an
+  // in-process peer engine land its rendezvous one-sided write by
+  // DIRECT memcpy into this engine's devicemem — the wire is bypassed
+  // entirely (the PCIe-p2p DMA of the reference).  Worlds with shared
+  // address space install the peer hook; wire-only worlds leave it
+  // unset and p2p buffers degrade gracefully to normal buffers. ----
+  void register_p2p(uint64_t addr, uint64_t bytes);
+  void unregister_p2p(uint64_t addr);
+  bool p2p_covers(uint64_t addr, uint64_t bytes) const;
+  void set_peer_hook(std::function<Engine*(uint32_t session)> hook) {
+    peer_hook_ = std::move(hook);
+  }
+  // Raw pointer into devicemem for zero-copy host mapping (the
+  // reference's bo.map<dtype*>() on a p2p BO).  nullptr when OOB.
+  uint8_t* raw_mem(uint64_t addr, uint64_t bytes);
+  // Receiver side of a direct p2p landing: same consume-write-complete
+  // discipline as the wire ingress (shared land_one_sided below).
+  void land_p2p(const WireHeader& hdr, const uint8_t* payload,
+                uint64_t payload_bytes);
+  // Egress traffic counters (message count / payload bytes actually
+  // handed to the transport) — lets tests PROVE the p2p path moved no
+  // payload over the wire.
+  void tx_stats(uint64_t* msgs, uint64_t* payload_bytes) const {
+    if (msgs) *msgs = tx_msgs_.load();
+    if (payload_bytes) *payload_bytes = tx_payload_bytes_.load();
+  }
+
  private:
   // engine loop
   void loop();
@@ -260,6 +302,9 @@ class Engine {
   std::map<uint64_t, uint64_t> free_spans_;   // addr -> size
   std::map<uint64_t, uint64_t> host_spans_;   // untagged addr -> size
   std::map<uint64_t, uint64_t> alloc_sizes_;  // addr -> size (both spaces)
+  // LOCK ORDER: mem_mu_ may be taken while holding posted_mu_ (the
+  // rendezvous landing path holds posted_mu_ across its payload copy,
+  // engine.cpp RndzvsMsg) — NEVER take posted_mu_ while holding mem_mu_.
   std::mutex mem_mu_;
 
   // Landing-pad registry for one-sided writes: rndzv_post_addr records
@@ -278,6 +323,20 @@ class Engine {
   };
   using PostedKey = std::tuple<uint32_t, uint32_t, uint32_t, uint64_t>;
   std::map<PostedKey, PostedRndzv> posted_;
+  // Shared landing logic for one-sided writes: wire ingress (RndzvsMsg)
+  // and the direct p2p path both run exactly this (consume posted
+  // record under posted_mu_, convert/copy under mem_mu_, surface the
+  // completion) so the two paths cannot diverge.
+  void land_one_sided(const WireHeader& hdr, const uint8_t* payload,
+                      uint64_t payload_bytes);
+
+  // p2p window registry + peer resolution (see public section)
+  mutable std::mutex p2p_mu_;
+  std::map<uint64_t, uint64_t> p2p_spans_;  // addr -> bytes
+  std::function<Engine*(uint32_t session)> peer_hook_;
+  std::atomic<uint64_t> tx_msgs_{0}, tx_payload_bytes_{0};
+  // LOCK ORDER: posted_mu_ comes BEFORE mem_mu_ (see mem_mu_ above);
+  // acquiring posted_mu_ under mem_mu_ would invert the order = deadlock.
   std::mutex posted_mu_;
 
   std::unique_ptr<Transport> transport_;
@@ -364,6 +423,10 @@ class Engine {
 
   Fifo<CallDesc> cmd_q_;
   std::deque<CallDesc> retry_q_;  // firmware retry FIFO (fw :2460-2479)
+  //: consecutive unproductive retry sweeps, for adaptive pacing in
+  //: loop(): yield first, escalate to a bounded sleep (engine thread
+  //: only — no locking needed)
+  uint32_t retry_idle_sweeps_ = 0;
   std::map<uint64_t, CallResult> results_;
   std::mutex results_mu_;
   std::condition_variable results_cv_;

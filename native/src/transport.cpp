@@ -86,9 +86,23 @@ void TcpTransport::stop() {
       fd = -1;
     }
   }
-  for (auto& t : threads_)
+  std::vector<std::thread> to_join;
+  {
+    // unblock reader threads parked in read(2) on ACCEPTED sockets —
+    // without this, a same-process peer that still holds its outbound
+    // end open leaves our reader blocked and the join below deadlocks
+    // (only surfaced once ranks could share a process; the
+    // process-per-rank rung tears the peer end down at process exit).
+    // threads_ is swapped out UNDER conn_mu_: a connection accepted in
+    // the closing window can no longer emplace into the vector we are
+    // iterating (accept_loop re-checks running_ under the same lock
+    // and closes the fd instead).
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (int fd : accepted_fds_) ::shutdown(fd, SHUT_RDWR);
+    to_join.swap(threads_);
+  }
+  for (auto& t : to_join)
     if (t.joinable()) t.join();
-  threads_.clear();
 }
 
 void TcpTransport::accept_loop() {
@@ -101,6 +115,11 @@ void TcpTransport::accept_loop() {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     std::lock_guard<std::mutex> g(conn_mu_);
+    if (!running_) {  // raced with stop(): the join sweep already ran
+      ::close(fd);
+      break;
+    }
+    accepted_fds_.push_back(fd);
     threads_.emplace_back([this, fd] { reader_loop(fd); });
   }
 }
@@ -118,10 +137,19 @@ void TcpTransport::reader_loop(int fd) {
       break;
     if (sink_) sink_(std::move(msg));
   }
+  {
+    // deregister before close so stop() never shuts down a recycled fd
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (auto it = accepted_fds_.begin(); it != accepted_fds_.end(); ++it)
+      if (*it == fd) {
+        accepted_fds_.erase(it);
+        break;
+      }
+  }
   ::close(fd);
 }
 
-int TcpTransport::connect_to(uint32_t dst) {
+int TcpTransport::connect_to(uint32_t dst, int max_attempts) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(uint16_t(base_port_ + int(dst)));
@@ -133,7 +161,7 @@ int TcpTransport::connect_to(uint32_t dst) {
   // configure time; we tolerate startup skew instead).  A fresh socket
   // per attempt — after a failed connect(2) the fd is in an unspecified
   // state and further connects on it can fail instantly.
-  for (int attempt = 0; attempt < 400; ++attempt) {
+  for (int attempt = 0; attempt < max_attempts; ++attempt) {
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
@@ -145,6 +173,24 @@ int TcpTransport::connect_to(uint32_t dst) {
     std::this_thread::sleep_for(std::chrono::milliseconds(25));
   }
   return -1;
+}
+
+int TcpTransport::open_session(uint32_t dst) {
+  if (dst >= peer_fds_.size()) return -1;
+  std::lock_guard<std::mutex> g(peer_mu_[dst]);
+  if (peer_fds_[dst] >= 0) return 0;  // already open: success no-op
+  peer_fds_[dst] = connect_to(dst, /*max_attempts=*/80);  // ~2 s window
+  return peer_fds_[dst] >= 0 ? 0 : -1;
+}
+
+int TcpTransport::close_session(uint32_t dst) {
+  if (dst >= peer_fds_.size()) return -1;
+  std::lock_guard<std::mutex> g(peer_mu_[dst]);
+  if (peer_fds_[dst] < 0) return -1;  // nothing open on this session
+  ::shutdown(peer_fds_[dst], SHUT_RDWR);
+  ::close(peer_fds_[dst]);
+  peer_fds_[dst] = -1;
+  return 0;
 }
 
 void TcpTransport::send(uint32_t dst, Message&& msg) {

@@ -32,6 +32,25 @@ class Transport {
   virtual void send(uint32_t global_dst, Message&& msg) = 0;
   virtual void start(Sink sink) = 0;
   virtual void stop() = 0;
+
+  // ---- explicit session lifecycle (reference tcp_session_handler +
+  // driver open_port/open_con/close_con, accl.hpp:1069-1083).  Session
+  // transports (TCP) implement real bring-up/teardown with surfaced
+  // errors; connectionless rungs (inproc hub, datagram) report success
+  // — there is nothing to open, exactly like the reference's UDP/RDMA
+  // designs which ship without the session handler kernel. ----
+  // Returns 0 on success, -1 on connection failure.
+  virtual int open_session(uint32_t global_dst) {
+    (void)global_dst;
+    return 0;
+  }
+  // Returns 0 if a session was closed, -1 if none was open.
+  virtual int close_session(uint32_t global_dst) {
+    (void)global_dst;
+    return 0;
+  }
+  // open_port: is the inbound endpoint live?
+  virtual bool listening() const { return true; }
 };
 
 // Shared in-process hub: global rank -> sink.
@@ -85,9 +104,17 @@ class TcpTransport : public Transport {
   void send(uint32_t dst, Message&& msg) override;
   void start(Sink sink) override;
   void stop() override;
+  // Explicit session bring-up: ONE bounded connect attempt window
+  // (~2 s) so a dead peer surfaces as an error instead of the lazy
+  // path's long startup-skew retry.  Re-opening an open session is a
+  // success no-op (the reference session handler returns the existing
+  // session's status).
+  int open_session(uint32_t dst) override;
+  int close_session(uint32_t dst) override;
+  bool listening() const override { return listen_fd_ >= 0; }
 
  private:
-  int connect_to(uint32_t dst);
+  int connect_to(uint32_t dst, int max_attempts = 400);
   void accept_loop();
   void reader_loop(int fd);
 
@@ -100,6 +127,7 @@ class TcpTransport : public Transport {
   std::atomic<bool> running_{false};
   std::vector<std::thread> threads_;
   std::mutex conn_mu_;
+  std::vector<int> accepted_fds_;  // live inbound sockets (conn_mu_)
 };
 
 }  // namespace accl

@@ -509,6 +509,113 @@ static void test_barrier_and_nop(ACCL& a, int rank) {
   if (a.last_duration_ns() < 0) throw std::runtime_error("perf counter");
 }
 
+static void test_p2p_buffer(ACCL& a, int rank) {
+  // Reference test_copy_p2p (test.cpp:63-85) + the wire-bypass
+  // property: a rendezvous send landing in a peer's p2p buffer must
+  // move ZERO payload bytes over the transport (direct peer-devicemem
+  // write, fpgabufferp2p.hpp role) — only the small RNDZVS_INIT
+  // control message crosses.  The p2p buffer's host view is a direct
+  // mapping: the landed data is visible WITHOUT sync_from_device.
+  const uint32_t N = MAX_EAGER / 4 + 64;  // rendezvous-sized
+  auto v = fill(N, 0, 55);
+  if (rank == 0) {
+    auto src = a.create_buffer<float>(N);
+    std::memcpy(src->data(), v.data(), N * 4);
+    uint64_t m0, b0, m1, b1;
+    a.engine()->tx_stats(&m0, &b0);
+    a.send(*src, N, 1, 11);
+    a.engine()->tx_stats(&m1, &b1);
+    if (b1 != b0)
+      throw std::runtime_error("p2p rendezvous send moved " +
+                               std::to_string(b1 - b0) +
+                               " payload bytes over the wire");
+  } else if (rank == 1) {
+    auto dst = a.create_buffer_p2p<float>(N);
+    a.recv(*dst, N, 0, 11);
+    // NO sync_from_device: the mapping is the device memory
+    for (uint32_t i = 0; i < N; ++i)
+      expect_close(dst->data()[i], v[i], 0.f, "p2p landing");
+  }
+  // local copy into an own p2p buffer (the reference's test shape)
+  auto op = a.create_buffer<float>(64);
+  auto p2p = a.create_buffer_p2p<float>(64);
+  auto w = fill(64, rank, 56);
+  std::memcpy(op->data(), w.data(), 64 * 4);
+  a.copy(*op, *p2p, 64);
+  for (uint32_t i = 0; i < 64; ++i)
+    expect_close(p2p->data()[i], w[i], 0.f, "copy_p2p");
+}
+
+static void test_rendezvous_latency(ACCL& a, int rank) {
+  // Contended-rendezvous pacing guard: every rendezvous call takes at
+  // least one NotReady retry (the receiver's address must cross the
+  // wire), so a fixed retry sleep puts a hard floor under ping-pong
+  // latency — the old 200 us pacing made each round >= ~400 us.  The
+  // adaptive spin-then-yield pacing (engine.cpp loop()) must keep the
+  // common fast path in the tens of microseconds; assert the best
+  // batch stays clearly below the old floor so a pacing regression
+  // cannot hide in CI noise (fw analog: the retry round-robin has no
+  // sleep at all, fw :2264-2288).
+  const uint32_t N = MAX_EAGER / 4 + 64;  // just past eager: rendezvous
+  const int ROUNDS = 50, BATCHES = 3;
+  if (rank > 1) return;
+  auto buf = a.create_buffer<float>(N);
+  auto v = fill(N, rank, 77);
+  std::memcpy(buf->data(), v.data(), N * 4);
+  // machine-speed proxy: an EAGER ping-pong round on the same world
+  // carries everything EXCEPT the rendezvous retry path (call submit,
+  // engine dispatch, wire hop, driver wait) — on a loaded CI box both
+  // numbers inflate together, so the guard is a ratio, not an absolute
+  // (repo perf-guard convention, best-of-N both sides)
+  const uint32_t NE = 64;  // well under the eager threshold
+  auto ebuf = a.create_buffer<float>(NE);
+  auto round_us = [&](auto&& one_round, int rounds) {
+    auto t0 = std::chrono::steady_clock::now();
+    for (int i = 0; i < rounds; ++i) one_round();
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now() - t0)
+               .count() /
+           double(rounds);
+  };
+  double best_rndzv = 1e30, best_eager = 1e30;
+  for (int b = 0; b < BATCHES; ++b) {
+    double eager = round_us(
+        [&] {
+          if (rank == 0) {
+            a.send(*ebuf, NE, 1, 5);
+            a.recv(*ebuf, NE, 1, 6);
+          } else {
+            a.recv(*ebuf, NE, 0, 5);
+            a.send(*ebuf, NE, 0, 6);
+          }
+        },
+        ROUNDS);
+    double rndzv = round_us(
+        [&] {
+          if (rank == 0) {
+            a.send(*buf, N, 1, 7);
+            a.recv(*buf, N, 1, 8);
+          } else {
+            a.recv(*buf, N, 0, 7);
+            a.send(*buf, N, 0, 8);
+          }
+        },
+        ROUNDS);
+    best_eager = std::min(best_eager, eager);
+    best_rndzv = std::min(best_rndzv, rndzv);
+  }
+  // old fixed 200 us retry sleep put >= ~400 us under every rendezvous
+  // round regardless of machine speed — an absolute floor that dwarfs
+  // the eager round.  Adaptive pacing must keep the rendezvous round
+  // within a small multiple of eager plus slack for the extra protocol
+  // legs (INIT + one-sided write + completion).
+  if (best_rndzv > 8.0 * best_eager + 150.0)
+    throw std::runtime_error(
+        "contended rendezvous round " + std::to_string(best_rndzv) +
+        " us vs eager " + std::to_string(best_eager) +
+        " us (pacing regression? old fixed-sleep floor was >= ~400 us)");
+}
+
 // ---------------------------------------------------------------------------
 // harness
 // ---------------------------------------------------------------------------
@@ -526,6 +633,12 @@ struct World {
       engines.push_back(std::make_unique<Engine>(
           uint32_t(r), 64ull << 20,
           std::make_unique<InprocTransport>(hub, r)));
+    // shared address space: enable the direct p2p landing (sessions
+    // are rank ids), same wiring as the capi inproc world
+    for (auto& e : engines)
+      e->set_peer_hook([this](uint32_t session) -> Engine* {
+        return session < engines.size() ? engines[session].get() : nullptr;
+      });
     for (int r = 0; r < NRANKS; ++r) {
       accls.push_back(std::make_unique<ACCL>(engines[r].get()));
       std::vector<uint32_t> sessions;
@@ -575,6 +688,8 @@ int main() {
       {"host_buffers", test_host_buffers},
       {"count_thresholds", test_count_thresholds},
       {"barrier_and_nop", test_barrier_and_nop},
+      {"p2p_buffer", test_p2p_buffer},
+      {"rendezvous_latency", test_rendezvous_latency},
   };
 
   int failed_cases = 0;
