@@ -153,8 +153,46 @@ def _measure(platform: str) -> dict:
         detail["xla_add_gbps"] = round(nbytes / dts["xla"] / 1e9, 2)
         detail["roofline_frac"] = round(dts["xla"] / dt, 3)
         detail["pallas_block_rows"] = best_rows
+        detail["tpu_only_tests"] = _run_tpu_only_tests()
         result["detail"] = detail
     return result
+
+
+def _run_tpu_only_tests() -> str:
+    """Execute the TPU-gated tests (skipif(not ON_TPU) — e.g. stochastic
+    rounding, which needs the hardware PRNG) in-process on the claimed
+    chip, so no test in the suite is permanently skipped on every rung.
+    ACCL_TEST_ON_TPU=1 makes conftest.py keep the live platform instead
+    of pinning the virtual-CPU mesh.  Best-effort: the result string is
+    recorded in the bench detail for the round record."""
+    import os
+
+    os.environ["ACCL_TEST_ON_TPU"] = "1"
+    try:
+        import pytest
+
+        class _Count:
+            passed = 0
+            skipped = 0
+
+            def pytest_runtest_logreport(self, report):
+                if report.when == "call" and report.passed:
+                    _Count.passed += 1
+                if report.skipped:
+                    _Count.skipped += 1
+
+        rc = pytest.main([
+            "tests/test_pallas_ops.py", "-q", "-x", "--no-header", "-p",
+            "no:cacheprovider", "-k", "stochastic",
+        ], plugins=[_Count()])
+        # "all skipped" must NOT read as success — the whole point is
+        # that these tests execute somewhere
+        if rc == 0 and _Count.passed > 0:
+            return f"passed:{_Count.passed}"
+        return (f"pytest_exit_{int(rc)} passed:{_Count.passed} "
+                f"skipped:{_Count.skipped}")
+    except Exception as e:  # noqa: BLE001 — never sink the bench
+        return f"{type(e).__name__}: {e}"
 
 
 def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:

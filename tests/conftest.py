@@ -4,10 +4,18 @@ Forces an 8-device virtual CPU platform *before* jax initializes, so the
 multi-chip sharding paths (mesh collectives, shard_map, pjit) run in CI
 without TPU hardware — the TPU translation of the reference's
 run-everything-against-the-CPU-emulator strategy (SURVEY §4).
+
+Set ACCL_TEST_ON_TPU=1 to SKIP the CPU pin and run against whatever
+platform jax claims — how bench.py's TPU worker executes the
+TPU-marked tests (stochastic rounding et al.) on the real chip, so no
+test is permanently skipped on every rung.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+_ON_TPU = os.environ.get("ACCL_TEST_ON_TPU") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 # Loaded CI hosts can stall a rank long enough for the 1 s reference
 # receive budget to fire spuriously; widen the *default* engine timeout
 # for tests (tests exercising timeout behavior pass explicit values).
@@ -23,7 +31,8 @@ if "xla_force_host_platform_device_count" not in flags:
 # actually pins tests to the virtual CPU mesh.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

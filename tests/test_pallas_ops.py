@@ -21,7 +21,9 @@ from accl_tpu.ops import (
 from accl_tpu.ops.fused import pallas_matmul
 from accl_tpu.parallel import make_mesh
 
-ON_TPU = jax.default_backend() == "tpu"
+# any non-CPU backend is the real chip (the bench chip claims as
+# "axon", not "tpu" — same idiom as bench.py's on_tpu check)
+ON_TPU = jax.default_backend() not in ("cpu",)
 INTERP = not ON_TPU
 
 
