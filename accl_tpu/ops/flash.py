@@ -92,7 +92,8 @@ def _finalize(acc, m, l, o_ref, lse_ref):
 
 def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                        *, scale: float, causal: bool, block_q: int,
-                       block_k: int, chunk_k: int, nk: int, mxu_dtype):
+                       block_k: int, chunk_k: int, nk: int, mxu_dtype,
+                       kv_resident: bool = False):
     """Streaming schedule: grid (bh, q_block, k_block); K/V blocks
     arrive per grid cell; the accumulator lives in VMEM scratch across
     the sequential k steps of one (bh, q_block) cell.  Each arriving
@@ -125,8 +126,12 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         for c in range(block_k // chunk_k):
             a, m_prev, l_prev = carry
             off = ik * block_k + c * chunk_k
-            kb = k_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
-            vb = v_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
+            # kv_resident: the refs hold the WHOLE row (the index map is
+            # pinned, so Pallas fetched it once per batch-head) and the
+            # block offset is applied here instead of by the pipeline
+            base = off if kv_resident else c * chunk_k
+            kb = k_ref[0, pl.ds(base, chunk_k), :].astype(mxu_dtype)
+            vb = v_ref[0, pl.ds(base, chunk_k), :].astype(mxu_dtype)
             mask = (iq * block_q, off) if masked else None
             carry = _softmax_fold(q, kb, vb, a, m_prev, l_prev,
                                   mask=mask, mxu_dtype=mxu_dtype)
@@ -186,10 +191,13 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
         def kv_chunk(off):
             return (kb_s[pl.ds(off, chunk_k), :],
                     vb_s[pl.ds(off, chunk_k), :])
-    else:  # input already in MXU dtype — read the block refs directly
+    else:
+        # no scratch: cast PER CHUNK like the grid schedule, so
+        # mxu_dtype always governs the matmul input format (a no-op
+        # when the input already arrives in MXU dtype)
         def kv_chunk(off):
-            return (k_ref[0, pl.ds(off, chunk_k), :],
-                    v_ref[0, pl.ds(off, chunk_k), :])
+            return (k_ref[0, pl.ds(off, chunk_k), :].astype(mxu_dtype),
+                    v_ref[0, pl.ds(off, chunk_k), :].astype(mxu_dtype))
 
     def step(j, carry, masked):
         # unrolled chunk run — `for c in range(...)` is static, letting
@@ -247,7 +255,8 @@ _RESIDENT_KV_BYTES = 6 << 20
 
 
 def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
-                       mxu_dtype, kernel, chunk_k=None):
+                       mxu_dtype, kernel, chunk_k=None,
+                       kv_cast_scratch=False):
     """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
     flattened — the splash-attention layout).  This is the zero-copy
     path: no transposes touch HBM; callers that keep activations packed
@@ -291,13 +300,16 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     scale = _LOG2E / float(D) ** 0.5
     vma = _vma_of(qp, kp, vp)
     mxu_dtype = jnp.dtype(mxu_dtype)
-    needs_cast = qp.dtype != mxu_dtype
+    # one-shot K/V cast scratch is OPT-IN: it trades the per-fold cast
+    # for a serialized q-block order ("arbitrary" semantics), a tradeoff
+    # that must be measured per chip generation
+    needs_cast = kv_cast_scratch and qp.dtype != mxu_dtype
 
     kv_bytes = 2 * Tk * D * (qp.dtype.itemsize
                              + (mxu_dtype.itemsize if needs_cast else 0))
     if kernel == "auto":
         kernel = ("resident" if kv_bytes <= _RESIDENT_KV_BYTES else "grid")
-    if kernel not in ("resident", "grid"):
+    if kernel not in ("resident", "grid", "grid_resident"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
 
     q_spec3 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
@@ -338,13 +350,23 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         )(qp, kp, vp)
     else:
         grid = (N, nq, nk)
-        kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
-                               memory_space=pltpu.VMEM)
+        kv_resident = kernel == "grid_resident"
+        if kv_resident:
+            # whole-row K/V block with a PINNED index map: Pallas only
+            # re-DMAs a block whose index changes, so the row is fetched
+            # once per batch-head while the cells keep the grid
+            # schedule's static predication and scratch carries
+            kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i, j: (b, 0, 0),
+                                   memory_space=pltpu.VMEM)
+        else:
+            kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                                   memory_space=pltpu.VMEM)
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)
         kfn = functools.partial(
             _flash_kernel_grid, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, chunk_k=ck, nk=nk, mxu_dtype=mxu_dtype)
+            block_k=bk, chunk_k=ck, nk=nk, mxu_dtype=mxu_dtype,
+            kv_resident=kv_resident)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec3, kv_spec, kv_spec],
@@ -421,12 +443,13 @@ def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "chunk_k"))
+                                    "chunk_k", "kv_cast_scratch"))
 def flash_attention_packed(q, k, v, causal: bool = False,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False,
                            mxu_dtype=jnp.bfloat16, kernel: str = "auto",
-                           chunk_k: int | None = None):
+                           chunk_k: int | None = None,
+                           kv_cast_scratch: bool = False):
     """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
     with N = batch x heads flattened (the splash-attention layout).
     Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
@@ -434,21 +457,24 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     family does between its projections) get the kernel at full rate.
     Returns out [N, T, D]."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
-                                   interpret, mxu_dtype, kernel, chunk_k)
+                                   interpret, mxu_dtype, kernel, chunk_k,
+                                   kv_cast_scratch)
     return out
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "chunk_k"))
+                                    "chunk_k", "kv_cast_scratch"))
 def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                block_q: int = 256, block_k: int = 512,
                                interpret: bool = False,
                                mxu_dtype=jnp.bfloat16, kernel: str = "auto",
-                               chunk_k: int | None = None):
+                               chunk_k: int | None = None,
+                               kv_cast_scratch: bool = False):
     """Head-packed [N, T, D] variant returning (out [N, T, D],
     lse [N, T] fp32) — the distributed callers' entry (ring attention
     folds shard partials via the lse)."""
     return _flash_call_packed(q, k, v, causal, block_q, block_k,
-                              interpret, mxu_dtype, kernel, chunk_k)
+                              interpret, mxu_dtype, kernel, chunk_k,
+                              kv_cast_scratch)

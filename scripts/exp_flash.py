@@ -75,16 +75,33 @@ def main() -> None:
         qp = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
         kp = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
         vp = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-        run("packed_f32_scratch", lambda x, kk, vv: fap(x, kk, vv, causal=True),
+        run("packed_f32", lambda x, kk, vv: fap(x, kk, vv, causal=True),
+            qp, (kp, vp))
+        run("packed_f32_scratch",
+            lambda x, kk, vv: fap(x, kk, vv, causal=True,
+                                  kv_cast_scratch=True),
             qp, (kp, vp))
         if "bf16" in which:
             qb, kb, vb = (qp.astype(jnp.bfloat16), kp.astype(jnp.bfloat16),
                           vp.astype(jnp.bfloat16))
-            for ck in (None, 256, 128):
+            for ck in (None, 256):
                 run(f"packed_bf16_ck{ck}",
                     lambda x, kk, vv, c=ck: fap(x, kk, vv, causal=True,
                                                 chunk_k=c),
                     qb, (kb, vb))
+            for ck in (None, 256):
+                run(f"gridres_bf16_ck{ck}",
+                    lambda x, kk, vv, c=ck: fap(x, kk, vv, causal=True,
+                                                kernel="grid_resident",
+                                                chunk_k=c),
+                    qb, (kb, vb))
+            run("gridres_bf16_bq512",
+                lambda x, kk, vv: fap(x, kk, vv, causal=True,
+                                      kernel="grid_resident", block_q=512),
+                qb, (kb, vb))
+            run("packed_bf16_bq512",
+                lambda x, kk, vv: fap(x, kk, vv, causal=True, block_q=512),
+                qb, (kb, vb))
 
     if "splash" in which:
         # calibration: jax's bundled splash kernel, [H, T, D] layout,

@@ -87,6 +87,25 @@ def test_flash_chunked_subfolds_match(causal, kernel):
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grid_resident_matches_grid(causal):
+    # grid_resident = grid schedule (static predicated cells, scratch
+    # carries) with the whole K/V row pinned via an unchanging block
+    # index — must be bit-identical to the streaming grid schedule
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(15)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64,
+              mxu_dtype=jnp.float32, interpret=True)
+    a, la = flash_attention_packed_lse(q, k, v, kernel="grid_resident",
+                                       **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, kernel="grid", **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
 def test_flash_chunk_snaps_to_divisor():
     # chunk snapping: 12 does not divide 64 -> largest divisor <= 12 and
     # >= 8 rows; must not decay below the tile floor (12->3->1 bug)
@@ -106,6 +125,26 @@ def test_flash_chunk_snaps_to_divisor():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_flash_resident_mixed_dtype_matches_grid(causal):
+    # regression: with f32 inputs and bf16 mxu_dtype and NO cast
+    # scratch, the resident kernel must still cast K/V per chunk like
+    # the grid schedule — an earlier version read raw f32 blocks and
+    # silently ignored mxu_dtype (resident vs grid diverged by ~3e-3)
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(17)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64,
+              mxu_dtype=jnp.bfloat16, interpret=True)
+    a, la = flash_attention_packed_lse(q, k, v, kernel="resident", **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, kernel="grid", **kw)
+    np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                  np.asarray(b, np.float32))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_flash_resident_cast_scratch(causal):
     # exercises the resident kernel's needs_cast path: input dtype
     # (bf16) differs from mxu_dtype (f32), so K/V are cast ONCE into
@@ -120,7 +159,8 @@ def test_flash_resident_cast_scratch(causal):
     q, k, v = mk(), mk(), mk()
     got, lse_r = flash_attention_packed_lse(
         q, k, v, causal=causal, block_q=64, block_k=128,
-        mxu_dtype=jnp.float32, kernel="resident", interpret=True)
+        mxu_dtype=jnp.float32, kernel="resident", interpret=True,
+        kv_cast_scratch=True)
     ref, lse_g = flash_attention_packed_lse(
         q, k, v, causal=causal, block_q=64, block_k=128,
         mxu_dtype=jnp.float32, kernel="grid", interpret=True)
