@@ -102,10 +102,29 @@ def _run_once(world, coll: str, count: int, dtype, root: int) -> float:
     P = world.nranks
 
     def body(accl, rank):
+        made = []
+
+        def mk(factory, *a):
+            buf = factory(*a)
+            made.append(buf)
+            return buf
+
+        try:
+            return _timed_body(accl, rank, mk)
+        finally:
+            # the emulator rungs have a real device-memory allocator:
+            # a full 2^4..2^19 sweep leaks gigabytes without this and
+            # starves the engine's own scratch allocations mid-schedule
+            for buf in made:
+                free = getattr(buf, "free", None)
+                if free is not None:
+                    free()
+
+    def _timed_body(accl, rank, mk):
         data = np.full(count, rank + 1, dtype)
         if coll == "sendrecv":
-            src = accl.create_buffer_like(data)
-            dst = accl.create_buffer(count, dtype)
+            src = mk(accl.create_buffer_like, data)
+            dst = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             nxt, prv = (rank + 1) % P, (rank - 1) % P
             sreq = accl.send(src, count, nxt, tag=1, run_async=True)
@@ -113,49 +132,49 @@ def _run_once(world, coll: str, count: int, dtype, root: int) -> float:
             sreq.wait(60)
             return time.perf_counter() - t0
         if coll == "bcast":
-            buf = accl.create_buffer_like(data)
+            buf = mk(accl.create_buffer_like, data)
             t0 = time.perf_counter()
             accl.bcast(buf, count, root)
             return time.perf_counter() - t0
         if coll == "scatter":
-            send = accl.create_buffer_like(np.tile(data, P))
-            recv = accl.create_buffer(count, dtype)
+            send = mk(accl.create_buffer_like, np.tile(data, P))
+            recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             accl.scatter(send, recv, count, root)
             return time.perf_counter() - t0
         if coll == "gather":
-            send = accl.create_buffer_like(data)
-            recv = accl.create_buffer(count * P, dtype)
+            send = mk(accl.create_buffer_like, data)
+            recv = mk(accl.create_buffer, count * P, dtype)
             t0 = time.perf_counter()
             accl.gather(send, recv, count, root)
             return time.perf_counter() - t0
         if coll == "allgather":
-            send = accl.create_buffer_like(data)
-            recv = accl.create_buffer(count * P, dtype)
+            send = mk(accl.create_buffer_like, data)
+            recv = mk(accl.create_buffer, count * P, dtype)
             t0 = time.perf_counter()
             accl.allgather(send, recv, count)
             return time.perf_counter() - t0
         if coll == "reduce":
-            send = accl.create_buffer_like(data)
-            recv = accl.create_buffer(count, dtype)
+            send = mk(accl.create_buffer_like, data)
+            recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             accl.reduce(send, recv, count, root, ReduceFunction.SUM)
             return time.perf_counter() - t0
         if coll == "allreduce":
-            send = accl.create_buffer_like(data)
-            recv = accl.create_buffer(count, dtype)
+            send = mk(accl.create_buffer_like, data)
+            recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             accl.allreduce(send, recv, count, ReduceFunction.SUM)
             return time.perf_counter() - t0
         if coll == "reduce_scatter":
-            send = accl.create_buffer_like(np.tile(data, P))
-            recv = accl.create_buffer(count, dtype)
+            send = mk(accl.create_buffer_like, np.tile(data, P))
+            recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             accl.reduce_scatter(send, recv, count, ReduceFunction.SUM)
             return time.perf_counter() - t0
         if coll == "alltoall":
-            send = accl.create_buffer_like(np.tile(data, P))
-            recv = accl.create_buffer(count * P, dtype)
+            send = mk(accl.create_buffer_like, np.tile(data, P))
+            recv = mk(accl.create_buffer, count * P, dtype)
             t0 = time.perf_counter()
             accl.alltoall(send, recv, count)
             return time.perf_counter() - t0

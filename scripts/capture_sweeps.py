@@ -10,6 +10,10 @@ test/host/xrt/src/bench.cpp:25-61 + parse_bench_results.py):
   sweep_rdma_r{N}.csv      same matrix over the queue-pair RDMA rung
   sweep_tpu8_r{N}.csv      driver busbw over the TPU backend gang
                            scheduler on the 8-virtual-device CPU mesh
+  driver_vs_raw_r{N}.csv   allreduce latency through the FULL driver
+                           stack vs a bare jitted shard_map psum on the
+                           same mesh (the Coyote harness's ACCL-vs-MPI
+                           comparison role, plot.py:10-44)
   pipeline_ab_r{N}.csv     eager egress pipelining A/B (depth 1 vs 3)
                            across message sizes on the emulator
 
@@ -33,9 +37,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--stages", default="emu,dgram,rdma,tpu8,vsraw,pipeline",
+                    help="comma list of stages to run")
+    ap.add_argument("--maxpow", type=int, default=19,
+                    help="largest 2^k element count (BASELINE metric of "
+                         "record: 2^4..2^19, reference bench.cpp:25-61)")
     ap.add_argument("--outdir", default=os.path.join("bench", "results"))
     args = ap.parse_args()
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
 
@@ -48,6 +62,7 @@ def main() -> None:
 
     os.makedirs(args.outdir, exist_ok=True)
     tag = f"r{args.round:02d}"
+    stages = set(args.stages.split(","))
 
     # 1. emulator rung (counts kept moderate: 1 core drives 4 engines)
     def raise_timeouts(w):
@@ -58,47 +73,152 @@ def main() -> None:
             a.call_timeout_s = 180.0
         return w
 
-    cfg = SweepConfig(count_pows=tuple(range(4, 15)), repetitions=3)
-    path = os.path.join(args.outdir, f"sweep_emu_{tag}.csv")
-    # rx pool provisioned for the worst eager case: (P-1) peers x 16
-    # segments in flight for alltoall at the 16 KB eager ceiling (the
-    # reference bench sizes its spare-buffer pool the same way and its
-    # tests SKIP when under-provisioned, test.cpp:279)
-    with EmuWorld(4, n_egr_rx_bufs=64, max_eager_size=16384,
-                  max_rendezvous_size=1 << 22) as w, \
-            open(path, "w", newline="") as f:
-        run_sweep(raise_timeouts(w), cfg, writer=f)
-    print(f"wrote {path}")
+    cfg = SweepConfig(count_pows=tuple(range(4, args.maxpow + 1)),
+                      repetitions=3)
+    if "emu" in stages:
+        path = os.path.join(args.outdir, f"sweep_emu_{tag}.csv")
+        # rx pool provisioned for the worst eager case: (P-1) peers x 16
+        # segments in flight for alltoall at the 16 KB eager ceiling (the
+        # reference bench sizes its spare-buffer pool the same way and its
+        # tests SKIP when under-provisioned, test.cpp:279)
+        with EmuWorld(4, devmem_bytes=256 << 20,
+                      n_egr_rx_bufs=64, max_eager_size=16384,
+                      max_rendezvous_size=64 << 20) as w, \
+                open(path, "w", newline="") as f:
+            run_sweep(raise_timeouts(w), cfg, writer=f)
+        print(f"wrote {path}")
 
     # 2. datagram rung (fragmentation + reorder on every transfer)
-    path = os.path.join(args.outdir, f"sweep_dgram_{tag}.csv")
-    with EmuWorld(4, transport="dgram", mtu=512, reorder_window=8,
-                  n_egr_rx_bufs=64, max_eager_size=16384,
-                  max_rendezvous_size=1 << 22) as w, \
-            open(path, "w", newline="") as f:
-        run_sweep(raise_timeouts(w), cfg, writer=f)
-    print(f"wrote {path}")
+    if "dgram" in stages:
+        path = os.path.join(args.outdir, f"sweep_dgram_{tag}.csv")
+        with EmuWorld(4, transport="dgram", mtu=512, reorder_window=8,
+                      devmem_bytes=256 << 20,
+                      n_egr_rx_bufs=64, max_eager_size=16384,
+                      max_rendezvous_size=64 << 20) as w, \
+                open(path, "w", newline="") as f:
+            run_sweep(raise_timeouts(w), cfg, writer=f)
+        print(f"wrote {path}")
 
     # 2b. RDMA rung (queue pairs; one-sided memory plane for rendezvous)
-    path = os.path.join(args.outdir, f"sweep_rdma_{tag}.csv")
-    with EmuWorld(4, transport="rdma", n_egr_rx_bufs=64,
-                  max_eager_size=16384, max_rendezvous_size=1 << 22) as w, \
-            open(path, "w", newline="") as f:
-        run_sweep(raise_timeouts(w), cfg, writer=f)
-    print(f"wrote {path}")
+    if "rdma" in stages:
+        path = os.path.join(args.outdir, f"sweep_rdma_{tag}.csv")
+        with EmuWorld(4, transport="rdma", devmem_bytes=256 << 20,
+                      n_egr_rx_bufs=64,
+                      max_eager_size=16384,
+                      max_rendezvous_size=64 << 20) as w, \
+                open(path, "w", newline="") as f:
+            run_sweep(raise_timeouts(w), cfg, writer=f)
+        print(f"wrote {path}")
 
     # 3. TPU backend gang scheduler on the virtual 8-device mesh
     from accl_tpu.backends.tpu import TpuWorld
 
-    path = os.path.join(args.outdir, f"sweep_tpu8_{tag}.csv")
+    if "tpu8" in stages:
+        path = os.path.join(args.outdir, f"sweep_tpu8_{tag}.csv")
+        with TpuWorld(8) as w, open(path, "w", newline="") as f:
+            # the full-range sweep rides the XLA collective path: on
+            # this VIRTUAL rung the ring kernels execute under the
+            # Pallas interpreter, whose per-element cost at multi-MB
+            # payloads is minutes per call and measures the
+            # interpreter, not the driver.  The ring path's correctness
+            # at 8 ranks is certified by dryrun_multichip (forced
+            # threshold 0); its hardware timing belongs to the
+            # real-chip bench.
+            w.engine.ring_threshold_bytes = 1 << 60
+            for a in w.accls:
+                a.call_timeout_s = 180.0  # 1 core, 8 gang members
+            run_sweep(w, SweepConfig(
+                count_pows=tuple(range(4, args.maxpow + 1)),
+                repetitions=3), writer=f)
+        print(f"wrote {path}")
+
+    # 3b + 4: the remaining stages self-select below
+    if "vsraw" in stages:
+        _vsraw_stage(args, tag, TpuWorld)
+    _pipeline_stage(args, tag, stages, EmuWorld)
+
+
+def _vsraw_stage(args, tag, TpuWorld) -> None:
+    # driver path vs raw XLA collective across the sweep — the Coyote
+    # harness's ACCL-vs-MPI comparison role (reference
+    # test/host/Coyote/run_scripts/plot.py:10-44): same mesh, same
+    # payload, allreduce through the full driver stack vs a bare jitted
+    # shard_map psum.  The ratio column is the driver's end-to-end
+    # overhead at each size.
+    import jax as _jax
+    import jax.numpy as _jnp
+    import numpy as _np
+    from jax.sharding import (Mesh as _Mesh, NamedSharding as _NS,
+                              PartitionSpec as _P)
+
+    path = os.path.join(args.outdir, f"driver_vs_raw_{tag}.csv")
     with TpuWorld(8) as w, open(path, "w", newline="") as f:
-        run_sweep(w, SweepConfig(count_pows=tuple(range(4, 15)),
-                                 repetitions=3), writer=f)
+        w.engine.ring_threshold_bytes = 1 << 60
+        for a in w.accls:
+            a.call_timeout_s = 180.0
+        wcsv = csv.DictWriter(f, fieldnames=[
+            "count", "bytes", "driver_us", "raw_us", "overhead_x"])
+        wcsv.writeheader()
+
+        devs = _jax.devices()[:8]
+        mesh = _Mesh(_np.array(devs), ("rank",))
+
+        def driver_best(count, reps=5):
+            def body(accl, rank):
+                import numpy as np
+                s = accl.create_buffer(count, np.float32)
+                r = accl.create_buffer(count, np.float32)
+                s.host[:] = rank
+                from accl_tpu import ReduceFunction
+                accl.allreduce(s, r, count, ReduceFunction.SUM)  # warm
+                best = 1e30
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    accl.allreduce(s, r, count, ReduceFunction.SUM,
+                                   from_fpga=True, to_fpga=True)
+                    best = min(best, time.perf_counter() - t0)
+                for b in (s, r):
+                    free = getattr(b, "free", None)
+                    if free:
+                        free()
+                return best
+            return max(w.run(body))
+
+        def raw_best(count, reps=5):
+            x = _jax.device_put(
+                _jnp.zeros((8 * count,), _jnp.float32),
+                _NS(mesh, _P("rank")))
+            fn = _jax.jit(_jax.shard_map(
+                lambda v: _jax.lax.psum(v, "rank"), mesh=mesh,
+                in_specs=_P("rank"), out_specs=_P("rank")))
+            _jax.block_until_ready(fn(x))
+            best = 1e30
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _jax.block_until_ready(fn(x))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        for pw in range(4, args.maxpow + 1):
+            count = 1 << pw
+            d_us = driver_best(count) * 1e6
+            r_us = raw_best(count) * 1e6
+            wcsv.writerow({
+                "count": count,
+                "bytes": count * 4,
+                "driver_us": round(d_us, 1),
+                "raw_us": round(r_us, 1),
+                "overhead_x": round(d_us / max(r_us, 1e-9), 2),
+            })
     print(f"wrote {path}")
 
+
+def _pipeline_stage(args, tag, stages, EmuWorld) -> None:
     # 4. egress pipelining A/B: depth 1 (strictly serial, the round-2
     #    engine's behavior) vs depth 3 (reference discipline) across
     #    multi-segment message sizes
+    if "pipeline" not in stages:
+        return
     path = os.path.join(args.outdir, f"pipeline_ab_{tag}.csv")
     with open(path, "w", newline="") as f:
         wcsv = csv.DictWriter(f, fieldnames=[
@@ -106,7 +226,7 @@ def main() -> None:
         wcsv.writeheader()
         for depth in (1, 3):
             with EmuWorld(2, max_eager_size=1 << 20,
-                          max_rendezvous_size=1 << 22) as w:
+                          max_rendezvous_size=64 << 20) as w:
                 def fn(accl, rank, count, depth=depth):
                     import numpy as np
                     accl.set_tuning(3, depth)  # EGRESS_PIPELINE_DEPTH

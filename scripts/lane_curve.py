@@ -1,0 +1,96 @@
+"""Single-chip on-path-reduction-lane curve: effective reduction
+bandwidth vs message size, 1 KB - 1 GB (BASELINE.md metric of record's
+single-chip leg; reference role: the CCLO's 64 B/cycle reduction
+datapath, kernels/plugins/reduce_ops.cpp, whose ceiling is flat at
+16 GB/s — here the curve shows the latency floor at small sizes and
+the HBM roofline at large ones).
+
+Measures accl_tpu.ops.reduce_ops.pallas_add (3 HBM streams per element)
+with the chained in-jit methodology of bench.py, A/B-interleaved with
+the plain XLA add as the same-window roofline reference.
+
+Writes bench/results/lane_curve_r{N}.csv.  Run on the real chip:
+  python scripts/lane_curve.py --round 4
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--outdir", default=os.path.join("bench", "results"))
+    ap.add_argument("--max-bytes", type=int, default=1 << 30)
+    ap.add_argument("--platform", default="",
+                    help="pin jax platform at runtime (cpu for a smoke "
+                         "run; empty = whatever the site claims)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    print(f"[lane_curve] backend={backend}", file=sys.stderr)
+
+    from accl_tpu.bench.timing import make_harness
+    from accl_tpu.ops.reduce_ops import pallas_add
+
+    _probe, timed_chain, timed_chain_ab, sync_s = make_harness(jax, jnp)
+    interpret = backend == "cpu"
+
+    os.makedirs(args.outdir, exist_ok=True)
+    path = os.path.join(args.outdir, f"lane_curve_r{args.round:02d}.csv")
+    rows = []
+    nbytes = 1 << 10
+    while nbytes <= args.max_bytes:
+        n = nbytes // 4
+        rows_n = max(1, n // 128)
+        a = jax.random.normal(jax.random.PRNGKey(0), (rows_n, 128),
+                              jnp.float32)
+        b = jax.random.normal(jax.random.PRNGKey(1), (rows_n, 128),
+                              jnp.float32)
+        streams = 3 * a.size * 4  # read a, read b, write out
+        # enough chained iterations that device time dwarfs RTT jitter,
+        # bounded so huge sizes don't take minutes
+        est_ns = streams / 660e9 * 1e9 + 3000
+        iters = int(min(2048, max(8, 15e6 / est_ns)))
+        fns = {
+            "pallas": lambda x, bb: pallas_add(x, bb, interpret=interpret,
+                                               donate=True),
+            "xla": lambda x, bb: x + bb,
+        }
+        dts = timed_chain_ab(fns, a, iters, trials=4, consts=(b,))
+        row = {
+            "bytes": a.size * 4,
+            "iters": iters,
+            "lane_GBps": round(streams / dts["pallas"] / 1e9, 3),
+            "xla_GBps": round(streams / dts["xla"] / 1e9, 3),
+            "lane_us": round(dts["pallas"] * 1e6, 3),
+            "roofline_frac": round(dts["xla"] / dts["pallas"], 4),
+        }
+        rows.append(row)
+        print(f"[lane_curve] {row}", file=sys.stderr)
+        nbytes *= 4
+
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {path} ({len(rows)} sizes, platform={backend})")
+
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    main()
+    print(f"total {time.perf_counter() - t0:.0f}s", file=sys.stderr)

@@ -46,7 +46,7 @@ def load(path):
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument("--round", type=int, default=4)
     args = ap.parse_args()
     tag = f"r{args.round:02d}"
     outdir = os.path.join(ROOT, "bench", "results")
@@ -124,6 +124,34 @@ def main() -> None:
         ax.legend(fontsize=8)
         fig.tight_layout()
         p = os.path.join(outdir, f"pipeline_ab_{tag}.svg")
+        fig.savefig(p)
+        print(f"wrote {p}")
+
+    # 4. driver path vs raw XLA collective (the Coyote harness's
+    #    ACCL-vs-MPI comparison role, plot.py:10-44)
+    path = os.path.join(outdir, f"driver_vs_raw_{tag}.csv")
+    if os.path.exists(path):
+        xs, d_us, r_us = [], [], []
+        with open(path) as f:
+            for row in csv.DictReader(f):
+                xs.append(int(row["bytes"]))
+                d_us.append(float(row["driver_us"]))
+                r_us.append(float(row["raw_us"]))
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(xs, d_us, marker="o", ms=3,
+                label="driver path (descriptor -> gang -> collective)")
+        ax.plot(xs, r_us, marker="s", ms=3,
+                label="raw jitted shard_map psum")
+        ax.set_xscale("log", base=2)
+        ax.set_yscale("log")
+        ax.set_xlabel("message size (bytes)")
+        ax.set_ylabel("allreduce latency (us, best)")
+        ax.set_title("driver vs raw collective, 8-virtual-device mesh "
+                     f"(round {args.round})")
+        ax.grid(True, which="both", alpha=0.3)
+        ax.legend(fontsize=8)
+        fig.tight_layout()
+        p = os.path.join(outdir, f"driver_vs_raw_{tag}.svg")
         fig.savefig(p)
         print(f"wrote {p}")
 
