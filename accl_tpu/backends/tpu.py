@@ -36,6 +36,7 @@ from ..constants import (
     ACCLError,
     CCLOCall,
     CompressionFlags,
+    ErrorCode,
     Operation,
     ReduceFunction,
     StreamFlags,
@@ -273,10 +274,26 @@ class TpuEngine:
 
     # -- local ops -----------------------------------------------------
     def _exec_copy(self, rank: int, call: CCLOCall) -> None:
-        src, soff = self.resolve(rank, call.addr_0)
-        dst, doff = self.resolve(rank, call.addr_2)
         n = call.count
-        vals = src.dev[soff:soff + n]
+        # stream-flagged variants (reference copy_to_stream /
+        # copy_from_stream, accl.cpp:310 + stream flag algebra): OP0
+        # from the local compute-kernel queue, RES into the local
+        # kernel stream keyed by the descriptor tag
+        if call.stream_flags & StreamFlags.OP0_STREAM:
+            q_in = self._krnl_in[rank]
+            vals = q_in.popleft() if q_in else None
+            if vals is None or vals.shape[0] < n:
+                raise ACCLError(
+                    f"stream operand {0 if vals is None else vals.shape[0]}"
+                    f" elems < required {n}")
+            vals = vals[:n]
+        else:
+            src, soff = self.resolve(rank, call.addr_0)
+            vals = src.dev[soff:soff + n]
+        if call.stream_flags & StreamFlags.RES_STREAM:
+            self._push_stream(rank, call.tag, vals)
+            return
+        dst, doff = self.resolve(rank, call.addr_2)
         if vals.dtype != dst.dev.dtype:  # per-operand compression: the
             vals = vals.astype(dst.dev.dtype)  # quantize/dequantize lane
         dst.set_dev_range(doff, vals)
@@ -315,10 +332,7 @@ class TpuEngine:
         if call.stream_flags & StreamFlags.RES_STREAM:
             # stream_put: land in the destination's kernel stream
             moved = jax.device_put(data, self.devices[dst_rank])
-            key = (dst_rank, call.tag)
-            with self._stream_cv:
-                self._streams.setdefault(key, deque()).append(moved)
-                self._stream_cv.notify_all()
+            self._push_stream(dst_rank, call.tag, moved)
             request.complete(0, 1.0)
             return
         # buffered eager semantics: capture payload, complete the sender,
@@ -393,10 +407,7 @@ class TpuEngine:
                 # per-operand compression: land in the RES representation
                 moved = moved.astype(dst.dev.dtype)
             if call.stream_flags & StreamFlags.RES_STREAM:
-                key = (rank, call.tag)
-                with self._stream_cv:
-                    self._streams.setdefault(key, deque()).append(moved)
-                    self._stream_cv.notify_all()
+                self._push_stream(rank, call.tag, moved)
             else:
                 dst.set_dev_range(doff, moved)
             request.complete(0, 1.0)
@@ -406,6 +417,27 @@ class TpuEngine:
                            request: Request) -> None:
         members = self._comms[call.comm]
         P = len(members)
+        # an OP0_STREAM operand is RESERVED in the submitting rank's own
+        # thread, preserving the reference's call-order stream pairing —
+        # popping at gang-execution time (an arbitrary member's thread)
+        # would let a later local stream op on this rank steal it
+        krnl = None
+        if call.stream_flags & StreamFlags.OP0_STREAM:
+            in_len = call.count * (
+                P if Operation(call.scenario) in (
+                    Operation.scatter, Operation.reduce_scatter,
+                    Operation.alltoall) else 1)
+            q_in = self._krnl_in[rank]
+            krnl = q_in.popleft() if q_in else None
+            if krnl is None or krnl.shape[0] < in_len:
+                # silent truncation/zero-padding of a short stream
+                # operand would corrupt the reduction with retcode 0
+                request.description += (
+                    f" [stream operand {0 if krnl is None else krnl.shape[0]}"
+                    f" elems < required {in_len}]")
+                request.complete(
+                    int(ErrorCode.SEGMENTER_EXPECTED_BTT_ERROR), 0.0)
+                return
         gkey = ("coll", int(call.scenario), call.comm, call.tag)
         ready = None
         with self._lock:
@@ -413,13 +445,13 @@ class TpuEngine:
             # find first gang this rank hasn't joined yet (FIFO per key)
             for gang in q:
                 if rank not in gang:
-                    gang[rank] = (call, request)
+                    gang[rank] = (call, request, krnl)
                     if len(gang) == P:
                         ready = gang
                         q.remove(gang)
                     break
             else:
-                gang = {rank: (call, request)}
+                gang = {rank: (call, request, krnl)}
                 q.append(gang)
                 if P == 1:
                     ready = gang
@@ -430,12 +462,10 @@ class TpuEngine:
     def _exec_gang(self, scenario: int, comm_id: int, gang: dict) -> None:
         try:
             dt_ns = self._run_collective(Operation(scenario), comm_id, gang)
-            for call, request in gang.values():
+            for call, request, _krnl in gang.values():
                 request.complete(0, float(dt_ns))
         except Exception as e:
-            from ..constants import ErrorCode
-
-            for call, request in gang.values():
+            for call, request, _krnl in gang.values():
                 request.description += f" [{e}]"
                 request.complete(int(ErrorCode.DMA_INTERNAL_ERROR), 0.0)
 
@@ -458,7 +488,8 @@ class TpuEngine:
         sig = (int(op), comm_id, self.ring_threshold_bytes,
                tuple((g,) + (lambda c: (c.addr_0, c.addr_2, c.count,
                                         c.root_src_dst, c.function,
-                                        c.compression_flags, c.arithcfg))(
+                                        c.compression_flags, c.arithcfg,
+                                        c.stream_flags, c.tag))(
                    gang[g][0]) for g in members))
         with self._lock:
             plan = self._gang_plans.get(sig)
@@ -496,28 +527,53 @@ class TpuEngine:
         # same way ACCL._build derives OP0/RES_COMPRESSED)
         dtype = None
         for g in members:
-            call, _ = gang[g]
+            call = gang[g][0]
             for addr in (call.addr_0, call.addr_2):
                 b, _o = self.resolve(g, addr)
                 if b is not None and (dtype is None
                                       or b.host.dtype.itemsize
                                       > np.dtype(dtype).itemsize):
                     dtype = b.host.dtype
+        if dtype is None:
+            # stream->stream collectives address no buffer at all: the
+            # dtype comes from the reserved kernel operands (np.dtype(
+            # None) would silently mean float64 and corrupt f32 streams)
+            for g in members:
+                krnl = gang[g][2]
+                if krnl is not None:
+                    dtype = np.dtype(krnl.dtype)
+                    break
+        if dtype is None:
+            raise ACCLError(
+                "collective addresses no buffer and no stream operand "
+                "was reserved — cannot derive the datapath dtype")
 
         ops = []
         for li, g in enumerate(members):
-            call, _ = gang[g]
+            call = gang[g][0]
+            op0_stream = bool(call.stream_flags & StreamFlags.OP0_STREAM)
+            res_stream = bool(call.stream_flags & StreamFlags.RES_STREAM)
             # operand: op0 for contributors; bcast non-root contributes its
-            # result buffer as placeholder (engine ignores the content)
-            buf, off = self.resolve(g, call.addr_0)
-            if buf is None:
-                buf, off = self.resolve(g, call.addr_2)
-            fast = (off == 0 and buf.dev.shape[0] == in_len
-                    and buf.dev.dtype == dtype)
+            # result buffer as placeholder (engine ignores the content);
+            # OP0_STREAM members contribute from their kernel queue at
+            # call time (the mem<->stream reduce variants, test.cpp
+            # :813-910)
+            if op0_stream:
+                buf, off, fast = None, 0, False
+            else:
+                buf, off = self.resolve(g, call.addr_0)
+                if buf is None:
+                    buf, off = self.resolve(g, call.addr_2)
+                fast = (buf is not None and off == 0
+                        and buf.dev.shape[0] == in_len
+                        and buf.dev.dtype == dtype)
             write_out = not (op in (Operation.reduce, Operation.gather)
                              and li != root)
             res, roff = self.resolve(g, call.addr_2)
-            ops.append((g, buf, off, fast, res if write_out else None, roff))
+            res_tag = call.tag if (res_stream and write_out) else None
+            ops.append((g, buf, off, fast,
+                        res if (write_out and not res_stream) else None,
+                        roff, op0_stream, res_tag))
 
         # large payloads ride the Pallas ring kernels (rendezvous path)
         ring = (op in (Operation.allreduce, Operation.allgather,
@@ -573,14 +629,19 @@ class TpuEngine:
         dtype = plan["dtype"]
 
         shards = []
-        for g, buf, off, fast, _res, _roff in plan["ops"]:
+        for g, buf, off, fast, _res, _roff, op0_stream, _rtag in plan["ops"]:
             if fast:
                 # whole-buffer operand already resident on its device:
                 # the buffer IS the shard (zero-copy call path,
                 # accl.cpp:796-839)
                 shards.append(buf.dev)
                 continue
-            shard = buf.dev[off:off + in_len]
+            if op0_stream:
+                # the operand was RESERVED at submit time in the
+                # member's own thread (call-order stream pairing)
+                shard = jnp.asarray(gang[g][2])[:in_len]
+            else:
+                shard = buf.dev[off:off + in_len]
             if shard.dtype != dtype:
                 shard = shard.astype(dtype)
             if shard.shape[0] < in_len:  # placeholder short buffer (bcast)
@@ -601,7 +662,12 @@ class TpuEngine:
         # single-device jax.Array on its gang member's chip
         out_shards = {self._dev_to_rank[s.device]: s.data
                       for s in y.addressable_shards}
-        for g, _buf, _off, _fast, res, roff in plan["ops"]:
+        for g, _buf, _off, _fast, res, roff, _op0s, res_tag in plan["ops"]:
+            if res_tag is not None:
+                # RES_STREAM: the member's result lands in its local
+                # kernel stream (uncompressed representation)
+                self._push_stream(g, res_tag, out_shards[g])
+                continue
             if res is None:
                 continue
             out = out_shards[g]
@@ -618,6 +684,15 @@ class TpuEngine:
 
         self._krnl_in[rank].append(
             jax.device_put(np.ascontiguousarray(data), self.devices[rank]))
+
+    def _push_stream(self, rank: int, strm: int, data) -> None:
+        """Deliver `data` into (rank, strm)'s kernel stream and wake
+        waiters — the single delivery point for every RES_STREAM path
+        (local copy, stream_put, recv landing, gang results)."""
+        key = (rank, strm)
+        with self._stream_cv:
+            self._streams.setdefault(key, deque()).append(data)
+            self._stream_cv.notify_all()
 
     def pop_stream(self, rank: int, strm: int, timeout_s: float):
         key = (rank, strm)

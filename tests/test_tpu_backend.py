@@ -252,6 +252,58 @@ def test_stream_put(world):
     world.run(fn)
 
 
+def test_copy_to_and_from_stream(world):
+    # local mem<->kernel-stream copies (reference copy_to_stream /
+    # copy_from_stream, accl.cpp:310 family) — same semantics as the
+    # emulator rung
+    def fn(accl, rank):
+        data = _data(COUNT, rank, salt=41)
+        src = accl.create_buffer_like(data)
+        accl.copy_to_stream(src, COUNT, stream_id=9)
+        raw = accl.device.pop_stream(9, COUNT * 4, timeout_s=30)
+        assert raw is not None
+        np.testing.assert_array_equal(
+            np.frombuffer(raw, dtype=np.float32), data)
+        accl.device.push_krnl(data * 2)
+        dst = accl.create_buffer(COUNT, np.float32)
+        accl.copy_from_stream(dst, COUNT)
+        np.testing.assert_array_equal(dst.host, data * 2)
+
+    world.run(fn)
+
+
+def test_reduce_mem_stream_variants(world):
+    # rooted reduce with stream-side operand/result (reference mem<->
+    # stream reduce tests, test.cpp:813-910) over the gang path
+    root = 1
+
+    def fn(accl, rank):
+        from accl_tpu.constants import StreamFlags
+
+        data = _data(COUNT, rank, salt=43)
+        # stream -> mem: every member feeds its operand via the kernel
+        # queue; the root's result lands in a buffer
+        accl.device.push_krnl(data)
+        recv = accl.create_buffer(COUNT, np.float32)
+        accl.reduce(None, recv, COUNT, root, ReduceFunction.SUM,
+                    stream_flags=StreamFlags.OP0_STREAM)
+        want = sum(_data(COUNT, r, salt=43) for r in range(NRANKS))
+        if rank == root:
+            np.testing.assert_allclose(recv.host, want, rtol=1e-5)
+        # mem -> stream: operands from buffers, root's result to its
+        # local kernel stream
+        send = accl.create_buffer_like(data)
+        accl.reduce(send, None, COUNT, root, ReduceFunction.SUM,
+                    stream_flags=StreamFlags.RES_STREAM, stream_id=11)
+        if rank == root:
+            raw = accl.device.pop_stream(11, COUNT * 4, timeout_s=30)
+            assert raw is not None
+            np.testing.assert_allclose(
+                np.frombuffer(raw, dtype=np.float32), want, rtol=1e-5)
+
+    world.run(fn)
+
+
 def test_sub_communicator(world):
     # split {0, 2} and allreduce inside it (reference: test_multicomm)
     members = [0, 2]
