@@ -14,6 +14,9 @@ test/host/xrt/src/bench.cpp:25-61 + parse_bench_results.py):
                            stack vs a bare jitted shard_map psum on the
                            same mesh (the Coyote harness's ACCL-vs-MPI
                            comparison role, plot.py:10-44)
+  sweep_{emu,tpu8}_f16_r{N}.csv  fp16 allreduce sweep (the metric of
+                           record names fp32/fp16) through the f16
+                           arithmetic lanes
   pipeline_ab_r{N}.csv     eager egress pipelining A/B (depth 1 vs 3)
                            across message sizes on the emulator
 
@@ -38,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, default=4)
-    ap.add_argument("--stages", default="emu,dgram,rdma,tpu8,vsraw,pipeline",
+    ap.add_argument("--stages", default="emu,dgram,rdma,tpu8,f16,vsraw,pipeline",
                     help="comma list of stages to run")
     ap.add_argument("--maxpow", type=int, default=19,
                     help="largest 2^k element count (BASELINE metric of "
@@ -130,6 +133,28 @@ def main() -> None:
             run_sweep(w, SweepConfig(
                 count_pows=tuple(range(4, args.maxpow + 1)),
                 repetitions=3), writer=f)
+        print(f"wrote {path}")
+
+    # 3c. fp16 allreduce sweep (BASELINE metric of record names
+    #     "fp32/fp16"): the f16 arithmetic lanes end to end on the
+    #     emulator rung + the TPU-backend gang
+    if "f16" in stages:
+        cfg16 = SweepConfig(collectives=("allreduce",),
+                            count_pows=tuple(range(4, args.maxpow + 1)),
+                            dtype="float16", repetitions=3)
+        path = os.path.join(args.outdir, f"sweep_emu_f16_{tag}.csv")
+        with EmuWorld(4, devmem_bytes=256 << 20, n_egr_rx_bufs=64,
+                      max_eager_size=16384,
+                      max_rendezvous_size=64 << 20) as w, \
+                open(path, "w", newline="") as f:
+            run_sweep(raise_timeouts(w), cfg16, writer=f)
+        print(f"wrote {path}")
+        path = os.path.join(args.outdir, f"sweep_tpu8_f16_{tag}.csv")
+        with TpuWorld(8) as w, open(path, "w", newline="") as f:
+            w.engine.ring_threshold_bytes = 1 << 60
+            for a in w.accls:
+                a.call_timeout_s = 180.0
+            run_sweep(w, cfg16, writer=f)
         print(f"wrote {path}")
 
     # 3b + 4: the remaining stages self-select below
