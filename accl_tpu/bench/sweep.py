@@ -78,6 +78,11 @@ def run_sweep(world, config: SweepConfig = SweepConfig(),
     for coll in config.collectives:
         for pw in config.count_pows:
             count = 1 << pw
+            # one untimed warmup per (collective, size): on the
+            # TPU-backend rung the first call pays the jit compile
+            # (observed 6-30x the steady-state time), which would
+            # dominate the recorded curve
+            _run_once(world, coll, count, dtype, config.root)
             for rep in range(config.repetitions):
                 dur_s = _run_once(world, coll, count, dtype, config.root)
                 nbytes = count * dtype.itemsize

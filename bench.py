@@ -213,6 +213,25 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         def fa(x, kk, vv):  # chained: output feeds the next queries
             return flash_attention(x, kk, vv, causal=True, interpret=False)
 
+        # D=128 candidate schedules, auto-tuned on the live chip: the
+        # resident default, the pinned-row grid_resident schedule, and
+        # chunked sub-folds (MXU/VPU pipelining).  The best lands in the
+        # round record with its name, so schedule selection is measured
+        # per chip generation instead of hardcoded.
+        from accl_tpu.ops.flash import flash_attention_packed as fap
+
+        def fa2_variant(kernel, ck):
+            def fn(x, kk, vv):
+                return fap(x, kk, vv, causal=True, kernel=kernel,
+                           chunk_k=ck, interpret=False)
+            return fn
+
+        d128_variants = {
+            "resident": fa2_variant("resident", None),
+            "grid_resident": fa2_variant("grid_resident", None),
+            "grid_resident_ck256": fa2_variant("grid_resident", 256),
+        }
+
         # MXU-peak context, interleaved: a big bf16 matmul is the
         # practical ceiling of this chip's systolic array
         mm_n = 4096
@@ -236,8 +255,15 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         q2 = jax.random.normal(k1, (B, T, H2, D2), jnp.float32)
         k2_ = jax.random.normal(k2, (B, T, H2, D2), jnp.float32)
         v2 = jax.random.normal(k3, (B, T, H2, D2), jnp.float32)
+        # head-packed operands for the schedule candidates (the
+        # zero-transpose entry; transposes measured ~free on this chip,
+        # so numbers stay comparable with the BTHD wrapper)
+        pk = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H2, T, D2)
+        q2p, k2p, v2p = pk(q2), pk(k2_), pk(v2)
 
         best_fa, best_f2, best_mm = None, None, None
+        best_pk = {name: None for name in d128_variants}
+        dead_variants: set = set()
         for _ in range(10):
             d1 = timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
             d2 = timed_chain(mm, ma, iters=48, trials=1, consts=(mb,))
@@ -245,6 +271,20 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
             best_fa = d1 if best_fa is None else min(best_fa, d1)
             best_mm = d2 if best_mm is None else min(best_mm, d2)
             best_f2 = d3 if best_f2 is None else min(best_f2, d3)
+            for name, vfn in d128_variants.items():
+                if name in dead_variants:
+                    continue
+                # a candidate schedule failing on this chip generation
+                # must not take down the established metrics with it
+                try:
+                    dv = timed_chain(vfn, q2p, iters=64, trials=1,
+                                     consts=(k2p, v2p))
+                except Exception as ve:  # noqa: BLE001
+                    dead_variants.add(name)
+                    best_pk[name] = f"{type(ve).__name__}"
+                    continue
+                prev = best_pk[name]
+                best_pk[name] = dv if prev is None else min(prev, dv)
         # causal: ~half of the 4*B*H*T^2*D matmul flops
         flops = 4 * B * H * T * T * D / 2
         detail["flash_attention_tflops"] = round(flops / best_fa / 1e12, 3)
@@ -252,9 +292,24 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         detail["matmul_bf16_tflops"] = round(mm_tflops, 2)
         detail["flash_mxu_frac"] = round(
             (flops / best_fa) / (2 * mm_n**3 / best_mm), 3)
+        # metric of record: the SAME BTHD entry as previous rounds
+        # (VERDICT's bar is against the existing methodology) — the
+        # packed-layout schedule candidates report under separate keys
         detail["flash_d128_tflops"] = round(flops / best_f2 / 1e12, 3)
         detail["flash_d128_mxu_frac"] = round(
             (flops / best_f2) / (2 * mm_n**3 / best_mm), 3)
+        live = {n: dt for n, dt in best_pk.items()
+                if isinstance(dt, float)}
+        if live:
+            win = min(live, key=lambda n: live[n])
+            detail["flash_d128_packed_tflops"] = round(
+                flops / live[win] / 1e12, 3)
+            detail["flash_d128_packed_mxu_frac"] = round(
+                (flops / live[win]) / (2 * mm_n**3 / best_mm), 3)
+            detail["flash_d128_packed_schedule"] = win
+        detail["flash_d128_packed_all"] = {
+            n: (round(flops / dt / 1e12, 2) if isinstance(dt, float)
+                else dt) for n, dt in best_pk.items()}
     except Exception as e:  # noqa: BLE001 — best-effort detail metric
         detail["flash_attention_error"] = f"{type(e).__name__}: {e}"
     try:

@@ -76,28 +76,38 @@ def main() -> None:
             a.call_timeout_s = 180.0
         return w
 
+    def prep_tpu_world(w):
+        # the full-range virtual sweeps ride the XLA collective path:
+        # the interpreted Pallas ring at multi-MB payloads measures the
+        # interpreter, not the driver (ring correctness at 8 ranks is
+        # certified by dryrun_multichip with the threshold forced to 0)
+        w.engine.ring_threshold_bytes = 1 << 60
+        for a in w.accls:
+            a.call_timeout_s = 180.0  # 1 core drives all 8 gang members
+        return w
+
+    def make_emu_world(**extra):
+        # ONE provisioning for every emulator-rung sweep: rx pool sized
+        # for the worst eager case ((P-1) peers x 16 segments at the
+        # 16 KB ceiling, the reference bench's sizing), 256MB devicemem
+        # + 64MB rendezvous cap for the 2^19 large-message regime
+        return EmuWorld(4, devmem_bytes=256 << 20, n_egr_rx_bufs=64,
+                        max_eager_size=16384,
+                        max_rendezvous_size=64 << 20, **extra)
+
     cfg = SweepConfig(count_pows=tuple(range(4, args.maxpow + 1)),
                       repetitions=3)
     if "emu" in stages:
         path = os.path.join(args.outdir, f"sweep_emu_{tag}.csv")
-        # rx pool provisioned for the worst eager case: (P-1) peers x 16
-        # segments in flight for alltoall at the 16 KB eager ceiling (the
-        # reference bench sizes its spare-buffer pool the same way and its
-        # tests SKIP when under-provisioned, test.cpp:279)
-        with EmuWorld(4, devmem_bytes=256 << 20,
-                      n_egr_rx_bufs=64, max_eager_size=16384,
-                      max_rendezvous_size=64 << 20) as w, \
-                open(path, "w", newline="") as f:
+        with make_emu_world() as w, open(path, "w", newline="") as f:
             run_sweep(raise_timeouts(w), cfg, writer=f)
         print(f"wrote {path}")
 
     # 2. datagram rung (fragmentation + reorder on every transfer)
     if "dgram" in stages:
         path = os.path.join(args.outdir, f"sweep_dgram_{tag}.csv")
-        with EmuWorld(4, transport="dgram", mtu=512, reorder_window=8,
-                      devmem_bytes=256 << 20,
-                      n_egr_rx_bufs=64, max_eager_size=16384,
-                      max_rendezvous_size=64 << 20) as w, \
+        with make_emu_world(transport="dgram", mtu=512,
+                            reorder_window=8) as w, \
                 open(path, "w", newline="") as f:
             run_sweep(raise_timeouts(w), cfg, writer=f)
         print(f"wrote {path}")
@@ -105,10 +115,7 @@ def main() -> None:
     # 2b. RDMA rung (queue pairs; one-sided memory plane for rendezvous)
     if "rdma" in stages:
         path = os.path.join(args.outdir, f"sweep_rdma_{tag}.csv")
-        with EmuWorld(4, transport="rdma", devmem_bytes=256 << 20,
-                      n_egr_rx_bufs=64,
-                      max_eager_size=16384,
-                      max_rendezvous_size=64 << 20) as w, \
+        with make_emu_world(transport="rdma") as w, \
                 open(path, "w", newline="") as f:
             run_sweep(raise_timeouts(w), cfg, writer=f)
         print(f"wrote {path}")
@@ -119,18 +126,7 @@ def main() -> None:
     if "tpu8" in stages:
         path = os.path.join(args.outdir, f"sweep_tpu8_{tag}.csv")
         with TpuWorld(8) as w, open(path, "w", newline="") as f:
-            # the full-range sweep rides the XLA collective path: on
-            # this VIRTUAL rung the ring kernels execute under the
-            # Pallas interpreter, whose per-element cost at multi-MB
-            # payloads is minutes per call and measures the
-            # interpreter, not the driver.  The ring path's correctness
-            # at 8 ranks is certified by dryrun_multichip (forced
-            # threshold 0); its hardware timing belongs to the
-            # real-chip bench.
-            w.engine.ring_threshold_bytes = 1 << 60
-            for a in w.accls:
-                a.call_timeout_s = 180.0  # 1 core, 8 gang members
-            run_sweep(w, SweepConfig(
+            run_sweep(prep_tpu_world(w), SweepConfig(
                 count_pows=tuple(range(4, args.maxpow + 1)),
                 repetitions=3), writer=f)
         print(f"wrote {path}")
@@ -143,18 +139,12 @@ def main() -> None:
                             count_pows=tuple(range(4, args.maxpow + 1)),
                             dtype="float16", repetitions=3)
         path = os.path.join(args.outdir, f"sweep_emu_f16_{tag}.csv")
-        with EmuWorld(4, devmem_bytes=256 << 20, n_egr_rx_bufs=64,
-                      max_eager_size=16384,
-                      max_rendezvous_size=64 << 20) as w, \
-                open(path, "w", newline="") as f:
+        with make_emu_world() as w, open(path, "w", newline="") as f:
             run_sweep(raise_timeouts(w), cfg16, writer=f)
         print(f"wrote {path}")
         path = os.path.join(args.outdir, f"sweep_tpu8_f16_{tag}.csv")
         with TpuWorld(8) as w, open(path, "w", newline="") as f:
-            w.engine.ring_threshold_bytes = 1 << 60
-            for a in w.accls:
-                a.call_timeout_s = 180.0
-            run_sweep(w, cfg16, writer=f)
+            run_sweep(prep_tpu_world(w), cfg16, writer=f)
         print(f"wrote {path}")
 
     # 3b + 4: the remaining stages self-select below

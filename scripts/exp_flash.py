@@ -1,8 +1,10 @@
 """Flash-attention tuning experiments (run on the real TPU chip).
 
 Decomposes the gap between flash_d128_mxu_frac and the matmul roofline:
-times the current kernel, a packed (no-transpose) entry, bf16 operands,
-and jax's bundled splash kernel as an achievability calibration.
+times the BTHD wrapper, the packed (no-transpose) entry with/without
+the K/V cast scratch, bf16 operands with chunked sub-folds, the
+grid_resident schedule, block_q=512 variants, and jax's bundled splash
+kernel as an achievability calibration.
 
 Usage: python scripts/exp_flash.py [variant ...]
 Variants: base d64 packed bf16 splash mm
