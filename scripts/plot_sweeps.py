@@ -57,8 +57,12 @@ def main() -> None:
         "RDMA rung (queue pairs)": f"sweep_rdma_{tag}.csv",
         "TPU backend gang (8 virtual devices)": f"sweep_tpu8_{tag}.csv",
     }
+    f16_rungs = {
+        "emulator fp16": f"sweep_emu_f16_{tag}.csv",
+        "TPU backend gang fp16": f"sweep_tpu8_f16_{tag}.csv",
+    }
 
-    # 1. allreduce busbw per rung
+    # 1. allreduce busbw per rung (fp32 solid, fp16 dashed)
     fig, ax = plt.subplots(figsize=(7, 4.5))
     for label, fname in rungs.items():
         path = os.path.join(outdir, fname)
@@ -68,6 +72,14 @@ def main() -> None:
         if data:
             xs, ys = zip(*data)
             ax.plot(xs, ys, marker="o", ms=3, label=label)
+    for label, fname in f16_rungs.items():
+        path = os.path.join(outdir, fname)
+        if not os.path.exists(path):
+            continue
+        data = load(path).get("allreduce", [])
+        if data:
+            xs, ys = zip(*data)
+            ax.plot(xs, ys, marker="x", ms=3, ls="--", lw=1, label=label)
     ax.axhline(CCLO_ANCHOR_GBPS, ls="--", c="gray", lw=1,
                label="reference CCLO datapath (16 GB/s)")
     ax.set_xscale("log", base=2)
