@@ -47,7 +47,13 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
 
     kb/vb: [bk, D] (mxu dtype); acc/m/l are f32 running state.  `mask`
     is None or (row0, col0) block offsets for the causal row >= col
-    test.  Returns (acc', m', l')."""
+    test.  Returns (acc', m', l').
+
+    FUSED-DENOMINATOR mode (`l_prev is None`): vb carries an appended
+    ones column and acc the matching accumulator column, so the row-sum
+    of p rides the PV matmul on the MXU and the explicit `jnp.sum` VPU
+    pass disappears — free where D pads to the same lane tile anyway
+    (D=64 -> 65 both pad to 128).  Returns (acc', m', None)."""
     block_q, block_k = q.shape[0], kb.shape[0]
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
@@ -69,25 +75,40 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
     alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
                       jnp.exp2(m_prev - shift))     # rescale of old state
-    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    l_new = (None if l_prev is None
+             else alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True))
     acc_new = acc * alpha + jax.lax.dot_general(
         p.astype(mxu_dtype), vb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return acc_new, m_new, l_new
 
 
-def _finalize(acc, m, l, o_ref, lse_ref):
+def _finalize(acc, m, l, o_ref, lse_ref, row_off=None):
     """Write the normalized output and the lse statistics (shared by
     both schedules so the denom/dead-row guards stay identical).  `m` is
     a log2-domain running max (see _softmax_fold); the emitted lse is in
-    NATURAL log units — the cross-shard merge contract."""
+    NATURAL log units — the cross-shard merge contract.
+
+    `row_off` selects a row range of the block to write (the q-tile
+    interleaved schedule finalizes per sub-tile); offset stores are used
+    rather than `.at[]` ref views because a view of the lse block slices
+    its tile-padded minor dim, which Mosaic rejects."""
+    from jax.experimental import pallas as pl
+
     denom = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / denom).astype(o_ref.dtype)
+    out = (acc / denom).astype(o_ref.dtype)
     dead = m <= NEG_INF / 2
     lse = jnp.where(dead, NEG_INF,
                     m * _LN2 + jnp.log(jnp.maximum(l, 1e-38)))
-    lse_ref[0] = lse  # [bq, 1] — the trailing unit dim keeps the block
-    # tile-aligned for Mosaic (second-minor bq % 8 == 0, minor == full)
+    # lse block is [bq, 1] — the trailing unit dim keeps it tile-aligned
+    # for Mosaic (second-minor bq % 8 == 0, minor == full)
+    if row_off is None:
+        o_ref[0] = out
+        lse_ref[0] = lse
+    else:
+        rows = acc.shape[0]
+        o_ref[0, pl.ds(row_off, rows), :] = out
+        lse_ref[0, pl.ds(row_off, rows), :] = lse
 
 
 def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
@@ -155,12 +176,13 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
 
 def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
                            scale: float, causal: bool, block_q: int,
-                           block_k: int, chunk_k: int, T: int, mxu_dtype):
+                           block_k: int, chunk_k: int, T: int, mxu_dtype,
+                           q_tiles: int = 1, fuse_denom: bool = False):
     """K/V-resident schedule: the whole K/V row for this batch-head sits
     in VMEM (fetched ONCE — the grid variant refetches it per q-block,
     which is the streaming bound at small-to-medium T).
 
-    Two throughput tricks beyond the plain fold:
+    Three throughput tricks beyond the plain fold:
     - when the input dtype differs from the MXU dtype, K/V are cast ONCE
       per batch-head into VMEM scratch at the first q-block (the naive
       per-fold cast re-converts the same rows nq times — measured as a
@@ -168,17 +190,46 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
     - each block_k fold is an UNROLLED run of chunk_k sub-folds, so
       Mosaic can issue chunk c+1's independent QK^T matmul while the VPU
       works on chunk c's softmax — without this the MXU idles during
-      every max/exp2/sum pass and the kernel tops out near 50% MXU."""
+      every max/exp2/sum pass and the kernel tops out near 50% MXU;
+    - q_tiles > 1 splits the q block into INDEPENDENT sub-tiles whose
+      folds are interleaved in program order: tile A's softmax (VPU) has
+      no data dependence on tile B's matmuls (MXU), so the scheduler can
+      run them concurrently — at D=128 one softmax pass costs about as
+      much VPU time as the fold's two matmuls cost MXU time, so a single
+      dependence chain caps the kernel near 50% MXU no matter how well
+      a lone chain pipelines."""
     from jax import lax as jlax
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    q = (q_ref[0] * scale).astype(mxu_dtype)        # [bq, D], pre-scaled
-    D = q.shape[-1]
+    D = q_ref.shape[-1]
     nk_total = T // block_k
     n_chunks = block_k // chunk_k
+    tq = block_q // q_tiles
+    # pre-scaled independent q sub-tiles (see q_tiles note above)
+    qs = [(q_ref[0, pl.ds(t * tq, tq), :] * scale).astype(mxu_dtype)
+          for t in range(q_tiles)]
 
-    if scratch:
+    if fuse_denom:
+        # fused-denominator layout (see _softmax_fold): the
+        # ones-extended V lives in scratch, built once per batch-head;
+        # K joins it only when it needs a dtype cast — otherwise it is
+        # read straight from its ref (review finding: an unconditional
+        # K copy wasted a (T, D) VMEM buffer when dtypes already match)
+        *k_scr, vb_s = scratch
+        @pl.when(iq == 0)
+        def _build_kv():
+            if k_scr:
+                k_scr[0][:] = k_ref[0].astype(mxu_dtype)
+            vb_s[:] = jnp.concatenate(
+                [v_ref[0].astype(mxu_dtype),
+                 jnp.ones((T, 1), mxu_dtype)], axis=1)
+
+        def kv_chunk(off):
+            kb = (k_scr[0][pl.ds(off, chunk_k), :] if k_scr
+                  else k_ref[0, pl.ds(off, chunk_k), :].astype(mxu_dtype))
+            return kb, vb_s[pl.ds(off, chunk_k), :]
+    elif scratch:
         kb_s, vb_s = scratch
         # grid order within one batch-head is sequential (the iq
         # dimension is marked "arbitrary"), so the cast done at the
@@ -199,21 +250,28 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
             return (k_ref[0, pl.ds(off, chunk_k), :].astype(mxu_dtype),
                     v_ref[0, pl.ds(off, chunk_k), :].astype(mxu_dtype))
 
-    def step(j, carry, masked):
+    def step(j, carries, masked):
         # unrolled chunk run — `for c in range(...)` is static, letting
         # the compiler software-pipeline MXU against VPU across chunks
+        # and across the independent q sub-tiles within one chunk
         for c in range(n_chunks):
-            acc, m_prev, l_prev = carry
             off = j * block_k + c * chunk_k
             kb, vb = kv_chunk(off)
-            mask = (iq * block_q, off) if masked else None
-            carry = _softmax_fold(q, kb, vb, acc, m_prev, l_prev,
-                                  mask=mask, mxu_dtype=mxu_dtype)
-        return carry
+            nxt = []
+            for t in range(q_tiles):
+                acc, m_prev, l_prev = carries[t]
+                mask = ((iq * block_q + t * tq, off) if masked else None)
+                nxt.append(_softmax_fold(qs[t], kb, vb, acc, m_prev,
+                                         l_prev, mask=mask,
+                                         mxu_dtype=mxu_dtype))
+            carries = tuple(nxt)
+        return carries
 
-    carry = (jnp.zeros((block_q, D), jnp.float32),
-             jnp.full((block_q, 1), NEG_INF, jnp.float32),
-             jnp.zeros((block_q, 1), jnp.float32))
+    acc_d = D + 1 if fuse_denom else D
+    carry = tuple((jnp.zeros((tq, acc_d), jnp.float32),
+                   jnp.full((tq, 1), NEG_INF, jnp.float32),
+                   None if fuse_denom else jnp.zeros((tq, 1), jnp.float32))
+                  for _ in range(q_tiles))
     if causal:
         # blocks fully in this q-block's past: unmasked bulk
         n_past = (iq * block_q) // block_k
@@ -227,8 +285,12 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
     else:
         carry = jlax.fori_loop(0, nk_total,
                                lambda j, c: step(j, c, masked=False), carry)
-    acc, m, l = carry
-    _finalize(acc, m, l, o_ref, lse_ref)
+    for t in range(q_tiles):
+        acc, m, l = carry[t]
+        if fuse_denom:
+            acc, l = acc[:, :D], acc[:, D:]
+        _finalize(acc, m, l, o_ref, lse_ref,
+                  row_off=None if q_tiles == 1 else t * tq)
 
 
 def _vma_of(*xs):
@@ -256,7 +318,8 @@ _RESIDENT_KV_BYTES = 6 << 20
 
 def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                        mxu_dtype, kernel, chunk_k=None,
-                       kv_cast_scratch=False):
+                       kv_cast_scratch=False, q_tiles=1,
+                       fuse_denom=False):
     """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
     flattened — the splash-attention layout).  This is the zero-copy
     path: no transposes touch HBM; callers that keep activations packed
@@ -317,6 +380,18 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     out_shapes = (_sds((N, T, D), qp.dtype, vma),
                   _sds((N, T, 1), jnp.float32, vma))
 
+    if q_tiles > 1 and (bq % q_tiles != 0 or (bq // q_tiles) % 8 != 0):
+        raise ValueError(
+            f"q_tiles={q_tiles} must split block_q={bq} into 8-row-"
+            f"aligned sub-tiles")
+    if (q_tiles > 1 or fuse_denom) and kernel != "resident":
+        # checked AFTER "auto" resolution: auto may legitimately land on
+        # the grid schedule (K/V too big for VMEM residency), and these
+        # options silently not applying would be a perf lie
+        raise ValueError(
+            "q_tiles/fuse_denom are resident-schedule options "
+            f"(kernel resolved to {kernel!r})")
+
     if kernel == "resident":
         grid = (N, nq)
         q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
@@ -328,23 +403,33 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0),
                                 memory_space=pltpu.VMEM)
         # one-time K/V cast scratch (see kernel docstring) — only when
-        # the input is not already in MXU format
-        scratch = ([pltpu.VMEM((Tk, D), mxu_dtype),
-                    pltpu.VMEM((Tk, D), mxu_dtype)] if needs_cast else [])
+        # the input is not already in MXU format.  fuse_denom builds the
+        # ones-extended V in scratch regardless of dtype.
+        if fuse_denom:
+            scratch = ([pltpu.VMEM((Tk, D), mxu_dtype)]
+                       if qp.dtype != mxu_dtype else [])
+            scratch += [pltpu.VMEM((Tk, D + 1), mxu_dtype)]
+        elif needs_cast:
+            scratch = [pltpu.VMEM((Tk, D), mxu_dtype),
+                       pltpu.VMEM((Tk, D), mxu_dtype)]
+        else:
+            scratch = []
         kfn = functools.partial(
             _flash_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, chunk_k=ck, T=Tk, mxu_dtype=mxu_dtype)
+            block_k=bk, chunk_k=ck, T=Tk, mxu_dtype=mxu_dtype,
+            q_tiles=q_tiles, fuse_denom=fuse_denom)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=(o_spec, lse_spec),
             scratch_shapes=scratch,
-            # with cast scratch the q-blocks of one batch-head must run
-            # in-order ("arbitrary") so the iq==0 cast is visible to the
-            # rest; without it every cell is independent ("parallel")
+            # with cast/fused scratch the q-blocks of one batch-head must
+            # run in-order ("arbitrary") so the iq==0 build is visible to
+            # the rest; without it every cell is independent ("parallel")
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=(
-                    ("parallel", "arbitrary") if needs_cast
+                    ("parallel", "arbitrary")
+                    if (needs_cast or fuse_denom)
                     else ("parallel", "parallel"))),
             interpret=interpret,
         )(qp, kp, vp)
@@ -443,38 +528,49 @@ def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "chunk_k", "kv_cast_scratch"))
+                                    "chunk_k", "kv_cast_scratch",
+                                    "q_tiles", "fuse_denom"))
 def flash_attention_packed(q, k, v, causal: bool = False,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False,
                            mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                            chunk_k: int | None = None,
-                           kv_cast_scratch: bool = False):
+                           kv_cast_scratch: bool = False,
+                           q_tiles: int = 1, fuse_denom: bool = False):
     """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
     with N = batch x heads flattened (the splash-attention layout).
     Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
     kernel — callers that keep activations packed (the transformer
     family does between its projections) get the kernel at full rate.
-    Returns out [N, T, D]."""
+    Returns out [N, T, D].
+
+    `q_tiles` (resident schedule only) splits each q block into that
+    many independent sub-tiles whose folds interleave — MXU/VPU overlap
+    across dependence chains.  `fuse_denom` (resident only) rides the
+    softmax row-sum on the PV matmul via a ones-extended V — one fewer
+    VPU pass per fold, free where D pads to the same lane tile (D=64).
+    See the kernel docstring."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
                                    interpret, mxu_dtype, kernel, chunk_k,
-                                   kv_cast_scratch)
+                                   kv_cast_scratch, q_tiles, fuse_denom)
     return out
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "chunk_k", "kv_cast_scratch"))
+                                    "chunk_k", "kv_cast_scratch",
+                                    "q_tiles", "fuse_denom"))
 def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                block_q: int = 256, block_k: int = 512,
                                interpret: bool = False,
                                mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                                chunk_k: int | None = None,
-                               kv_cast_scratch: bool = False):
+                               kv_cast_scratch: bool = False,
+                               q_tiles: int = 1, fuse_denom: bool = False):
     """Head-packed [N, T, D] variant returning (out [N, T, D],
     lse [N, T] fp32) — the distributed callers' entry (ring attention
     folds shard partials via the lse)."""
     return _flash_call_packed(q, k, v, causal, block_q, block_k,
                               interpret, mxu_dtype, kernel, chunk_k,
-                              kv_cast_scratch)
+                              kv_cast_scratch, q_tiles, fuse_denom)

@@ -64,22 +64,17 @@ def main() -> None:
 
     # 1. allreduce busbw per rung (fp32 solid, fp16 dashed)
     fig, ax = plt.subplots(figsize=(7, 4.5))
-    for label, fname in rungs.items():
-        path = os.path.join(outdir, fname)
-        if not os.path.exists(path):
-            continue
-        data = load(path).get("allreduce", [])
-        if data:
-            xs, ys = zip(*data)
-            ax.plot(xs, ys, marker="o", ms=3, label=label)
-    for label, fname in f16_rungs.items():
-        path = os.path.join(outdir, fname)
-        if not os.path.exists(path):
-            continue
-        data = load(path).get("allreduce", [])
-        if data:
-            xs, ys = zip(*data)
-            ax.plot(xs, ys, marker="x", ms=3, ls="--", lw=1, label=label)
+    for rung_map, style in ((rungs, dict(marker="o", ms=3)),
+                            (f16_rungs, dict(marker="x", ms=3, ls="--",
+                                             lw=1))):
+        for label, fname in rung_map.items():
+            path = os.path.join(outdir, fname)
+            if not os.path.exists(path):
+                continue
+            data = load(path).get("allreduce", [])
+            if data:
+                xs, ys = zip(*data)
+                ax.plot(xs, ys, label=label, **style)
     ax.axhline(CCLO_ANCHOR_GBPS, ls="--", c="gray", lw=1,
                label="reference CCLO datapath (16 GB/s)")
     ax.set_xscale("log", base=2)

@@ -276,3 +276,75 @@ def test_flash_lse_merge_reconstructs_full():
     full = flash_attention(q, k, v, mxu_dtype=jnp.float32, interpret=True)
     np.testing.assert_allclose(np.asarray(oM), np.asarray(full),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("q_tiles", [2, 4])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_q_tiles_match(causal, q_tiles):
+    # q_tiles splits each q block into independent interleaved sub-tile
+    # chains (MXU/VPU overlap) — per-row math is identical to a single
+    # chain, so results must be bit-equal
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(23)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64,
+              mxu_dtype=jnp.float32, kernel="resident", interpret=True)
+    a, la = flash_attention_packed_lse(q, k, v, q_tiles=q_tiles, **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, **kw)
+    # per-row math is shape-independent, but the backend gemm may block
+    # [32, D] and [64, D] differently — tight tolerance, not bit-equal
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fuse_denom_matches(causal):
+    # fused denominator: the softmax row-sum rides the PV matmul via a
+    # ones-extended V column instead of a jnp.sum VPU pass.  Same
+    # additions in a different evaluation order -> tight tolerance, and
+    # the lse contract must hold exactly enough for ring merging
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(29)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64,
+              mxu_dtype=jnp.float32, kernel="resident", interpret=True)
+    a, la = flash_attention_packed_lse(q, k, v, fuse_denom=True, **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-6)
+    # combined with q_tiles (the two options compose) — out AND lse
+    # (ring attention merges shards via lse, so the composed finalize
+    # path's lse stores must hold too)
+    c, lc = flash_attention_packed_lse(q, k, v, fuse_denom=True,
+                                       q_tiles=2, **kw)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lc), np.asarray(lb),
+                               rtol=1e-6, atol=1e-6)
+    # matching dtype: V-only scratch (no K copy) — same results
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    kwb = dict(kw, mxu_dtype=jnp.bfloat16)
+    d, ld = flash_attention_packed_lse(qb, kb, vb, fuse_denom=True, **kwb)
+    e, le = flash_attention_packed_lse(qb, kb, vb, **kwb)
+    np.testing.assert_allclose(np.asarray(d, np.float32),
+                               np.asarray(e, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_flash_q_tiles_validation():
+    from accl_tpu.ops.flash import flash_attention_packed
+    q, k, v = (jnp.zeros((1, 64, 32), jnp.float32) for _ in range(3))
+    with pytest.raises(ValueError):
+        flash_attention_packed(q, k, v, block_q=64, block_k=64,
+                               q_tiles=3, interpret=True)
+    with pytest.raises(ValueError):
+        flash_attention_packed(q, k, v, block_q=64, block_k=64,
+                               q_tiles=2, kernel="grid", interpret=True)
