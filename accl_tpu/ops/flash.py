@@ -368,10 +368,34 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     # that must be measured per chip generation
     needs_cast = kv_cast_scratch and qp.dtype != mxu_dtype
 
+    if q_tiles < 1:
+        raise ValueError(f"q_tiles={q_tiles} must be >= 1")
+    if (q_tiles > 1 or fuse_denom) and kernel not in ("resident", "auto"):
+        # an EXPLICIT non-resident kernel with resident-only options is
+        # a contradiction — silently not applying them would be a perf
+        # lie.  (Under "auto" they are tuning HINTS and drop gracefully
+        # below when the schedule lands on grid.)
+        raise ValueError(
+            "q_tiles/fuse_denom are resident-schedule options "
+            f"(kernel={kernel!r})")
+
     kv_bytes = 2 * Tk * D * (qp.dtype.itemsize
                              + (mxu_dtype.itemsize if needs_cast else 0))
+    # fuse_denom's ones-extended V (and K-cast, when dtypes differ)
+    # scratch counts against the same VMEM residency budget
+    fd_scr_bytes = (
+        Tk * (D + 1 + (D if qp.dtype != mxu_dtype else 0))
+        * mxu_dtype.itemsize) if fuse_denom else 0
     if kernel == "auto":
-        kernel = ("resident" if kv_bytes <= _RESIDENT_KV_BYTES else "grid")
+        if kv_bytes <= _RESIDENT_KV_BYTES:
+            kernel = "resident"
+            if fuse_denom and kv_bytes + fd_scr_bytes > _RESIDENT_KV_BYTES:
+                fuse_denom = False  # rows fit, the extra scratch wouldn't
+        else:
+            # distributed callers forward tuned opts without knowing
+            # each shard's size (docs/parallelism.md) — hints drop here
+            kernel = "grid"
+            q_tiles, fuse_denom = 1, False
     if kernel not in ("resident", "grid", "grid_resident"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
 
@@ -380,17 +404,12 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     out_shapes = (_sds((N, T, D), qp.dtype, vma),
                   _sds((N, T, 1), jnp.float32, vma))
 
-    if q_tiles > 1 and (bq % q_tiles != 0 or (bq // q_tiles) % 8 != 0):
-        raise ValueError(
-            f"q_tiles={q_tiles} must split block_q={bq} into 8-row-"
-            f"aligned sub-tiles")
-    if (q_tiles > 1 or fuse_denom) and kernel != "resident":
-        # checked AFTER "auto" resolution: auto may legitimately land on
-        # the grid schedule (K/V too big for VMEM residency), and these
-        # options silently not applying would be a perf lie
-        raise ValueError(
-            "q_tiles/fuse_denom are resident-schedule options "
-            f"(kernel resolved to {kernel!r})")
+    # snap q_tiles down until the sub-tiles are 8-row-aligned divisors
+    # of the (possibly auto-shrunk) q block — the same keep-working
+    # contract as the block halving and chunk snapping above
+    while q_tiles > 1 and (bq % q_tiles != 0
+                           or (bq // q_tiles) % 8 != 0):
+        q_tiles -= 1
 
     if kernel == "resident":
         grid = (N, nq)
@@ -472,7 +491,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
-                kernel):
+                kernel, q_tiles=1, fuse_denom=False):
     """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the core
     call (two HBM transposes per operand direction — callers on the hot
     path should use the packed entry points).  Returns (out [B,T,H,D],
@@ -485,17 +504,20 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
 
     out, lse = _flash_call_packed(pack(q), pack(k), pack(v), causal,
                                   block_q, block_k, interpret, mxu_dtype,
-                                  kernel)
+                                  kernel, q_tiles=q_tiles,
+                                  fuse_denom=fuse_denom)
     return (out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
             lse.reshape(B, H, T))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "mxu_dtype", "kernel"))
+                                    "interpret", "mxu_dtype", "kernel",
+                                    "q_tiles", "fuse_denom"))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
-                    mxu_dtype=jnp.bfloat16, kernel: str = "auto"):
+                    mxu_dtype=jnp.bfloat16, kernel: str = "auto",
+                    q_tiles: int = 1, fuse_denom: bool = False):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
     causal mask).  T must be divisible by the (auto-shrunk) block sizes.
 
@@ -505,24 +527,28 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
 
     `kernel` selects the schedule: "resident" pins the whole K/V row in
     VMEM per batch-head (fetched once; best while it fits), "grid"
-    streams K/V blocks per q-block (any T), "auto" picks by K/V size."""
+    streams K/V blocks per q-block (any T), "auto" picks by K/V size.
+    `q_tiles`/`fuse_denom` are the resident schedule's throughput
+    options (see :func:`flash_attention_packed`)."""
     out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                            mxu_dtype, kernel)
+                            mxu_dtype, kernel, q_tiles, fuse_denom)
     return out
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "mxu_dtype", "kernel"))
+                                    "interpret", "mxu_dtype", "kernel",
+                                    "q_tiles", "fuse_denom"))
 def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
                         block_k: int = 512, interpret: bool = False,
-                        mxu_dtype=jnp.bfloat16, kernel: str = "auto"):
+                        mxu_dtype=jnp.bfloat16, kernel: str = "auto",
+                        q_tiles: int = 1, fuse_denom: bool = False):
     """Like :func:`flash_attention` but also returns the log-sum-exp
     statistics: (out [B, T, H, D], lse [B, H, T] fp32).  Partial results
     over different K/V shards combine exactly via lse weighting — the
     cross-shard fold ring attention applies around the ICI ring."""
     return _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                       mxu_dtype, kernel)
+                       mxu_dtype, kernel, q_tiles, fuse_denom)
 
 
 @functools.partial(jax.jit,

@@ -101,7 +101,8 @@ def zigzag_indices_inverse(T: int, P: int):
 
 
 def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
-                   impl: str | None = None, schedule: str = "contiguous"):
+                   impl: str | None = None, schedule: str = "contiguous",
+                   flash_opts: dict | None = None):
     """Exact attention over the full (ring-distributed) sequence.
 
     Per-member shapes [B, T_local, H, D]; the global sequence is the
@@ -121,6 +122,10 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     causal work exactly across ranks on every hop (each rank computes
     precisely two live half-chunk pairs per hop); the output is in the
     same zigzag order.  `schedule="contiguous"` is the natural layout.
+
+    `flash_opts` forwards static schedule options to the per-hop flash
+    kernel (e.g. ``{"q_tiles": 2, "fuse_denom": True}``) so distributed
+    callers can run the chip-tuned schedule; ignored by the dense impl.
     """
     if schedule not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring_attention schedule {schedule!r}")
@@ -132,12 +137,14 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
                              "attention (non-causal hops are already "
                              "balanced)")
         if impl == "flash":
-            return _ring_attention_flash_zigzag(q, k, v, axis)
+            return _ring_attention_flash_zigzag(q, k, v, axis,
+                                                flash_opts=flash_opts)
         if impl != "dense":
             raise ValueError(f"unknown ring_attention impl {impl!r}")
         return _ring_attention_dense_zigzag(q, k, v, axis)
     if impl == "flash":
-        return _ring_attention_flash(q, k, v, axis, causal)
+        return _ring_attention_flash(q, k, v, axis, causal,
+                                     flash_opts=flash_opts)
     if impl != "dense":
         raise ValueError(f"unknown ring_attention impl {impl!r}")
     if causal:
@@ -240,7 +247,8 @@ def _ring_attention_dense_zigzag(q, k, v, axis: str):
     return _dense_ring_loop(q, k, v, axis, bias_fn)
 
 
-def _ring_attention_flash_zigzag(q, k, v, axis: str):
+def _ring_attention_flash_zigzag(q, k, v, axis: str,
+                                 flash_opts: dict | None = None):
     """Flash-backed zigzag causal ring schedule — exact per-hop load
     balance.
 
@@ -276,7 +284,8 @@ def _ring_attention_flash_zigzag(q, k, v, axis: str):
 
     def flash(qx, kx, vx, causal):
         return flash_attention_lse(qx, kx, vx, causal=causal,
-                                   interpret=interpret, mxu_dtype=mxu_dt)
+                                   interpret=interpret, mxu_dtype=mxu_dt,
+                                   **(flash_opts or {}))
 
     def dead(kx, vx):
         # zeros carrying the same device-variance as the live branches
@@ -333,7 +342,8 @@ def _ring_attention_flash_zigzag(q, k, v, axis: str):
     return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
 
 
-def _ring_attention_flash(q, k, v, axis: str, causal: bool):
+def _ring_attention_flash(q, k, v, axis: str, causal: bool,
+                          flash_opts: dict | None = None):
     """Flash-backed ring schedule: each hop runs the K/V-resident flash
     kernel on the local (Q shard, arriving K/V shard) pair and the
     results merge by lse weighting — the streaming-softmax fold lifted
@@ -359,12 +369,14 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool):
     def hop_full(kv):
         kc, vc = kv
         return flash_attention_lse(q, kc, vc, causal=False,
-                                   interpret=interpret, mxu_dtype=mxu_dt)
+                                   interpret=interpret, mxu_dtype=mxu_dt,
+                                   **(flash_opts or {}))
 
     def hop_diag(kv):
         kc, vc = kv
         return flash_attention_lse(q, kc, vc, causal=True,
-                                   interpret=interpret, mxu_dtype=mxu_dt)
+                                   interpret=interpret, mxu_dtype=mxu_dt,
+                                   **(flash_opts or {}))
 
     def hop_dead(kv):
         # zeros derived from q AND the rotating k/v so this branch's

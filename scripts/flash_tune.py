@@ -1,10 +1,13 @@
 """Flash-attention schedule tuner — runs on the live TPU chip.
 
-Sweeps resident-schedule block shapes / chunking / cast-scratch on the
-bench's D=128 shape and prints a TFLOPs table (matmul peak measured
-interleaved so fractions are window-robust on the shared chip).
+Sweeps resident-schedule block shapes / chunking / q-tile interleave /
+fused-denominator on the bench's D=128 shape and prints a TFLOPs table
+(matmul peak measured interleaved so fractions are window-robust on the
+shared chip).  The sweep loop itself lives in
+accl_tpu.bench.flash_sweep (shared with scripts/chip_session.py).
 
 Usage: python scripts/flash_tune.py [rounds]
+Env:   FLASH_TUNE_ONLY=substr1,substr2   filter candidates
 """
 from __future__ import annotations
 
@@ -17,54 +20,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import jax
 import jax.numpy as jnp
 
-from accl_tpu.bench.timing import make_harness
-from accl_tpu.ops.flash import flash_attention_packed as fap
+from accl_tpu.bench.flash_sweep import make_variant, report, run_sweep
 
-B, T, H, D = 4, 2048, 4, 128
 ROUNDS = int(sys.argv[1]) if len(sys.argv) > 1 else 6
 
 
 def main():
     print(f"backend={jax.default_backend()}", file=sys.stderr)
+    from accl_tpu.bench.timing import make_harness
+
     _probe, timed_chain, _ab, _sync = make_harness(jax, jnp)
-
-    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
-    q = jax.random.normal(k1, (B * H, T, D), jnp.float32)
-    k = jax.random.normal(k2, (B * H, T, D), jnp.float32)
-    v = jax.random.normal(k3, (B * H, T, D), jnp.float32)
-
-    mm_n = 4096
-    ka, kb = jax.random.split(jax.random.PRNGKey(7))
-    ma = jax.random.normal(ka, (mm_n, mm_n), jnp.bfloat16)
-    mb = jax.random.normal(kb, (mm_n, mm_n), jnp.bfloat16)
-    mm = lambda x, y: (x @ y).astype(jnp.bfloat16)
-
-    def variant(kernel, bq, bk, ck, cast, qt=1):
-        def fn(x, kk, vv):
-            return fap(x, kk, vv, causal=True, kernel=kernel,
-                       block_q=bq, block_k=bk, chunk_k=ck,
-                       kv_cast_scratch=cast, q_tiles=qt)
-        return fn
 
     cands = {}
     for bq, bk in ((256, 512), (512, 512), (256, 256), (512, 256),
                    (1024, 512), (512, 1024), (256, 1024)):
-        cands[f"res_bq{bq}_bk{bk}"] = variant("resident", bq, bk, None,
-                                              False)
+        cands[f"res_bq{bq}_bk{bk}"] = make_variant(bq, bk)
     for bq, bk, ck in ((256, 512, 256), (512, 512, 256), (512, 512, 128),
                        (256, 512, 128)):
-        cands[f"res_bq{bq}_bk{bk}_ck{ck}"] = variant(
-            "resident", bq, bk, ck, False)
+        cands[f"res_bq{bq}_bk{bk}_ck{ck}"] = make_variant(bq, bk, ck=ck)
     for bq, bk in ((256, 512), (512, 512)):
-        cands[f"res_bq{bq}_bk{bk}_cast"] = variant("resident", bq, bk,
-                                                   None, True)
+        cands[f"res_bq{bq}_bk{bk}_cast"] = make_variant(bq, bk, cast=True)
     for bq, bk, ck, qt in ((256, 512, None, 2), (512, 512, None, 2),
                            (512, 512, None, 4), (256, 512, None, 4),
                            (512, 512, 256, 2), (256, 512, 256, 2),
                            (512, 1024, None, 2)):
         ckn = f"_ck{ck}" if ck else ""
-        cands[f"res_bq{bq}_bk{bk}{ckn}_qt{qt}"] = variant(
-            "resident", bq, bk, ck, False, qt)
+        cands[f"res_bq{bq}_bk{bk}{ckn}_qt{qt}"] = make_variant(
+            bq, bk, ck=ck, qt=qt)
+    for bq, bk, qt in ((256, 512, 1), (512, 512, 2), (256, 512, 2)):
+        cands[f"res_bq{bq}_bk{bk}_qt{qt}_fd"] = make_variant(
+            bq, bk, qt=qt, fd=True)
 
     only = os.environ.get("FLASH_TUNE_ONLY")
     if only:
@@ -72,44 +57,17 @@ def main():
         cands = {n: f for n, f in cands.items()
                  if any(s in n for s in keep)}
 
-    import time as _time
-
-    best = {n: None for n in cands}
-    best_mm = None
-    dead = set()
-    for r in range(ROUNDS):
-        d = timed_chain(mm, ma, iters=48, trials=1, consts=(mb,))
-        best_mm = d if best_mm is None else min(best_mm, d)
-        for name, fn in cands.items():
-            if name in dead:
-                continue
-            t0 = _time.perf_counter()
-            try:
-                dv = timed_chain(fn, q, iters=64, trials=1, consts=(k, v))
-            except Exception as e:  # noqa: BLE001
-                dead.add(name)
-                best[name] = f"{type(e).__name__}: {e}"
-                print(f"  {name}: DEAD {e}", file=sys.stderr, flush=True)
-                continue
-            wall = _time.perf_counter() - t0
-            print(f"  [r{r}] {name}: {dv * 1e3:.2f} ms "
-                  f"(wall {wall:.0f}s)", file=sys.stderr, flush=True)
-            prev = best[name]
-            best[name] = dv if prev is None else min(prev, dv)
-        print(f"[round {r}] done", file=sys.stderr, flush=True)
-
-    flops = 4 * B * H * T * T * D / 2
-    mm_tf = 2 * mm_n**3 / best_mm / 1e12
-    print(f"matmul_bf16: {mm_tf:.1f} TFLOPs")
-    rows = []
-    for name, dt in best.items():
-        if isinstance(dt, float):
-            tf = flops / dt / 1e12
-            rows.append((tf, name))
+    best, best_mm = run_sweep(jax, jnp, timed_chain, cands, rounds=ROUNDS)
+    res = report(best, best_mm)
+    print(f"matmul_bf16: {res['matmul_bf16_tflops']:.1f} TFLOPs")
+    rows = sorted(res["schedules"].items(),
+                  key=lambda kv: -kv[1].get("tflops", 0.0))
+    for name, r in rows:
+        if "tflops" in r:
+            print(f"  {name:32s} {r['tflops']:7.2f} TF  "
+                  f"frac={r['mxu_frac']:.3f}")
         else:
-            rows.append((0.0, f"{name} [{dt}]"))
-    for tf, name in sorted(rows, reverse=True):
-        print(f"  {name:32s} {tf:7.2f} TF  frac={tf / mm_tf:.3f}")
+            print(f"  {name:32s} [{r['error']}]")
 
 
 if __name__ == "__main__":

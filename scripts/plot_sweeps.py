@@ -134,6 +134,41 @@ def main() -> None:
         fig.savefig(p)
         print(f"wrote {p}")
 
+    # 4b. single-chip 1KB-1GB reduce-lane curve (metric-of-record proxy:
+    #     on-path reduction busbw vs size with the XLA add as the
+    #     per-size HBM roofline; BASELINE.md "busbw vs size, 1KB-1GB")
+    path = os.path.join(outdir, f"lane_sweep_{tag}.csv")
+    if os.path.exists(path):
+        xs, p_gb, x_gb = [], [], []
+        with open(path) as f:
+            for row in csv.DictReader(f):
+                xs.append(int(row["bytes"]))
+                p_gb.append(float(row["pallas_GBps"]))
+                x_gb.append(float(row["xla_GBps"]))
+        order = sorted(range(len(xs)), key=lambda i: xs[i])
+        xs = [xs[i] for i in order]
+        p_gb = [p_gb[i] for i in order]
+        x_gb = [x_gb[i] for i in order]
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(xs, p_gb, marker="o", ms=3,
+                label="reduction lane (Pallas, real TPU)")
+        ax.plot(xs, x_gb, marker="s", ms=3, ls="--", lw=1,
+                label="XLA add (per-size HBM roofline)")
+        ax.axhline(CCLO_ANCHOR_GBPS, ls="--", c="gray", lw=1,
+                   label="reference CCLO datapath (16 GB/s)")
+        ax.set_xscale("log", base=2)
+        ax.set_yscale("log")
+        ax.set_xlabel("operand size (bytes)")
+        ax.set_ylabel("effective reduction bandwidth (GB/s)")
+        ax.set_title("on-path reduction lane vs size, single TPU chip "
+                     f"(round {args.round})")
+        ax.grid(True, which="both", alpha=0.3)
+        ax.legend(fontsize=8)
+        fig.tight_layout()
+        p = os.path.join(outdir, f"lane_sweep_{tag}.svg")
+        fig.savefig(p)
+        print(f"wrote {p}")
+
     # 4. driver path vs raw XLA collective (the Coyote harness's
     #    ACCL-vs-MPI comparison role, plot.py:10-44)
     path = os.path.join(outdir, f"driver_vs_raw_{tag}.csv")

@@ -342,9 +342,35 @@ def test_flash_fuse_denom_matches(causal):
 def test_flash_q_tiles_validation():
     from accl_tpu.ops.flash import flash_attention_packed
     q, k, v = (jnp.zeros((1, 64, 32), jnp.float32) for _ in range(3))
+    # non-divisor / too-fine q_tiles snap DOWN to a valid split (the
+    # same keep-working contract as block auto-shrink), so ring callers
+    # can pass tuned opts without knowing the shard's shrunk block size
+    flash_attention_packed(q, k, v, block_q=64, block_k=64,
+                           q_tiles=3, interpret=True)
+    flash_attention_packed(q, k, v, block_q=8, block_k=64,
+                           q_tiles=2, interpret=True)
     with pytest.raises(ValueError):
         flash_attention_packed(q, k, v, block_q=64, block_k=64,
-                               q_tiles=3, interpret=True)
+                               q_tiles=0, interpret=True)
     with pytest.raises(ValueError):
         flash_attention_packed(q, k, v, block_q=64, block_k=64,
                                q_tiles=2, kernel="grid", interpret=True)
+
+
+def test_flash_opts_degrade_on_auto_grid():
+    # under kernel="auto" the resident-only options are tuning HINTS:
+    # when the K/V row exceeds the VMEM residency budget and auto lands
+    # on the grid schedule, they drop instead of raising — distributed
+    # callers forward tuned opts without knowing each shard's size.
+    # (An EXPLICIT non-resident kernel still raises, tested above.)
+    import accl_tpu.ops.flash as F
+    q, k, v = (jnp.zeros((1, 256, 32), jnp.float32) for _ in range(3))
+    orig = F._RESIDENT_KV_BYTES
+    F._RESIDENT_KV_BYTES = 1  # force auto -> grid
+    try:
+        out = F.flash_attention_packed(
+            q, k, v, block_q=64, block_k=64, q_tiles=2, fuse_denom=True,
+            interpret=True)
+        assert out.shape == q.shape
+    finally:
+        F._RESIDENT_KV_BYTES = orig

@@ -336,3 +336,44 @@ def test_ulysses_flash_attn_fn_matches_dense():
     # TPU host and compare flash against itself
     want = run(functools.partial(_dense_attention, causal=True))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_flash_opts_passthrough():
+    # flash_opts forwards the chip-tuned resident-schedule options
+    # (q_tiles / fuse_denom) into every per-hop kernel call — results
+    # must stay dense-exact through both ring schedules
+    import jax
+
+    from accl_tpu.parallel.mesh import make_mesh
+    from accl_tpu.parallel.ring_attention import (zigzag_indices,
+                                                  zigzag_indices_inverse)
+
+    P_sp = 4
+    mesh = make_mesh(sp=P_sp)
+    B, Tl, H, D = 1, 16, 2, 16
+    T = P_sp * Tl
+    rng = np.random.default_rng(31)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)),
+                           jnp.float32) for _ in range(3))
+    opts = {"q_tiles": 2, "fuse_denom": True}
+    spec = P(None, "sp", None, None)
+
+    fn = jax.jit(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis="sp", causal=True,
+                                       impl="flash", flash_opts=opts),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False))
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(_dense_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    perm = zigzag_indices(T, P_sp)
+    inv = zigzag_indices_inverse(T, P_sp)
+    fz = jax.jit(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis="sp", causal=True,
+                                       impl="flash", schedule="zigzag",
+                                       flash_opts=opts),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False))
+    gz = np.asarray(fz(q[:, perm], k[:, perm], v[:, perm])[:, inv])
+    np.testing.assert_allclose(gz, want, rtol=2e-4, atol=2e-4)
