@@ -13,20 +13,24 @@ import sys
 import time
 
 #: the bench shape of record (BENCH_r{N} flash_d128 detail keys):
-#: head-packed [B*H, T, D] causal attention, f32 inputs, bf16 MXU
+#: head-packed [B*H, T, D] causal attention, f32 inputs, bf16 MXU.
+#: D=64 sweeps use H=8, D=64 — same total flops (H*D preserved).
 B, T, H, D = 4, 2048, 4, 128
 MM_N = 4096
 
 
 def causal_flops():
-    """Matmul flops of the sweep shape (causal halves the score work)."""
+    """Matmul flops of the sweep shape (causal halves the score work).
+    Invariant under the D=64 variant (H doubles as D halves)."""
     return 4 * B * H * T * T * D / 2
 
 
-def make_inputs(jax, jnp):
-    """(q, k, v) head-packed operands of the sweep shape."""
+def make_inputs(jax, jnp, d=D):
+    """(q, k, v) head-packed operands of the sweep shape; `d` picks the
+    head dim (64 or 128) with H scaled to keep total flops fixed."""
+    h = (H * D) // d
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
-    mk = lambda kk: jax.random.normal(kk, (B * H, T, D), jnp.float32)
+    mk = lambda kk: jax.random.normal(kk, (B * h, T, d), jnp.float32)
     return mk(k1), mk(k2), mk(k3)
 
 
@@ -51,7 +55,7 @@ def make_variant(bq, bk, ck=None, qt=1, fd=False, cast=False,
     return fn
 
 
-def run_sweep(jax, jnp, timed_chain, cands, rounds=3, log=None):
+def run_sweep(jax, jnp, timed_chain, cands, rounds=3, log=None, d=D):
     """Interleaved best-of-rounds sweep.
 
     Returns (best, best_mm): best maps candidate name -> best seconds
@@ -60,7 +64,7 @@ def run_sweep(jax, jnp, timed_chain, cands, rounds=3, log=None):
     """
     if log is None:
         log = lambda msg: print(msg, file=sys.stderr, flush=True)
-    q, k, v = make_inputs(jax, jnp)
+    q, k, v = make_inputs(jax, jnp, d=d)
     mm, ma, mb = matmul_context(jax, jnp)
 
     best = {n: None for n in cands}

@@ -114,17 +114,21 @@ def _finalize(acc, m, l, o_ref, lse_ref, row_off=None):
 def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                        *, scale: float, causal: bool, block_q: int,
                        block_k: int, chunk_k: int, nk: int, mxu_dtype,
-                       kv_resident: bool = False):
+                       kv_resident: bool = False, q_tiles: int = 1):
     """Streaming schedule: grid (bh, q_block, k_block); K/V blocks
     arrive per grid cell; the accumulator lives in VMEM scratch across
     the sequential k steps of one (bh, q_block) cell.  Each arriving
     block is folded as an unrolled run of chunk_k sub-folds so the MXU
     stays busy while the VPU runs the previous chunk's softmax (same
-    pipelining rationale as the resident kernel)."""
+    pipelining rationale as the resident kernel).  q_tiles > 1 splits
+    the q block into independent sub-tile chains whose folds interleave
+    (see the resident kernel's docstring) — the long-context schedule's
+    version of the same MXU/VPU overlap."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
     ik = pl.program_id(2)
+    tq = block_q // q_tiles
 
     @pl.when(ik == 0)
     def _init():
@@ -141,11 +145,12 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
         if causal else False
 
     q = (q_ref[0] * scale).astype(mxu_dtype)  # pre-scale once per block
+    qs = [q[t * tq:(t + 1) * tq] for t in range(q_tiles)]
 
     def body(masked: bool):
-        carry = (acc[:], m_s[:], l_s[:])
+        carries = [(acc[pl.ds(t * tq, tq), :], m_s[pl.ds(t * tq, tq), :],
+                    l_s[pl.ds(t * tq, tq), :]) for t in range(q_tiles)]
         for c in range(block_k // chunk_k):
-            a, m_prev, l_prev = carry
             off = ik * block_k + c * chunk_k
             # kv_resident: the refs hold the WHOLE row (the index map is
             # pinned, so Pallas fetched it once per batch-head) and the
@@ -153,10 +158,16 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
             base = off if kv_resident else c * chunk_k
             kb = k_ref[0, pl.ds(base, chunk_k), :].astype(mxu_dtype)
             vb = v_ref[0, pl.ds(base, chunk_k), :].astype(mxu_dtype)
-            mask = (iq * block_q, off) if masked else None
-            carry = _softmax_fold(q, kb, vb, a, m_prev, l_prev,
-                                  mask=mask, mxu_dtype=mxu_dtype)
-        acc[:], m_s[:], l_s[:] = carry
+            carries = [
+                _softmax_fold(qs[t], kb, vb, *carries[t],
+                              mask=((iq * block_q + t * tq, off)
+                                    if masked else None),
+                              mxu_dtype=mxu_dtype)
+                for t in range(q_tiles)]
+        for t, (a, m, l) in enumerate(carries):
+            acc[pl.ds(t * tq, tq), :] = a
+            m_s[pl.ds(t * tq, tq), :] = m
+            l_s[pl.ds(t * tq, tq), :] = l
 
     if causal:
         @pl.when(diag)
@@ -370,14 +381,14 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
 
     if q_tiles < 1:
         raise ValueError(f"q_tiles={q_tiles} must be >= 1")
-    if (q_tiles > 1 or fuse_denom) and kernel not in ("resident", "auto"):
-        # an EXPLICIT non-resident kernel with resident-only options is
-        # a contradiction — silently not applying them would be a perf
-        # lie.  (Under "auto" they are tuning HINTS and drop gracefully
-        # below when the schedule lands on grid.)
+    if fuse_denom and kernel not in ("resident", "auto"):
+        # an EXPLICIT non-resident kernel with the resident-only option
+        # is a contradiction — silently not applying it would be a perf
+        # lie.  (Under "auto" it is a tuning HINT and drops gracefully
+        # below when the schedule lands on grid.  q_tiles is supported
+        # by every schedule.)
         raise ValueError(
-            "q_tiles/fuse_denom are resident-schedule options "
-            f"(kernel={kernel!r})")
+            f"fuse_denom is a resident-schedule option (kernel={kernel!r})")
 
     kv_bytes = 2 * Tk * D * (qp.dtype.itemsize
                              + (mxu_dtype.itemsize if needs_cast else 0))
@@ -393,9 +404,10 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                 fuse_denom = False  # rows fit, the extra scratch wouldn't
         else:
             # distributed callers forward tuned opts without knowing
-            # each shard's size (docs/parallelism.md) — hints drop here
+            # each shard's size (docs/parallelism.md) — the resident-only
+            # hint drops here; q_tiles carries over to the grid schedule
             kernel = "grid"
-            q_tiles, fuse_denom = 1, False
+            fuse_denom = False
     if kernel not in ("resident", "grid", "grid_resident"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
 
@@ -470,7 +482,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         kfn = functools.partial(
             _flash_kernel_grid, scale=scale, causal=causal, block_q=bq,
             block_k=bk, chunk_k=ck, nk=nk, mxu_dtype=mxu_dtype,
-            kv_resident=kv_resident)
+            kv_resident=kv_resident, q_tiles=q_tiles)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec3, kv_spec, kv_spec],

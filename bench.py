@@ -226,14 +226,13 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         # chunked sub-folds (MXU/VPU pipelining).  The best lands in the
         # round record with its name, so schedule selection is measured
         # per chip generation instead of hardcoded.
-        from accl_tpu.ops.flash import flash_attention_packed as fap
+        # candidate construction is shared with the live-chip tuner
+        # scripts so methodology fixes land once (flash_sweep docstring)
+        from accl_tpu.bench.flash_sweep import make_variant
 
         def fa2_variant(kernel, ck, qt=1, fd=False):
-            def fn(x, kk, vv):
-                return fap(x, kk, vv, causal=True, kernel=kernel,
-                           chunk_k=ck, q_tiles=qt, fuse_denom=fd,
-                           interpret=False)
-            return fn
+            return make_variant(256, 512, ck=ck, qt=qt, fd=fd,
+                                kernel=kernel)
 
         # grid_resident_ck256 earned its slot out (r04: 29-49 TF vs
         # resident's 75); the q-tile interleave and fused-denominator

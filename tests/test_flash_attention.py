@@ -354,7 +354,29 @@ def test_flash_q_tiles_validation():
                                q_tiles=0, interpret=True)
     with pytest.raises(ValueError):
         flash_attention_packed(q, k, v, block_q=64, block_k=64,
-                               q_tiles=2, kernel="grid", interpret=True)
+                               fuse_denom=True, kernel="grid",
+                               interpret=True)
+
+
+@pytest.mark.parametrize("kernel", ["grid", "grid_resident"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grid_q_tiles_match(causal, kernel):
+    # the grid schedules support the q-tile interleave too (the
+    # long-context path auto lands on) — same per-row math as a single
+    # chain
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(37)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64,
+              mxu_dtype=jnp.float32, kernel=kernel, interpret=True)
+    a, la = flash_attention_packed_lse(q, k, v, q_tiles=2, **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-6)
 
 
 def test_flash_opts_degrade_on_auto_grid():

@@ -64,8 +64,15 @@ def test_ring_compressed_lowers_through_mosaic(phased=None):
         RANKS * 1024, dtype=jnp.bfloat16))
 
 
-@pytest.mark.parametrize("kern", ["resident", "grid"])
-def test_flash_kernels_lower_through_mosaic(kern):
+@pytest.mark.parametrize("kern,opts", [
+    ("resident", {}),
+    ("grid", {}),
+    # the chip-tuned resident schedule options (bench candidates)
+    ("resident", {"q_tiles": 2}),
+    ("resident", {"fuse_denom": True}),
+    ("resident", {"q_tiles": 2, "fuse_denom": True}),
+])
+def test_flash_kernels_lower_through_mosaic(kern, opts):
     from accl_tpu.ops.flash import flash_attention_packed
 
     N, T, D = 4, 2048, 128  # the bench shape (MXU-native head dim)
@@ -73,7 +80,24 @@ def test_flash_kernels_lower_through_mosaic(kern):
                  for _ in range(3))
     exp = jax.export.export(
         jax.jit(lambda q, k, v: flash_attention_packed(
-            q, k, v, causal=True, kernel=kern)),
+            q, k, v, causal=True, kernel=kern, **opts)),
+        platforms=["tpu"])(*args)
+    _assert_mosaic(exp.mlir_module())
+
+
+def test_flash_cast_scratch_lowers_through_mosaic():
+    # f32 inputs + bf16 MXU dtype: the one-shot K/V cast scratch and
+    # the fused-denominator V build both allocate VMEM scratch — lower
+    # the exact bench configuration (f32 operands)
+    from accl_tpu.ops.flash import flash_attention_packed
+
+    N, T, D = 4, 2048, 128
+    args = tuple(jax.ShapeDtypeStruct((N, T, D), jnp.float32)
+                 for _ in range(3))
+    exp = jax.export.export(
+        jax.jit(lambda q, k, v: flash_attention_packed(
+            q, k, v, causal=True, kernel="resident", q_tiles=2,
+            fuse_denom=True)),
         platforms=["tpu"])(*args)
     _assert_mosaic(exp.mlir_module())
 
