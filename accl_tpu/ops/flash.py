@@ -540,8 +540,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     `kernel` selects the schedule: "resident" pins the whole K/V row in
     VMEM per batch-head (fetched once; best while it fits), "grid"
     streams K/V blocks per q-block (any T), "auto" picks by K/V size.
-    `q_tiles`/`fuse_denom` are the resident schedule's throughput
-    options (see :func:`flash_attention_packed`)."""
+    `q_tiles` (any schedule) and `fuse_denom` (resident only) are the
+    throughput options (see :func:`flash_attention_packed`)."""
     out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
                             mxu_dtype, kernel, q_tiles, fuse_denom)
     return out
@@ -582,12 +582,13 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     family does between its projections) get the kernel at full rate.
     Returns out [N, T, D].
 
-    `q_tiles` (resident schedule only) splits each q block into that
-    many independent sub-tiles whose folds interleave — MXU/VPU overlap
-    across dependence chains.  `fuse_denom` (resident only) rides the
-    softmax row-sum on the PV matmul via a ones-extended V — one fewer
-    VPU pass per fold, free where D pads to the same lane tile (D=64).
-    See the kernel docstring."""
+    `q_tiles` (every schedule) splits each q block into that many
+    independent sub-tiles whose folds interleave — MXU/VPU overlap
+    across dependence chains; it snaps down to a valid 8-row-aligned
+    split.  `fuse_denom` (resident only; dropped when "auto" lands on
+    grid) rides the softmax row-sum on the PV matmul via a
+    ones-extended V — one fewer VPU pass per fold, free where D pads
+    to the same lane tile (D=64).  See the kernel docstrings."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
                                    interpret, mxu_dtype, kernel, chunk_k,
                                    kv_cast_scratch, q_tiles, fuse_denom)

@@ -84,8 +84,12 @@ def flash_stage(timed_chain):
 
 
 def _write_json(path, obj):
-    with open(path, "w") as f:
+    # atomic: a kill mid-rewrite must not corrupt the previous result
+    # (the resume logic depends on it)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
     print(f"wrote {path}", file=sys.stderr, flush=True)
 
 
@@ -93,18 +97,31 @@ def lane_stage(timed_chain_ab):
     """busbw-vs-size curve for the on-path reduction lane, 1KB-1GB."""
     from accl_tpu.ops.reduce_ops import pallas_add
 
+    header = "bytes,pallas_GBps,xla_GBps,iters\n"
     done = set()
     if os.path.exists(LANE_CSV):
+        # keep only fully-written rows; a row truncated by a timeout
+        # kill is dropped (and re-measured) rather than trusted
+        good = []
         with open(LANE_CSV) as f:
             next(f, None)
             for line in f:
+                parts = line.strip().split(",")
                 try:
-                    done.add(int(line.split(",")[0]))
-                except ValueError:
-                    continue  # truncated row from a killed run
+                    nb = int(parts[0])
+                    float(parts[1]); float(parts[2]); int(parts[3])
+                except (ValueError, IndexError):
+                    continue
+                done.add(nb)
+                good.append(line if line.endswith("\n") else line + "\n")
+        tmp = LANE_CSV + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(header)
+            f.writelines(good)
+        os.replace(tmp, LANE_CSV)
     else:
         with open(LANE_CSV, "w") as f:
-            f.write("bytes,pallas_GBps,xla_GBps,iters\n")
+            f.write(header)
 
     for p in range(10, 31, 2):  # 1 KB .. 1 GB per operand
         nbytes = 1 << p

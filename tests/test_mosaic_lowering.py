@@ -85,10 +85,18 @@ def test_flash_kernels_lower_through_mosaic(kern, opts):
     _assert_mosaic(exp.mlir_module())
 
 
-def test_flash_cast_scratch_lowers_through_mosaic():
-    # f32 inputs + bf16 MXU dtype: the one-shot K/V cast scratch and
-    # the fused-denominator V build both allocate VMEM scratch — lower
-    # the exact bench configuration (f32 operands)
+@pytest.mark.parametrize("opts", [
+    # fused-denominator scratch build (f32 -> bf16 K cast + ones-V)
+    {"q_tiles": 2, "fuse_denom": True},
+    # the two-buffer one-shot K/V cast scratch branch (the _cast sweep
+    # candidates) — distinct scratch path from fuse_denom
+    {"kv_cast_scratch": True},
+    {"kv_cast_scratch": True, "q_tiles": 2},
+])
+def test_flash_scratch_paths_lower_through_mosaic(opts):
+    # f32 inputs + bf16 MXU dtype: every VMEM scratch branch of the
+    # resident kernel must lower, or live-chip sweep candidates die
+    # DEAD in a scarce claim window
     from accl_tpu.ops.flash import flash_attention_packed
 
     N, T, D = 4, 2048, 128
@@ -96,8 +104,7 @@ def test_flash_cast_scratch_lowers_through_mosaic():
                  for _ in range(3))
     exp = jax.export.export(
         jax.jit(lambda q, k, v: flash_attention_packed(
-            q, k, v, causal=True, kernel="resident", q_tiles=2,
-            fuse_denom=True)),
+            q, k, v, causal=True, kernel="resident", **opts)),
         platforms=["tpu"])(*args)
     _assert_mosaic(exp.mlir_module())
 
