@@ -166,6 +166,16 @@ class ACCL:
     def communicator(self, comm_id: int) -> Communicator:
         return self._communicators[comm_id]
 
+    def arithcfg_id(self, uncompressed: DataType,
+                    compressed: Optional[DataType] = None) -> int:
+        """Device id of the arithmetic config for a dtype pair — what a
+        device-side caller passes to :class:`~accl_tpu.device_api.
+        ACCLCommand` (the exchange-memory arithcfg offset the reference's
+        HLS bindings take, driver/hls/accl_hls.h:82).  `compressed`
+        defaults to the uncompressed dtype (no compression lane)."""
+        pair = (uncompressed, compressed or uncompressed)
+        return self._arith_ids[pair]
+
     def create_communicator(self, indices: Sequence[int]) -> int:
         """Create a sub-communicator from global-rank indices; returns its
         id (reference: accl.cpp:971-978).

@@ -106,6 +106,8 @@ def lane_stage(timed_chain_ab):
         with open(LANE_CSV) as f:
             next(f, None)
             for line in f:
+                if not line.endswith("\n"):
+                    continue  # truncated final row — drop, re-measure
                 parts = line.strip().split(",")
                 try:
                     nb = int(parts[0])
@@ -113,7 +115,7 @@ def lane_stage(timed_chain_ab):
                 except (ValueError, IndexError):
                     continue
                 done.add(nb)
-                good.append(line if line.endswith("\n") else line + "\n")
+                good.append(line)
         tmp = LANE_CSV + ".tmp"
         with open(tmp, "w") as f:
             f.write(header)

@@ -30,3 +30,8 @@ def test_train_transformer_3d_example():
     out = _run("train_transformer_3d.py",
                extra_env={"ACCL_EXAMPLE_STEPS": "2"})
     assert "OK" in out
+
+
+def test_device_vadd_put_example():
+    out = _run("device_vadd_put.py")
+    assert "OK" in out
