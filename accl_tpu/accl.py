@@ -173,8 +173,14 @@ class ACCL:
         ACCLCommand` (the exchange-memory arithcfg offset the reference's
         HLS bindings take, driver/hls/accl_hls.h:82).  `compressed`
         defaults to the uncompressed dtype (no compression lane)."""
-        pair = (uncompressed, compressed or uncompressed)
-        return self._arith_ids[pair]
+        pair = (uncompressed,
+                uncompressed if compressed is None else compressed)
+        try:
+            return self._arith_ids[pair]
+        except KeyError:
+            raise ACCLError(
+                f"no arithmetic config for dtype pair {pair} — supported "
+                f"pairs: {sorted(p for p in self._arith_ids)}") from None
 
     def create_communicator(self, indices: Sequence[int]) -> int:
         """Create a sub-communicator from global-rank indices; returns its

@@ -1,0 +1,25 @@
+"""Host-platform environment helpers (jax-free at import time).
+
+One home for the XLA virtual-device-count dance so its rule lives in
+one place (tests/conftest.py keeps a private inline copy because its
+bootstrap must run before this package can be imported).
+"""
+from __future__ import annotations
+
+import os
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def ensure_host_device_count(n: int) -> None:
+    """Make the CPU platform expose at least `n` virtual devices by
+    appending ``--xla_force_host_platform_device_count=n`` to
+    ``XLA_FLAGS`` — a no-op if the flag is already set (the caller's
+    explicit choice wins).  Must run BEFORE the first jax import; to
+    actually select the CPU platform also call
+    ``jax.config.update("jax_platforms", "cpu")`` after importing jax
+    (environment hooks may pin a hardware platform at interpreter
+    start; see docs/troubleshooting.md)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _FLAG not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} --{_FLAG}={n}".strip()

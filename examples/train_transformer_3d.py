@@ -13,13 +13,12 @@ sequence axis all compiled into one SPMD program.
 import os
 import sys
 
-_DEV_FLAG = "--xla_force_host_platform_device_count=8"
-if "--xla_force_host_platform_device_count" not in os.environ.get(
-        "XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + " " + _DEV_FLAG).strip()
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from accl_tpu.utils.platform import ensure_host_device_count
+
+ensure_host_device_count(8)
 
 import jax
 

@@ -49,10 +49,9 @@ def main() -> None:
     ap.add_argument("--outdir", default=os.path.join("bench", "results"))
     args = ap.parse_args()
 
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+    from accl_tpu.utils.platform import ensure_host_device_count
+
+    ensure_host_device_count(8)
 
     import jax
 

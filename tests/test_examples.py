@@ -35,3 +35,8 @@ def test_train_transformer_3d_example():
 def test_device_vadd_put_example():
     out = _run("device_vadd_put.py")
     assert "OK" in out
+
+
+def test_collectives_tpu_gang_example():
+    out = _run("collectives_tpu_gang.py")
+    assert "OK" in out
