@@ -411,17 +411,38 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     if kernel not in ("resident", "grid", "grid_resident"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
 
-    q_spec3 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
-                           memory_space=pltpu.VMEM)
-    out_shapes = (_sds((N, T, D), qp.dtype, vma),
-                  _sds((N, T, 1), jnp.float32, vma))
-
     # snap q_tiles down until the sub-tiles are 8-row-aligned divisors
     # of the (possibly auto-shrunk) q block — the same keep-working
     # contract as the block halving and chunk snapping above
     while q_tiles > 1 and (bq % q_tiles != 0
                            or (bq // q_tiles) % 8 != 0):
         q_tiles -= 1
+
+    # everything static is resolved; the traced part goes through the
+    # custom-vjp boundary so jax.grad works on every entry point
+    cfg = (causal, bq, bk, ck, interpret, mxu_dtype, kernel, needs_cast,
+           q_tiles, fuse_denom)
+    return _flash_packed_diff(qp, kp, vp, cfg)
+
+
+def _flash_forward_impl(qp, kp, vp, cfg):
+    """The schedule dispatch — resolved static config only (see
+    `_flash_call_packed`, which owns validation/auto-tuning)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    (causal, bq, bk, ck, interpret, mxu_dtype, kernel, needs_cast,
+     q_tiles, fuse_denom) = cfg
+    N, T, D = qp.shape
+    Tk = kp.shape[1]
+    nq, nk = T // bq, Tk // bk
+    scale = _LOG2E / float(D) ** 0.5
+    vma = _vma_of(qp, kp, vp)
+
+    q_spec3 = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    out_shapes = (_sds((N, T, D), qp.dtype, vma),
+                  _sds((N, T, 1), jnp.float32, vma))
 
     if kernel == "resident":
         grid = (N, nq)
@@ -500,6 +521,244 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         )(qp, kp, vp)
 
     return out, lse.reshape(N, T)
+
+
+# ---------------------------------------------------------------------------
+# backward pass (jax.custom_vjp)
+# ---------------------------------------------------------------------------
+#
+# The standard flash-attention backward, TPU-shaped: with the saved
+# (out, lse), normalized probabilities rebuild per block as
+# P = exp2(s2 - lse2) (log2 domain like the forward), and
+#
+#   dV_j  = sum_i P_ij dO_i
+#   dS_ij = P_ij * (dO_i . V_j - dvec_i),  dvec_i = dO_i . out_i - dlse_i
+#   dQ_i  = a * sum_j dS_ij K_j,   dK_j = a * sum_i dS_ij Q_i
+#
+# (the dlse term folds the lse output's cotangent in — ring attention
+# differentiates through its lse-weighted shard merge).  Two grid
+# kernels: dQ accumulates over k blocks per q block; dK/dV accumulate
+# over q blocks per k block.  Causal cells are predicated off exactly
+# like the forward grid schedule.
+
+def _flash_bwd_p_block(q2, kb, l2, iq, ik, bq, bk, masked):
+    """Rebuild the normalized probability block [bq, bk] from prescaled
+    q2 (a*log2e folded in) and the log2-domain lse; dead rows (lse =
+    NEG_INF, fully-masked forward) produce zeros.  `masked` applies the
+    causal diagonal test — callers predicate it to the straddling cells
+    only (past cells need no mask; same lane-work split as the forward
+    grid kernel)."""
+    s2 = jax.lax.dot_general(q2, kb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    p = jnp.where(l2 <= NEG_INF / 2, 0.0, jnp.exp2(s2 - l2))
+    if masked:
+        rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        p = jnp.where(rows >= cols, p, 0.0)
+    return p
+
+
+def _bwd_live_diag(iq, ik, bq, bk, causal):
+    """(live, diag) causal cell predicates — identical split to the
+    forward grid kernel: skip future cells entirely, mask only cells
+    straddling the diagonal."""
+    if not causal:
+        return True, False
+    live = ik * bk <= iq * bq + bq - 1
+    diag = (ik * bk + bk - 1 > iq * bq) & live
+    return live, diag
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
+                         dq_ref, acc, *, causal, bq, bk, nk, mxu_dtype,
+                         inv_scale_a):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    live, diag = _bwd_live_diag(iq, ik, bq, bk, causal)
+
+    def body(masked):
+        q2 = q_ref[0].astype(mxu_dtype)      # pre-scaled on the host
+        kb = k_ref[0].astype(mxu_dtype)
+        vb = v_ref[0].astype(mxu_dtype)
+        do = do_ref[0].astype(mxu_dtype)
+        p = _flash_bwd_p_block(q2, kb, l2_ref[0], iq, ik, bq, bk, masked)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0])
+        acc[:] += jax.lax.dot_general(
+            ds.astype(mxu_dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(diag)
+        def _diag_body():
+            body(masked=True)
+
+        @pl.when(live & jnp.logical_not(diag))
+        def _past_body():
+            body(masked=False)
+    else:
+        body(masked=False)
+
+    @pl.when(ik == nk - 1)
+    def _fin():
+        dq_ref[0] = (acc[:] * inv_scale_a).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, causal, bq,
+                          bk, nq, mxu_dtype):
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live, diag = _bwd_live_diag(iq, ik, bq, bk, causal)
+
+    def body(masked):
+        q2 = q_ref[0].astype(mxu_dtype)
+        kb = k_ref[0].astype(mxu_dtype)
+        vb = v_ref[0].astype(mxu_dtype)
+        do = do_ref[0].astype(mxu_dtype)
+        p = _flash_bwd_p_block(q2, kb, l2_ref[0], iq, ik, bq, bk, masked)
+        pc = p.astype(mxu_dtype)
+        dv_acc[:] += jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - dvec_ref[0])).astype(mxu_dtype)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(diag)
+        def _diag_body():
+            body(masked=True)
+
+        @pl.when(live & jnp.logical_not(diag))
+        def _past_body():
+            body(masked=False)
+    else:
+        body(masked=False)
+
+    @pl.when(iq == nq - 1)
+    def _fin():
+        # q2 carries the a*log2e prescale, so dK needs it divided back
+        # out on top of its own `a` factor: a / (a*log2e) = 1/log2e
+        dk_ref[0] = (dk_acc[:] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    (causal, bq, bk, _ck, interpret, mxu_dtype, _kernel, _nc, _qt,
+     _fd) = cfg
+    N, T, D = qp.shape
+    Tk = kp.shape[1]
+    nq, nk = T // bq, Tk // bk
+    a = 1.0 / float(D) ** 0.5
+    vma = _vma_of(qp, kp, vp, g_out)
+
+    # host-side prep: prescaled q (exp2 domain), log2-domain lse, and
+    # the dS offset with the lse cotangent folded in
+    q2 = (qp.astype(jnp.float32) * (a * _LOG2E)).astype(qp.dtype)
+    l2 = (lse * _LOG2E)[..., None]                       # [N, T, 1]
+    dvec = jnp.sum(g_out.astype(jnp.float32)
+                   * out.astype(jnp.float32), axis=-1, keepdims=True)
+    if g_lse is not None:
+        dvec = dvec - g_lse.astype(jnp.float32)[..., None]
+
+    qb_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kb_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    ql_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, bq=bq,
+                          bk=bk, nk=nk, mxu_dtype=mxu_dtype,
+                          inv_scale_a=a),
+        out_shape=_sds((N, T, D), qp.dtype, vma),
+        grid=(N, nq, nk),
+        in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, ql_spec, ql_spec],
+        out_specs=qb_spec,
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q2, kp, vp, g_out, l2, dvec)
+
+    # dK/dV: swap the roles — k blocks on the parallel axis, q blocks
+    # accumulated sequentially
+    qs_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    ks_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    ls_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal, bq=bq,
+                          bk=bk, nq=nq, mxu_dtype=mxu_dtype),
+        out_shape=(_sds((N, Tk, D), kp.dtype, vma),
+                   _sds((N, Tk, D), vp.dtype, vma)),
+        grid=(N, nk, nq),
+        in_specs=[qs_spec, ks_spec, ks_spec, qs_spec, ls_spec, ls_spec],
+        out_specs=(ks_spec, ks_spec),
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q2, kp, vp, g_out, l2, dvec)
+
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_packed_diff(qp, kp, vp, cfg):
+    return _flash_forward_impl(qp, kp, vp, cfg)
+
+
+def _flash_diff_fwd(qp, kp, vp, cfg):
+    # symbolic_zeros=True wraps primals in (value, perturbed) records
+    qp, kp, vp = (getattr(x, "value", x) for x in (qp, kp, vp))
+    out, lse = _flash_forward_impl(qp, kp, vp, cfg)
+    return (out, lse), (qp, kp, vp, out, lse)
+
+
+def _flash_diff_bwd(cfg, res, cts):
+    from jax.custom_derivatives import SymbolicZero
+
+    qp, kp, vp, out, lse = res
+    g_out, g_lse = cts
+    # callers that discard lse (most) get a SYMBOLIC zero cotangent —
+    # skip the dvec subtract instead of materializing a zero [N, T]
+    if isinstance(g_lse, SymbolicZero):
+        g_lse = None
+    if isinstance(g_out, SymbolicZero):  # lse-only losses (rare)
+        g_out = jnp.zeros(out.shape, out.dtype)
+    return _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg)
+
+
+_flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
+                          symbolic_zeros=True)
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,

@@ -117,3 +117,22 @@ def test_reduce_lane_lowers_through_mosaic():
         jax.jit(lambda a, b: pallas_add(a, b, interpret=False)),
         platforms=["tpu"])(x, x)
     _assert_mosaic(exp.mlir_module())
+
+
+def test_flash_backward_lowers_through_mosaic():
+    # the custom-VJP backward (dq and dk/dv kernels) must lower for the
+    # real TPU target too — training on hardware runs exactly this
+    from accl_tpu.ops.flash import flash_attention_packed
+
+    N, T, D = 4, 2048, 128
+    arg = jax.ShapeDtypeStruct((N, T, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention_packed(
+            q, k, v, causal=True, kernel="resident").astype(jnp.float32))
+
+    exp = jax.export.export(
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))),
+        platforms=["tpu"])(arg, arg, arg)
+    text = exp.mlir_module()
+    _assert_mosaic(text)

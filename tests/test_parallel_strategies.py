@@ -377,3 +377,36 @@ def test_ring_attention_flash_opts_passthrough():
         check_vma=False))
     gz = np.asarray(fz(q[:, perm], k[:, perm], v[:, perm])[:, inv])
     np.testing.assert_allclose(gz, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_flash_trains():
+    # on real TPU the SP train path defaults to impl="flash" — the
+    # kernel's custom VJP must produce dense-exact gradients through
+    # the lse-weighted ring merge (a non-differentiable kernel would
+    # break training exactly where CPU CI can't see it)
+    import jax
+
+    from accl_tpu.parallel.mesh import make_mesh
+
+    P_sp = 4
+    mesh = make_mesh(sp=P_sp)
+    B, Tl, H, D = 1, 32, 2, 16
+    rng = np.random.default_rng(41)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, P_sp * Tl, H, D)),
+                           jnp.float32) for _ in range(3))
+    spec = P(None, "sp", None, None)
+
+    def mkloss(impl):
+        fn = jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis="sp",
+                                           causal=True, impl=impl),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False)
+        return lambda a, b, c: jnp.sum(fn(a, b, c) ** 2)
+
+    gf = jax.jit(jax.grad(mkloss("flash"), argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(mkloss("dense"), argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
