@@ -369,10 +369,6 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         ck = next((d for d in range(min(chunk_k, bk), 7, -1)
                    if bk % d == 0), bk)
 
-    # log2(e) folds into the q prescale so the fold's exponentials are
-    # native exp2 with no per-score multiply (see _softmax_fold)
-    scale = _LOG2E / float(D) ** 0.5
-    vma = _vma_of(qp, kp, vp)
     mxu_dtype = jnp.dtype(mxu_dtype)
     # one-shot K/V cast scratch is OPT-IN: it trades the per-fold cast
     # for a serialized q-block order ("arbitrary" semantics), a tradeoff

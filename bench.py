@@ -288,6 +288,17 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         best_fa, best_f2, best_mm = None, None, None
         best_pk = {name: None for name in d128_variants}
         best_pk64 = {name: None for name in d64_variants}
+        # backward pass (the custom-VJP Pallas kernels): chained via dq
+        # feeding the next q.  7 matmuls over the causal cells vs the
+        # forward's 2 -> 3.5x the forward flops.
+        from accl_tpu.ops.flash import flash_attention_packed as _fap
+
+        def fa_bwd(x, kk, vv):
+            return jax.grad(lambda a, b, c: jnp.sum(
+                _fap(a, b, c, causal=True, kernel="resident")
+                .astype(jnp.float32)), argnums=(0,))(x, kk, vv)[0]
+
+        best_bwd = None
         dead_variants: set = set()
         for _ in range(10):
             d1 = timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
@@ -322,6 +333,15 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
                     continue
                 prev = best_pk64[name]
                 best_pk64[name] = dv if prev is None else min(prev, dv)
+            if "bwd" not in dead_variants:
+                try:
+                    dv = timed_chain(fa_bwd, q2p, iters=24, trials=1,
+                                     consts=(k2p, v2p))
+                    best_bwd = (dv if best_bwd is None
+                                else min(best_bwd, dv))
+                except Exception as ve:  # noqa: BLE001
+                    dead_variants.add("bwd")
+                    detail["flash_d128_fwdbwd_error"] = type(ve).__name__
         # causal: ~half of the 4*B*H*T^2*D matmul flops
         flops = 4 * B * H * T * T * D / 2
         detail["flash_attention_tflops"] = round(flops / best_fa / 1e12, 3)
@@ -347,6 +367,15 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         detail["flash_d128_packed_all"] = {
             n: (round(flops / dt / 1e12, 2) if isinstance(dt, float)
                 else dt) for n, dt in best_pk.items()}
+        if best_bwd is not None:
+            # the timed chain runs forward + backward per iteration
+            # (jax.grad re-runs the custom-VJP forward): 2 fwd matmuls
+            # + 7 bwd matmuls per causal cell = 4.5x the fwd flops
+            bwd_flops = 4.5 * flops
+            detail["flash_d128_fwdbwd_tflops"] = round(
+                bwd_flops / best_bwd / 1e12, 3)
+            detail["flash_d128_fwdbwd_mxu_frac"] = round(
+                (bwd_flops / best_bwd) / (2 * mm_n**3 / best_mm), 3)
         live64 = {n: dt for n, dt in best_pk64.items()
                   if isinstance(dt, float)}
         if live64:
