@@ -43,8 +43,10 @@ class ModelConfig:
     #: parallel.ring_attention.zigzag_indices)
     sp_schedule: str = "contiguous"
     #: rematerialize each transformer block on the backward pass
-    #: (jax.checkpoint): activation memory O(T) instead of
-    #: O(n_layers * T) at ~1/3 more compute — the long-context lever
+    #: (jax.checkpoint): only the block-input residuals stay live; the
+    #: per-layer intermediates (d_ff activations, attention
+    #: probabilities) are recomputed, at ~1/3 more compute — the
+    #: long-context memory lever
     remat: bool = False
 
     def __post_init__(self):
@@ -160,10 +162,11 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         return x + m
 
     if cfg.remat:
-        # rematerialize each block on the backward pass: activation
-        # memory drops from O(n_layers * T) to O(T) at ~1/3 more
-        # compute — the long-context memory lever (jax.checkpoint over
-        # the layer, same policy knob the big training stacks expose)
+        # rematerialize each block on the backward pass: only the
+        # block-input residuals stay live across layers; the per-layer
+        # intermediates (d_ff activations, attention probabilities —
+        # the bulky part) recompute at ~1/3 more FLOPs (jax.checkpoint
+        # over the layer, the knob the big training stacks expose)
         block = jax.checkpoint(block)
     for blk in params["blocks"]:
         x = block(x, blk)

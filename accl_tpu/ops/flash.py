@@ -358,7 +358,6 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     if T % bq != 0 or Tk % bk != 0:
         raise ValueError(
             f"sequence lengths {T}/{Tk} not divisible by blocks ({bq}, {bk})")
-    nq, nk = T // bq, Tk // bk
     # sub-fold chunk (None = whole block): smaller chunks give the
     # compiler MXU/VPU pipelining slack at the price of smaller matmuls.
     # Snap to the largest divisor of bk at or below the request, never

@@ -71,3 +71,52 @@ def initialize_world(design: Design | str, nranks: int, rank: int = 0,
 
         return TpuWorld(nranks, **kwargs)
     raise ValueError(f"unknown design {design}")
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None,
+                         local_device_ids=None,
+                         dry_run: bool = False) -> dict:
+    """Multi-host JAX bring-up — the reference's MPI-launch role
+    (test/host/Coyote run scripts start one driver process per node and
+    exchange QPs over MPI; here each host process joins the cluster via
+    jax.distributed so `jax.devices()` spans every host and the hybrid
+    ICI x DCN meshes of :func:`accl_tpu.parallel.make_hybrid_mesh`
+    compile against the full device set).
+
+    Call once per host process BEFORE any other jax use.  Arguments
+    default from the environment: ``ACCL_COORDINATOR`` (host:port of
+    process 0), ``ACCL_NUM_PROCESSES``, ``ACCL_PROCESS_ID`` — on cloud
+    TPU pods all three may be omitted entirely (jax auto-detects from
+    the TPU metadata).  ``dry_run=True`` returns the resolved kwargs
+    without touching jax (arg-assembly testing on CI, where a second
+    host doesn't exist)."""
+    import os
+
+    def _env_int(name):
+        val = os.environ.get(name)
+        return int(val) if val is not None else None
+
+    kwargs = {}
+    coordinator_address = (coordinator_address
+                           or os.environ.get("ACCL_COORDINATOR"))
+    num_processes = (num_processes if num_processes is not None
+                     else _env_int("ACCL_NUM_PROCESSES"))
+    process_id = (process_id if process_id is not None
+                  else _env_int("ACCL_PROCESS_ID"))
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    if dry_run:
+        return kwargs
+
+    import jax
+
+    jax.distributed.initialize(**kwargs)
+    return kwargs

@@ -340,7 +340,11 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
                     best_bwd = (dv if best_bwd is None
                                 else min(best_bwd, dv))
                 except Exception as ve:  # noqa: BLE001
+                    # same convention as the schedule candidates: the
+                    # error REPLACES the number (a half-measured best
+                    # would read as trustworthy)
                     dead_variants.add("bwd")
+                    best_bwd = None
                     detail["flash_d128_fwdbwd_error"] = type(ve).__name__
         # causal: ~half of the 4*B*H*T^2*D matmul flops
         flops = 4 * B * H * T * T * D / 2

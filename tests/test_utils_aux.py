@@ -71,3 +71,29 @@ def test_debug_logging_env(capsys, monkeypatch):
     monkeypatch.delenv("ACCL_DEBUG")
     stdlog.getLogger("accl_tpu").handlers.clear()
     importlib.reload(alog)
+
+
+def test_initialize_multihost_arg_assembly(monkeypatch):
+    # dry_run resolves explicit args + ACCL_* env defaults without
+    # touching jax (a second host doesn't exist on CI); explicit
+    # arguments win over the environment
+    from accl_tpu.utils.bringup import initialize_multihost
+
+    monkeypatch.setenv("ACCL_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("ACCL_NUM_PROCESSES", "4")
+    monkeypatch.setenv("ACCL_PROCESS_ID", "2")
+    kw = initialize_multihost(dry_run=True)
+    assert kw == {"coordinator_address": "10.0.0.1:8476",
+                  "num_processes": 4, "process_id": 2}
+
+    kw = initialize_multihost(coordinator_address="h:1", process_id=0,
+                              local_device_ids=[0, 1], dry_run=True)
+    assert kw["coordinator_address"] == "h:1"
+    assert kw["process_id"] == 0
+    assert kw["local_device_ids"] == [0, 1]
+    assert kw["num_processes"] == 4  # env still fills the gap
+
+    monkeypatch.delenv("ACCL_COORDINATOR")
+    monkeypatch.delenv("ACCL_NUM_PROCESSES")
+    monkeypatch.delenv("ACCL_PROCESS_ID")
+    assert initialize_multihost(dry_run=True) == {}  # pod auto-detect
