@@ -47,28 +47,56 @@ def flash_stage(timed_chain):
         except ValueError:
             res = {}  # partial write from a killed run — redo
 
-    if "schedules" not in res:
-        cands = {
-            "bq256_bk512": make_variant(256, 512),
-            "bq512_bk512": make_variant(512, 512),
-            "bq512_bk256": make_variant(512, 256),
-            "bq256_bk512_ck256": make_variant(256, 512, ck=256),
-            "bq256_bk512_qt2": make_variant(256, 512, qt=2),
-            "bq512_bk512_qt2": make_variant(512, 512, qt=2),
-            "bq512_bk512_qt4": make_variant(512, 512, qt=4),
-            "bq256_bk512_fd": make_variant(256, 512, fd=True),
-            "bq256_bk512_qt2_fd": make_variant(256, 512, qt=2, fd=True),
-            "bq512_bk512_qt2_fd": make_variant(512, 512, qt=2, fd=True),
-            # one-shot K/V cast (kills the per-fold f32->bf16 VPU pass)
-            # stacked with the interleaved chains
-            "bq256_bk512_cast": make_variant(256, 512, cast=True),
-            "bq256_bk512_qt2_cast": make_variant(256, 512, qt=2,
-                                                 cast=True),
-            "bq512_bk512_qt2_cast": make_variant(512, 512, qt=2,
-                                                 cast=True),
-        }
-        best, best_mm = run_sweep(jax, jnp, timed_chain, cands, rounds=3)
-        res = report(best, best_mm)
+    cands = {
+        "bq256_bk512": make_variant(256, 512),
+        "bq512_bk512": make_variant(512, 512),
+        "bq512_bk256": make_variant(512, 256),
+        "bq256_bk512_ck256": make_variant(256, 512, ck=256),
+        "bq256_bk512_qt2": make_variant(256, 512, qt=2),
+        "bq512_bk512_qt2": make_variant(512, 512, qt=2),
+        "bq512_bk512_qt4": make_variant(512, 512, qt=4),
+        "bq256_bk512_fd": make_variant(256, 512, fd=True),
+        "bq256_bk512_qt2_fd": make_variant(256, 512, qt=2, fd=True),
+        "bq512_bk512_qt2_fd": make_variant(512, 512, qt=2, fd=True),
+        # one-shot K/V cast (kills the per-fold f32->bf16 VPU pass)
+        # stacked with the interleaved chains
+        "bq256_bk512_cast": make_variant(256, 512, cast=True),
+        "bq256_bk512_qt2_cast": make_variant(256, 512, qt=2,
+                                             cast=True),
+        "bq512_bk512_qt2_cast": make_variant(512, 512, qt=2,
+                                             cast=True),
+    }
+    # per-ROUND persistence: a brief claim window that only survives
+    # one round still banks its minimums (raw seconds merge across
+    # runs; `schedules` is recomputed from the merged raw each time).
+    # An artifact from the pre-persistence format (has schedules but no
+    # raw seconds) is COMPLETE — don't throw its banked minimums away.
+    raw = res.get("raw_s", {})
+    raw_mm = res.get("raw_mm_s")
+    rounds_done = res.get("rounds_done",
+                          3 if "schedules" in res else 0)
+    dead_local: set = set()  # compile-failed THIS process: skip its
+    # remaining rounds (transient claim errors get retried by the next
+    # process invocation)
+    for _ in range(rounds_done, 3):
+        live = {n: f for n, f in cands.items() if n not in dead_local}
+        best, best_mm = run_sweep(jax, jnp, timed_chain, live, rounds=1)
+        raw_mm = best_mm if raw_mm is None else min(raw_mm, best_mm)
+        for name, dt in best.items():
+            prev = raw.get(name)
+            if isinstance(dt, float):
+                raw[name] = (dt if not isinstance(prev, float)
+                             else min(prev, dt))
+            else:
+                dead_local.add(name)
+                if prev is None:
+                    raw[name] = dt  # error string; next process retries
+        rep = report(raw, raw_mm)
+        res.update(rep)
+        res["raw_s"] = raw
+        res["raw_mm_s"] = raw_mm
+        rounds_done += 1
+        res["rounds_done"] = rounds_done
         _write_json(FLASH_JSON, res)
 
     if "d64" not in res:
