@@ -224,32 +224,40 @@ def loss_fn(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
     return jnp.sum(nll), jnp.sum(valid.astype(jnp.float32))
 
 
-def sum_count_device_step(loss_closure, params, data_axes, lr):
-    """Shared per-device SGD step for loss functions returning a LOCAL
+def _mean_grads(loss_closure, params, data_axes):
+    """Per-device mean gradients for loss functions returning a LOCAL
     ``(loss_sum, count)`` pair (the sum-and-count discipline).
 
     Gradients of replicated parameters come back from ``value_and_grad``
     already psummed over the axes they are unvarying on (jax's
     replication-aware vma transpose), and sharded leaves keep per-shard
     grads — so re-reducing here would multiply the gradient by the mesh
-    size.  The only remaining work is the global count/loss psum and a
-    single lr/total scale.  Returns ``(new_params, mean_loss)``.
-    """
+    size.  The only remaining work is the global count/loss psum and
+    the 1/total normalization.  Returns ``(g_mean, mean_loss)``."""
     (loss_sum, count), grads = jax.value_and_grad(
         loss_closure, has_aux=True)(params)
     total, loss_tot = count, loss_sum
     for a in data_axes:
         total = lax.psum(total, a)
         loss_tot = lax.psum(loss_tot, a)
-    scale = lr / jnp.maximum(total, 1.0)
+    denom = jnp.maximum(total, 1.0)
+    g_mean = jax.tree_util.tree_map(lambda g: g / denom, grads)
+    return g_mean, loss_tot / denom
+
+
+def sum_count_device_step(loss_closure, params, data_axes, lr):
+    """Plain-SGD per-device step over :func:`_mean_grads`.
+    Returns ``(new_params, mean_loss)``."""
+    g_mean, mean_loss = _mean_grads(loss_closure, params, data_axes)
     new_params = jax.tree_util.tree_map(
-        lambda p_, g_: p_ - scale * g_, params, grads)
-    return new_params, loss_tot / jnp.maximum(total, 1.0)
+        lambda p_, g_: p_ - lr * g_, params, g_mean)
+    return new_params, mean_loss
 
 
 def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
                     dp: Optional[str] = "dp", tp: Optional[str] = "tp",
-                    sp: Optional[str] = "sp"):
+                    sp: Optional[str] = "sp", optimizer=None,
+                    params=None):
     """Build the jitted SPMD train step over `mesh`.
 
     Axes not present in the mesh are dropped automatically.  Gradient
@@ -260,8 +268,24 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
     Megatron discipline.  For explicitly compressed gradient sync use
     strategies.sync_gradients in a custom step.
 
-    Returns (step_fn, (param_specs, token_spec)) where
-    step_fn(params, tokens) -> (new_params, mean_loss)."""
+    Default (``optimizer=None``): plain SGD at `lr`; returns
+    (step_fn, (param_specs, token_spec)) with
+    step_fn(params, tokens) -> (new_params, mean_loss).
+
+    With an optax ``optimizer`` (requires `params` for state-spec
+    derivation): optimizer states shard exactly like the parameters
+    they mirror (tp-sharded moments stay sharded), and the returned
+    bundle is (step_fn, (param_specs, opt_state_specs, token_spec),
+    init_opt) with step_fn(params, opt_state, tokens) ->
+    (new_params, new_opt_state, mean_loss) and init_opt(params) placing
+    a fresh state on the mesh.
+
+    The update runs PER SHARD inside shard_map, so the transform must
+    be parameter-local/elementwise (adam, adamw, sgd, momentum, ...).
+    Transforms that take cross-parameter statistics — e.g.
+    ``clip_by_global_norm`` — would compute them from local tp shards
+    and diverge from the single-device result; apply those to the mean
+    gradients in a custom step instead."""
     axes = set(mesh.axis_names)
     dp = dp if dp in axes else None
     tp = tp if tp in axes else None
@@ -275,14 +299,56 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
     tok_spec = P(dp, sp)
     data_axes = tuple(a for a in (dp, sp) if a)
 
-    def device_step(params, tokens):
-        return sum_count_device_step(
-            lambda p: loss_fn(p, tokens, cfg, tp, sp), params, data_axes, lr)
+    if optimizer is None:
+        def device_step(params, tokens):
+            return sum_count_device_step(
+                lambda p: loss_fn(p, tokens, cfg, tp, sp), params,
+                data_axes, lr)
+
+        step = jax.shard_map(device_step, mesh=mesh,
+                             in_specs=(specs, tok_spec),
+                             out_specs=(specs, P()))
+        return jax.jit(step), (specs, tok_spec)
+
+    if params is None:
+        raise ValueError("optimizer path needs `params` (a host or "
+                         "sharded pytree) to derive optimizer-state "
+                         "PartitionSpecs")
+    # optimizer states carry whole param-shaped subtrees (adam's mu/nu
+    # are literally params-structured trees): substitute the param spec
+    # tree for every state node with the params' treedef, replicate the
+    # rest (step counts etc.)
+    p_treedef = jax.tree_util.tree_structure(params)
+
+    def _params_like(node):
+        return jax.tree_util.tree_structure(node) == p_treedef
+
+    state_shapes = jax.eval_shape(optimizer.init, params)
+    st_leaves, st_def = jax.tree_util.tree_flatten(
+        state_shapes, is_leaf=_params_like)
+    opt_specs = jax.tree_util.tree_unflatten(
+        st_def, [specs if _params_like(leaf) else P()
+                 for leaf in st_leaves])
+
+    import optax as _optax
+
+    def device_step(params, opt_state, tokens):
+        g_mean, mean_loss = _mean_grads(
+            lambda p: loss_fn(p, tokens, cfg, tp, sp), params, data_axes)
+        updates, new_state = optimizer.update(g_mean, opt_state, params)
+        new_params = _optax.apply_updates(params, updates)
+        return new_params, new_state, mean_loss
 
     step = jax.shard_map(device_step, mesh=mesh,
-                         in_specs=(specs, tok_spec),
-                         out_specs=(specs, P()))
-    return jax.jit(step), (specs, tok_spec)
+                         in_specs=(specs, opt_specs, tok_spec),
+                         out_specs=(specs, opt_specs, P()))
+
+    def init_opt(p):
+        return _place(optimizer.init(
+            jax.tree_util.tree_map(lambda x: jnp.asarray(x), p)),
+            opt_specs, mesh)
+
+    return jax.jit(step), (specs, opt_specs, tok_spec), init_opt
 
 
 def shard_params(params, mesh, cfg: ModelConfig, tp: Optional[str] = "tp"):

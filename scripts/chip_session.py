@@ -99,6 +99,25 @@ def flash_stage(timed_chain):
         res["rounds_done"] = rounds_done
         _write_json(FLASH_JSON, res)
 
+    # error-marked candidates from earlier invocations get ONE retry
+    # per process even after all rounds completed (a transient claim
+    # error in the final round must not freeze an {"error": ...} into
+    # the artifact forever)
+    errs = [n for n in cands
+            if n in raw and not isinstance(raw[n], float)
+            and n not in dead_local]
+    if errs:
+        best, best_mm = run_sweep(
+            jax, jnp, timed_chain, {n: cands[n] for n in errs}, rounds=1)
+        raw_mm = best_mm if raw_mm is None else min(raw_mm, best_mm)
+        for name, dt in best.items():
+            if isinstance(dt, float):
+                raw[name] = dt
+        res.update(report(raw, raw_mm))
+        res["raw_s"] = raw
+        res["raw_mm_s"] = raw_mm
+        _write_json(FLASH_JSON, res)
+
     if "d64" not in res:
         cands64 = {
             "d64_resident": make_variant(256, 512),
