@@ -257,8 +257,13 @@ def sum_count_device_step(loss_closure, params, data_axes, lr):
 def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
                     dp: Optional[str] = "dp", tp: Optional[str] = "tp",
                     sp: Optional[str] = "sp", optimizer=None,
-                    params=None):
+                    params=None, check_vma: bool = True):
     """Build the jitted SPMD train step over `mesh`.
+
+    `check_vma=False` is needed on the CPU rung when cfg.attn="flash"
+    (the Pallas HLO interpreter inside shard_map trips jax's
+    vma/dynamic_slice limitation — same caveat as ring_attention's
+    flash impl); compiled TPU execution keeps the default.
 
     Axes not present in the mesh are dropped automatically.  Gradient
     synchronization (the fw allreduce role) happens through jax's
@@ -307,7 +312,8 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
 
         step = jax.shard_map(device_step, mesh=mesh,
                              in_specs=(specs, tok_spec),
-                             out_specs=(specs, P()))
+                             out_specs=(specs, P()),
+                             check_vma=check_vma)
         return jax.jit(step), (specs, tok_spec)
 
     if params is None:
@@ -341,7 +347,8 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
 
     step = jax.shard_map(device_step, mesh=mesh,
                          in_specs=(specs, opt_specs, tok_spec),
-                         out_specs=(specs, opt_specs, P()))
+                         out_specs=(specs, opt_specs, P()),
+                         check_vma=check_vma)
 
     def init_opt(p):
         return _place(optimizer.init(
