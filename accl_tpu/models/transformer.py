@@ -257,13 +257,14 @@ def sum_count_device_step(loss_closure, params, data_axes, lr):
 def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
                     dp: Optional[str] = "dp", tp: Optional[str] = "tp",
                     sp: Optional[str] = "sp", optimizer=None,
-                    params=None, check_vma: bool = True):
+                    params=None, check_vma: Optional[bool] = None):
     """Build the jitted SPMD train step over `mesh`.
 
-    `check_vma=False` is needed on the CPU rung when cfg.attn="flash"
-    (the Pallas HLO interpreter inside shard_map trips jax's
-    vma/dynamic_slice limitation — same caveat as ring_attention's
-    flash impl); compiled TPU execution keeps the default.
+    `check_vma` defaults per backend: on the CPU rung with
+    cfg.attn="flash" the Pallas HLO interpreter inside shard_map trips
+    jax's vma/dynamic_slice limitation (same caveat as ring_attention's
+    flash impl), so the check is disabled there automatically; compiled
+    TPU execution keeps it on.  Pass an explicit bool to override.
 
     Axes not present in the mesh are dropped automatically.  Gradient
     synchronization (the fw allreduce role) happens through jax's
@@ -303,6 +304,9 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
     specs = param_specs(cfg, tp)
     tok_spec = P(dp, sp)
     data_axes = tuple(a for a in (dp, sp) if a)
+    if check_vma is None:
+        check_vma = not (cfg.attn == "flash"
+                         and jax.default_backend() != "tpu")
 
     if optimizer is None:
         def device_step(params, tokens):

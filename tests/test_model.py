@@ -212,9 +212,8 @@ def test_optax_train_step_matches_single_device():
 def test_bf16_flash_remat_training_smoke():
     # the real-TPU training configuration (bf16 activations, flash
     # attention, per-block remat) on a dp x tp mesh: losses stay finite
-    # and decrease.  check_vma=False is the CPU-rung escape hatch for
-    # the Pallas HLO interpreter inside shard_map (compiled TPU
-    # execution keeps the default).
+    # and decrease (check_vma auto-disables on the CPU rung for the
+    # flash interpreter inside shard_map; compiled TPU keeps it on).
     import dataclasses
 
     from jax.sharding import NamedSharding
@@ -223,8 +222,7 @@ def test_bf16_flash_remat_training_smoke():
                               remat=True)
     params = init_params(np.random.default_rng(0), cfg)
     mesh = make_mesh(dp=2, tp=2)
-    step, (specs, tok_spec) = make_train_step(mesh, cfg, lr=1e-2,
-                                              check_vma=False)
+    step, (specs, tok_spec) = make_train_step(mesh, cfg, lr=1e-2)
     p = shard_params(params, mesh, cfg)
     tok = jax.device_put(jnp.asarray(_tokens(4, 32, seed=1)),
                          NamedSharding(mesh, tok_spec))
