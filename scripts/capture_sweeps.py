@@ -14,9 +14,9 @@ test/host/xrt/src/bench.cpp:25-61 + parse_bench_results.py):
                            stack vs a bare jitted shard_map psum on the
                            same mesh (the Coyote harness's ACCL-vs-MPI
                            comparison role, plot.py:10-44)
-  sweep_{emu,tpu8}_f16_r{N}.csv  fp16 allreduce sweep (the metric of
-                           record names fp32/fp16) through the f16
-                           arithmetic lanes
+  sweep_{emu,dgram,rdma,tpu8}_f16_r{N}.csv  fp16 allreduce sweep on
+                           every rung (the metric of record names
+                           fp32/fp16) through the f16 arithmetic lanes
   pipeline_ab_r{N}.csv     eager egress pipelining A/B (depth 1 vs 3)
                            across message sizes on the emulator
 
@@ -41,7 +41,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, default=4)
-    ap.add_argument("--stages", default="emu,dgram,rdma,tpu8,f16,vsraw,pipeline",
+    ap.add_argument("--stages",
+                    default="emu,dgram,rdma,tpu8,f16,f16all,vsraw,pipeline",
                     help="comma list of stages to run")
     ap.add_argument("--maxpow", type=int, default=19,
                     help="largest 2^k element count (BASELINE metric of "
@@ -145,6 +146,22 @@ def main() -> None:
         with TpuWorld(8) as w, open(path, "w", newline="") as f:
             run_sweep(prep_tpu_world(w), cfg16, writer=f)
         print(f"wrote {path}")
+
+    # 3d. f16 on the lossy/datagram and RDMA rungs too ("f16all"),
+    # completing the fp32+fp16 matrix across every transport rung
+    if "f16all" in stages:
+        cfg16 = SweepConfig(collectives=("allreduce",),
+                            count_pows=tuple(range(4, args.maxpow + 1)),
+                            dtype="float16", repetitions=3)
+        for rung, kw in (("dgram", dict(transport="dgram", mtu=512,
+                                        reorder_window=8)),
+                         ("rdma", dict(transport="rdma"))):
+            path = os.path.join(args.outdir,
+                                f"sweep_{rung}_f16_{tag}.csv")
+            with make_emu_world(**kw) as w, \
+                    open(path, "w", newline="") as f:
+                run_sweep(raise_timeouts(w), cfg16, writer=f)
+            print(f"wrote {path}")
 
     # 3b + 4: the remaining stages self-select below
     if "vsraw" in stages:

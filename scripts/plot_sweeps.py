@@ -59,6 +59,8 @@ def main() -> None:
     }
     f16_rungs = {
         "emulator fp16": f"sweep_emu_f16_{tag}.csv",
+        "datagram rung fp16": f"sweep_dgram_f16_{tag}.csv",
+        "RDMA rung fp16": f"sweep_rdma_f16_{tag}.csv",
         "TPU backend gang fp16": f"sweep_tpu8_f16_{tag}.csv",
     }
 
