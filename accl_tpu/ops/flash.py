@@ -326,10 +326,21 @@ def _sds(shape, dtype, vma):
 #: blocks (~16 MB/core)
 _RESIDENT_KV_BYTES = 6 << 20
 
+#: Auto-schedule defaults applied when the caller leaves q_tiles=None
+#: (the public default).  A single fold chain serializes MXU (QK^T,
+#: PV) against VPU (max/exp2); two independent q sub-tile chains plus
+#: a split fold give the scheduler independent work to overlap.  The
+#: values are tuned against the live-chip schedule sweep
+#: (scripts/chip_session.py -> bench/results/flash_tune_r{N}.json; the
+#: plain single-chain schedule is the `bq256_bk512` candidate there).
+#: Explicit q_tiles/chunk_k always win over the auto table.
+_AUTO_Q_TILES = 2
+_AUTO_CHUNK_K = 256
+
 
 def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                        mxu_dtype, kernel, chunk_k=None,
-                       kv_cast_scratch=False, q_tiles=1,
+                       kv_cast_scratch=False, q_tiles=None,
                        fuse_denom=False):
     """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
     flattened — the splash-attention layout).  This is the zero-copy
@@ -362,11 +373,11 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     # compiler MXU/VPU pipelining slack at the price of smaller matmuls.
     # Snap to the largest divisor of bk at or below the request, never
     # under the 8-row tile floor (halving alone can decay 12->3->1)
-    if chunk_k is None:
-        ck = bk
-    else:
-        ck = next((d for d in range(min(chunk_k, bk), 7, -1)
-                   if bk % d == 0), bk)
+    def snap_ck(req):
+        return next((d for d in range(min(req, bk), 7, -1)
+                     if bk % d == 0), bk)
+
+    ck = bk if chunk_k is None else snap_ck(chunk_k)
 
     mxu_dtype = jnp.dtype(mxu_dtype)
     # one-shot K/V cast scratch is OPT-IN: it trades the per-fold cast
@@ -374,7 +385,13 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     # that must be measured per chip generation
     needs_cast = kv_cast_scratch and qp.dtype != mxu_dtype
 
-    if q_tiles < 1:
+    # q_tiles=None (the public default) opts into the auto schedule:
+    # tuned (q_tiles, chunk_k) applied after the kernel resolves below.
+    # Explicit q_tiles (incl. 1 = plain single-chain) is always honored.
+    auto_sched = q_tiles is None
+    if auto_sched:
+        q_tiles = _AUTO_Q_TILES
+    elif q_tiles < 1:
         raise ValueError(f"q_tiles={q_tiles} must be >= 1")
     if fuse_denom and kernel not in ("resident", "auto"):
         # an EXPLICIT non-resident kernel with the resident-only option
@@ -405,6 +422,9 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
             fuse_denom = False
     if kernel not in ("resident", "grid", "grid_resident"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
+
+    if auto_sched and chunk_k is None:
+        ck = snap_ck(_AUTO_CHUNK_K)
 
     # snap q_tiles down until the sub-tiles are 8-row-aligned divisors
     # of the (possibly auto-shrunk) q block — the same keep-working
@@ -757,7 +777,7 @@ _flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
-                kernel, q_tiles=1, fuse_denom=False):
+                kernel, q_tiles=None, fuse_denom=False):
     """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the core
     call (two HBM transposes per operand direction — callers on the hot
     path should use the packed entry points).  Returns (out [B,T,H,D],
@@ -783,7 +803,7 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
                     mxu_dtype=jnp.bfloat16, kernel: str = "auto",
-                    q_tiles: int = 1, fuse_denom: bool = False):
+                    q_tiles: int | None = None, fuse_denom: bool = False):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
     causal mask).  T must be divisible by the (auto-shrunk) block sizes.
 
@@ -795,7 +815,10 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     VMEM per batch-head (fetched once; best while it fits), "grid"
     streams K/V blocks per q-block (any T), "auto" picks by K/V size.
     `q_tiles` (any schedule) and `fuse_denom` (resident only) are the
-    throughput options (see :func:`flash_attention_packed`)."""
+    throughput options (see :func:`flash_attention_packed`); leaving
+    `q_tiles` at None applies the tuned auto schedule (interleaved
+    sub-tile chains + split folds), `q_tiles=1` forces the plain
+    single-chain schedule."""
     out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
                             mxu_dtype, kernel, q_tiles, fuse_denom)
     return out
@@ -808,7 +831,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
 def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
                         block_k: int = 512, interpret: bool = False,
                         mxu_dtype=jnp.bfloat16, kernel: str = "auto",
-                        q_tiles: int = 1, fuse_denom: bool = False):
+                        q_tiles: int | None = None, fuse_denom: bool = False):
     """Like :func:`flash_attention` but also returns the log-sum-exp
     statistics: (out [B, T, H, D], lse [B, H, T] fp32).  Partial results
     over different K/V shards combine exactly via lse weighting — the
@@ -828,7 +851,7 @@ def flash_attention_packed(q, k, v, causal: bool = False,
                            mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                            chunk_k: int | None = None,
                            kv_cast_scratch: bool = False,
-                           q_tiles: int = 1, fuse_denom: bool = False):
+                           q_tiles: int | None = None, fuse_denom: bool = False):
     """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
     with N = batch x heads flattened (the splash-attention layout).
     Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
@@ -839,10 +862,13 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     `q_tiles` (every schedule) splits each q block into that many
     independent sub-tiles whose folds interleave — MXU/VPU overlap
     across dependence chains; it snaps down to a valid 8-row-aligned
-    split.  `fuse_denom` (resident only; dropped when "auto" lands on
-    grid) rides the softmax row-sum on the PV matmul via a
-    ones-extended V — one fewer VPU pass per fold, free where D pads
-    to the same lane tile (D=64).  See the kernel docstrings."""
+    split.  The default None applies the tuned AUTO schedule: q_tiles
+    and (unless explicitly given) chunk_k are set from the measured
+    table at the top of this module; pass q_tiles=1 for the plain
+    single-chain schedule.  `fuse_denom` (resident only; dropped when
+    "auto" lands on grid) rides the softmax row-sum on the PV matmul
+    via a ones-extended V — one fewer VPU pass per fold, free where D
+    pads to the same lane tile (D=64).  See the kernel docstrings."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
                                    interpret, mxu_dtype, kernel, chunk_k,
                                    kv_cast_scratch, q_tiles, fuse_denom)
@@ -860,7 +886,7 @@ def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                                chunk_k: int | None = None,
                                kv_cast_scratch: bool = False,
-                               q_tiles: int = 1, fuse_denom: bool = False):
+                               q_tiles: int | None = None, fuse_denom: bool = False):
     """Head-packed [N, T, D] variant returning (out [N, T, D],
     lse [N, T] fp32) — the distributed callers' entry (ring attention
     folds shard partials via the lse)."""

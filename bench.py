@@ -230,19 +230,19 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         # scripts so methodology fixes land once (flash_sweep docstring)
         from accl_tpu.bench.flash_sweep import make_variant
 
-        def fa2_variant(kernel, ck, qt=1, fd=False):
-            return make_variant(256, 512, ck=ck, qt=qt, fd=fd,
-                                kernel=kernel)
-
-        # grid_resident_ck256 earned its slot out (r04: 29-49 TF vs
-        # resident's 75); the q-tile interleave and fused-denominator
-        # options compete in its place (see ops/flash.py docstrings)
+        # grid_resident earned its slot out (r04: 29-49 TF vs resident's
+        # 75), and fused-denominator at D=128 is out on physics (the
+        # ones-extended V pads 129 -> 256 lanes, doubling PV).  The
+        # remaining slots compose the two pipelining levers — q-tile
+        # interleave (independent fold chains) x chunk_k sub-folds
+        # (softmax of chunk c overlaps QK^T of chunk c+1) — which
+        # earlier rounds only measured one at a time.
         d128_variants = {
-            "resident": fa2_variant("resident", None),
-            "grid_resident": fa2_variant("grid_resident", None),
-            "resident_qt2": fa2_variant("resident", None, qt=2),
-            "resident_qt2_fd": fa2_variant("resident", None, qt=2,
-                                           fd=True),
+            "resident": make_variant(256, 512),
+            "resident_qt2": make_variant(256, 512, qt=2),
+            "resident_qt2_ck256": make_variant(256, 512, ck=256, qt=2),
+            "resident_bq512_qt2_ck256": make_variant(512, 512, ck=256,
+                                                     qt=2),
         }
 
         # MXU-peak context, interleaved: a big bf16 matmul is the
@@ -279,10 +279,9 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         pk1 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
         q1p, k1p, v1p = pk1(q), pk1(k), pk1(v)
         d64_variants = {
-            "resident": fa2_variant("resident", None),
-            "resident_fd": fa2_variant("resident", None, fd=True),
-            "resident_qt2_fd": fa2_variant("resident", None, qt=2,
-                                           fd=True),
+            "resident": make_variant(256, 512),
+            "resident_fd": make_variant(256, 512, fd=True),
+            "resident_qt2_fd": make_variant(256, 512, qt=2, fd=True),
         }
 
         best_fa, best_f2, best_mm = None, None, None

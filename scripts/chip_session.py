@@ -12,7 +12,12 @@ exists:
      per-size memory roofline; reference role test/host/xrt/src/bench.cpp
      sweep + BASELINE.md "All-reduce busbw vs message size, 1KB-1GB")
 
-Run under `timeout` from a retry loop; stages persist incrementally.
+Run under `timeout` from a retry loop (scripts/chip_retry.sh); stages
+persist incrementally.  `--check` exits 0 iff every artifact is
+complete BY THIS SCRIPT'S OWN DEFINITION (same candidate sets, same
+row-validity rules) — the retry loop's termination test.  --check never
+imports jax (under the axon platform even `import jax` can block on the
+chip claim).
 """
 from __future__ import annotations
 
@@ -23,16 +28,61 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
-
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "bench", "results")
 FLASH_JSON = os.path.join(OUT, "flash_tune_r04.json")
 LANE_CSV = os.path.join(OUT, "lane_sweep_r04.csv")
+# consecutive-failure counts per lane size: a size that fails this many
+# sessions in a row (e.g. deterministic OOM) is retired so the retry
+# loop can terminate instead of rerunning a forever-incomplete sweep
+LANE_FAIL_JSON = os.path.join(OUT, "lane_sweep_r04_failures.json")
+LANE_MAX_FAILS = 3
+LANE_SIZES = [1 << p for p in range(10, 31, 2)]  # 1 KB .. 1 GB
+
+# Candidate SPECS as plain data (closures are built inside flash_stage)
+# so --check can compare the current sets against a banked artifact
+# without importing jax.  The two pipelining levers compose: q_tiles
+# gives INDEPENDENT fold chains (VPU of tile A overlaps MXU of tile B),
+# chunk_k splits each fold into an unrolled run (chunk c's softmax
+# overlaps chunk c+1's QK^T).  Earlier sweeps measured each lever alone
+# (qt2 OR ck256); the combinations are the untried half of the space.
+# fd at D=128 is out on physics: the ones-extended V pads 129 -> 256
+# lanes, doubling the PV matmul (it stays in the D=64 set, where 65 and
+# 64 pad to the same 128-lane tile).  The `cast` variants add the
+# one-shot K/V cast scratch (kills the per-fold f32->bf16 VPU pass).
+D128_SPECS = {
+    "bq256_bk512": dict(bq=256, bk=512),
+    "bq256_bk512_ck256": dict(bq=256, bk=512, ck=256),
+    "bq256_bk512_ck128": dict(bq=256, bk=512, ck=128),
+    "bq256_bk512_qt2": dict(bq=256, bk=512, qt=2),
+    "bq256_bk512_qt2_ck256": dict(bq=256, bk=512, ck=256, qt=2),
+    "bq256_bk512_qt2_ck128": dict(bq=256, bk=512, ck=128, qt=2),
+    "bq512_bk512_qt2": dict(bq=512, bk=512, qt=2),
+    "bq512_bk512_qt2_ck256": dict(bq=512, bk=512, ck=256, qt=2),
+    "bq512_bk512_qt4": dict(bq=512, bk=512, qt=4),
+    "bq512_bk512_qt4_ck256": dict(bq=512, bk=512, ck=256, qt=4),
+    "bq512_bk1024_qt2_ck256": dict(bq=512, bk=1024, ck=256, qt=2),
+    "bq256_bk512_qt2_cast": dict(bq=256, bk=512, qt=2, cast=True),
+    "bq256_bk512_qt2_ck256_cast": dict(bq=256, bk=512, ck=256, qt=2,
+                                       cast=True),
+}
+D64_SPECS = {
+    "d64_resident": dict(bq=256, bk=512),
+    "d64_resident_fd": dict(bq=256, bk=512, fd=True),
+    "d64_resident_qt2_fd": dict(bq=256, bk=512, qt=2, fd=True),
+    "d64_resident_qt2_ck256_fd": dict(bq=256, bk=512, ck=256, qt=2,
+                                      fd=True),
+}
 
 
-def flash_stage(timed_chain):
+def _build(make_variant, specs):
+    return {name: make_variant(sp["bq"], sp["bk"], ck=sp.get("ck"),
+                               qt=sp.get("qt", 1), fd=sp.get("fd", False),
+                               cast=sp.get("cast", False))
+            for name, sp in specs.items()}
+
+
+def flash_stage(jax, jnp, timed_chain):
     from accl_tpu.bench.flash_sweep import (make_variant, report,
                                             run_sweep)
 
@@ -47,34 +97,26 @@ def flash_stage(timed_chain):
         except ValueError:
             res = {}  # partial write from a killed run — redo
 
-    cands = {
-        "bq256_bk512": make_variant(256, 512),
-        "bq512_bk512": make_variant(512, 512),
-        "bq512_bk256": make_variant(512, 256),
-        "bq256_bk512_ck256": make_variant(256, 512, ck=256),
-        "bq256_bk512_qt2": make_variant(256, 512, qt=2),
-        "bq512_bk512_qt2": make_variant(512, 512, qt=2),
-        "bq512_bk512_qt4": make_variant(512, 512, qt=4),
-        "bq256_bk512_fd": make_variant(256, 512, fd=True),
-        "bq256_bk512_qt2_fd": make_variant(256, 512, qt=2, fd=True),
-        "bq512_bk512_qt2_fd": make_variant(512, 512, qt=2, fd=True),
-        # one-shot K/V cast (kills the per-fold f32->bf16 VPU pass)
-        # stacked with the interleaved chains
-        "bq256_bk512_cast": make_variant(256, 512, cast=True),
-        "bq256_bk512_qt2_cast": make_variant(256, 512, qt=2,
-                                             cast=True),
-        "bq512_bk512_qt2_cast": make_variant(512, 512, qt=2,
-                                             cast=True),
-    }
+    cands = _build(make_variant, D128_SPECS)
     # per-ROUND persistence: a brief claim window that only survives
     # one round still banks its minimums (raw seconds merge across
     # runs; `schedules` is recomputed from the merged raw each time).
-    # An artifact from the pre-persistence format (has schedules but no
-    # raw seconds) is COMPLETE — don't throw its banked minimums away.
     raw = res.get("raw_s", {})
     raw_mm = res.get("raw_mm_s")
     rounds_done = res.get("rounds_done",
                           3 if "schedules" in res else 0)
+    # rounds_done counts rounds of THE CURRENT candidate set: when the
+    # set changes (candidates added/renamed between sessions), a banked
+    # artifact must not let the new candidates skip their measurement
+    # rounds.  Minimums for still-present names are kept.
+    cand_set = sorted(cands)
+    if res.get("cand_set") != cand_set:
+        rounds_done = 0
+        # prune retired/renamed names so report() emits only the
+        # current set (stale minimums from other contention windows
+        # must not compete with the live candidates)
+        raw = {n: v for n, v in raw.items() if n in cands}
+    res["cand_set"] = cand_set
     dead_local: set = set()  # compile-failed THIS process: skip its
     # remaining rounds (transient claim errors get retried by the next
     # process invocation)
@@ -102,7 +144,8 @@ def flash_stage(timed_chain):
     # error-marked candidates from earlier invocations get ONE retry
     # per process even after all rounds completed (a transient claim
     # error in the final round must not freeze an {"error": ...} into
-    # the artifact forever)
+    # the artifact forever).  A candidate that keeps failing keeps its
+    # error string — completeness does not require it to turn numeric.
     errs = [n for n in cands
             if n in raw and not isinstance(raw[n], float)
             and n not in dead_local]
@@ -118,15 +161,14 @@ def flash_stage(timed_chain):
         res["raw_mm_s"] = raw_mm
         _write_json(FLASH_JSON, res)
 
-    if "d64" not in res:
-        cands64 = {
-            "d64_resident": make_variant(256, 512),
-            "d64_resident_fd": make_variant(256, 512, fd=True),
-            "d64_resident_qt2_fd": make_variant(256, 512, qt=2, fd=True),
-        }
+    # d64 sweep carries the same stale-set guard as the main set
+    d64_set = sorted(D64_SPECS)
+    if "d64" not in res or res.get("d64_cand_set") != d64_set:
+        cands64 = _build(make_variant, D64_SPECS)
         best64, best_mm64 = run_sweep(jax, jnp, timed_chain, cands64,
                                       rounds=2, d=64)
         res["d64"] = report(best64, best_mm64)
+        res["d64_cand_set"] = d64_set
         _write_json(FLASH_JSON, res)
 
 
@@ -140,29 +182,56 @@ def _write_json(path, obj):
     print(f"wrote {path}", file=sys.stderr, flush=True)
 
 
-def lane_stage(timed_chain_ab):
+def _lane_done() -> set:
+    """Sizes with a fully-written CSV row (same validity rule the
+    resume logic applies: trailing newline + parseable fields)."""
+    done = set()
+    if not os.path.exists(LANE_CSV):
+        return done
+    with open(LANE_CSV) as f:
+        next(f, None)
+        for line in f:
+            if not line.endswith("\n"):
+                continue
+            parts = line.strip().split(",")
+            try:
+                nb = int(parts[0])
+                float(parts[1]); float(parts[2]); int(parts[3])
+            except (ValueError, IndexError):
+                continue
+            done.add(nb)
+    return done
+
+
+def _lane_fails() -> dict:
+    try:
+        with open(LANE_FAIL_JSON) as f:
+            return {int(k): int(v) for k, v in json.load(f).items()}
+    except Exception:  # noqa: BLE001 — absent/corrupt: start clean
+        return {}
+
+
+def lane_stage(jax, jnp, timed_chain_ab):
     """busbw-vs-size curve for the on-path reduction lane, 1KB-1GB."""
     from accl_tpu.ops.reduce_ops import pallas_add
 
     header = "bytes,pallas_GBps,xla_GBps,iters\n"
-    done = set()
+    done = _lane_done()
     if os.path.exists(LANE_CSV):
-        # keep only fully-written rows; a row truncated by a timeout
-        # kill is dropped (and re-measured) rather than trusted
+        # rewrite keeping only fully-written rows; a row truncated by a
+        # timeout kill is dropped (and re-measured) rather than trusted
         good = []
         with open(LANE_CSV) as f:
             next(f, None)
             for line in f:
                 if not line.endswith("\n"):
-                    continue  # truncated final row — drop, re-measure
-                parts = line.strip().split(",")
-                try:
-                    nb = int(parts[0])
-                    float(parts[1]); float(parts[2]); int(parts[3])
-                except (ValueError, IndexError):
                     continue
-                done.add(nb)
-                good.append(line)
+                try:
+                    nb = int(line.split(",", 1)[0])
+                except ValueError:
+                    continue
+                if nb in done:
+                    good.append(line)
         tmp = LANE_CSV + ".tmp"
         with open(tmp, "w") as f:
             f.write(header)
@@ -172,28 +241,53 @@ def lane_stage(timed_chain_ab):
         with open(LANE_CSV, "w") as f:
             f.write(header)
 
-    for p in range(10, 31, 2):  # 1 KB .. 1 GB per operand
-        nbytes = 1 << p
+    fails = _lane_fails()
+    for nbytes in LANE_SIZES:
         if nbytes in done:
+            continue
+        if fails.get(nbytes, 0) >= LANE_MAX_FAILS:
+            print(f"  lane {nbytes}B: retired after "
+                  f"{fails[nbytes]} failed sessions", file=sys.stderr,
+                  flush=True)
             continue
         n = nbytes // 4
         rows = max(1, n // 128)
-        a = jax.random.normal(jax.random.PRNGKey(0), (rows, 128),
-                              jnp.float32)
-        b = jax.random.normal(jax.random.PRNGKey(1), (rows, 128),
-                              jnp.float32)
         # keep ~8-30 ms of device work per dispatch across sizes
         iters = max(20, min(20000, (160 << 20) // nbytes))
         br = min(2048, rows)
         run = lambda x, bb: pallas_add(x, bb, block_rows=br, donate=True)
         xla = lambda x, bb: x + bb
         try:
+            # operand allocation INSIDE the try: a deterministic OOM at
+            # the big sizes must count toward retirement too
+            a = jax.random.normal(jax.random.PRNGKey(0), (rows, 128),
+                                  jnp.float32)
+            b = jax.random.normal(jax.random.PRNGKey(1), (rows, 128),
+                                  jnp.float32)
             dts = timed_chain_ab({"pallas": run, "xla": xla}, a, iters,
                                  consts=(b,))
         except Exception as e:  # noqa: BLE001
-            print(f"  lane {nbytes}B: FAILED {e}", file=sys.stderr,
-                  flush=True)
+            # distinguish a size-specific failure (OOM — count toward
+            # retirement) from the chip claim dying under us (the
+            # documented normal case the retry loop rides out — do NOT
+            # count, end the session and let the next window resume)
+            try:
+                float(jnp.zeros((), jnp.float32) + 1.0)
+            except Exception:  # noqa: BLE001 — chip gone
+                print(f"  lane {nbytes}B: chip lost mid-measure ({e}); "
+                      "ending session", file=sys.stderr, flush=True)
+                return
+            fails[nbytes] = fails.get(nbytes, 0) + 1
+            _write_json(LANE_FAIL_JSON, {str(k): v
+                                         for k, v in fails.items()})
+            print(f"  lane {nbytes}B: FAILED "
+                  f"({fails[nbytes]}/{LANE_MAX_FAILS}) {e}",
+                  file=sys.stderr, flush=True)
             continue
+        if nbytes in fails:
+            del fails[nbytes]
+            _write_json(LANE_FAIL_JSON, {str(k): v
+                                         for k, v in fails.items()})
         stream = 3 * nbytes  # read a, read b, write out
         row = (nbytes, round(stream / dts["pallas"] / 1e9, 3),
                round(stream / dts["xla"] / 1e9, 3), iters)
@@ -204,13 +298,44 @@ def lane_stage(timed_chain_ab):
     print(f"wrote {LANE_CSV}", file=sys.stderr, flush=True)
 
 
+def check_complete() -> bool:
+    """True iff every artifact is complete for the CURRENT candidate
+    sets.  Error-string candidates count as complete (measured as
+    failing, recorded); lane sizes count when measured OR retired."""
+    try:
+        with open(FLASH_JSON) as f:
+            res = json.load(f)
+    except Exception:  # noqa: BLE001
+        return False
+    if "schedules" not in res or res.get("rounds_done", 0) < 3:
+        return False
+    if res.get("cand_set") != sorted(D128_SPECS):
+        return False
+    if "d64" not in res or res.get("d64_cand_set") != sorted(D64_SPECS):
+        return False
+    raw = res.get("raw_s", {})
+    if any(n not in raw for n in D128_SPECS):
+        return False
+    done, fails = _lane_done(), _lane_fails()
+    return all(nb in done or fails.get(nb, 0) >= LANE_MAX_FAILS
+               for nb in LANE_SIZES)
+
+
 def main():
+    if "--check" in sys.argv:
+        ok = check_complete()
+        print("complete" if ok else "incomplete", file=sys.stderr)
+        sys.exit(0 if ok else 1)
+
+    import jax
+    import jax.numpy as jnp
+
     print(f"backend={jax.default_backend()}", file=sys.stderr, flush=True)
     from accl_tpu.bench.timing import make_harness
 
     _p, timed_chain, timed_chain_ab, _s = make_harness(jax, jnp)
-    flash_stage(timed_chain)
-    lane_stage(timed_chain_ab)
+    flash_stage(jax, jnp, timed_chain)
+    lane_stage(jax, jnp, timed_chain_ab)
     print("chip session complete", file=sys.stderr, flush=True)
 
 

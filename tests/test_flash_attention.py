@@ -292,7 +292,7 @@ def test_flash_q_tiles_match(causal, q_tiles):
     kw = dict(causal=causal, block_q=64, block_k=64,
               mxu_dtype=jnp.float32, kernel="resident", interpret=True)
     a, la = flash_attention_packed_lse(q, k, v, q_tiles=q_tiles, **kw)
-    b, lb = flash_attention_packed_lse(q, k, v, **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, q_tiles=1, **kw)
     # per-row math is shape-independent, but the backend gemm may block
     # [32, D] and [64, D] differently — tight tolerance, not bit-equal
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -314,8 +314,9 @@ def test_flash_fuse_denom_matches(causal):
     q, k, v = mk(), mk(), mk()
     kw = dict(causal=causal, block_q=64, block_k=64,
               mxu_dtype=jnp.float32, kernel="resident", interpret=True)
-    a, la = flash_attention_packed_lse(q, k, v, fuse_denom=True, **kw)
-    b, lb = flash_attention_packed_lse(q, k, v, **kw)
+    a, la = flash_attention_packed_lse(q, k, v, fuse_denom=True,
+                                       q_tiles=1, **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, q_tiles=1, **kw)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
@@ -372,7 +373,7 @@ def test_flash_grid_q_tiles_match(causal, kernel):
     kw = dict(causal=causal, block_q=64, block_k=64,
               mxu_dtype=jnp.float32, kernel=kernel, interpret=True)
     a, la = flash_attention_packed_lse(q, k, v, q_tiles=2, **kw)
-    b, lb = flash_attention_packed_lse(q, k, v, **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, q_tiles=1, **kw)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
@@ -496,3 +497,27 @@ def test_model_trains_with_flash_attention():
                     jax.tree_util.tree_leaves(gd)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_auto_schedule_matches_plain(causal):
+    # q_tiles=None (the public default) resolves the tuned auto
+    # schedule (interleaved sub-tile chains + split folds); per-row
+    # math is identical to the explicit plain single-chain schedule
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(41)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64,
+              mxu_dtype=jnp.float32, kernel="resident", interpret=True)
+    a, la = flash_attention_packed_lse(q, k, v, **kw)          # auto
+    b, lb = flash_attention_packed_lse(q, k, v, q_tiles=1, **kw)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=1e-6, atol=1e-6)
+    # an explicit chunk_k is honored under the auto q_tiles too
+    c, _ = flash_attention_packed_lse(q, k, v, chunk_k=32, **kw)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
