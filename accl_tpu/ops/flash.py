@@ -338,6 +338,15 @@ _AUTO_Q_TILES = 2
 _AUTO_CHUNK_K = 256
 
 
+def _snap_chunk(req: int, blk: int) -> int:
+    """Largest divisor of `blk` at or below `req`, never under the
+    8-row tile floor (falls back to the whole block) — the one snapping
+    rule for every sub-chunk unroll (forward folds and backward cells).
+    """
+    return next((d for d in range(min(req, blk), 7, -1)
+                 if blk % d == 0), blk)
+
+
 def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                        mxu_dtype, kernel, chunk_k=None,
                        kv_cast_scratch=False, q_tiles=None,
@@ -373,11 +382,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     # compiler MXU/VPU pipelining slack at the price of smaller matmuls.
     # Snap to the largest divisor of bk at or below the request, never
     # under the 8-row tile floor (halving alone can decay 12->3->1)
-    def snap_ck(req):
-        return next((d for d in range(min(req, bk), 7, -1)
-                     if bk % d == 0), bk)
-
-    ck = bk if chunk_k is None else snap_ck(chunk_k)
+    ck = bk if chunk_k is None else _snap_chunk(chunk_k, bk)
 
     mxu_dtype = jnp.dtype(mxu_dtype)
     # one-shot K/V cast scratch is OPT-IN: it trades the per-fold cast
@@ -424,7 +429,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         raise ValueError(f"unknown flash kernel {kernel!r}")
 
     if auto_sched and chunk_k is None:
-        ck = snap_ck(_AUTO_CHUNK_K)
+        ck = _snap_chunk(_AUTO_CHUNK_K, bk)
 
     # snap q_tiles down until the sub-tiles are 8-row-aligned divisors
     # of the (possibly auto-shrunk) q block — the same keep-working
@@ -556,19 +561,21 @@ def _flash_forward_impl(qp, kp, vp, cfg):
 # over q blocks per k block.  Causal cells are predicated off exactly
 # like the forward grid schedule.
 
-def _flash_bwd_p_block(q2, kb, l2, iq, ik, bq, bk, masked):
-    """Rebuild the normalized probability block [bq, bk] from prescaled
-    q2 (a*log2e folded in) and the log2-domain lse; dead rows (lse =
-    NEG_INF, fully-masked forward) produce zeros.  `masked` applies the
-    causal diagonal test — callers predicate it to the straddling cells
-    only (past cells need no mask; same lane-work split as the forward
-    grid kernel)."""
+def _flash_bwd_p_block(q2, kb, l2, row0, col0, masked):
+    """Rebuild the normalized probability block [rows(q2), rows(kb)]
+    from prescaled q2 (a*log2e folded in) and the log2-domain lse; dead
+    rows (lse = NEG_INF, fully-masked forward) produce zeros.  `masked`
+    applies the causal row >= col test against the (row0, col0) global
+    offsets — callers predicate it to the straddling cells only (past
+    cells need no mask; same lane-work split as the forward grid
+    kernel)."""
+    rq, rk = q2.shape[0], kb.shape[0]
     s2 = jax.lax.dot_general(q2, kb, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     p = jnp.where(l2 <= NEG_INF / 2, 0.0, jnp.exp2(s2 - l2))
     if masked:
-        rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (rq, rk), 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (rq, rk), 1)
         p = jnp.where(rows >= cols, p, 0.0)
     return p
 
@@ -586,7 +593,12 @@ def _bwd_live_diag(iq, ik, bq, bk, causal):
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
                          dq_ref, acc, *, causal, bq, bk, nk, mxu_dtype,
-                         inv_scale_a):
+                         inv_scale_a, chunk_k):
+    """dQ cell: accumulate ds @ K over the k blocks of one q block.
+    Each cell runs as an UNROLLED run of chunk_k sub-chunks — the same
+    MXU/VPU pipelining lever as the forward fold: chunk c's exp2/ds VPU
+    work has no dependence on chunk c+1's matmuls, and the per-chunk
+    partial dq contributions are additive."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -600,16 +612,22 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
 
     def body(masked):
         q2 = q_ref[0].astype(mxu_dtype)      # pre-scaled on the host
-        kb = k_ref[0].astype(mxu_dtype)
-        vb = v_ref[0].astype(mxu_dtype)
         do = do_ref[0].astype(mxu_dtype)
-        p = _flash_bwd_p_block(q2, kb, l2_ref[0], iq, ik, bq, bk, masked)
-        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - dvec_ref[0])
-        acc[:] += jax.lax.dot_general(
-            ds.astype(mxu_dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        l2 = l2_ref[0]
+        dvec = dvec_ref[0]
+        total = acc[:]
+        for c in range(bk // chunk_k):
+            kb = k_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
+            vb = v_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
+            p = _flash_bwd_p_block(q2, kb, l2, iq * bq,
+                                   ik * bk + c * chunk_k, masked)
+            dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - dvec)
+            total = total + jax.lax.dot_general(
+                ds.astype(mxu_dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        acc[:] = total
 
     if causal:
         @pl.when(diag)
@@ -629,7 +647,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal, bq,
-                          bk, nq, mxu_dtype):
+                          bk, nq, mxu_dtype, chunk_q):
+    """dK/dV cell: accumulate over the q blocks of one k block.  The
+    q block is processed as an UNROLLED run of chunk_q sub-chunks (the
+    roles of q and k swap relative to the dq kernel, so here the chunk
+    axis is q) — independent sub-chunks whose partial dK/dV
+    contributions are additive, giving Mosaic MXU/VPU overlap."""
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(1)
@@ -643,21 +666,28 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
     live, diag = _bwd_live_diag(iq, ik, bq, bk, causal)
 
     def body(masked):
-        q2 = q_ref[0].astype(mxu_dtype)
         kb = k_ref[0].astype(mxu_dtype)
         vb = v_ref[0].astype(mxu_dtype)
-        do = do_ref[0].astype(mxu_dtype)
-        p = _flash_bwd_p_block(q2, kb, l2_ref[0], iq, ik, bq, bk, masked)
-        pc = p.astype(mxu_dtype)
-        dv_acc[:] += jax.lax.dot_general(
-            pc, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - dvec_ref[0])).astype(mxu_dtype)
-        dk_acc[:] += jax.lax.dot_general(
-            ds, q2, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dk_tot, dv_tot = dk_acc[:], dv_acc[:]
+        for c in range(bq // chunk_q):
+            sl = pl.ds(c * chunk_q, chunk_q)
+            q2 = q_ref[0, sl, :].astype(mxu_dtype)
+            do = do_ref[0, sl, :].astype(mxu_dtype)
+            p = _flash_bwd_p_block(q2, kb, l2_ref[0, sl, :],
+                                   iq * bq + c * chunk_q, ik * bk,
+                                   masked)
+            pc = p.astype(mxu_dtype)
+            dv_tot = dv_tot + jax.lax.dot_general(
+                pc, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - dvec_ref[0, sl, :])).astype(mxu_dtype)
+            dk_tot = dk_tot + jax.lax.dot_general(
+                ds, q2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_tot
+        dv_acc[:] = dv_tot
 
     if causal:
         @pl.when(diag)
@@ -682,12 +712,18 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    (causal, bq, bk, _ck, interpret, mxu_dtype, _kernel, _nc, _qt,
+    (causal, bq, bk, ck, interpret, mxu_dtype, _kernel, _nc, _qt,
      _fd) = cfg
     N, T, D = qp.shape
     Tk = kp.shape[1]
     nq, nk = T // bq, Tk // bk
     a = 1.0 / float(D) ** 0.5
+    # sub-chunk widths for the unrolled backward cells (the forward's
+    # MXU/VPU pipelining lever): ck arrives resolved from the forward
+    # call and already divides bk — dq chunks over k at ck directly;
+    # dkv chunks over q, re-snapped against bq
+    ckb = ck
+    ckq = _snap_chunk(ck, bq)
     vma = _vma_of(qp, kp, vp, g_out)
 
     # host-side prep: prescaled q (exp2 domain), log2-domain lse, and
@@ -709,7 +745,7 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, bq=bq,
                           bk=bk, nk=nk, mxu_dtype=mxu_dtype,
-                          inv_scale_a=a),
+                          inv_scale_a=a, chunk_k=ckb),
         out_shape=_sds((N, T, D), qp.dtype, vma),
         grid=(N, nq, nk),
         in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, ql_spec, ql_spec],
@@ -730,7 +766,8 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal, bq=bq,
-                          bk=bk, nq=nq, mxu_dtype=mxu_dtype),
+                          bk=bk, nq=nq, mxu_dtype=mxu_dtype,
+                          chunk_q=ckq),
         out_shape=(_sds((N, Tk, D), kp.dtype, vma),
                    _sds((N, Tk, D), vp.dtype, vma)),
         grid=(N, nk, nq),
