@@ -582,8 +582,8 @@ class TpuEngine:
                 and in_len * np.dtype(dtype).itemsize
                 >= self.ring_threshold_bytes)
 
-        # compiled once per (mesh, op, shape, root, func, ...) and cached;
-        # donate_argnums lets XLA reuse the assembled operand's buffers
+        # compiled once per (mesh, op, shape, root, func, ...) and
+        # cached (no donation — see _collective_fn)
         compiled = (None if op == Operation.barrier else _collective_fn(
             mesh, op, nranks, in_len, root, func, wire_dtype,
             str(np.dtype(dtype)), ring))
@@ -649,8 +649,26 @@ class TpuEngine:
                 shard = jnp.concatenate([shard, pad])
             shards.append(jax.device_put(shard, self.devices[g]))
 
-        x = jax.make_array_from_single_device_arrays(
-            (plan["nranks"] * in_len,), plan["sharding"], shards)
+        # assembled-global cache: when every shard is the IDENTICAL
+        # array object as the previous call (the steady state of a
+        # training loop — all-fast-path operands, none rebound since),
+        # the previous global is still an exact alias of them, so the
+        # per-call make_array disappears.  Sound because jax arrays are
+        # immutable: any buffer update rebinds to a NEW object and
+        # misses this check.  The cache holds strong refs, so object
+        # identity cannot be recycled out from under it.
+        cached = plan.get("assembled")
+        if (cached is not None and len(cached[0]) == len(shards)
+                and all(a is b for a, b in zip(cached[0], shards))):
+            x = cached[1]
+        else:
+            x = jax.make_array_from_single_device_arrays(
+                (plan["nranks"] * in_len,), plan["sharding"], shards)
+            # only all-fast-path gangs can ever hit (slow-path members
+            # create fresh arrays per call), so storing anything else
+            # would just pin dead device copies between calls
+            if all(o[3] for o in plan["ops"]):
+                plan["assembled"] = (shards, x)
 
         t0 = time.perf_counter_ns()
         y = plan["compiled"](x)
