@@ -327,15 +327,17 @@ def _sds(shape, dtype, vma):
 _RESIDENT_KV_BYTES = 6 << 20
 
 #: Auto-schedule defaults applied when the caller leaves q_tiles=None
-#: (the public default).  A single fold chain serializes MXU (QK^T,
-#: PV) against VPU (max/exp2); two independent q sub-tile chains plus
-#: a split fold give the scheduler independent work to overlap.  The
-#: values are tuned against the live-chip schedule sweep
-#: (scripts/chip_session.py -> bench/results/flash_tune_r{N}.json; the
-#: plain single-chain schedule is the `bq256_bk512` candidate there).
+#: (the public default).  Tuned against the live-chip schedule sweep
+#: (scripts/flash_tune.py / scripts/chip_session.py over
+#: accl_tpu/bench/flash_sweep.py): the r04 sweeps measure the PLAIN single
+#: fold chain at bq256/bk512 fastest at D=128 (0.66 / 0.27 MXU
+#: fraction across two contention windows, vs 0.41 / 0.27 for two
+#: interleaved q-tile chains and 0.30 / 0.22 for split folds) — the
+#: compiler already pipelines MXU against VPU within the unrolled
+#: fori_loop body, so the extra chains only shrink the matmuls.
 #: Explicit q_tiles/chunk_k always win over the auto table.
-_AUTO_Q_TILES = 2
-_AUTO_CHUNK_K = 256
+_AUTO_Q_TILES = 1
+_AUTO_CHUNK_K = None  # None = fold whole K blocks (no sub-chunk split)
 
 
 def _snap_chunk(req: int, blk: int) -> int:
@@ -347,26 +349,13 @@ def _snap_chunk(req: int, blk: int) -> int:
                  if blk % d == 0), blk)
 
 
-def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
-                       mxu_dtype, kernel, chunk_k=None,
-                       kv_cast_scratch=False, q_tiles=None,
-                       fuse_denom=False):
-    """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
-    flattened — the splash-attention layout).  This is the zero-copy
-    path: no transposes touch HBM; callers that keep activations packed
-    (the model families do) pay only the kernel itself.
-    Returns (out [N, T, D], lse [N, T] f32)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    N, T, D = qp.shape
-    Tk = kp.shape[1]
-    if kp.shape != vp.shape or kp.shape[0] != N or kp.shape[2] != D:
-        raise ValueError(f"k/v shape {kp.shape}/{vp.shape} incompatible "
-                         f"with q {qp.shape}")
-    if causal and Tk != T:
-        raise ValueError("causal masking requires Tq == Tk "
-                         "(cross-length attention has no diagonal)")
+def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
+                      interpret, mxu_dtype, kernel, chunk_k,
+                      kv_cast_scratch, q_tiles, fuse_denom):
+    """Static schedule resolution shared by the head-packed and BTHD
+    entries: block shrinking, chunk snapping, kernel/auto selection and
+    the tuned-auto q_tiles/fuse_denom choices.  Returns the cfg tuple
+    consumed by the forward/backward impls."""
     # shrink blocks (by halving, down to the 8-row f32 tile floor) until
     # they divide their sequence length, so defaults keep working for
     # any T smaller defaults accepted
@@ -388,7 +377,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     # one-shot K/V cast scratch is OPT-IN: it trades the per-fold cast
     # for a serialized q-block order ("arbitrary" semantics), a tradeoff
     # that must be measured per chip generation
-    needs_cast = kv_cast_scratch and qp.dtype != mxu_dtype
+    needs_cast = kv_cast_scratch and qdtype != mxu_dtype
 
     # q_tiles=None (the public default) opts into the auto schedule:
     # tuned (q_tiles, chunk_k) applied after the kernel resolves below.
@@ -398,7 +387,10 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         q_tiles = _AUTO_Q_TILES
     elif q_tiles < 1:
         raise ValueError(f"q_tiles={q_tiles} must be >= 1")
-    if fuse_denom and kernel not in ("resident", "auto"):
+    # fuse_denom=None (the public default) is the auto choice, resolved
+    # after the kernel lands below; explicit True/False always wins
+    auto_fd = fuse_denom is None
+    if not auto_fd and fuse_denom and kernel not in ("resident", "auto"):
         # an EXPLICIT non-resident kernel with the resident-only option
         # is a contradiction — silently not applying it would be a perf
         # lie.  (Under "auto" it is a tuning HINT and drops gracefully
@@ -407,28 +399,38 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
         raise ValueError(
             f"fuse_denom is a resident-schedule option (kernel={kernel!r})")
 
-    kv_bytes = 2 * Tk * D * (qp.dtype.itemsize
+    kv_bytes = 2 * Tk * D * (qdtype.itemsize
                              + (mxu_dtype.itemsize if needs_cast else 0))
     # fuse_denom's ones-extended V (and K-cast, when dtypes differ)
     # scratch counts against the same VMEM residency budget
     fd_scr_bytes = (
-        Tk * (D + 1 + (D if qp.dtype != mxu_dtype else 0))
-        * mxu_dtype.itemsize) if fuse_denom else 0
-    if kernel == "auto":
-        if kv_bytes <= _RESIDENT_KV_BYTES:
-            kernel = "resident"
-            if fuse_denom and kv_bytes + fd_scr_bytes > _RESIDENT_KV_BYTES:
-                fuse_denom = False  # rows fit, the extra scratch wouldn't
-        else:
-            # distributed callers forward tuned opts without knowing
-            # each shard's size (docs/parallelism.md) — the resident-only
-            # hint drops here; q_tiles carries over to the grid schedule
-            kernel = "grid"
-            fuse_denom = False
+        Tk * (D + 1 + (D if qdtype != mxu_dtype else 0))
+        * mxu_dtype.itemsize)
+    auto_kernel = kernel == "auto"
+    if auto_kernel:
+        kernel = ("resident" if kv_bytes <= _RESIDENT_KV_BYTES
+                  else "grid")
     if kernel not in ("resident", "grid", "grid_resident"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
+    if auto_fd:
+        # the ones column rides free only when D and D+1 pad to the
+        # same 128-lane tile (D=64 -> 65 both pad to 128; D=128 -> 129
+        # pads to 256, doubling every PV matmul) — measured at D=64 as
+        # the fastest schedule (0.21 vs 0.18 MXU frac, r04 sweep)
+        fuse_denom = (kernel == "resident" and D % 128 != 0
+                      and kv_bytes + fd_scr_bytes <= _RESIDENT_KV_BYTES)
+    elif fuse_denom and auto_kernel:
+        # distributed callers forward tuned opts without knowing each
+        # shard's size (docs/parallelism.md) — under kernel="auto" the
+        # resident-only hint drops when the schedule lands on grid (or
+        # when its scratch would blow the residency budget); q_tiles
+        # carries over to the grid schedule.  An EXPLICIT resident
+        # kernel keeps the explicit option unconditionally.
+        if kernel != "resident" \
+                or kv_bytes + fd_scr_bytes > _RESIDENT_KV_BYTES:
+            fuse_denom = False
 
-    if auto_sched and chunk_k is None:
+    if auto_sched and chunk_k is None and _AUTO_CHUNK_K is not None:
         ck = _snap_chunk(_AUTO_CHUNK_K, bk)
 
     # snap q_tiles down until the sub-tiles are 8-row-aligned divisors
@@ -438,10 +440,33 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                            or (bq // q_tiles) % 8 != 0):
         q_tiles -= 1
 
+    return (causal, bq, bk, ck, interpret, mxu_dtype, kernel,
+            needs_cast, q_tiles, fuse_denom)
+
+
+def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
+                       mxu_dtype, kernel, chunk_k=None,
+                       kv_cast_scratch=False, q_tiles=None,
+                       fuse_denom=None):
+    """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
+    flattened — the splash-attention layout).  This is the zero-copy
+    path: no transposes touch HBM; callers that keep activations packed
+    (the model families do) pay only the kernel itself.
+    Returns (out [N, T, D], lse [N, T] f32)."""
+    N, T, D = qp.shape
+    Tk = kp.shape[1]
+    if kp.shape != vp.shape or kp.shape[0] != N or kp.shape[2] != D:
+        raise ValueError(f"k/v shape {kp.shape}/{vp.shape} incompatible "
+                         f"with q {qp.shape}")
+    if causal and Tk != T:
+        raise ValueError("causal masking requires Tq == Tk "
+                         "(cross-length attention has no diagonal)")
     # everything static is resolved; the traced part goes through the
     # custom-vjp boundary so jax.grad works on every entry point
-    cfg = (causal, bq, bk, ck, interpret, mxu_dtype, kernel, needs_cast,
-           q_tiles, fuse_denom)
+    cfg = _resolve_schedule(T, Tk, D, qp.dtype, causal, block_q,
+                            block_k, interpret, mxu_dtype, kernel,
+                            chunk_k, kv_cast_scratch, q_tiles,
+                            fuse_denom)
     return _flash_packed_diff(qp, kp, vp, cfg)
 
 
@@ -470,8 +495,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
                               memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0),
                                memory_space=pltpu.VMEM)
-        o_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
-                              memory_space=pltpu.VMEM)
+        o_spec = q_spec
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0),
                                 memory_space=pltpu.VMEM)
         # one-time K/V cast scratch (see kernel docstring) — only when
@@ -814,11 +838,16 @@ _flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
-                kernel, q_tiles=None, fuse_denom=False):
-    """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the core
-    call (two HBM transposes per operand direction — callers on the hot
-    path should use the packed entry points).  Returns (out [B,T,H,D],
-    lse [B,H,T] f32)."""
+                kernel, q_tiles=None, fuse_denom=None):
+    """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the
+    core call (one HBM transpose per operand direction; XLA hoists the
+    K/V packs out of iteration loops — callers on the hot path should
+    still prefer the packed entry points).  A lane-blocked in-place
+    alternative (index maps picking each head's 128-aligned lane chunk
+    of a [B,T,H*D] view) was measured SLOWER than these transposes on
+    the r04 chip — the per-head 512-byte strided DMA costs more than
+    the packs — so the wrapper deliberately stays on the packing path.
+    Returns (out [B,T,H,D], lse [B,H,T] f32)."""
     B, T, H, D = q.shape
 
     def pack(x):  # [B, t, H, D] -> [B*H, t, D]
@@ -840,7 +869,8 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
                     mxu_dtype=jnp.bfloat16, kernel: str = "auto",
-                    q_tiles: int | None = None, fuse_denom: bool = False):
+                    q_tiles: int | None = None,
+                    fuse_denom: bool | None = None):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
     causal mask).  T must be divisible by the (auto-shrunk) block sizes.
 
@@ -853,9 +883,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     streams K/V blocks per q-block (any T), "auto" picks by K/V size.
     `q_tiles` (any schedule) and `fuse_denom` (resident only) are the
     throughput options (see :func:`flash_attention_packed`); leaving
-    `q_tiles` at None applies the tuned auto schedule (interleaved
-    sub-tile chains + split folds), `q_tiles=1` forces the plain
-    single-chain schedule."""
+    both at None applies the tuned auto schedule (plain single fold
+    chain; fused denominator where its ones column is lane-tile-free,
+    e.g. D=64)."""
     out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
                             mxu_dtype, kernel, q_tiles, fuse_denom)
     return out
@@ -868,7 +898,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
 def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
                         block_k: int = 512, interpret: bool = False,
                         mxu_dtype=jnp.bfloat16, kernel: str = "auto",
-                        q_tiles: int | None = None, fuse_denom: bool = False):
+                        q_tiles: int | None = None,
+                        fuse_denom: bool | None = None):
     """Like :func:`flash_attention` but also returns the log-sum-exp
     statistics: (out [B, T, H, D], lse [B, H, T] fp32).  Partial results
     over different K/V shards combine exactly via lse weighting — the
@@ -888,7 +919,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
                            mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                            chunk_k: int | None = None,
                            kv_cast_scratch: bool = False,
-                           q_tiles: int | None = None, fuse_denom: bool = False):
+                           q_tiles: int | None = None,
+                           fuse_denom: bool | None = None):
     """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
     with N = batch x heads flattened (the splash-attention layout).
     Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
@@ -899,13 +931,15 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     `q_tiles` (every schedule) splits each q block into that many
     independent sub-tiles whose folds interleave — MXU/VPU overlap
     across dependence chains; it snaps down to a valid 8-row-aligned
-    split.  The default None applies the tuned AUTO schedule: q_tiles
-    and (unless explicitly given) chunk_k are set from the measured
-    table at the top of this module; pass q_tiles=1 for the plain
-    single-chain schedule.  `fuse_denom` (resident only; dropped when
-    "auto" lands on grid) rides the softmax row-sum on the PV matmul
-    via a ones-extended V — one fewer VPU pass per fold, free where D
-    pads to the same lane tile (D=64).  See the kernel docstrings."""
+    split.  `fuse_denom` (resident only; dropped when "auto" lands on
+    grid) rides the softmax row-sum on the PV matmul via a
+    ones-extended V — one fewer VPU pass per fold, free where D pads
+    to the same lane tile (D=64).  Leaving either at None applies the
+    tuned AUTO schedule from the measured table at the top of this
+    module: the plain single fold chain over whole K blocks, with the
+    fused denominator exactly where its ones column is lane-tile-free;
+    explicit values (incl. q_tiles=1 / fuse_denom=False) always win.
+    See the kernel docstrings."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
                                    interpret, mxu_dtype, kernel, chunk_k,
                                    kv_cast_scratch, q_tiles, fuse_denom)
@@ -923,7 +957,8 @@ def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                                chunk_k: int | None = None,
                                kv_cast_scratch: bool = False,
-                               q_tiles: int | None = None, fuse_denom: bool = False):
+                               q_tiles: int | None = None,
+                               fuse_denom: bool | None = None):
     """Head-packed [N, T, D] variant returning (out [N, T, D],
     lse [N, T] fp32) — the distributed callers' entry (ring attention
     folds shard partials via the lse)."""

@@ -135,8 +135,12 @@ def test_flash_resident_mixed_dtype_matches_grid(causal):
     rng = np.random.default_rng(17)
     mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
     q, k, v = mk(), mk(), mk()
+    # fuse_denom pinned off: the auto schedule turns it on for the
+    # resident kernel at this lane-tile-free D, and its denominator
+    # (bf16 p summed on the MXU) differs from grid's f32 jnp.sum in
+    # the last bits — this test is about the cast path, bit-exactly
     kw = dict(causal=causal, block_q=64, block_k=64,
-              mxu_dtype=jnp.bfloat16, interpret=True)
+              mxu_dtype=jnp.bfloat16, interpret=True, fuse_denom=False)
     a, la = flash_attention_packed_lse(q, k, v, kernel="resident", **kw)
     b, lb = flash_attention_packed_lse(q, k, v, kernel="grid", **kw)
     np.testing.assert_array_equal(np.asarray(a, np.float32),
@@ -316,7 +320,10 @@ def test_flash_fuse_denom_matches(causal):
               mxu_dtype=jnp.float32, kernel="resident", interpret=True)
     a, la = flash_attention_packed_lse(q, k, v, fuse_denom=True,
                                        q_tiles=1, **kw)
-    b, lb = flash_attention_packed_lse(q, k, v, q_tiles=1, **kw)
+    # baseline pins fuse_denom=False: at this D the AUTO default now
+    # resolves to the fused path, which would compare it to itself
+    b, lb = flash_attention_packed_lse(q, k, v, fuse_denom=False,
+                                       q_tiles=1, **kw)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
@@ -334,7 +341,7 @@ def test_flash_fuse_denom_matches(causal):
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
     kwb = dict(kw, mxu_dtype=jnp.bfloat16)
     d, ld = flash_attention_packed_lse(qb, kb, vb, fuse_denom=True, **kwb)
-    e, le = flash_attention_packed_lse(qb, kb, vb, **kwb)
+    e, le = flash_attention_packed_lse(qb, kb, vb, fuse_denom=False, **kwb)
     np.testing.assert_allclose(np.asarray(d, np.float32),
                                np.asarray(e, np.float32),
                                rtol=2e-2, atol=2e-2)
