@@ -329,12 +329,13 @@ _RESIDENT_KV_BYTES = 6 << 20
 #: Auto-schedule defaults applied when the caller leaves q_tiles=None
 #: (the public default).  Tuned against the live-chip schedule sweep
 #: (scripts/flash_tune.py / scripts/chip_session.py over
-#: accl_tpu/bench/flash_sweep.py): the r04 sweeps measure the PLAIN single
-#: fold chain at bq256/bk512 fastest at D=128 (0.66 / 0.27 MXU
-#: fraction across two contention windows, vs 0.41 / 0.27 for two
-#: interleaved q-tile chains and 0.30 / 0.22 for split folds) — the
-#: compiler already pipelines MXU against VPU within the unrolled
-#: fori_loop body, so the extra chains only shrink the matmuls.
+#: accl_tpu/bench/flash_sweep.py) under the min-RTT timing harness
+#: (bench/timing.py — earlier sweeps banked an inflated sync estimate
+#: and their numbers were unusable): across four honest windows at
+#: D=128 the plain single chain and the two-chain q-tile interleave
+#: are statistically tied (0.29-0.39 MXU fraction, ordering flips
+#: window to window) while split folds (chunk_k < block_k) and qt4
+#: consistently lose — so the auto table keeps the SIMPLEST schedule.
 #: Explicit q_tiles/chunk_k always win over the auto table.
 _AUTO_Q_TILES = 1
 _AUTO_CHUNK_K = None  # None = fold whole K blocks (no sub-chunk split)
@@ -416,7 +417,8 @@ def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
         # the ones column rides free only when D and D+1 pad to the
         # same 128-lane tile (D=64 -> 65 both pad to 128; D=128 -> 129
         # pads to 256, doubling every PV matmul) — measured at D=64 as
-        # the fastest schedule (0.21 vs 0.18 MXU frac, r04 sweep)
+        # the fastest schedule (0.19 vs 0.16 MXU frac, honest-timing
+        # r04 sweep; confirmed in every window swept)
         fuse_denom = (kernel == "resident" and D % 128 != 0
                       and kv_bytes + fd_scr_bytes <= _RESIDENT_KV_BYTES)
     elif fuse_denom and auto_kernel:
