@@ -54,9 +54,19 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
     of p rides the PV matmul on the MXU and the explicit `jnp.sum` VPU
     pass disappears — free where D pads to the same lane tile anyway
     (D=64 -> 65 both pad to 128).  Returns (acc', m', None)."""
-    block_q, block_k = q.shape[0], kb.shape[0]
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    return _fold_consume(s, vb, acc, m_prev, l_prev, mask=mask,
+                         mxu_dtype=mxu_dtype)
+
+
+def _fold_consume(s, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
+    """The softmax/PV half of the fold, consuming a PRECOMPUTED score
+    block `s` [bq, bk] (raw, unmasked).  Split out so the skewed
+    schedule can issue block j+1's QK^T before consuming block j's
+    scores — numerics identical to :func:`_softmax_fold`, which now
+    delegates here."""
+    block_q, block_k = s.shape
     masked = mask is not None
     if masked:
         row0, col0 = mask
@@ -109,6 +119,39 @@ def _finalize(acc, m, l, o_ref, lse_ref, row_off=None):
         rows = acc.shape[0]
         o_ref[0, pl.ds(row_off, rows), :] = out
         lse_ref[0, pl.ds(row_off, rows), :] = lse
+
+
+def _causal_block_bounds(iq, block_q, block_k, nk_total):
+    """(n_past, n_live) k-block bounds for q-block `iq` under the
+    causal mask: blocks [0, n_past) are strictly past (no mask work),
+    [n_past, n_live) straddle the diagonal (masked), [n_live, nk) are
+    strictly future (skipped).  Shared by every resident-style
+    schedule so the bounds cannot desynchronize between kernels."""
+    n_past = (iq * block_q) // block_k
+    n_live = (iq * block_q + block_q + block_k - 1) // block_k
+    return n_past, jnp.minimum(n_live, nk_total)
+
+
+def _run_block_loops(body, carry, causal, iq, block_q, block_k,
+                     nk_total):
+    """Drive `body(j, carry, masked)` over the k-blocks: the causal
+    split (unmasked past bulk, masked diagonal epilogue) or the full
+    unmasked range.  One copy of the loop scaffolding for every
+    resident-style schedule — the carry (including the skew schedule's
+    prefetched score block) crosses the loop boundary intact."""
+    from jax import lax as jlax
+
+    if causal:
+        n_past, n_live = _causal_block_bounds(iq, block_q, block_k,
+                                              nk_total)
+        carry = jlax.fori_loop(0, n_past,
+                               lambda j, c: body(j, c, masked=False),
+                               carry)
+        return jlax.fori_loop(n_past, n_live,
+                              lambda j, c: body(j, c, masked=True),
+                              carry)
+    return jlax.fori_loop(0, nk_total,
+                          lambda j, c: body(j, c, masked=False), carry)
 
 
 def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
@@ -209,7 +252,6 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
       much VPU time as the fold's two matmuls cost MXU time, so a single
       dependence chain caps the kernel near 50% MXU no matter how well
       a lone chain pipelines."""
-    from jax import lax as jlax
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -283,25 +325,70 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
                    jnp.full((tq, 1), NEG_INF, jnp.float32),
                    None if fuse_denom else jnp.zeros((tq, 1), jnp.float32))
                   for _ in range(q_tiles))
-    if causal:
-        # blocks fully in this q-block's past: unmasked bulk
-        n_past = (iq * block_q) // block_k
-        # blocks overlapping [iq*bq, iq*bq + bq): masked epilogue
-        n_live = (iq * block_q + block_q + block_k - 1) // block_k
-        n_live = jnp.minimum(n_live, nk_total)
-        carry = jlax.fori_loop(0, n_past,
-                               lambda j, c: step(j, c, masked=False), carry)
-        carry = jlax.fori_loop(n_past, n_live,
-                               lambda j, c: step(j, c, masked=True), carry)
-    else:
-        carry = jlax.fori_loop(0, nk_total,
-                               lambda j, c: step(j, c, masked=False), carry)
+    carry = _run_block_loops(step, carry, causal, iq, block_q,
+                             block_k, nk_total)
     for t in range(q_tiles):
         acc, m, l = carry[t]
         if fuse_denom:
             acc, l = acc[:, :D], acc[:, D:]
         _finalize(acc, m, l, o_ref, lse_ref,
                   row_off=None if q_tiles == 1 else t * tq)
+
+
+def _flash_kernel_resident_skew(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                                scale: float, causal: bool, block_q: int,
+                                block_k: int, T: int, mxu_dtype):
+    """Software-pipelined resident schedule (single fold chain): the
+    QK^T for k-block j+1 is issued BEFORE block j's softmax/PV consume
+    its scores, carrying the prefetched score block [bq, bk] through
+    the fori_loop.  In the plain chain the next matmul depends on the
+    fold's full VPU pass (via the alpha rescale), so the MXU idles
+    through every max/exp2/sum; the skew makes the lookahead matmul
+    data-independent of the current consume, exposing a legal MXU/VPU
+    overlap window to the static scheduler instead of hoping it finds
+    one inside a serialized body.  The lookahead at the last block
+    clamps its read and is discarded.  Numerics are bit-identical to
+    the plain resident chain (same _fold_consume, same fold order).
+
+    MEASURED RESULT (honest-timing r04 sweeps): consistently SLOWER
+    than the plain chain (0.21-0.22 vs 0.34-0.36 MXU fraction at
+    D=128) — the [bq, bk] f32 score block carried through the
+    fori_loop costs more VMEM traffic than the exposed overlap buys.
+    Kept as a selectable schedule so the negative result stays
+    reproducible (`kernel="resident_skew"`); not in the auto table."""
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    nk_total = T // block_k
+    q = (q_ref[0] * scale).astype(mxu_dtype)
+
+    def score(j):
+        # clamp the lookahead read: at the final block this computes a
+        # discarded extra score block against the last K rows
+        off = jnp.minimum(j, nk_total - 1) * block_k
+        kb = k_ref[0, pl.ds(off, block_k), :].astype(mxu_dtype)
+        return jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    def body(j, carry, masked):
+        acc, m, l, s_cur = carry
+        # lookahead FIRST in program order — independent of the consume
+        s_nxt = score(j + 1)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
+        mask = (iq * block_q, j * block_k) if masked else None
+        acc, m, l = _fold_consume(s_cur, vb, acc, m, l, mask=mask,
+                                  mxu_dtype=mxu_dtype)
+        return acc, m, l, s_nxt
+
+    D = q_ref.shape[-1]
+    carry = (jnp.zeros((block_q, D), jnp.float32),
+             jnp.full((block_q, 1), NEG_INF, jnp.float32),
+             jnp.zeros((block_q, 1), jnp.float32),
+             score(0))
+    carry = _run_block_loops(body, carry, causal, iq, block_q,
+                             block_k, nk_total)
+    acc, m, l, _ = carry
+    _finalize(acc, m, l, o_ref, lse_ref)
 
 
 def _vma_of(*xs):
@@ -411,8 +498,23 @@ def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
     if auto_kernel:
         kernel = ("resident" if kv_bytes <= _RESIDENT_KV_BYTES
                   else "grid")
-    if kernel not in ("resident", "grid", "grid_resident"):
+    if kernel not in ("resident", "grid", "grid_resident",
+                      "resident_skew"):
         raise ValueError(f"unknown flash kernel {kernel!r}")
+    if kernel == "resident_skew":
+        # same rule as the fuse_denom check above: silently ignoring an
+        # explicit schedule option would record fake sweep results
+        if q_tiles > 1:
+            raise ValueError("resident_skew is a single-chain schedule "
+                             "(the skewed score carry IS its overlap "
+                             "mechanism); q_tiles > 1 is not supported")
+        if chunk_k is not None:
+            raise ValueError("resident_skew folds whole K blocks (the "
+                             "score carry spans block_k); chunk_k is "
+                             "not supported")
+        if kv_cast_scratch:
+            raise ValueError("resident_skew casts K/V per block read; "
+                             "kv_cast_scratch is not supported")
     if auto_fd:
         # the ones column rides free only when D and D+1 pad to the
         # same 128-lane tile (D=64 -> 65 both pad to 128; D=128 -> 129
@@ -491,35 +593,42 @@ def _flash_forward_impl(qp, kp, vp, cfg):
     out_shapes = (_sds((N, T, D), qp.dtype, vma),
                   _sds((N, T, 1), jnp.float32, vma))
 
-    if kernel == "resident":
+    if kernel in ("resident", "resident_skew"):
         grid = (N, nq)
         q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
                               memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0),
                                memory_space=pltpu.VMEM)
-        o_spec = q_spec
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0),
                                 memory_space=pltpu.VMEM)
-        # one-time K/V cast scratch (see kernel docstring) — only when
-        # the input is not already in MXU format.  fuse_denom builds the
-        # ones-extended V in scratch regardless of dtype.
-        if fuse_denom:
-            scratch = ([pltpu.VMEM((Tk, D), mxu_dtype)]
-                       if qp.dtype != mxu_dtype else [])
-            scratch += [pltpu.VMEM((Tk, D + 1), mxu_dtype)]
-        elif needs_cast:
-            scratch = [pltpu.VMEM((Tk, D), mxu_dtype),
-                       pltpu.VMEM((Tk, D), mxu_dtype)]
-        else:
+        if kernel == "resident_skew":
+            # single-chain, per-block-read casts: no scratch variants
             scratch = []
-        kfn = functools.partial(
-            _flash_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, chunk_k=ck, T=Tk, mxu_dtype=mxu_dtype,
-            q_tiles=q_tiles, fuse_denom=fuse_denom)
+            kfn = functools.partial(
+                _flash_kernel_resident_skew, scale=scale, causal=causal,
+                block_q=bq, block_k=bk, T=Tk, mxu_dtype=mxu_dtype)
+        else:
+            # one-time K/V cast scratch (see kernel docstring) — only
+            # when the input is not already in MXU format.  fuse_denom
+            # builds the ones-extended V in scratch regardless of dtype.
+            if fuse_denom:
+                scratch = ([pltpu.VMEM((Tk, D), mxu_dtype)]
+                           if qp.dtype != mxu_dtype else [])
+                scratch += [pltpu.VMEM((Tk, D + 1), mxu_dtype)]
+            elif needs_cast:
+                scratch = [pltpu.VMEM((Tk, D), mxu_dtype),
+                           pltpu.VMEM((Tk, D), mxu_dtype)]
+            else:
+                scratch = []
+            kfn = functools.partial(
+                _flash_kernel_resident, scale=scale, causal=causal,
+                block_q=bq, block_k=bk, chunk_k=ck, T=Tk,
+                mxu_dtype=mxu_dtype, q_tiles=q_tiles,
+                fuse_denom=fuse_denom)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec, kv_spec, kv_spec],
-            out_specs=(o_spec, lse_spec),
+            out_specs=(q_spec, lse_spec),
             scratch_shapes=scratch,
             # with cast/fused scratch the q-blocks of one batch-head must
             # run in-order ("arbitrary") so the iq==0 build is visible to

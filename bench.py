@@ -227,28 +227,28 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         def fa(x, kk, vv):  # chained: output feeds the next queries
             return flash_attention(x, kk, vv, causal=True, interpret=False)
 
-        # D=128 candidate schedules, auto-tuned on the live chip: the
-        # resident default, the pinned-row grid_resident schedule, and
-        # chunked sub-folds (MXU/VPU pipelining).  The best lands in the
-        # round record with its name, so schedule selection is measured
-        # per chip generation instead of hardcoded.
-        # candidate construction is shared with the live-chip tuner
-        # scripts so methodology fixes land once (flash_sweep docstring)
+        # D=128 candidate schedules, measured on the live chip each
+        # round: the best lands in the round record with its name, so
+        # schedule selection is tracked per chip generation instead of
+        # hardcoded.  Candidate construction is shared with the
+        # live-chip tuner scripts so methodology fixes land once
+        # (flash_sweep docstring).
         from accl_tpu.bench.flash_sweep import make_variant
 
-        # grid_resident earned its slot out (r04: 29-49 TF vs resident's
-        # 75), and fused-denominator at D=128 is out on physics (the
-        # ones-extended V pads 129 -> 256 lanes, doubling PV).  The
-        # remaining slots compose the two pipelining levers — q-tile
-        # interleave (independent fold chains) x chunk_k sub-folds
-        # (softmax of chunk c overlaps QK^T of chunk c+1) — which
-        # earlier rounds only measured one at a time.
+        # candidate set = the honest-timing Pareto front (min-RTT
+        # harness r04 sweeps): the plain chain at bq256 and bq512, the
+        # two-chain q-tile interleave at bq512 (statistically tied with
+        # plain across windows — kept so each round's record shows the
+        # live ordering), and the bk1024 row variant.  Split folds
+        # (chunk_k < block_k), qt4, fused-denominator at D=128 (the
+        # ones-extended V pads 129 -> 256 lanes, doubling PV), and the
+        # skewed score-carry schedule all measured consistently slower
+        # under honest timing and are out.
         d128_variants = {
             "resident": make_variant(256, 512),
-            "resident_qt2": make_variant(256, 512, qt=2),
-            "resident_qt2_ck256": make_variant(256, 512, ck=256, qt=2),
-            "resident_bq512_qt2_ck256": make_variant(512, 512, ck=256,
-                                                     qt=2),
+            "resident_bq512": make_variant(512, 512),
+            "resident_bq512_qt2": make_variant(512, 512, qt=2),
+            "resident_bq512_bk1024": make_variant(512, 1024),
         }
 
         # MXU-peak context, interleaved: a big bf16 matmul is the
@@ -261,11 +261,13 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
 
         # interleave manually (timed_chain_ab shares one input; the two
         # workloads here have different operand shapes).  10 rounds:
-        # observed contention windows on this shared chip last minutes
-        # and depress identical kernels 30x (matmul 19 vs 557 TFLOPs),
-        # so the best-window estimator needs enough rounds to straddle
-        # a window boundary.  Iteration counts put >= ~10 ms of device
-        # work in one dispatch so the RTT jitter is amortized away.
+        # contention windows on this shared chip last minutes and can
+        # depress identical kernels several-fold (readings ABOVE peak,
+        # e.g. "557 TFLOPs" matmul, were the old median-RTT subtraction
+        # artifact — fixed in bench/timing.py), so the best-window
+        # estimator needs enough rounds to straddle a window boundary.
+        # Iteration counts put >= ~10 ms of device work in one dispatch
+        # so the RTT jitter is amortized away.
         # D=128 variant (same flops: H halved): the MXU-native head dim —
         # at D=64 the contraction uses half the systolic array and the
         # softmax VPU passes dominate, so this shows the kernel's

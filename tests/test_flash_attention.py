@@ -560,3 +560,40 @@ def test_flash_backward_chunked_matches_dense(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4,
                                    err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_resident_skew_matches_plain(causal):
+    # the software-pipelined schedule (QK^T of block j+1 issued before
+    # block j's softmax/PV consume, score block carried through the
+    # loop) must be bit-identical to the plain resident chain — same
+    # _fold_consume, same fold order, only the issue order differs
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(23)
+    mk = lambda: jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    kw = dict(causal=causal, block_q=64, block_k=64, interpret=True,
+              mxu_dtype=jnp.bfloat16, q_tiles=1, fuse_denom=False)
+    a, la = flash_attention_packed_lse(q, k, v, kernel="resident_skew",
+                                       **kw)
+    b, lb = flash_attention_packed_lse(q, k, v, kernel="resident", **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_flash_resident_skew_rejects_inapplicable_options():
+    # the module rule: silently ignoring an explicit schedule option
+    # records fake sweep results — every inapplicable option raises
+    from accl_tpu.ops.flash import flash_attention_packed
+    N, T, D = 1, 128, 32
+    x = jnp.zeros((N, T, D), jnp.float32)
+    with pytest.raises(ValueError, match="single-chain"):
+        flash_attention_packed(x, x, x, kernel="resident_skew",
+                               q_tiles=2, interpret=True)
+    with pytest.raises(ValueError, match="chunk_k"):
+        flash_attention_packed(x, x, x, kernel="resident_skew",
+                               chunk_k=64, interpret=True)
+    with pytest.raises(ValueError, match="kv_cast_scratch"):
+        flash_attention_packed(x, x, x, kernel="resident_skew",
+                               kv_cast_scratch=True, interpret=True)

@@ -71,6 +71,9 @@ def test_ring_compressed_lowers_through_mosaic(phased=None):
     ("resident", {"q_tiles": 2}),
     ("resident", {"fuse_denom": True}),
     ("resident", {"q_tiles": 2, "fuse_denom": True}),
+    # the software-pipelined score-carry schedule (kept selectable;
+    # see its docstring for the measured result)
+    ("resident_skew", {"q_tiles": 1}),
 ])
 def test_flash_kernels_lower_through_mosaic(kern, opts):
     from accl_tpu.ops.flash import flash_attention_packed
