@@ -41,44 +41,40 @@ LANE_SIZES = [1 << p for p in range(10, 31, 2)]  # 1 KB .. 1 GB
 
 # Candidate SPECS as plain data (closures are built inside flash_stage)
 # so --check can compare the current sets against a banked artifact
-# without importing jax.  The two pipelining levers compose: q_tiles
-# gives INDEPENDENT fold chains (VPU of tile A overlaps MXU of tile B),
-# chunk_k splits each fold into an unrolled run (chunk c's softmax
-# overlaps chunk c+1's QK^T).  Earlier sweeps measured each lever alone
-# (qt2 OR ck256); the combinations are the untried half of the space.
-# fd at D=128 is out on physics: the ones-extended V pads 129 -> 256
-# lanes, doubling the PV matmul (it stays in the D=64 set, where 65 and
-# 64 pad to the same 128-lane tile).  The `cast` variants add the
-# one-shot K/V cast scratch (kills the per-fold f32->bf16 VPU pass).
+# without importing jax.  The sets follow the honest-timing (min-RTT
+# harness) r04 findings: plain chains and the bq512 q-tile interleave
+# are the Pareto front; split folds, qt4, and D=128 fused-denominator
+# are out (fd at D=128 also on physics: the ones-extended V pads
+# 129 -> 256 lanes, doubling the PV matmul — it stays in the D=64 set,
+# where 65 and 64 pad to the same 128-lane tile); the skew schedule
+# and one qt2+ck256 composition ride along so the rejected families
+# keep being re-measured per chip generation.  The `cast` variant adds
+# the one-shot K/V cast scratch (kills the per-fold f32->bf16 pass).
 D128_SPECS = {
     "bq256_bk512": dict(bq=256, bk=512),
-    "bq256_bk512_ck256": dict(bq=256, bk=512, ck=256),
-    "bq256_bk512_ck128": dict(bq=256, bk=512, ck=128),
-    "bq256_bk512_qt2": dict(bq=256, bk=512, qt=2),
-    "bq256_bk512_qt2_ck256": dict(bq=256, bk=512, ck=256, qt=2),
-    "bq256_bk512_qt2_ck128": dict(bq=256, bk=512, ck=128, qt=2),
+    "bq512_bk512": dict(bq=512, bk=512),
     "bq512_bk512_qt2": dict(bq=512, bk=512, qt=2),
+    "bq256_bk512_qt2": dict(bq=256, bk=512, qt=2),
+    "bq512_bk1024": dict(bq=512, bk=1024),
+    "bq512_bk1024_qt2": dict(bq=512, bk=1024, qt=2),
+    "bq256_bk1024": dict(bq=256, bk=1024),
+    "bq512_bk512_cast": dict(bq=512, bk=512, cast=True),
+    "bq256_bk512_skew": dict(bq=256, bk=512, kernel="resident_skew"),
     "bq512_bk512_qt2_ck256": dict(bq=512, bk=512, ck=256, qt=2),
-    "bq512_bk512_qt4": dict(bq=512, bk=512, qt=4),
-    "bq512_bk512_qt4_ck256": dict(bq=512, bk=512, ck=256, qt=4),
-    "bq512_bk1024_qt2_ck256": dict(bq=512, bk=1024, ck=256, qt=2),
-    "bq256_bk512_qt2_cast": dict(bq=256, bk=512, qt=2, cast=True),
-    "bq256_bk512_qt2_ck256_cast": dict(bq=256, bk=512, ck=256, qt=2,
-                                       cast=True),
 }
 D64_SPECS = {
     "d64_resident": dict(bq=256, bk=512),
     "d64_resident_fd": dict(bq=256, bk=512, fd=True),
+    "d64_bq512_fd": dict(bq=512, bk=512, fd=True),
     "d64_resident_qt2_fd": dict(bq=256, bk=512, qt=2, fd=True),
-    "d64_resident_qt2_ck256_fd": dict(bq=256, bk=512, ck=256, qt=2,
-                                      fd=True),
 }
 
 
 def _build(make_variant, specs):
     return {name: make_variant(sp["bq"], sp["bk"], ck=sp.get("ck"),
                                qt=sp.get("qt", 1), fd=sp.get("fd", False),
-                               cast=sp.get("cast", False))
+                               cast=sp.get("cast", False),
+                               kernel=sp.get("kernel", "resident"))
             for name, sp in specs.items()}
 
 
