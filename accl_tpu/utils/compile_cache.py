@@ -1,0 +1,60 @@
+"""Best-effort persistent XLA compilation cache.
+
+Chip claim windows on the shared TPU are scarce and short; a cold
+bench/sweep attempt pays ~10 program compiles at 20-40 s each before it
+measures anything.  Enabling JAX's persistent compilation cache lets
+every retry attempt and every chip-facing tool (bench.py worker,
+scripts/chip_session.py, scripts/flash_tune.py) reuse the executables
+the previous window already paid for, so a brief window goes to
+MEASUREMENT instead of recompiles.
+
+Best-effort by design: backends that cannot serialize executables
+(some remote/tunneled plugins) simply skip the cache — enabling it
+must never break a measurement run.
+"""
+from __future__ import annotations
+
+import getpass
+import os
+import sys
+import tempfile
+
+
+def _default_dir() -> str:
+    # per-user path: a world-shared fixed dir would be created by the
+    # first user and silently reject every other user's cache writes
+    # (and is an executable-cache-poisoning surface on a shared host)
+    try:
+        user = getpass.getuser()
+    except Exception:  # noqa: BLE001 — no passwd entry in a container
+        user = f"uid{os.getuid()}" if hasattr(os, "getuid") else "user"
+    return os.path.join(tempfile.gettempdir(), f"accl-jax-cache-{user}")
+
+
+def enable(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at `path` (default:
+    $ACCL_COMPILE_CACHE or a per-user tmpdir location).  Returns the
+    cache dir, or None when the cache could not be enabled.  Call
+    after `import jax` and before the first compile."""
+    import jax
+
+    path = path or os.environ.get("ACCL_COMPILE_CACHE", _default_dir())
+    try:
+        os.makedirs(path, exist_ok=True)
+        # threshold first, dir last: if any update raises, no partial
+        # state is left behind (the dir setting is what activates the
+        # cache).  0 = cache every compile: the tunnel RTT makes every
+        # remote compile round-trip expensive regardless of XLA's own
+        # compile time, so even "quick" programs are worth persisting.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_compilation_cache_dir", path)
+        return path
+    except Exception as e:  # noqa: BLE001 — never break a bench run
+        try:  # roll back so the reported state matches the real state
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:  # noqa: BLE001
+            pass
+        print(f"[compile-cache] disabled: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None

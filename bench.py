@@ -82,6 +82,9 @@ CPU_TIMEOUT_S = 420
 def _measure(platform: str) -> dict:
     import jax
 
+    from accl_tpu.utils.compile_cache import enable as _enable_cache
+    _enable_cache()  # chip windows go to measurement, not recompiles
+
     if platform == "cpu":
         # the axon sitecustomize re-pins the platform at interpreter
         # start; the runtime config update is what actually frees us

@@ -326,6 +326,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from accl_tpu.utils.compile_cache import enable as _enable_cache
+
+    _enable_cache()  # retry attempts reuse the prior window's compiles
     print(f"backend={jax.default_backend()}", file=sys.stderr, flush=True)
     from accl_tpu.bench.timing import make_harness
 

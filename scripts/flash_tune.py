@@ -21,6 +21,9 @@ import jax
 import jax.numpy as jnp
 
 from accl_tpu.bench.flash_sweep import make_variant, report, run_sweep
+from accl_tpu.utils.compile_cache import enable as _enable_cache
+
+_enable_cache()
 
 ROUNDS = int(sys.argv[1]) if len(sys.argv) > 1 else 6
 
