@@ -39,22 +39,30 @@ def enable(path: str | None = None) -> str | None:
     import jax
 
     path = path or os.environ.get("ACCL_COMPILE_CACHE", _default_dir())
+    # snapshot both settings so a failure restores EXACTLY the prior
+    # state — including a cache some earlier call successfully enabled
+    prev = {}
+    for key in ("jax_persistent_cache_min_compile_time_secs",
+                "jax_compilation_cache_dir"):
+        try:
+            prev[key] = getattr(jax.config, key)
+        except AttributeError:
+            pass
     try:
         os.makedirs(path, exist_ok=True)
-        # threshold first, dir last: if any update raises, no partial
-        # state is left behind (the dir setting is what activates the
-        # cache).  0 = cache every compile: the tunnel RTT makes every
-        # remote compile round-trip expensive regardless of XLA's own
-        # compile time, so even "quick" programs are worth persisting.
+        # 0 = cache every compile: the tunnel RTT makes every remote
+        # compile round-trip expensive regardless of XLA's own compile
+        # time, so even "quick" programs are worth persisting
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.0)
         jax.config.update("jax_compilation_cache_dir", path)
         return path
     except Exception as e:  # noqa: BLE001 — never break a bench run
-        try:  # roll back so the reported state matches the real state
-            jax.config.update("jax_compilation_cache_dir", None)
-        except Exception:  # noqa: BLE001
-            pass
+        for key, val in prev.items():
+            try:
+                jax.config.update(key, val)
+            except Exception:  # noqa: BLE001
+                pass
         print(f"[compile-cache] disabled: {type(e).__name__}: {e}",
               file=sys.stderr)
         return None

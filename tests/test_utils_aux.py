@@ -1,6 +1,9 @@
 """Aux-subsystem utilities (SURVEY §5): timer, profiling hooks,
 topology/capability probe (the hwid parse analog), debug logging."""
+import os
+
 import numpy as np
+import pytest
 
 
 def test_timer_shape():
@@ -97,3 +100,57 @@ def test_initialize_multihost_arg_assembly(monkeypatch):
     monkeypatch.delenv("ACCL_NUM_PROCESSES")
     monkeypatch.delenv("ACCL_PROCESS_ID")
     assert initialize_multihost(dry_run=True) == {}  # pod auto-detect
+
+
+@pytest.fixture
+def _restore_jax_cache_config():
+    # enable() mutates process-global jax config; leaking it would make
+    # every later compile in the suite silently persist to a test dir
+    import jax
+
+    keys = ("jax_persistent_cache_min_compile_time_secs",
+            "jax_compilation_cache_dir")
+    prev = {k: getattr(jax.config, k) for k in keys}
+    yield
+    for k, v in prev.items():
+        jax.config.update(k, v)
+
+
+def test_compile_cache_enable(tmp_path, _restore_jax_cache_config):
+    # the chip-facing tools call this before their first compile; it
+    # must activate the persistent cache (compiles survive process
+    # restarts) and report the directory it actually used
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.utils.compile_cache import enable
+
+    d = enable(str(tmp_path / "cache"))
+    assert d == str(tmp_path / "cache")
+    assert os.path.isdir(d)
+    # a compile after enable() lands an artifact in the cache dir
+    fn = jax.jit(lambda x: x * 2 + 1)
+    fn(jnp.ones((8, 128))).block_until_ready()
+    assert os.listdir(d), "no cache entry written for a fresh compile"
+
+
+def test_compile_cache_env_override(tmp_path, monkeypatch,
+                                    _restore_jax_cache_config):
+    # $ACCL_COMPILE_CACHE wins over the per-user default when no
+    # explicit path is passed
+    from accl_tpu.utils.compile_cache import enable
+
+    target = str(tmp_path / "envcache")
+    monkeypatch.setenv("ACCL_COMPILE_CACHE", target)
+    assert enable() == target
+
+
+def test_compile_cache_default_dir_is_per_user():
+    # a world-shared fixed path would be owned by whoever ran first on
+    # a shared host; the default must be user-scoped
+    import getpass
+
+    from accl_tpu.utils import compile_cache
+
+    d = compile_cache._default_dir()
+    assert getpass.getuser() in os.path.basename(d)
