@@ -30,6 +30,12 @@ class ModelConfig:
     d_model: int = 128
     n_layers: int = 2
     n_heads: int = 4
+    #: K/V heads (grouped-query attention, the Llama-family layout):
+    #: None = n_heads (plain MHA).  Must divide n_heads; each K/V head
+    #: serves n_heads/n_kv_heads query heads.  The flash path consumes
+    #: the grouped layout expansion-free (ops/flash.py GQA index maps);
+    #: dense and ring-SP paths expand K/V per q head.
+    n_kv_heads: int | None = None
     d_head: int = 32
     d_ff: int = 512
     dtype: str = "float32"  # compute dtype; bf16 on real TPU
@@ -54,6 +60,16 @@ class ModelConfig:
             raise ValueError(f"unknown attn implementation {self.attn!r}")
         if self.sp_schedule not in ("contiguous", "zigzag"):
             raise ValueError(f"unknown sp schedule {self.sp_schedule!r}")
+        if self.n_kv_heads is not None and (
+                self.n_kv_heads <= 0
+                or self.n_heads % self.n_kv_heads != 0):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must divide "
+                f"n_heads={self.n_heads}")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     @property
     def jdtype(self):
@@ -67,11 +83,12 @@ def init_params(rng: np.random.Generator, cfg: ModelConfig) -> dict:
         return (rng.standard_normal(shape) * scale).astype(np.float32)
 
     D, H, Dh, F = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff
+    G = cfg.kv_heads
     blocks = []
     for _ in range(cfg.n_layers):
         blocks.append({
             "ln1": np.ones(D, np.float32),
-            "wq": g(D, H, Dh), "wk": g(D, H, Dh), "wv": g(D, H, Dh),
+            "wq": g(D, H, Dh), "wk": g(D, G, Dh), "wv": g(D, G, Dh),
             "wo": g(H, Dh, D),
             "ln2": np.ones(D, np.float32),
             "w1": g(D, F), "w2": g(F, D),
@@ -86,7 +103,9 @@ def init_params(rng: np.random.Generator, cfg: ModelConfig) -> dict:
 
 def param_specs(cfg: ModelConfig, tp: Optional[str] = "tp") -> dict:
     """PartitionSpec pytree: head/hidden dims sharded over `tp`, the
-    rest replicated (None specs)."""
+    rest replicated (None specs).  Under GQA the K/V projections shard
+    their (smaller) head axis over the same `tp` — the mesh's tp extent
+    must divide n_kv_heads for tensor parallelism to apply."""
     t = tp
     block = {
         "ln1": P(None),
@@ -129,6 +148,13 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
         k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
         v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+        group = q.shape[2] // k.shape[2]  # q heads per K/V head (GQA)
+        if group > 1 and (sp_axis is not None or cfg.attn != "flash"):
+            # dense and ring-SP attention consume one K/V head per q
+            # head; only the flash kernel reads the grouped layout
+            # in place (its K/V index maps share rows across the group)
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         if sp_axis is not None:
             if cfg.attn == "flash":
                 raise ValueError(

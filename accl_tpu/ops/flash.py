@@ -556,21 +556,32 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     flattened — the splash-attention layout).  This is the zero-copy
     path: no transposes touch HBM; callers that keep activations packed
     (the model families do) pay only the kernel itself.
+    GROUPED-QUERY ATTENTION (GQA): when K/V arrive with FEWER packed
+    heads than q — shape [Nk, Tk, D] with N % Nk == 0 — each K/V head
+    serves N/Nk consecutive q heads (q row n reads K/V row
+    n // (N // Nk)).  Zero-copy on the forward path: only the kernel's
+    K/V block index maps change, no expansion touches HBM.  The
+    backward expands K/V (jnp.repeat) and lets autodiff's transpose of
+    the repeat produce the per-group dK/dV sums.
+
     Returns (out [N, T, D], lse [N, T] f32)."""
     N, T, D = qp.shape
     Tk = kp.shape[1]
-    if kp.shape != vp.shape or kp.shape[0] != N or kp.shape[2] != D:
+    if (kp.shape != vp.shape or kp.shape[2] != D
+            or kp.shape[0] == 0 or N % kp.shape[0] != 0):
         raise ValueError(f"k/v shape {kp.shape}/{vp.shape} incompatible "
-                         f"with q {qp.shape}")
+                         f"with q {qp.shape} (K/V heads must divide "
+                         f"q heads for GQA)")
     if causal and Tk != T:
         raise ValueError("causal masking requires Tq == Tk "
                          "(cross-length attention has no diagonal)")
+    kv_group = N // kp.shape[0]
     # everything static is resolved; the traced part goes through the
     # custom-vjp boundary so jax.grad works on every entry point
     cfg = _resolve_schedule(T, Tk, D, qp.dtype, causal, block_q,
                             block_k, interpret, mxu_dtype, kernel,
                             chunk_k, kv_cast_scratch, q_tiles,
-                            fuse_denom)
+                            fuse_denom) + (kv_group,)
     return _flash_packed_diff(qp, kp, vp, cfg)
 
 
@@ -581,7 +592,8 @@ def _flash_forward_impl(qp, kp, vp, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, kernel, needs_cast,
-     q_tiles, fuse_denom) = cfg
+     q_tiles, fuse_denom, kv_group) = cfg
+    g = kv_group  # q-heads per K/V head (1 = plain MHA)
     N, T, D = qp.shape
     Tk = kp.shape[1]
     nq, nk = T // bq, Tk // bk
@@ -597,7 +609,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
         grid = (N, nq)
         q_spec = pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
                               memory_space=pltpu.VMEM)
-        kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0),
+        kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i: (b // g, 0, 0),
                                memory_space=pltpu.VMEM)
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0),
                                 memory_space=pltpu.VMEM)
@@ -648,10 +660,12 @@ def _flash_forward_impl(qp, kp, vp, cfg):
             # re-DMAs a block whose index changes, so the row is fetched
             # once per batch-head while the cells keep the grid
             # schedule's static predication and scratch carries
-            kv_spec = pl.BlockSpec((1, Tk, D), lambda b, i, j: (b, 0, 0),
+            kv_spec = pl.BlockSpec((1, Tk, D),
+                                   lambda b, i, j: (b // g, 0, 0),
                                    memory_space=pltpu.VMEM)
         else:
-            kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+            kv_spec = pl.BlockSpec((1, bk, D),
+                                   lambda b, i, j: (b // g, j, 0),
                                    memory_space=pltpu.VMEM)
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)
@@ -848,7 +862,7 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, _kernel, _nc, _qt,
-     _fd) = cfg
+     _fd, _kvg) = cfg
     N, T, D = qp.shape
     Tk = kp.shape[1]
     nq, nk = T // bq, Tk // bk
@@ -941,7 +955,24 @@ def _flash_diff_bwd(cfg, res, cts):
         g_lse = None
     if isinstance(g_out, SymbolicZero):  # lse-only losses (rare)
         g_out = jnp.zeros(out.shape, out.dtype)
-    return _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg)
+    kv_group = cfg[-1]
+    if kv_group == 1:
+        return _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg)
+    # GQA backward: expand K/V to one row per q head (the forward's
+    # zero-copy index maps have no transpose), run the plain backward,
+    # and fold each group's dK/dV contributions with an f32 sum — the
+    # exact transpose of the forward's row sharing
+    nk_heads = kp.shape[0]
+    kpe = jnp.repeat(kp, kv_group, axis=0)
+    vpe = jnp.repeat(vp, kv_group, axis=0)
+    dq, dk, dv = _flash_backward(qp, kpe, vpe, out, lse, g_out, g_lse,
+                                 cfg[:-1] + (1,))
+
+    def fold(d, dtype):
+        d = d.reshape(nk_heads, kv_group, *d.shape[1:])
+        return d.astype(jnp.float32).sum(axis=1).astype(dtype)
+
+    return dq, fold(dk, kp.dtype), fold(dv, vp.dtype)
 
 
 _flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
@@ -958,12 +989,23 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
     of a [B,T,H*D] view) was measured SLOWER than these transposes on
     the r04 chip — the per-head 512-byte strided DMA costs more than
     the packs — so the wrapper deliberately stays on the packing path.
+
+    GQA: k/v may carry FEWER heads than q ([B, Tk, G, D], H % G == 0) —
+    each K/V head serves H/G consecutive q heads, expansion-free in
+    the forward (see :func:`_flash_call_packed`).
+
     Returns (out [B,T,H,D], lse [B,H,T] f32)."""
     B, T, H, D = q.shape
+    G = k.shape[2] if k.ndim == 4 else -1
+    if (k.shape != v.shape or k.ndim != 4 or k.shape[0] != B
+            or k.shape[3] != D or G <= 0 or H % G != 0):
+        raise ValueError(f"k/v shape {k.shape}/{v.shape} incompatible "
+                         f"with q {q.shape} (K/V heads must divide "
+                         f"q heads for GQA)")
 
-    def pack(x):  # [B, t, H, D] -> [B*H, t, D]
-        t = x.shape[1]
-        return x.transpose(0, 2, 1, 3).reshape(B * H, t, D)
+    def pack(x):  # [B, t, h, D] -> [B*h, t, D] (h = that tensor's heads)
+        t, h = x.shape[1], x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, t, D)
 
     out, lse = _flash_call_packed(pack(q), pack(k), pack(v), causal,
                                   block_q, block_k, interpret, mxu_dtype,

@@ -597,3 +597,83 @@ def test_flash_resident_skew_rejects_inapplicable_options():
     with pytest.raises(ValueError, match="kv_cast_scratch"):
         flash_attention_packed(x, x, x, kernel="resident_skew",
                                kv_cast_scratch=True, interpret=True)
+
+
+@pytest.mark.parametrize("kernel,opts", [
+    ("resident", {}),
+    ("grid", {}),
+    # the separately-written pinned-row index map
+    ("grid_resident", {}),
+    ("resident_skew", {"q_tiles": 1, "fuse_denom": False}),
+    # scratch paths: their @pl.when(iq == 0) builds must read the
+    # GROUP's K/V rows, not the q-head index's
+    ("resident", {"fuse_denom": True}),
+    ("resident", {"kv_cast_scratch": True, "mxu_dtype": jnp.bfloat16}),
+    ("resident", {"q_tiles": 2}),
+])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_expanded(causal, kernel, opts):
+    # grouped-query attention: K/V with fewer heads than q — the
+    # kernel's K/V index maps share each row across H/G consecutive q
+    # heads, so the result must be BIT-identical to running the same
+    # kernel on explicitly expanded (repeated) K/V.  B > 1 exercises
+    # the packed-layout fold (b*H + h) // group == b*G + h // group.
+    from accl_tpu.ops.flash import (flash_attention_lse,
+                                    flash_attention_packed_lse)
+    B, T, H, G, D = 2, 128, 4, 2, 32
+    rng = np.random.default_rng(33)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, G, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, G, D)), jnp.float32)
+    rep = lambda x: jnp.repeat(x, H // G, axis=2)
+    kw = dict(causal=causal, block_q=64, block_k=64, interpret=True,
+              mxu_dtype=jnp.float32, kernel=kernel)
+    kw.update(opts)
+    if kernel in ("resident", "grid") and "kv_cast_scratch" not in opts:
+        # BTHD wrapper path (no kv_cast_scratch arg there)
+        a, la = flash_attention_lse(q, k, v, **kw)
+        b, lb = flash_attention_lse(q, rep(k), rep(v), **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # packed entry covers every kernel and option
+    pk = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        B * x.shape[2], T, D)
+    a, la = flash_attention_packed_lse(pk(q), pk(k), pk(v), **kw)
+    b, lb = flash_attention_packed_lse(pk(q), pk(rep(k)), pk(rep(v)),
+                                       **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_flash_gqa_grads_match_expansion():
+    # the GQA backward expands K/V and group-sums dK/dV; that must
+    # equal autodiff through an explicit repeat (whose transpose IS the
+    # group sum)
+    from accl_tpu.ops.flash import flash_attention_lse
+    B, T, H, G, D = 1, 128, 4, 2, 32
+    rng = np.random.default_rng(35)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, G, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, G, D)), jnp.float32)
+    rep = lambda x: jnp.repeat(x, H // G, axis=2)
+
+    def loss(q, k, v):
+        o, lse = flash_attention_lse(q, k, v, causal=True, block_q=64,
+                                     block_k=64, interpret=True,
+                                     mxu_dtype=jnp.float32)
+        return jnp.sum(o * o) + 0.1 * jnp.sum(lse)
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: loss(q, rep(k), rep(v)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gqa_rejects_nondividing_heads():
+    from accl_tpu.ops.flash import flash_attention
+    q = jnp.zeros((1, 64, 4, 32), jnp.float32)
+    kv = jnp.zeros((1, 64, 3, 32), jnp.float32)  # 3 does not divide 4
+    with pytest.raises(ValueError, match="GQA"):
+        flash_attention(q, kv, kv, interpret=True)

@@ -88,6 +88,22 @@ def test_flash_kernels_lower_through_mosaic(kern, opts):
     _assert_mosaic(exp.mlir_module())
 
 
+@pytest.mark.parametrize("kern", ["resident", "grid"])
+def test_flash_gqa_lowers_through_mosaic(kern):
+    # GQA: the grouped K/V index maps (b // group) must lower — a map
+    # regression would strand the Llama-family layout in interpret mode
+    from accl_tpu.ops.flash import flash_attention_packed
+
+    N, Nk, T, D = 8, 2, 2048, 128
+    q = jax.ShapeDtypeStruct((N, T, D), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((Nk, T, D), jnp.bfloat16)
+    exp = jax.export.export(
+        jax.jit(lambda q, k, v: flash_attention_packed(
+            q, k, v, causal=True, kernel=kern)),
+        platforms=["tpu"])(q, kv, kv)
+    _assert_mosaic(exp.mlir_module())
+
+
 @pytest.mark.parametrize("opts", [
     # fused-denominator scratch build (f32 -> bf16 K cast + ones-V)
     {"q_tiles": 2, "fuse_denom": True},
