@@ -148,13 +148,15 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
         k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
         v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
-        group = q.shape[2] // k.shape[2]  # q heads per K/V head (GQA)
-        if group > 1 and (sp_axis is not None or cfg.attn != "flash"):
-            # dense and ring-SP attention consume one K/V head per q
-            # head; only the flash kernel reads the grouped layout
-            # in place (its K/V index maps share rows across the group)
-            k = jnp.repeat(k, group, axis=2)
-            v = jnp.repeat(v, group, axis=2)
+        if (k.shape[2] != q.shape[2] and sp_axis is None
+                and cfg.attn != "flash"):
+            # only the local dense path consumes one K/V head per q
+            # head; the flash kernel reads the grouped layout in place
+            # (K/V index maps share rows across the group) and the ring
+            # layer rotates the grouped shards, expanding internally
+            # only on its dense reference rung
+            from ..parallel.ring_attention import expand_gqa_kv
+            k, v = expand_gqa_kv(k, v, q.shape[2])
         if sp_axis is not None:
             if cfg.attn == "flash":
                 raise ValueError(
@@ -391,6 +393,15 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
 def shard_params(params, mesh, cfg: ModelConfig, tp: Optional[str] = "tp"):
     """Place a host param pytree on the mesh per param_specs."""
     tp = tp if tp in set(mesh.axis_names) else None
+    if tp is not None:
+        ext = mesh.shape[tp]
+        if cfg.kv_heads % ext != 0:
+            # fail with the config-level story, not jax's generic
+            # "dimension not divisible" from device_put
+            raise ValueError(
+                f"tensor-parallel extent {ext} must divide "
+                f"n_kv_heads={cfg.kv_heads} (the grouped K/V "
+                f"projections shard their head axis over {tp!r})")
     specs = param_specs(cfg, tp)
     return _place(params, specs, mesh)
 

@@ -131,6 +131,17 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
         raise ValueError(f"unknown ring_attention schedule {schedule!r}")
     if impl is None:
         impl = "flash" if _flash_defaults(q)[0] else "dense"
+    if k.shape[2] != q.shape[2]:
+        # grouped-query K/V ([B, Tl, G, D], G dividing H): the flash
+        # hops consume the grouped layout in place — the ring then
+        # rotates H/G-times-smaller shards, a direct ICI-bandwidth win.
+        # The dense reference path expands per q head here instead.
+        if q.shape[2] % k.shape[2] != 0:
+            raise ValueError(
+                f"K/V heads {k.shape[2]} must divide q heads "
+                f"{q.shape[2]} for GQA")
+        if impl == "dense":
+            k, v = expand_gqa_kv(k, v, q.shape[2])
     if schedule == "zigzag":
         if not causal:
             raise ValueError("zigzag schedule only makes sense for causal "
@@ -424,18 +435,25 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
     head subset, then reshards back (built on the reference's alltoall,
     fw :2123-2218).  Requires H % P == 0.
 
-    q/k/v: [B, T_local, H, D] → out: [B, T_local, H, D]
+    q: [B, T_local, H, D], k/v: [B, T_local, H or G, D] (grouped-query
+    K/V reshard their own smaller head axis — G must also divide by P)
+    → out: [B, T_local, H, D]
     """
     P = lax.axis_size(axis)
     B, Tl, H, D = q.shape
+    G = k.shape[2]
     if H % P != 0:
         raise ValueError(f"heads {H} not divisible by sp={P}")
+    if G != H and (G % P != 0 or H % G != 0):
+        raise ValueError(f"K/V heads {G} must divide q heads {H} and "
+                         f"be divisible by sp={P} for Ulysses GQA")
 
     def seq_to_heads(x):
-        # [B, Tl, H, D] -> [B, P*Tl, H/P, D]
-        x = x.reshape(B, Tl, P, H // P, D)
+        # [B, Tl, h, D] -> [B, P*Tl, h/P, D] (h = that tensor's heads)
+        h = x.shape[2]
+        x = x.reshape(B, Tl, P, h // P, D)
         x = lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
-        return x.reshape(B, P * Tl, H // P, D)  # squeeze the split axis
+        return x.reshape(B, P * Tl, h // P, D)  # squeeze the split axis
 
     def heads_to_seq(x):
         x = x.reshape(B, P * Tl, 1, H // P, D)
@@ -443,6 +461,7 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
         return x.reshape(B, Tl, H, D)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    attn_fn_wants_expansion = attn_fn is not None  # caller-supplied
     if attn_fn is None:
         import jax as _jax
         if _jax.default_backend() == "tpu":
@@ -455,8 +474,27 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
                                         mxu_dtype=mxu_dt)
         else:
             attn_fn = functools.partial(_dense_attention, causal=causal)
+            attn_fn_wants_expansion = True
+    if kg.shape[2] != qg.shape[2] and attn_fn_wants_expansion:
+        # a grouped head subset reaches a non-flash attention callable
+        # (the dense default, or any caller-supplied fn — assumed NOT
+        # GQA-aware; correctness beats the expansion saving there)
+        kg, vg = expand_gqa_kv(kg, vg, qg.shape[2])
     og = attn_fn(qg, kg, vg)
     return heads_to_seq(og)
+
+
+def expand_gqa_kv(k, v, n_q_heads: int):
+    """Expand grouped K/V ([B, T, G, D]) to one head per q head by
+    repeating each K/V head across its CONSECUTIVE group — the same
+    row-sharing layout as the flash kernel's GQA index maps (q head n
+    reads K/V head n // (H/G)).  The one place the expansion layout is
+    defined; dense reference paths call this instead of repeating
+    inline."""
+    group = n_q_heads // k.shape[2]
+    if group == 1:
+        return k, v
+    return (jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2))
 
 
 def _dense_attention(q, k, v, causal: bool = False):

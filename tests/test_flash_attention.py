@@ -648,9 +648,10 @@ def test_flash_gqa_matches_expanded(causal, kernel, opts):
 def test_flash_gqa_grads_match_expansion():
     # the GQA backward expands K/V and group-sums dK/dV; that must
     # equal autodiff through an explicit repeat (whose transpose IS the
-    # group sum)
+    # group sum).  B=2 exercises the batch-interleaved packed fold
+    # (a wrong reshape order in the group-sum passes at B=1)
     from accl_tpu.ops.flash import flash_attention_lse
-    B, T, H, G, D = 1, 128, 4, 2, 32
+    B, T, H, G, D = 2, 128, 4, 2, 32
     rng = np.random.default_rng(35)
     q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, T, G, D)), jnp.float32)

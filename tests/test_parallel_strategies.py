@@ -492,3 +492,90 @@ def test_ulysses_flash_attn_trains():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4,
                                    err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("impl", ["dense", "flash"])
+@pytest.mark.parametrize("schedule", ["contiguous", "zigzag"])
+def test_ring_attention_gqa_matches_expanded(schedule, impl):
+    # grouped-query K/V through the ring: the flash hops consume the
+    # grouped layout in place (the ring rotates H/G-times-smaller
+    # shards); the dense rung expands internally.  Either way the
+    # result must match the same ring fed explicitly expanded K/V.
+    SP, B, T, H, G, D = 4, 1, 64, 4, 2, 16
+    mesh = make_mesh(sp=SP)
+    q = _rand((B, T, H, D), 11)
+    k, v = (_rand((B, T, G, D), s) for s in (12, 13))
+    rep = lambda x: np.repeat(x, H // G, axis=2)
+
+    def shard_seq(x):
+        return np.stack(np.split(x, SP, axis=1))
+
+    def make(expanded):
+        def body(qb, kb, vb):
+            return ring_attention(
+                qb[0], kb[0], vb[0], axis="sp", causal=True,
+                impl=impl, schedule=schedule)[None]
+        kk, vv = (rep(k), rep(v)) if expanded else (k, v)
+        f = shard_map(body, mesh=mesh,
+                      in_specs=(P("sp", None, None, None, None),) * 3,
+                      out_specs=P("sp", None, None, None, None),
+                      check_vma=impl != "flash")
+        return np.asarray(jax.jit(f)(
+            *(jnp.asarray(shard_seq(x)) for x in (q, kk, vv))))
+
+    np.testing.assert_allclose(make(False), make(True),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_gqa_expands_for_custom_attn_fn():
+    # a caller-supplied attn_fn is assumed NOT GQA-aware: the grouped
+    # head subset must arrive expanded (correctness beats the saving)
+    SP, B, T, H, G, D = 4, 1, 32, 8, 4, 16
+    mesh = make_mesh(sp=SP)
+    q = _rand((B, T, H, D), 41)
+    k, v = (_rand((B, T, G, D), s) for s in (42, 43))
+    seen = []
+
+    def probe_fn(qx, kx, vx):
+        seen.append((qx.shape, kx.shape))
+        return _dense_attention(qx, kx, vx, causal=True)
+
+    def body(qb, kb, vb):
+        return ulysses_attention(qb[0], kb[0], vb[0], axis="sp",
+                                 causal=True, attn_fn=probe_fn)[None]
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P("sp", None, None, None, None),) * 3,
+                  out_specs=P("sp", None, None, None, None))
+    jax.jit(f)(*(jnp.asarray(np.stack(np.split(x, SP, axis=1)))
+                 for x in (q, k, v)))
+    qshape, kshape = seen[0]
+    assert kshape[2] == qshape[2], (qshape, kshape)
+
+
+def test_ulysses_gqa_matches_expanded():
+    # Ulysses GQA: K/V reshard their own (smaller) head axis over the
+    # ranks; the grouped full-sequence attention on each head subset
+    # must match resharding explicitly expanded K/V
+    SP, B, T, H, G, D = 4, 1, 64, 8, 4, 16
+    mesh = make_mesh(sp=SP)
+    q = _rand((B, T, H, D), 21)
+    k, v = (_rand((B, T, G, D), s) for s in (22, 23))
+    rep = lambda x: np.repeat(x, H // G, axis=2)
+
+    def shard_seq(x):
+        return np.stack(np.split(x, SP, axis=1))
+
+    def make(expanded):
+        def body(qb, kb, vb):
+            return ulysses_attention(qb[0], kb[0], vb[0], axis="sp",
+                                     causal=True)[None]
+        kk, vv = (rep(k), rep(v)) if expanded else (k, v)
+        f = shard_map(body, mesh=mesh,
+                      in_specs=(P("sp", None, None, None, None),) * 3,
+                      out_specs=P("sp", None, None, None, None))
+        return np.asarray(jax.jit(f)(
+            *(jnp.asarray(shard_seq(x)) for x in (q, kk, vv))))
+
+    np.testing.assert_allclose(make(False), make(True),
+                               rtol=1e-5, atol=1e-5)
