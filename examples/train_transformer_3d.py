@@ -44,8 +44,13 @@ STEPS = int(os.environ.get("ACCL_EXAMPLE_STEPS", "5"))
 
 def main():
     mesh = make_mesh(dp=2, tp=2, sp=2)
+    # n_kv_heads=2: grouped-query attention (the Llama-family layout).
+    # On TPU the flash ring reads the grouped layout without expansion
+    # and rotates half-size K/V shards; this CPU demo's dense ring
+    # expands per q head first (the reference-path contract)
     cfg = ModelConfig(vocab=256, d_model=64, n_layers=2, n_heads=4,
-                      d_head=16, d_ff=128, sp_schedule="zigzag")
+                      n_kv_heads=2, d_head=16, d_ff=128,
+                      sp_schedule="zigzag")
     params = init_params(np.random.default_rng(0), cfg)
 
     step, (param_specs, tok_spec) = make_train_step(mesh, cfg, lr=1e-2)

@@ -234,27 +234,28 @@ def test_bf16_flash_remat_training_smoke():
     assert losses[-1] < losses[0], losses
 
 
-def test_gqa_flash_matches_dense():
+@pytest.mark.parametrize("kvh", [2, 1])  # grouped (GQA) and MQA
+def test_gqa_flash_matches_dense(kvh):
     # grouped-query attention config: the flash path reads the grouped
     # K/V in place (ops/flash.py GQA index maps) while the dense path
     # expands per q head — same math, so logits must agree to f32
     # kernel tolerance, and training must move
     import dataclasses
 
-    cfg_d = dataclasses.replace(CFG, n_kv_heads=2, attn="dense")
-    cfg_f = dataclasses.replace(CFG, n_kv_heads=2, attn="flash")
+    cfg_d = dataclasses.replace(CFG, n_kv_heads=kvh, attn="dense")
+    cfg_f = dataclasses.replace(CFG, n_kv_heads=kvh, attn="flash")
     params = init_params(np.random.default_rng(3), cfg_d)
     tok = jnp.asarray(_tokens(2, 64, seed=5))
     out_d = forward(params, tok, cfg_d)
     out_f = forward(params, tok, cfg_f)
-    assert params["blocks"][0]["wk"].shape == (CFG.d_model, 2,
+    assert params["blocks"][0]["wk"].shape == (CFG.d_model, kvh,
                                               CFG.d_head)
     np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f),
                                rtol=2e-4, atol=2e-5)
     # gradients flow through the grouped projections
     g = jax.grad(lambda p: loss_fn(p, tok, cfg_f)[0] )(params)
     gk = np.asarray(g["blocks"][0]["wk"])
-    assert gk.shape == (CFG.d_model, 2, CFG.d_head)
+    assert gk.shape == (CFG.d_model, kvh, CFG.d_head)
     assert np.isfinite(gk).all() and np.abs(gk).max() > 0
 
 
