@@ -579,3 +579,30 @@ def test_ulysses_gqa_matches_expanded():
 
     np.testing.assert_allclose(make(False), make(True),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_ring_gqa_permutes_grouped_shards():
+    # the bandwidth claim, certified at the COMPILED level: with
+    # grouped K/V the flash ring's collective-permutes carry the
+    # G-head shards (half the bytes at G = H/2), not expanded ones
+    import re
+
+    SP, B, T, H, G, D = 4, 1, 64, 4, 2, 16
+    mesh = make_mesh(sp=SP)
+
+    def compiled_permute_shapes(g):
+        def body(qb, kb, vb):
+            return ring_attention(qb[0], kb[0], vb[0], axis="sp",
+                                  causal=True, impl="flash")[None]
+        f = shard_map(body, mesh=mesh,
+                      in_specs=(P("sp", None, None, None, None),) * 3,
+                      out_specs=P("sp", None, None, None, None),
+                      check_vma=False)
+        q = jnp.zeros((SP, B, T // SP, H, D), jnp.float32)
+        kv = jnp.zeros((SP, B, T // SP, g, D), jnp.float32)
+        hlo = jax.jit(f).lower(q, kv, kv).compile().as_text()
+        return set(re.findall(
+            r"(f32\[[^\]]+\])[^\n]*collective-permute", hlo))
+
+    assert compiled_permute_shapes(H) == {f"f32[1,16,{H},16]"}
+    assert compiled_permute_shapes(G) == {f"f32[1,16,{G},16]"}
