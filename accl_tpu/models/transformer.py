@@ -48,6 +48,14 @@ class ModelConfig:
     #: load balance — feed tokens permuted by
     #: parallel.ring_attention.zigzag_indices)
     sp_schedule: str = "contiguous"
+    #: rotary position embeddings (RoPE, the Llama-family positional
+    #: scheme): rotate q/k per GLOBAL token position before attention.
+    #: Off by default (the parity baselines predate it); under
+    #: sequence parallelism each shard rotates by its own global
+    #: positions — including the zigzag layout's split chunks — so
+    #: distributed and single-device runs agree exactly.
+    rope: bool = False
+    rope_theta: float = 10000.0
     #: rematerialize each transformer block on the backward pass
     #: (jax.checkpoint): only the block-input residuals stay live; the
     #: per-layer intermediates (d_ff activations, attention
@@ -66,6 +74,10 @@ class ModelConfig:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must divide "
                 f"n_heads={self.n_heads}")
+        if self.rope and self.d_head % 2 != 0:
+            raise ValueError(
+                f"rope rotates feature PAIRS; d_head={self.d_head} "
+                f"must be even")
 
     @property
     def kv_heads(self) -> int:
@@ -127,6 +139,38 @@ def _rmsnorm(x, scale):
     return (x * lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
+def _rope(x, positions, theta: float):
+    """Rotary position embedding on [B, T, h, Dh] (h = that tensor's
+    heads; Dh must be even).  Rotates feature pairs (i, i + Dh/2) by
+    position-dependent angles — the Llama convention — in f32, cast
+    back to the input dtype."""
+    B, T, h, Dh = x.shape
+    half = Dh // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]       # [1, T, 1, half]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _global_positions(Tl: int, cfg: ModelConfig, sp_axis: Optional[str]):
+    """Global token positions of this member's local sequence shard:
+    arange outside SP; shard-offset arange for contiguous shards; the
+    split (chunk idx, mirror chunk 2P-1-idx) positions for zigzag."""
+    if sp_axis is None:
+        return jnp.arange(Tl)
+    idx = lax.axis_index(sp_axis)
+    if cfg.sp_schedule == "zigzag":
+        P_ = lax.axis_size(sp_axis)
+        C = Tl // 2
+        a = jnp.arange(C)
+        return jnp.concatenate([idx * C + a, (2 * P_ - 1 - idx) * C + a])
+    return idx * Tl + jnp.arange(Tl)
+
+
 def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             sp_axis: Optional[str] = None):
     """Token ids [B, T_local] → logits [B, T_local, vocab].
@@ -142,12 +186,19 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         raise ValueError("sp_schedule='zigzag' requires an sp axis "
                          "(tokens are in zigzag order)")
     x = params["embed"][tokens].astype(cfg.jdtype)  # [B, Tl, D]
+    rope_pos = (_global_positions(tokens.shape[1], cfg, sp_axis)
+                if cfg.rope else None)
 
     def block(x, blk):
         h = _rmsnorm(x, blk["ln1"])
         q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
         k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
         v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+        if rope_pos is not None:
+            # rotate BEFORE any GQA expansion (k carries its own head
+            # count; the rotation broadcasts over heads)
+            q = _rope(q, rope_pos, cfg.rope_theta)
+            k = _rope(k, rope_pos, cfg.rope_theta)
         if (k.shape[2] != q.shape[2] and sp_axis is None
                 and cfg.attn != "flash"):
             # only the local dense path consumes one K/V head per q
