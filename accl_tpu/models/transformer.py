@@ -48,6 +48,11 @@ class ModelConfig:
     #: load balance — feed tokens permuted by
     #: parallel.ring_attention.zigzag_indices)
     sp_schedule: str = "contiguous"
+    #: MLP flavor: "gelu" (plain two-matrix) or "swiglu" (the
+    #: Llama-family gated unit: silu(x W1) * (x W3) W2 — a third
+    #: projection whose gate multiplies elementwise before the down
+    #: projection; same tp sharding, hidden dim sharded on both)
+    mlp: str = "gelu"
     #: rotary position embeddings (RoPE, the Llama-family positional
     #: scheme): rotate q/k per GLOBAL token position before attention.
     #: Off by default (the parity baselines predate it); under
@@ -74,6 +79,8 @@ class ModelConfig:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must divide "
                 f"n_heads={self.n_heads}")
+        if self.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown mlp flavor {self.mlp!r}")
         if self.rope and self.d_head % 2 != 0:
             raise ValueError(
                 f"rope rotates feature PAIRS; d_head={self.d_head} "
@@ -104,6 +111,7 @@ def init_params(rng: np.random.Generator, cfg: ModelConfig) -> dict:
             "wo": g(H, Dh, D),
             "ln2": np.ones(D, np.float32),
             "w1": g(D, F), "w2": g(F, D),
+            **({"w3": g(D, F)} if cfg.mlp == "swiglu" else {}),
         })
     params = {
         "embed": g(cfg.vocab, D, scale=0.02),
@@ -127,6 +135,8 @@ def param_specs(cfg: ModelConfig, tp: Optional[str] = "tp") -> dict:
         "ln2": P(None),
         "w1": P(None, t), "w2": P(t, None),
     }
+    if cfg.mlp == "swiglu":
+        block["w3"] = P(None, t)  # gate shards like w1
     return {
         "embed": P(None, None),
         "blocks": [dict(block) for _ in range(cfg.n_layers)],
@@ -234,7 +244,12 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         x = x + o
         h = _rmsnorm(x, blk["ln2"])
         m = jnp.einsum("btd,df->btf", h, blk["w1"].astype(cfg.jdtype))
-        m = jax.nn.gelu(m)
+        if cfg.mlp == "swiglu":
+            gate = jnp.einsum("btd,df->btf", h,
+                              blk["w3"].astype(cfg.jdtype))
+            m = jax.nn.silu(m) * gate
+        else:
+            m = jax.nn.gelu(m)
         m = jnp.einsum("btf,fd->btd", m, blk["w2"].astype(cfg.jdtype))
         if tp_axis is not None:
             m = lax.psum(m, tp_axis)

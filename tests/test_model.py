@@ -350,3 +350,55 @@ def test_rope_parallel_train_step_matches_single(axes, schedule):
                         jax.tree_util.tree_leaves(ref_params)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("axes", [dict(tp=2), dict(dp=2, tp=2, sp=2)])
+def test_swiglu_parallel_matches_single(axes):
+    # the gated MLP (silu(x W1) * (x W3) W2): the gate projection
+    # shards its hidden dim like w1, so the tp row-parallel psum
+    # contract holds — distributed step == single-device step
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    B, T = 4, 16
+    mesh = make_mesh(**axes)
+    cfg = dataclasses.replace(CFG, mlp="swiglu")
+    params = init_params(np.random.default_rng(17), cfg)
+    assert "w3" in params["blocks"][0]
+    tokens = _tokens(B, T, seed=18)
+
+    def single(p, tok, lr=1e-3):
+        (loss_sum, count), grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tok, cfg), has_aux=True)(p)
+        scale = lr / count
+        return (jax.tree_util.tree_map(lambda a, g: a - scale * g, p,
+                                       grads),
+                loss_sum / count)
+
+    ref_params, ref_loss = jax.jit(single)(params, jnp.asarray(tokens))
+    step, (specs, tok_spec) = make_train_step(mesh, cfg)
+    p_sharded = shard_params(params, mesh, cfg)
+    tok_dev = jax.device_put(jnp.asarray(tokens),
+                             NamedSharding(mesh, tok_spec))
+    new_params, loss = step(p_sharded, tok_dev)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5,
+                               atol=1e-6)
+    for got, exp in zip(jax.tree_util.tree_leaves(new_params),
+                        jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_swiglu_differs_from_gelu():
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, mlp="swiglu")
+    p = init_params(np.random.default_rng(19), cfg)
+    tok = jnp.asarray(_tokens(2, 16, seed=20))
+    out_s = forward(p, tok, cfg)
+    # same params minus the gate run the gelu MLP
+    p_g = {**p, "blocks": [{k: v for k, v in b.items() if k != "w3"}
+                           for b in p["blocks"]]}
+    out_g = forward(p_g, tok, CFG)
+    assert np.abs(np.asarray(out_s) - np.asarray(out_g)).max() > 1e-4
