@@ -48,6 +48,14 @@ class ModelConfig:
     #: load balance — feed tokens permuted by
     #: parallel.ring_attention.zigzag_indices)
     sp_schedule: str = "contiguous"
+    #: sliding-window attention (the Mistral-family long-context
+    #: tool): each position attends only its trailing `attn_window`
+    #: tokens.  flash bounds the grid schedules (forward AND both
+    #: backward kernels) to the visible blocks — out-of-window K/V is
+    #: never fetched (ops/flash.py); dense applies the band mask.
+    #: Not composable with sequence parallelism (the ring's hop
+    #: liveness does not model a window).
+    attn_window: int | None = None
     #: MLP flavor: "gelu" (plain two-matrix) or "swiglu" (the
     #: Llama-family gated unit: silu(x W1) * (x W3) W2 — a third
     #: projection whose gate multiplies elementwise before the down
@@ -79,6 +87,9 @@ class ModelConfig:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must divide "
                 f"n_heads={self.n_heads}")
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(f"attn_window={self.attn_window} must be "
+                             f">= 1")
         if self.mlp not in ("gelu", "swiglu"):
             raise ValueError(f"unknown mlp flavor {self.mlp!r}")
         if self.rope and self.d_head % 2 != 0:
@@ -219,6 +230,11 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             from ..parallel.ring_attention import expand_gqa_kv
             k, v = expand_gqa_kv(k, v, q.shape[2])
         if sp_axis is not None:
+            if cfg.attn_window is not None:
+                raise ValueError(
+                    "attn_window does not compose with sequence "
+                    "parallelism (the ring's hop liveness does not "
+                    "model a window)")
             if cfg.attn == "flash":
                 raise ValueError(
                     "attn='flash' is the single-shard attention kernel; "
@@ -235,9 +251,11 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
                       else jnp.float32)
             attn = flash_attention(q, k, v, causal=True,
                                    mxu_dtype=mxu_dt,
+                                   window=cfg.attn_window,
                                    interpret=jax.default_backend() != "tpu")
         else:
-            attn = _dense_attention(q, k, v, causal=True)
+            attn = _dense_attention(q, k, v, causal=True,
+                                    window=cfg.attn_window)
         o = jnp.einsum("bthk,hkd->btd", attn, blk["wo"].astype(cfg.jdtype))
         if tp_axis is not None:
             o = lax.psum(o, tp_axis)  # row-parallel combine

@@ -46,8 +46,10 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
     (exp2(log2e*(x - m_nat)) == exp(x - m_nat)), so acc/l match exactly.
 
     kb/vb: [bk, D] (mxu dtype); acc/m/l are f32 running state.  `mask`
-    is None or (row0, col0) block offsets for the causal row >= col
-    test.  Returns (acc', m', l').
+    is None or (row0, col0, window) block offsets for the causal
+    row >= col test, with `window` further restricting each row to its
+    trailing `window` columns (None = unwindowed).
+    Returns (acc', m', l').
 
     FUSED-DENOMINATOR mode (`l_prev is None`): vb carries an appended
     ones column and acc the matching accumulator column, so the row-sum
@@ -69,12 +71,16 @@ def _fold_consume(s, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
     block_q, block_k = s.shape
     masked = mask is not None
     if masked:
-        row0, col0 = mask
+        row0, col0, window = mask
         rows = row0 + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = col0 + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
+        keep = rows >= cols
+        if window is not None:
+            # sliding window: row r attends cols (r-window, r]
+            keep = keep & (rows - cols < window)
+        s = jnp.where(keep, s, NEG_INF)
     m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_blk)
     # fully-masked block rows keep m at NEG_INF; exp2(s - NEG_INF) would
@@ -156,8 +162,10 @@ def _run_block_loops(body, carry, causal, iq, block_q, block_k,
 
 def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                        *, scale: float, causal: bool, block_q: int,
-                       block_k: int, chunk_k: int, nk: int, mxu_dtype,
-                       kv_resident: bool = False, q_tiles: int = 1):
+                       block_k: int, chunk_k: int, nk: int,
+                       nk_total: int | None = None, mxu_dtype,
+                       kv_resident: bool = False, q_tiles: int = 1,
+                       window=None):
     """Streaming schedule: grid (bh, q_block, k_block); K/V blocks
     arrive per grid cell; the accumulator lives in VMEM scratch across
     the sequential k steps of one (bh, q_block) cell.  Each arriving
@@ -170,10 +178,17 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    ik = pl.program_id(2)
+    j = pl.program_id(2)
+    # with a sliding window the k grid dimension is BOUNDED: it spans
+    # only the blocks a q block can see (O(window) of them), and the
+    # K/V index maps fetch from the same shifted base — out-of-window
+    # blocks are never DMA'd, not merely predicated off.  ik is the
+    # REAL k-block index the liveness/mask math needs.
+    ik = j + (_window_first_block(iq, block_q, block_k, window)
+              if window is not None else 0)
     tq = block_q // q_tiles
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
         m_s[:] = jnp.full_like(m_s, NEG_INF)
@@ -182,10 +197,18 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
     # a causal k-block strictly in this q-block's future contributes
     # nothing — skip its whole body (roughly halves the MXU work).
     # Blocks strictly in the past need no mask at all; only the blocks
-    # straddling the diagonal pay the iota/where lane work.
-    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
-    diag = ((ik * block_k + block_k - 1 > iq * block_q) & live) \
-        if causal else False
+    # straddling the diagonal (or the window edge) pay the iota/where
+    # lane work.  ONE liveness helper is shared with both backward
+    # kernels so the schedules cannot desynchronize.
+    live, diag = _grid_live_masked(iq, ik, block_q, block_k, causal,
+                                   window)
+    if window is not None and nk_total is not None:
+        # phantom tail cells of the bounded span (clamped fetches past
+        # the real k range) stay dead regardless of the mask algebra —
+        # here causality already kills them, but the guard keeps the
+        # invariant explicit and future-proof
+        live = live & (ik < nk_total)
+        diag = diag & live
 
     q = (q_ref[0] * scale).astype(mxu_dtype)  # pre-scale once per block
     qs = [q[t * tq:(t + 1) * tq] for t in range(q_tiles)]
@@ -203,7 +226,7 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
             vb = v_ref[0, pl.ds(base, chunk_k), :].astype(mxu_dtype)
             carries = [
                 _softmax_fold(qs[t], kb, vb, *carries[t],
-                              mask=((iq * block_q + t * tq, off)
+                              mask=((iq * block_q + t * tq, off, window)
                                     if masked else None),
                               mxu_dtype=mxu_dtype)
                 for t in range(q_tiles)]
@@ -223,7 +246,7 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
     else:
         body(masked=False)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(j == nk - 1)
     def _fin():
         _finalize(acc[:], m_s[:], l_s[:], o_ref, lse_ref)
 
@@ -313,7 +336,8 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
             nxt = []
             for t in range(q_tiles):
                 acc, m_prev, l_prev = carries[t]
-                mask = ((iq * block_q + t * tq, off) if masked else None)
+                mask = ((iq * block_q + t * tq, off, None)
+                        if masked else None)
                 nxt.append(_softmax_fold(qs[t], kb, vb, acc, m_prev,
                                          l_prev, mask=mask,
                                          mxu_dtype=mxu_dtype))
@@ -375,7 +399,7 @@ def _flash_kernel_resident_skew(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         # lookahead FIRST in program order — independent of the consume
         s_nxt = score(j + 1)
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
-        mask = (iq * block_q, j * block_k) if masked else None
+        mask = (iq * block_q, j * block_k, None) if masked else None
         acc, m, l = _fold_consume(s_cur, vb, acc, m, l, mask=mask,
                                   mxu_dtype=mxu_dtype)
         return acc, m, l, s_nxt
@@ -439,7 +463,8 @@ def _snap_chunk(req: int, blk: int) -> int:
 
 def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
                       interpret, mxu_dtype, kernel, chunk_k,
-                      kv_cast_scratch, q_tiles, fuse_denom):
+                      kv_cast_scratch, q_tiles, fuse_denom,
+                      window=None):
     """Static schedule resolution shared by the head-packed and BTHD
     entries: block shrinking, chunk snapping, kernel/auto selection and
     the tuned-auto q_tiles/fuse_denom choices.  Returns the cfg tuple
@@ -544,14 +569,32 @@ def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
                            or (bq // q_tiles) % 8 != 0):
         q_tiles -= 1
 
+    if window is not None:
+        # sliding-window attention: the streaming (grid) schedules own
+        # the block liveness logic; the resident family's fori bounds
+        # do not model a window
+        if not causal:
+            raise ValueError("window requires causal=True (a sliding "
+                             "window is a trailing-context mask)")
+        if window < 1:
+            raise ValueError(f"window={window} must be >= 1")
+        if kernel == "resident" and auto_kernel:
+            kernel = "grid"   # auto landed on resident: move to grid
+        if kernel not in ("grid", "grid_resident"):
+            # same explicit-option contract as fuse_denom/resident_skew
+            # above: silently running a different schedule than the one
+            # named would record fake sweep results
+            raise ValueError("window is a grid-schedule option "
+                             f"(kernel={kernel!r})")
+        fuse_denom = False    # resident-only option can't apply
     return (causal, bq, bk, ck, interpret, mxu_dtype, kernel,
-            needs_cast, q_tiles, fuse_denom)
+            needs_cast, q_tiles, fuse_denom, window)
 
 
 def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                        mxu_dtype, kernel, chunk_k=None,
                        kv_cast_scratch=False, q_tiles=None,
-                       fuse_denom=None):
+                       fuse_denom=None, window=None):
     """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
     flattened — the splash-attention layout).  This is the zero-copy
     path: no transposes touch HBM; callers that keep activations packed
@@ -581,7 +624,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     cfg = _resolve_schedule(T, Tk, D, qp.dtype, causal, block_q,
                             block_k, interpret, mxu_dtype, kernel,
                             chunk_k, kv_cast_scratch, q_tiles,
-                            fuse_denom) + (kv_group,)
+                            fuse_denom, window) + (kv_group,)
     return _flash_packed_diff(qp, kp, vp, cfg)
 
 
@@ -592,7 +635,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, kernel, needs_cast,
-     q_tiles, fuse_denom, kv_group) = cfg
+     q_tiles, fuse_denom, window, kv_group) = cfg
     g = kv_group  # q-heads per K/V head (1 = plain MHA)
     N, T, D = qp.shape
     Tk = kp.shape[1]
@@ -653,7 +696,21 @@ def _flash_forward_impl(qp, kp, vp, cfg):
             interpret=interpret,
         )(qp, kp, vp)
     else:
-        grid = (N, nq, nk)
+        # with a sliding window the k grid dimension is BOUNDED to the
+        # O(window/bk) blocks a q block can actually see; the K/V index
+        # maps fetch from the same shifted base (clamped at the last
+        # block — a clamped fetch belongs to a dead cell), so
+        # out-of-window K/V blocks are never DMA'd
+        if window is not None:
+            nk_eff = min(nk, (window - 1 + bq + bk - 1) // bk + 1)
+
+            def _kv_block(i, j):
+                first = _window_first_block(i, bq, bk, window)
+                return jnp.minimum(first + j, nk - 1)
+        else:
+            nk_eff = nk
+            _kv_block = lambda i, j: j
+        grid = (N, nq, nk_eff)
         kv_resident = kernel == "grid_resident"
         if kv_resident:
             # whole-row K/V block with a PINNED index map: Pallas only
@@ -664,15 +721,17 @@ def _flash_forward_impl(qp, kp, vp, cfg):
                                    lambda b, i, j: (b // g, 0, 0),
                                    memory_space=pltpu.VMEM)
         else:
-            kv_spec = pl.BlockSpec((1, bk, D),
-                                   lambda b, i, j: (b // g, j, 0),
-                                   memory_space=pltpu.VMEM)
+            kv_spec = pl.BlockSpec(
+                (1, bk, D),
+                lambda b, i, j: (b // g, _kv_block(i, j), 0),
+                memory_space=pltpu.VMEM)
         lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)
         kfn = functools.partial(
             _flash_kernel_grid, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, chunk_k=ck, nk=nk, mxu_dtype=mxu_dtype,
-            kv_resident=kv_resident, q_tiles=q_tiles)
+            block_k=bk, chunk_k=ck, nk=nk_eff, nk_total=nk,
+            mxu_dtype=mxu_dtype,
+            kv_resident=kv_resident, q_tiles=q_tiles, window=window)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec3, kv_spec, kv_spec],
@@ -710,11 +769,12 @@ def _flash_forward_impl(qp, kp, vp, cfg):
 # over q blocks per k block.  Causal cells are predicated off exactly
 # like the forward grid schedule.
 
-def _flash_bwd_p_block(q2, kb, l2, row0, col0, masked):
+def _flash_bwd_p_block(q2, kb, l2, row0, col0, masked, window=None):
     """Rebuild the normalized probability block [rows(q2), rows(kb)]
     from prescaled q2 (a*log2e folded in) and the log2-domain lse; dead
     rows (lse = NEG_INF, fully-masked forward) produce zeros.  `masked`
-    applies the causal row >= col test against the (row0, col0) global
+    applies the causal row >= col test (AND the sliding-window
+    row - col < window test when set) against the (row0, col0) global
     offsets — callers predicate it to the straddling cells only (past
     cells need no mask; same lane-work split as the forward grid
     kernel)."""
@@ -725,24 +785,41 @@ def _flash_bwd_p_block(q2, kb, l2, row0, col0, masked):
     if masked:
         rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (rq, rk), 0)
         cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (rq, rk), 1)
-        p = jnp.where(rows >= cols, p, 0.0)
+        keep = rows >= cols
+        if window is not None:
+            keep = keep & (rows - cols < window)
+        p = jnp.where(keep, p, 0.0)
     return p
 
 
-def _bwd_live_diag(iq, ik, bq, bk, causal):
-    """(live, diag) causal cell predicates — identical split to the
-    forward grid kernel: skip future cells entirely, mask only cells
-    straddling the diagonal."""
+def _window_first_block(iq, block_q, block_k, window):
+    """Index of the first k block any row of q-block `iq` can see under
+    the sliding window — the k-grid base the bounded schedule and its
+    K/V index maps share."""
+    lo = iq * block_q - (window - 1)     # earliest visible column
+    return jnp.maximum(lo, 0) // block_k
+
+
+def _grid_live_masked(iq, ik, bq, bk, causal, window=None):
+    """(live, masked) cell predicates shared by the forward grid kernel
+    and BOTH backward kernels (one copy, so forward and backward can
+    never disagree): skip future cells (and, under a sliding window,
+    cells strictly before every row's window) entirely; mask only the
+    cells straddling the diagonal or the window edge."""
     if not causal:
         return True, False
     live = ik * bk <= iq * bq + bq - 1
     diag = (ik * bk + bk - 1 > iq * bq) & live
+    if window is not None:
+        live = live & (ik * bk + bk - 1 > iq * bq - window)
+        wedge = ik * bk < iq * bq + bq - window
+        diag = (diag | wedge) & live
     return live, diag
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
-                         dq_ref, acc, *, causal, bq, bk, nk, mxu_dtype,
-                         inv_scale_a, chunk_k):
+                         dq_ref, acc, *, causal, bq, bk, nk, nk_total,
+                         mxu_dtype, inv_scale_a, chunk_k, window=None):
     """dQ cell: accumulate ds @ K over the k blocks of one q block.
     Each cell runs as an UNROLLED run of chunk_k sub-chunks — the same
     MXU/VPU pipelining lever as the forward fold: chunk c's exp2/ds VPU
@@ -751,13 +828,24 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    ik = pl.program_id(2)
+    j = pl.program_id(2)
+    # under a sliding window the k dimension is bounded exactly like
+    # the forward grid: j counts the O(window) visible blocks from the
+    # shifted base, and ik is the REAL k-block index
+    ik = j + (_window_first_block(iq, bq, bk, window)
+              if window is not None else 0)
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    live, diag = _bwd_live_diag(iq, ik, bq, bk, causal)
+    live, diag = _grid_live_masked(iq, ik, bq, bk, causal, window)
+    if window is not None:
+        # phantom cells past the REAL k range (the bounded span's tail
+        # with a clamped fetch) must stay dead regardless of the
+        # causal/window algebra
+        live = live & (ik < nk_total)
+        diag = diag & live
 
     def body(masked):
         q2 = q_ref[0].astype(mxu_dtype)      # pre-scaled on the host
@@ -769,7 +857,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
             kb = k_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
             vb = v_ref[0, pl.ds(c * chunk_k, chunk_k), :].astype(mxu_dtype)
             p = _flash_bwd_p_block(q2, kb, l2, iq * bq,
-                                   ik * bk + c * chunk_k, masked)
+                                   ik * bk + c * chunk_k, masked,
+                                   window)
             dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             ds = p * (dp - dvec)
@@ -789,14 +878,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
     else:
         body(masked=False)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(j == nk - 1)
     def _fin():
         dq_ref[0] = (acc[:] * inv_scale_a).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal, bq,
-                          bk, nq, mxu_dtype, chunk_q):
+                          bk, nq, nq_total, mxu_dtype, chunk_q,
+                          window=None):
     """dK/dV cell: accumulate over the q blocks of one k block.  The
     q block is processed as an UNROLLED run of chunk_q sub-chunks (the
     roles of q and k swap relative to the dq kernel, so here the chunk
@@ -805,14 +895,25 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(1)
-    iq = pl.program_id(2)
+    j = pl.program_id(2)
+    # bounded q iteration under a window: the q blocks that can see
+    # k-block ik start at the causal lower bound (ik*bk)//bq and end
+    # O(window) blocks later; j counts from that base
+    iq = j + ((ik * bk) // bq if window is not None else 0)
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live, diag = _bwd_live_diag(iq, ik, bq, bk, causal)
+    live, diag = _grid_live_masked(iq, ik, bq, bk, causal, window)
+    if window is not None:
+        # CRITICAL: phantom cells past the REAL q range are causally
+        # LIVE (future q rows attend past k columns), and their clamped
+        # q fetches would accumulate garbage under wrong mask offsets —
+        # bound liveness by the real grid
+        live = live & (iq < nq_total)
+        diag = diag & live
 
     def body(masked):
         kb = k_ref[0].astype(mxu_dtype)
@@ -824,7 +925,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
             do = do_ref[0, sl, :].astype(mxu_dtype)
             p = _flash_bwd_p_block(q2, kb, l2_ref[0, sl, :],
                                    iq * bq + c * chunk_q, ik * bk,
-                                   masked)
+                                   masked, window)
             pc = p.astype(mxu_dtype)
             dv_tot = dv_tot + jax.lax.dot_general(
                 pc, do, (((0,), (0,)), ((), ())),
@@ -849,7 +950,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
     else:
         body(masked=False)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(j == nq - 1)
     def _fin():
         # q2 carries the a*log2e prescale, so dK needs it divided back
         # out on top of its own `a` factor: a / (a*log2e) = 1/log2e
@@ -862,7 +963,7 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, _kernel, _nc, _qt,
-     _fd, _kvg) = cfg
+     _fd, window, _kvg) = cfg
     N, T, D = qp.shape
     Tk = kp.shape[1]
     nq, nk = T // bq, Tk // bk
@@ -884,19 +985,41 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     if g_lse is not None:
         dvec = dvec - g_lse.astype(jnp.float32)[..., None]
 
+    # under a sliding window both backward grids are BOUNDED like the
+    # forward: the k dimension of dq spans only the O(window) visible
+    # blocks from each q block's shifted base, and the q dimension of
+    # dkv spans only the O(window) q blocks that can see each k block
+    # (clamped fetches belong to dead, predicated-off cells)
+    if window is not None:
+        nk_eff = min(nk, (window - 1 + bq + bk - 1) // bk + 1)
+        nq_eff = min(nq, (bk + window - 2) // bq + 2)
+
+        def _kblk(i, j):
+            return jnp.minimum(
+                _window_first_block(i, bq, bk, window) + j, nk - 1)
+
+        def _qblk(jk, j2):
+            return jnp.minimum((jk * bk) // bq + j2, nq - 1)
+    else:
+        nk_eff, nq_eff = nk, nq
+        _kblk = lambda i, j: j
+        _qblk = lambda jk, j2: j2
+
     qb_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)
-    kb_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+    kb_spec = pl.BlockSpec((1, bk, D),
+                           lambda b, i, j: (b, _kblk(i, j), 0),
                            memory_space=pltpu.VMEM)
     ql_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, bq=bq,
-                          bk=bk, nk=nk, mxu_dtype=mxu_dtype,
-                          inv_scale_a=a, chunk_k=ckb),
+                          bk=bk, nk=nk_eff, nk_total=nk,
+                          mxu_dtype=mxu_dtype,
+                          inv_scale_a=a, chunk_k=ckb, window=window),
         out_shape=_sds((N, T, D), qp.dtype, vma),
-        grid=(N, nq, nk),
+        grid=(N, nq, nk_eff),
         in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, ql_spec, ql_spec],
         out_specs=qb_spec,
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
@@ -907,19 +1030,22 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
 
     # dK/dV: swap the roles — k blocks on the parallel axis, q blocks
     # accumulated sequentially
-    qs_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0),
+    qs_spec = pl.BlockSpec((1, bq, D),
+                           lambda b, jk, i: (b, _qblk(jk, i), 0),
                            memory_space=pltpu.VMEM)
-    ks_spec = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0),
+    ks_spec = pl.BlockSpec((1, bk, D), lambda b, jk, i: (b, jk, 0),
                            memory_space=pltpu.VMEM)
-    ls_spec = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+    ls_spec = pl.BlockSpec((1, bq, 1),
+                           lambda b, jk, i: (b, _qblk(jk, i), 0),
                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal, bq=bq,
-                          bk=bk, nq=nq, mxu_dtype=mxu_dtype,
-                          chunk_q=ckq),
+                          bk=bk, nq=nq_eff, nq_total=nq,
+                          mxu_dtype=mxu_dtype,
+                          chunk_q=ckq, window=window),
         out_shape=(_sds((N, Tk, D), kp.dtype, vma),
                    _sds((N, Tk, D), vp.dtype, vma)),
-        grid=(N, nk, nq),
+        grid=(N, nk, nq_eff),
         in_specs=[qs_spec, ks_spec, ks_spec, qs_spec, ls_spec, ls_spec],
         out_specs=(ks_spec, ks_spec),
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
@@ -980,7 +1106,7 @@ _flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
-                kernel, q_tiles=None, fuse_denom=None):
+                kernel, q_tiles=None, fuse_denom=None, window=None):
     """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the
     core call (one HBM transpose per operand direction; XLA hoists the
     K/V packs out of iteration loops — callers on the hot path should
@@ -1010,7 +1136,7 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
     out, lse = _flash_call_packed(pack(q), pack(k), pack(v), causal,
                                   block_q, block_k, interpret, mxu_dtype,
                                   kernel, q_tiles=q_tiles,
-                                  fuse_denom=fuse_denom)
+                                  fuse_denom=fuse_denom, window=window)
     return (out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
             lse.reshape(B, H, T))
 
@@ -1018,12 +1144,13 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "q_tiles", "fuse_denom"))
+                                    "q_tiles", "fuse_denom", "window"))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
                     mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                     q_tiles: int | None = None,
-                    fuse_denom: bool | None = None):
+                    fuse_denom: bool | None = None,
+                    window: int | None = None):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
     causal mask).  T must be divisible by the (auto-shrunk) block sizes.
 
@@ -1040,32 +1167,34 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     chain; fused denominator where its ones column is lane-tile-free,
     e.g. D=64)."""
     out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                            mxu_dtype, kernel, q_tiles, fuse_denom)
+                            mxu_dtype, kernel, q_tiles, fuse_denom,
+                            window)
     return out
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "q_tiles", "fuse_denom"))
+                                    "q_tiles", "fuse_denom", "window"))
 def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
                         block_k: int = 512, interpret: bool = False,
                         mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                         q_tiles: int | None = None,
-                        fuse_denom: bool | None = None):
+                        fuse_denom: bool | None = None,
+                        window: int | None = None):
     """Like :func:`flash_attention` but also returns the log-sum-exp
     statistics: (out [B, T, H, D], lse [B, H, T] fp32).  Partial results
     over different K/V shards combine exactly via lse weighting — the
     cross-shard fold ring attention applies around the ICI ring."""
     return _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                       mxu_dtype, kernel, q_tiles, fuse_denom)
+                       mxu_dtype, kernel, q_tiles, fuse_denom, window)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
                                     "chunk_k", "kv_cast_scratch",
-                                    "q_tiles", "fuse_denom"))
+                                    "q_tiles", "fuse_denom", "window"))
 def flash_attention_packed(q, k, v, causal: bool = False,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False,
@@ -1073,7 +1202,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
                            chunk_k: int | None = None,
                            kv_cast_scratch: bool = False,
                            q_tiles: int | None = None,
-                           fuse_denom: bool | None = None):
+                           fuse_denom: bool | None = None,
+                           window: int | None = None):
     """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
     with N = batch x heads flattened (the splash-attention layout).
     Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
@@ -1095,7 +1225,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     See the kernel docstrings."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
                                    interpret, mxu_dtype, kernel, chunk_k,
-                                   kv_cast_scratch, q_tiles, fuse_denom)
+                                   kv_cast_scratch, q_tiles, fuse_denom,
+                                   window)
     return out
 
 
@@ -1103,7 +1234,7 @@ def flash_attention_packed(q, k, v, causal: bool = False,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
                                     "chunk_k", "kv_cast_scratch",
-                                    "q_tiles", "fuse_denom"))
+                                    "q_tiles", "fuse_denom", "window"))
 def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                block_q: int = 256, block_k: int = 512,
                                interpret: bool = False,
@@ -1111,10 +1242,12 @@ def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                chunk_k: int | None = None,
                                kv_cast_scratch: bool = False,
                                q_tiles: int | None = None,
-                               fuse_denom: bool | None = None):
+                               fuse_denom: bool | None = None,
+                               window: int | None = None):
     """Head-packed [N, T, D] variant returning (out [N, T, D],
     lse [N, T] fp32) — the distributed callers' entry (ring attention
     folds shard partials via the lse)."""
     return _flash_call_packed(q, k, v, causal, block_q, block_k,
                               interpret, mxu_dtype, kernel, chunk_k,
-                              kv_cast_scratch, q_tiles, fuse_denom)
+                              kv_cast_scratch, q_tiles, fuse_denom,
+                              window)

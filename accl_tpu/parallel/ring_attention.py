@@ -497,8 +497,11 @@ def expand_gqa_kv(k, v, n_q_heads: int):
     return (jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2))
 
 
-def _dense_attention(q, k, v, causal: bool = False):
-    """Reference dense attention [B, T, H, D] (fp32 accumulation)."""
+def _dense_attention(q, k, v, causal: bool = False,
+                     window: int | None = None):
+    """Reference dense attention [B, T, H, D] (fp32 accumulation).
+    `window` (causal only) restricts each row to its trailing `window`
+    columns — the banded reference the flash grid schedules match."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32),
@@ -507,7 +510,10 @@ def _dense_attention(q, k, v, causal: bool = False):
         T = q.shape[1]
         qpos = lax.broadcasted_iota(jnp.int32, (T, T), 0)
         kpos = lax.broadcasted_iota(jnp.int32, (T, T), 1)
-        s = jnp.where((qpos >= kpos)[None, None], s, NEG_INF)
+        keep = qpos >= kpos
+        if window is not None:
+            keep = keep & (qpos - kpos < window)
+        s = jnp.where(keep[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
                      preferred_element_type=jnp.float32)

@@ -678,3 +678,70 @@ def test_flash_gqa_rejects_nondividing_heads():
     kv = jnp.zeros((1, 64, 3, 32), jnp.float32)  # 3 does not divide 4
     with pytest.raises(ValueError, match="GQA"):
         flash_attention(q, kv, kv, interpret=True)
+
+
+def _dense_windowed(q, k, v, window):
+    # the shared banded reference (one implementation repo-wide)
+    from accl_tpu.parallel.ring_attention import _dense_attention
+    return _dense_attention(q, k, v, causal=True, window=window)
+
+
+@pytest.mark.parametrize("kernel", ["grid", "grid_resident"])
+@pytest.mark.parametrize("window", [1, 17, 64, 100, 1000])
+def test_flash_sliding_window_matches_banded_dense(window, kernel):
+    # sliding-window attention: blocks strictly before every row's
+    # window are skipped, window-edge straddlers are masked — result
+    # must equal the dense banded softmax for any window/block phase
+    from accl_tpu.ops.flash import flash_attention_lse
+    B, T, H, D = 1, 256, 2, 32
+    rng = np.random.default_rng(41)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, T, H, D)),
+                             jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    o, _ = flash_attention_lse(q, k, v, causal=True, window=window,
+                               block_q=64, block_k=64, interpret=True,
+                               mxu_dtype=jnp.float32, kernel=kernel)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(_dense_windowed(q, k, v, window)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_sliding_window_grads_match_banded_dense():
+    # the backward kernels carry the same window liveness/mask split
+    from accl_tpu.ops.flash import flash_attention_lse
+    B, T, H, D, window = 1, 256, 2, 32, 48
+    rng = np.random.default_rng(43)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, T, H, D)),
+                             jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def loss_flash(q, k, v):
+        o, _ = flash_attention_lse(q, k, v, causal=True, window=window,
+                                   block_q=64, block_k=64, interpret=True,
+                                   mxu_dtype=jnp.float32, kernel="grid")
+        return jnp.sum(o * o)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(
+        _dense_windowed(q, k, v, window) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_window_validation():
+    from accl_tpu.ops.flash import flash_attention
+    x = jnp.zeros((1, 128, 2, 32), jnp.float32)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(x, x, x, causal=False, window=16, interpret=True)
+    with pytest.raises(ValueError, match="grid-schedule"):
+        flash_attention(x, x, x, causal=True, window=16,
+                        kernel="resident_skew", q_tiles=1,
+                        fuse_denom=False, interpret=True)
+    # an EXPLICIT resident kernel with window raises too (the
+    # explicit-option contract); only kernel="auto" moves to grid
+    with pytest.raises(ValueError, match="grid-schedule"):
+        flash_attention(x, x, x, causal=True, window=16, interpret=True,
+                        kernel="resident")
+    o = flash_attention(x, x, x, causal=True, window=16, interpret=True)
+    assert o.shape == x.shape

@@ -402,3 +402,35 @@ def test_swiglu_differs_from_gelu():
                            for b in p["blocks"]]}
     out_g = forward(p_g, tok, CFG)
     assert np.abs(np.asarray(out_s) - np.asarray(out_g)).max() > 1e-4
+
+
+def test_sliding_window_flash_matches_dense():
+    # attn_window: the flash grid schedule (dead blocks skipped) must
+    # agree with the dense banded mask, and the window must change the
+    # result vs full causal attention
+    import dataclasses
+
+    cfg_d = dataclasses.replace(CFG, attn_window=8, attn="dense")
+    cfg_f = dataclasses.replace(CFG, attn_window=8, attn="flash")
+    params = init_params(np.random.default_rng(23), cfg_d)
+    tok = jnp.asarray(_tokens(2, 64, seed=24))
+    out_d = forward(params, tok, cfg_d)
+    out_f = forward(params, tok, cfg_f)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f),
+                               rtol=2e-4, atol=2e-5)
+    out_full = forward(params, tok, CFG)
+    assert np.abs(np.asarray(out_d) - np.asarray(out_full)).max() > 1e-4
+
+
+def test_sliding_window_rejects_sp():
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, attn_window=8)
+    mesh = make_mesh(sp=2)
+    step, (specs, tok_spec) = make_train_step(mesh, cfg)
+    p = shard_params(init_params(np.random.default_rng(1), cfg), mesh, cfg)
+    from jax.sharding import NamedSharding
+    tok = jax.device_put(jnp.asarray(_tokens(2, 16)),
+                         NamedSharding(mesh, tok_spec))
+    with pytest.raises(Exception, match="attn_window"):
+        step(p, tok)

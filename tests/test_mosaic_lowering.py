@@ -88,6 +88,20 @@ def test_flash_kernels_lower_through_mosaic(kern, opts):
     _assert_mosaic(exp.mlir_module())
 
 
+def test_flash_sliding_window_lowers_through_mosaic():
+    # windowed liveness/masks ride the grid schedule's predication —
+    # the banded long-context path must lower for the real target
+    from accl_tpu.ops.flash import flash_attention_packed
+
+    N, T, D = 4, 4096, 128
+    a = jax.ShapeDtypeStruct((N, T, D), jnp.bfloat16)
+    exp = jax.export.export(
+        jax.jit(lambda q, k, v: flash_attention_packed(
+            q, k, v, causal=True, window=1024, kernel="grid")),
+        platforms=["tpu"])(a, a, a)
+    _assert_mosaic(exp.mlir_module())
+
+
 @pytest.mark.parametrize("kern", ["resident", "grid"])
 def test_flash_gqa_lowers_through_mosaic(kern):
     # GQA: the grouped K/V index maps (b // group) must lower — a map
