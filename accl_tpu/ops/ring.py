@@ -70,23 +70,36 @@ def rs_signals_ack(step: int, P: int) -> bool:
 
 
 def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
-                           collective_id: int = 0):
+                           collective_id: int = 0,
+                           ring_size: int | None = None):
     """All-gather over a ring: per-member [n, ...] → [P, n, ...].
 
     Pattern: local slot write, then P-1 hops; each hop remote-copies the
     newest chunk to the right neighbor's double-buffered landing slot
     (the guide's canonical ring; fw eager allgather relay :1404-1502).
+
+    ``ring_size`` (only with a 1-member axis) runs the kernel as a
+    VIRTUAL V-rank self-ring on the single device: every hop is a real
+    remote DMA (device_id = self) with the real semaphore handshakes
+    and ACK-window flow control, so the compiled collective executes on
+    one chip — the reference's run-the-synthesized-artifact rung
+    (test/model/simulator/cclo_sim.cpp:57-559).  Since every virtual
+    rank is this device, the result is x tiled V times (checkable).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     P = lax.axis_size(axis)
-    if P == 1:
+    V = ring_size if ring_size is not None else P
+    if V != P and P != 1:
+        raise ValueError("ring_size override requires a 1-member axis "
+                         f"(self-ring mode); got P={P}, ring_size={V}")
+    if V == 1:
         return x[None]
 
     def kernel(x_ref, out_ref, comm_buf, send_sem, recv_sem, ack_sem,
                copy_sem):
-        my = lax.axis_index(axis)
+        my = lax.axis_index(axis) % V
         right = (my + 1) % P
 
         # neighbor handshake so nobody's landing slot is written before
@@ -107,7 +120,7 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
         local_out.wait()
         local_comm.wait()
 
-        for step in range(P - 1):
+        for step in range(V - 1):
             slot = step % 2
             nxt = (step + 1) % 2
             # flow control: the slot we are about to write on the right
@@ -115,7 +128,7 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
             # its consumption ACK so a fast ring segment can't overrun the
             # double buffer (the firmware's rx-buffer RAW hazard,
             # fw :1457-1460, solved with sequence windows there)
-            if ag_waits_ack(step, P):
+            if ag_waits_ack(step, V):
                 pltpu.semaphore_wait(ack_sem.at[nxt], 1)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=comm_buf.at[slot],
@@ -129,17 +142,17 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
             rdma.wait()
             # our send of comm_buf[slot] is complete: that slot is free
             # for the left neighbor's next write into it
-            if ag_signals_ack(step, P):
+            if ag_signals_ack(step, V):
                 pltpu.semaphore_signal(
                     ack_sem.at[slot], inc=1, device_id=left,
                     device_id_type=pltpu.DeviceIdType.LOGICAL)
-            origin = (my - step - 1) % P
+            origin = (my - step - 1) % V
             put = pltpu.make_async_copy(comm_buf.at[nxt], out_ref.at[origin],
                                         copy_sem)
             put.start()
             put.wait()
 
-    out_shape = jax.ShapeDtypeStruct((P,) + x.shape, x.dtype)
+    out_shape = jax.ShapeDtypeStruct((V,) + x.shape, x.dtype)
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -160,24 +173,35 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
 
 def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
                                interpret: bool = False,
-                               collective_id: int = 1):
+                               collective_id: int = 1,
+                               ring_size: int | None = None):
     """Ring reduce-scatter: per-member [P, n, ...] → member's reduced
     [n, ...] (fw :1782-1850: send chunk (rank-1), P-2 fused
-    recv+reduce+forward hops, final hop folds chunk `rank`)."""
+    recv+reduce+forward hops, final hop folds chunk `rank`).
+
+    ``ring_size`` (1-member axis only): virtual V-rank self-ring on one
+    device — real remote DMAs and semaphore flow control, every virtual
+    rank being this device (see ring_all_gather_pallas).  The self-ring
+    result is the full `op`-reduction of our own V chunks (each hop's
+    incoming partial is our own accumulator)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     P = lax.axis_size(axis)
-    if P == 1:
+    V = ring_size if ring_size is not None else P
+    if V != P and P != 1:
+        raise ValueError("ring_size override requires a 1-member axis "
+                         f"(self-ring mode); got P={P}, ring_size={V}")
+    if V == 1:
         return x[0]
     chunk_shape = x.shape[1:]
     is_max = op == "max"
 
     def kernel(x_ref, out_ref, acc, landing, send_sem, recv_sem, ack_sem,
                copy_sem):
-        my = lax.axis_index(axis)
-        right = (my + 1) % P
-        left = (my + P - 1) % P
+        my = lax.axis_index(axis) % V
+        right = ((my + 1) % V) % P
+        left = ((my + V - 1) % V) % P
 
         barrier = pltpu.get_barrier_semaphore()
         pltpu.semaphore_signal(barrier, inc=1, device_id=left,
@@ -187,17 +211,17 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
         pltpu.semaphore_wait(barrier, 2)
 
         # acc starts as our chunk (my - 1): the first payload forwarded
-        first = (my + P - 1) % P
+        first = (my + V - 1) % V
         ld = pltpu.make_async_copy(x_ref.at[first], acc, copy_sem)
         ld.start()
         ld.wait()
 
-        for step in range(P - 1):
+        for step in range(V - 1):
             slot = step % 2
             # flow control: the landing slot we target was consumed by
             # the right neighbor's fold two steps ago — wait for its ACK
             # so ring skew can't overrun the double buffer
-            if rs_waits_ack(step, P):
+            if rs_waits_ack(step, V):
                 pltpu.semaphore_wait(ack_sem.at[slot], 1)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=acc,
@@ -210,8 +234,8 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
             rdma.start()
             rdma.wait()
             # fold the arriving partial with our local copy of the chunk
-            # now travelling: chunk (my - 2 - step) mod P
-            cidx = (my - 2 - step) % P
+            # now travelling: chunk (my - 2 - step) mod V
+            cidx = (my - 2 - step) % V
             ld2 = pltpu.make_async_copy(x_ref.at[cidx], acc, copy_sem)
             ld2.start()
             ld2.wait()
@@ -221,7 +245,7 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
                 acc[...] = acc[...] + landing[slot]
             # landing[slot] consumed: free it for the left neighbor's
             # write at its step (step + 2)
-            if rs_signals_ack(step, P):
+            if rs_signals_ack(step, V):
                 pltpu.semaphore_signal(
                     ack_sem.at[slot], inc=1, device_id=left,
                     device_id_type=pltpu.DeviceIdType.LOGICAL)
@@ -252,23 +276,31 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
 
 def ring_all_reduce_pallas(x, axis: str = "rank", op: str = "sum",
                            interpret: bool = False, cid_rs: int = 1,
-                           cid_ag: int = 0):
+                           cid_ag: int = 0, ring_size: int | None = None):
     """Segmented ring allreduce = ring reduce-scatter + ring all-gather
     (fw :1888-2071).  Per-member x: [P * n, ...] → same shape, reduced.
 
     The two phases reuse the ring kernels; XLA overlaps the phase
     boundary across segments when callers loop over segments.
+    ``ring_size`` propagates the single-device virtual self-ring mode
+    (see ring_all_gather_pallas).
     """
     P = lax.axis_size(axis)
-    if P == 1:
+    V = ring_size if ring_size is not None else P
+    if V != P and P != 1:
+        raise ValueError("ring_size override requires a 1-member axis "
+                         f"(self-ring mode); got P={P}, ring_size={V}")
+    if V == 1:
         return x
-    n = x.shape[0] // P
-    chunks = x.reshape((P, n) + x.shape[1:])
+    n = x.shape[0] // V
+    chunks = x.reshape((V, n) + x.shape[1:])
     mine = ring_reduce_scatter_pallas(chunks, axis, op=op,
                                       interpret=interpret,
-                                      collective_id=cid_rs)
+                                      collective_id=cid_rs,
+                                      ring_size=ring_size)
     gathered = ring_all_gather_pallas(mine, axis, interpret=interpret,
-                                      collective_id=cid_ag)
+                                      collective_id=cid_ag,
+                                      ring_size=ring_size)
     return gathered.reshape(x.shape)
 
 

@@ -60,6 +60,74 @@ BASELINE_GBPS = 16.0  # reference CCLO datapath (BASELINE.md)
 LAST_TPU_JSON = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench", "results", "last_tpu_bench.json")
 
+# per-STAGE ledger: the worker banks each completed measurement stage
+# here as it lands (atomic rewrite), so a chip claim that hangs midway
+# through a later stage still leaves this run's earlier stages fresh —
+# r4 lost its whole record to exactly this (three timed-out attempts,
+# stale replay).  The orchestrator assembles a partial-but-fresh result
+# from the ledger when every full attempt dies, and a retry attempt in
+# the same run skips stages the previous attempt already banked.
+STAGE_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench", "results", "bench_stages.json")
+
+
+def _load_ledger(run_id: str) -> dict:
+    try:
+        with open(STAGE_LEDGER) as f:
+            led = json.load(f)
+        if led.get("run_id") == run_id:
+            return led
+    except (OSError, ValueError):
+        pass
+    return {"run_id": run_id, "stages": {}}
+
+
+def _bank_stage(led: dict, name: str, data: dict) -> None:
+    led["stages"][name] = data
+    led["banked_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        tmp = STAGE_LEDGER + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(led, f)
+        os.replace(tmp, STAGE_LEDGER)
+        print(f"[bench worker] banked stage {name!r}", file=sys.stderr,
+              flush=True)
+    except OSError as e:  # never sink a measurement over disk trouble
+        print(f"[bench worker] could not bank stage {name!r}: {e}",
+              file=sys.stderr)
+
+
+#: stages every complete TPU record carries, in execution order —
+#: headline first (it is the metric of record), then the detail lanes
+ALL_STAGES = ("headline", "flash", "compression", "selfring", "tpu_tests")
+
+
+def _assemble(stages: dict) -> dict | None:
+    """Build the result line from banked stage fragments.  Returns None
+    without a headline stage (there is no metric to report)."""
+    head = stages.get("headline")
+    if not head:
+        return None
+    gbps = head["gbps"]
+    result = {
+        "metric": "on-path reduction lane sustained throughput (fp32 sum, "
+                  "TPU)",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 2),
+        "platform": head.get("platform", "tpu"),
+    }
+    detail = {k: v for k, v in head.items()
+              if k not in ("gbps", "platform")}
+    for name in ALL_STAGES[1:]:
+        if name in stages:
+            detail.update(stages[name])
+    missing = [n for n in ALL_STAGES if n not in stages]
+    if missing:
+        result["stages_missing"] = missing
+    result["detail"] = detail
+    return result
+
 # Wall-clock budgets (seconds).  The TPU claim itself can eat minutes
 # and a cold remote-compile cache pays ~10 program compiles at 20-40 s
 # each; the attempts bound the total below typical driver patience
@@ -123,65 +191,93 @@ def _measure(platform: str) -> dict:
 
     _probe, timed_chain, timed_chain_ab, _sync_s = make_harness(jax, jnp)
 
-    # autotune the VMEM tile depth: dispatch-bound at small blocks,
-    # pipeline-starved at huge ones; pick the best of a short ladder
-    best_dt, best_rows = None, 0
-    iters = 30 if on_tpu else 3
-    for rows in ((512, 2048) if on_tpu else (512,)):
-        fn = lambda x, bb, r=rows: pallas_add(x, bb, interpret=interpret,
-                                              block_rows=r, donate=True)
-        dt_r = timed_chain(fn, a, max(4, iters // 4), trials=2, consts=(b,))
-        if best_dt is None or dt_r < best_dt:
-            best_dt, best_rows = dt_r, rows
-    print(f"[bench worker] pallas_add autotune -> block_rows={best_rows}",
-          file=sys.stderr)
+    if not on_tpu:
+        # CPU fallback: headline only, no ledger (nothing hardware-fresh
+        # to bank), interpret-mode kernels
+        run = lambda x, bb: pallas_add(x, bb, interpret=interpret,
+                                       block_rows=512, donate=True)
+        dt = timed_chain(run, a, 3, trials=3, consts=(b,))
+        gbps = 3 * n * 4 / dt / 1e9
+        return {
+            "metric": "on-path reduction lane sustained throughput "
+                      "(fp32 sum, CPU-interpret fallback)",
+            "value": round(gbps, 2),
+            "unit": "GB/s",
+            "vs_baseline": round(gbps / BASELINE_GBPS, 2),
+            "platform": backend,
+        }
 
-    run = lambda x, bb: pallas_add(x, bb, interpret=interpret,
-                                   block_rows=best_rows, donate=True)
-    nbytes = 3 * n * 4  # read a, read b, write out
+    # a worker launched directly (no orchestrator env) must NOT resume
+    # a previous run's ledger as if freshly measured — give it a unique
+    # id so it always starts clean
+    run_id = (os.environ.get("ACCL_BENCH_RUN_ID")
+              or f"direct-{os.getpid()}-{int(time.time())}")
+    led = _load_ledger(run_id)
+    stages = led["stages"]
 
-    if on_tpu:
-        # headline + roofline measured interleaved: the same 3-stream add
-        # through plain XLA is the practical HBM ceiling on this chip, so
-        # the headline number carries its own context
+    if "headline" not in stages:
+        # autotune the VMEM tile depth: dispatch-bound at small blocks,
+        # pipeline-starved at huge ones; best of a short ladder
+        best_dt, best_rows = None, 0
+        for rows in (512, 2048):
+            fn = lambda x, bb, r=rows: pallas_add(x, bb, interpret=False,
+                                                  block_rows=r, donate=True)
+            dt_r = timed_chain(fn, a, 8, trials=2, consts=(b,))
+            if best_dt is None or dt_r < best_dt:
+                best_dt, best_rows = dt_r, rows
+        print(f"[bench worker] pallas_add autotune -> "
+              f"block_rows={best_rows}", file=sys.stderr)
+        run = lambda x, bb: pallas_add(x, bb, interpret=False,
+                                      block_rows=best_rows, donate=True)
+        nbytes = 3 * n * 4  # read a, read b, write out
+        # headline + roofline measured interleaved: the same 3-stream
+        # add through plain XLA is the practical HBM ceiling on this
+        # chip, so the headline number carries its own context
         xla_add = lambda x, bb: x + bb
-        dts = timed_chain_ab({"pallas": run, "xla": xla_add}, a, iters,
+        dts = timed_chain_ab({"pallas": run, "xla": xla_add}, a, 30,
                              consts=(b,))
-        dt = dts["pallas"]
-    else:
-        dt = timed_chain(run, a, iters, trials=3, consts=(b,))
-        dts = {}
+        _bank_stage(led, "headline", {
+            "gbps": 3 * n * 4 / dts["pallas"] / 1e9,
+            "platform": backend,
+            "xla_add_gbps": round(nbytes / dts["xla"] / 1e9, 2),
+            "roofline_frac": round(dts["xla"] / dts["pallas"], 3),
+            "pallas_block_rows": best_rows,
+        })
+        # provisional line after every stage: the orchestrator takes the
+        # LAST JSON line, so a kill during any later stage still lands
+        # everything banked so far
+        print(json.dumps(_assemble(stages)), flush=True)
 
-    gbps = nbytes / dt / 1e9
-    result = {
-        "metric": "on-path reduction lane sustained throughput (fp32 sum, "
-                  + ("TPU" if on_tpu else "CPU-interpret fallback") + ")",
-        "value": round(gbps, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / BASELINE_GBPS, 2),
-        "platform": backend,
-    }
-    if on_tpu:
-        detail = _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab)
-        detail["xla_add_gbps"] = round(nbytes / dts["xla"] / 1e9, 2)
-        detail["roofline_frac"] = round(dts["xla"] / dt, 3)
-        detail["pallas_block_rows"] = best_rows
-        result["detail"] = detail
-        # provisional line FIRST: the orchestrator takes the LAST JSON
-        # line, so if the attempt budget kills us during the pytest leg
-        # below, the measurements above still land
-        print(json.dumps(result), flush=True)
-        detail["tpu_only_tests"] = _run_tpu_only_tests()
-    return result
+    if "flash" not in stages:
+        _bank_stage(led, "flash",
+                    _flash_stage(jax, jnp, timed_chain))
+        print(json.dumps(_assemble(stages)), flush=True)
+
+    if "compression" not in stages:
+        _bank_stage(led, "compression",
+                    _compression_stage(jax, jnp, timed_chain_ab))
+        print(json.dumps(_assemble(stages)), flush=True)
+
+    if "selfring" not in stages:
+        _bank_stage(led, "selfring", _selfring_stage(jax, jnp, timed_chain))
+        print(json.dumps(_assemble(stages)), flush=True)
+
+    if "tpu_tests" not in stages:
+        _bank_stage(led, "tpu_tests",
+                    {"tpu_only_tests": _run_tpu_only_tests()})
+
+    return _assemble(stages)
 
 
 def _run_tpu_only_tests() -> str:
-    """Execute the TPU-gated tests (skipif(not ON_TPU) — e.g. stochastic
-    rounding, which needs the hardware PRNG) in-process on the claimed
-    chip, so no test in the suite is permanently skipped on every rung.
-    ACCL_TEST_ON_TPU=1 makes conftest.py keep the live platform instead
-    of pinning the virtual-CPU mesh.  Best-effort: the result string is
-    recorded in the bench detail for the round record."""
+    """Execute the single-device-runnable Pallas kernel tests COMPILED
+    on the claimed chip: the TPU-gated ones (stochastic rounding needs
+    the hardware PRNG) plus the reduce/compression/matmul lanes and the
+    virtual self-ring collectives (real semaphore + remote-DMA code).
+    The multi-device ring tests are excluded — they need a >=2-chip
+    mesh.  ACCL_TEST_ON_TPU=1 makes conftest.py keep the live platform
+    instead of pinning the virtual-CPU mesh.  Best-effort: the result
+    string is recorded in the bench detail for the round record."""
     import os
 
     os.environ["ACCL_TEST_ON_TPU"] = "1"
@@ -199,8 +295,8 @@ def _run_tpu_only_tests() -> str:
                     _Count.skipped += 1
 
         rc = pytest.main([
-            "tests/test_pallas_ops.py", "-q", "-x", "--no-header", "-p",
-            "no:cacheprovider", "-k", "stochastic",
+            "tests/test_pallas_ops.py", "-q", "--no-header", "-p",
+            "no:cacheprovider", "-k", "not test_ring",
         ], plugins=[_Count()])
         # "all skipped" must NOT read as success — the whole point is
         # that these tests execute somewhere
@@ -212,11 +308,11 @@ def _run_tpu_only_tests() -> str:
         return f"{type(e).__name__}: {e}"
 
 
-def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
-    """Compiled-on-TPU runs of the flash-attention and compression
-    kernels, measured with the SAME chained-iteration + sync-subtraction
-    methodology as the headline metric (round 2 recorded single-call
-    dispatch latencies here, which looked like evidence and wasn't).
+def _flash_stage(jax, jnp, timed_chain) -> dict:
+    """Compiled-on-TPU runs of the flash-attention kernels, measured
+    with the SAME chained-iteration + sync-subtraction methodology as
+    the headline metric (round 2 recorded single-call dispatch
+    latencies here, which looked like evidence and wasn't).
     Best-effort — failures are recorded, not fatal."""
     detail: dict = {}
     try:
@@ -303,6 +399,29 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         q2b, k2b, v2b = (x.astype(jnp.bfloat16) for x in (q2p, k2p, v2p))
         fa_bf16 = make_variant(256, 512)
 
+        # EXTERNAL ANCHOR: JAX's own splash-attention kernel on the
+        # same packed operands, same windows — the practical same-shape
+        # ceiling this chip generation offers.  [B*H2, T, D2] is
+        # exactly splash's single-device MHA layout (heads, seq, hd)
+        # with a per-head causal mask.
+        try:
+            from jax.experimental.pallas.ops.tpu import (
+                splash_attention as _sp)
+            _mask = _sp.splash_attention_mask.MultiHeadMask(
+                [_sp.splash_attention_mask.CausalMask((T, T))] * (B * H2))
+            _splash = _sp.make_splash_mha_single_device(_mask)
+
+            def splash_fwd(x, kk, vv):
+                return _splash(x, kk, vv)
+
+            def splash_bwd(x, kk, vv):
+                g = jax.grad(lambda a, b, c: jnp.sum(
+                    _splash(a, b, c)), argnums=(0, 1, 2))(x, kk, vv)
+                return g[0] + g[1] + g[2]
+        except Exception as ve:  # noqa: BLE001 — anchor is best-effort
+            splash_fwd = splash_bwd = None
+            detail["splash_anchor_error"] = type(ve).__name__
+
         best_fa, best_f2, best_mm, best_bf = None, None, None, None
         best_pk = {name: None for name in d128_variants}
         best_pk64 = {name: None for name in d64_variants}
@@ -330,8 +449,31 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
         detail["flash_fwdbwd_pallas_calls"] = n_pallas
 
         best_bwd = None
+        best_sp = best_sp_bwd = None
         dead_variants: set = set()
         for _ in range(10):
+            if splash_fwd is not None and "splash" not in dead_variants:
+                try:
+                    dv = timed_chain(splash_fwd, q2p, iters=64, trials=1,
+                                     consts=(k2p, v2p))
+                    best_sp = dv if best_sp is None else min(best_sp, dv)
+                except Exception as ve:  # noqa: BLE001
+                    dead_variants.add("splash")
+                    best_sp = None
+                    detail["splash_anchor_error"] = type(ve).__name__
+            if (splash_bwd is not None and "splash" not in dead_variants
+                    and "splash_bwd" not in dead_variants):
+                # separate lane: a backward OOM must not erase the
+                # already-valid forward ceiling number
+                try:
+                    db = timed_chain(splash_bwd, q2p, iters=24, trials=1,
+                                     consts=(k2p, v2p))
+                    best_sp_bwd = (db if best_sp_bwd is None
+                                   else min(best_sp_bwd, db))
+                except Exception as ve:  # noqa: BLE001
+                    dead_variants.add("splash_bwd")
+                    best_sp_bwd = None
+                    detail["splash_bwd_anchor_error"] = type(ve).__name__
             d1 = timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
             d2 = timed_chain(mm, ma, iters=48, trials=1, consts=(mb,))
             d3 = timed_chain(fa, q2, iters=64, trials=1, consts=(k2_, v2))
@@ -461,6 +603,19 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
                     "implied_bwd_frac": (round(implied_bwd_frac, 3)
                                          if implied_bwd_frac else None),
                 }
+        if best_sp is not None:
+            # the anchor under the identical flop credit: either our
+            # kernel matches/beats it, or its number IS the recorded
+            # practical same-shape ceiling (r4 review item 3)
+            detail["splash_anchor_tflops"] = round(
+                flops / best_sp / 1e12, 3)
+            detail["splash_anchor_mxu_frac"] = round(
+                (flops / best_sp) / (2 * mm_n**3 / best_mm), 3)
+        if best_sp_bwd is not None:
+            detail["splash_anchor_fwdbwd_tflops"] = round(
+                4.5 * flops / best_sp_bwd / 1e12, 3)
+            detail["splash_anchor_fwdbwd_mxu_frac"] = round(
+                (4.5 * flops / best_sp_bwd) / (2 * mm_n**3 / best_mm), 3)
         live64 = {n: dt for n, dt in best_pk64.items()
                   if isinstance(dt, float)}
         if live64:
@@ -475,6 +630,13 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
                 else dt) for n, dt in best_pk64.items()}
     except Exception as e:  # noqa: BLE001 — best-effort detail metric
         detail["flash_attention_error"] = f"{type(e).__name__}: {e}"
+    return detail
+
+
+def _compression_stage(jax, jnp, timed_chain_ab) -> dict:
+    """Wire-compression roundtrip lane vs the same-window XLA cast pair
+    (the practical ceiling for this access pattern)."""
+    detail: dict = {}
     try:
         from accl_tpu.ops.compression import compress_cast
         # 256 MB fp32: larger than any on-chip scratch (observed: at
@@ -517,6 +679,80 @@ def _secondary_kernels(jax, jnp, timed_chain, timed_chain_ab) -> dict:
     return detail
 
 
+def _selfring_stage(jax, jnp, timed_chain) -> dict:
+    """Execute the Mosaic-COMPILED ring collectives on the chip as a
+    virtual 8-rank self-ring: every hop is a real remote DMA
+    (device_id = self) with the real semaphore handshakes and
+    ACK-window flow control — no interpreter anywhere.  This is the
+    reference's execute-the-synthesized-artifact rung
+    (test/model/simulator/cclo_sim.cpp:57-559): until r5 the compiled
+    semaphore/remote-DMA code had only ever been *compiled*, never run.
+    Correctness is asserted against the self-ring closed forms (ag →
+    x tiled V times; rs → op-fold of our own V chunks) before anything
+    is timed."""
+    detail: dict = {}
+    try:
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from accl_tpu.ops.ring import (ring_all_gather_pallas,
+                                       ring_all_reduce_pallas,
+                                       ring_reduce_scatter_pallas)
+
+        V = 8
+        rows = 4096                      # 4096 x 128 f32 = 2 MB chunk
+        mesh = Mesh(np.array(jax.devices()[:1]), ("r",))
+        spec = P()                       # 1-member axis: full array local
+
+        def smap(f):
+            return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=spec,
+                                         out_specs=spec,
+                                         check_vma=False))
+
+        x = jax.random.normal(jax.random.PRNGKey(11), (rows, 128),
+                              jnp.float32)
+        xs = jax.random.normal(jax.random.PRNGKey(12), (V, rows, 128),
+                               jnp.float32)
+
+        # correctness first: the compiled kernels must produce the
+        # self-ring closed forms or no bandwidth number is reported
+        ag = smap(lambda v: ring_all_gather_pallas(v, "r", ring_size=V))
+        got = np.asarray(ag(x))
+        want = np.broadcast_to(np.asarray(x), (V, rows, 128))
+        assert np.array_equal(got, want), "self-ring allgather mismatch"
+
+        rs = smap(lambda v: ring_reduce_scatter_pallas(v, "r",
+                                                       ring_size=V))
+        got = np.asarray(rs(xs))
+        want = np.asarray(xs).astype(np.float64).sum(axis=0)
+        err = np.max(np.abs(got - want) / (np.abs(want) + 1e-6))
+        assert err < 1e-3, f"self-ring reduce-scatter mismatch {err}"
+        detail["ring_compiled_selfring_ok"] = True
+
+        # bandwidth of the remote-DMA path: (V-1) hops x chunk bytes
+        # per kernel; chained via the [0] row (== x for the self-ring)
+        ag_chain = smap(
+            lambda v: ring_all_gather_pallas(v, "r", ring_size=V)[0])
+        dt = timed_chain(ag_chain, x, iters=48, trials=3)
+        hop_bytes = (V - 1) * rows * 128 * 4
+        detail["ring_selfring_ag_gbps"] = round(hop_bytes / dt / 1e9, 2)
+
+        # allreduce self-ring: rs + ag composition, value renormalized
+        # by V so the chain carry stays bounded (self-ring sum tiles
+        # the chunk-fold; /V makes iteration a bounded fixed point)
+        arx = jax.random.normal(jax.random.PRNGKey(13), (V * rows, 128),
+                                jnp.float32)
+        ar_chain = smap(
+            lambda v: ring_all_reduce_pallas(v, "r", ring_size=V) / V)
+        dt = timed_chain(ar_chain, arx, iters=32, trials=3)
+        # rs phase: (V-1) hops x chunk; ag phase: (V-1) hops x chunk
+        ar_bytes = 2 * (V - 1) * rows * 128 * 4
+        detail["ring_selfring_ar_gbps"] = round(ar_bytes / dt / 1e9, 2)
+    except Exception as e:  # noqa: BLE001 — best-effort detail metric
+        detail["ring_selfring_error"] = f"{type(e).__name__}: {e}"
+    return detail
+
+
 def _numpy_last_resort() -> dict:
     """If jax itself is broken, still land a labeled number."""
     import numpy as np
@@ -545,15 +781,19 @@ def _numpy_last_resort() -> dict:
 # orchestrator: subprocess + timeout around every jax touch
 # ---------------------------------------------------------------------------
 
-def _run_worker(platform: str, timeout_s: int) -> dict | None:
+def _run_worker(platform: str, timeout_s: int,
+                run_id: str = "") -> dict | None:
     """Run `python bench.py --worker <platform>` and parse its last
-    stdout line as JSON.  Returns None on timeout / crash / bad JSON."""
+    stdout line as JSON.  Returns None on timeout / crash / bad JSON.
+    `run_id` keys the per-stage ledger: a retry attempt in the same run
+    resumes after the last banked stage instead of starting over."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", platform]
+    env = dict(os.environ, ACCL_BENCH_RUN_ID=run_id)
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         print(f"[bench] {platform} worker timed out after {timeout_s}s "
               "(TPU claim hung?)", file=sys.stderr)
@@ -585,15 +825,30 @@ def main() -> None:
         return
 
     result = None
+    run_id = f"run-{os.getpid()}-{int(time.time())}"
     for i, budget in enumerate(TPU_ATTEMPT_TIMEOUTS):
         print(f"[bench] TPU attempt {i + 1}/{len(TPU_ATTEMPT_TIMEOUTS)} "
               f"(budget {budget}s)", file=sys.stderr)
-        result = _run_worker("tpu", budget)
+        result = _run_worker("tpu", budget, run_id=run_id)
         if result is not None:
             break
-    if result is not None and result.get("platform") not in (None, "cpu",
-                                                             "numpy"):
-        # bank the fresh hardware measurement for future blocked windows
+    if result is None:
+        # every attempt died mid-run — but any stage a worker banked
+        # before its claim hung is still a FRESH hardware measurement;
+        # a partial fresh record beats a complete stale one (r4 lost
+        # its whole round record to an all-or-nothing worker)
+        led = _load_ledger(run_id)
+        result = _assemble(led["stages"])
+        if result is not None:
+            print("[bench] assembling PARTIAL result from "
+                  f"{sorted(led['stages'])} stages banked before the "
+                  "attempts timed out", file=sys.stderr)
+    if (result is not None
+            and result.get("platform") not in (None, "cpu", "numpy")
+            and not result.get("stages_missing")):
+        # bank the fresh COMPLETE hardware measurement for future
+        # blocked windows (a partial must not overwrite a complete
+        # record's detail lanes; partials live in the stage ledger)
         try:
             tmp = LAST_TPU_JSON + ".tmp"
             with open(tmp, "w") as f:

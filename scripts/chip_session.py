@@ -5,8 +5,8 @@ window opens this script harvests everything the round needs from real
 hardware, stage by stage, skipping stages whose artifact already
 exists:
 
-  1. flash-attention schedule sweep  -> bench/results/flash_tune_r04.json
-  2. 1KB-1GB reduce-lane size curve  -> bench/results/lane_sweep_r04.csv
+  1. flash-attention schedule sweep  -> bench/results/flash_tune_r05.json
+  2. 1KB-1GB reduce-lane size curve  -> bench/results/lane_sweep_r05.csv
      (the single-chip busbw-vs-size metric-of-record proxy: the on-path
      reduction lane streamed over HBM, with the plain-XLA add as the
      per-size memory roofline; reference role test/host/xrt/src/bench.cpp
@@ -30,12 +30,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "bench", "results")
-FLASH_JSON = os.path.join(OUT, "flash_tune_r04.json")
-LANE_CSV = os.path.join(OUT, "lane_sweep_r04.csv")
+FLASH_JSON = os.path.join(OUT, "flash_tune_r05.json")
+LANE_CSV = os.path.join(OUT, "lane_sweep_r05.csv")
 # consecutive-failure counts per lane size: a size that fails this many
 # sessions in a row (e.g. deterministic OOM) is retired so the retry
 # loop can terminate instead of rerunning a forever-incomplete sweep
-LANE_FAIL_JSON = os.path.join(OUT, "lane_sweep_r04_failures.json")
+LANE_FAIL_JSON = os.path.join(OUT, "lane_sweep_r05_failures.json")
 LANE_MAX_FAILS = 3
 LANE_SIZES = [1 << p for p in range(10, 31, 2)]  # 1 KB .. 1 GB
 

@@ -140,3 +140,43 @@ def test_parse_bench_results_roundtrip(tmp_path):
     parse.report(data, baseline=data, out=out)
     text = out.getvalue()
     assert "allreduce" in text and "1.00x" in text and "peak busbw" in text
+
+
+def test_bench_stage_ledger_roundtrip(tmp_path, monkeypatch):
+    """bench.py's per-stage banking: stages persist atomically under a
+    run id, a different run id starts clean, and _assemble builds the
+    result line from whatever fragments landed (r4 lost its round
+    record to an all-or-nothing worker; this is the regression lock)."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setattr(bench, "STAGE_LEDGER",
+                        str(tmp_path / "stages.json"))
+
+    led = bench._load_ledger("run-A")
+    assert led["stages"] == {}
+    bench._bank_stage(led, "headline", {"gbps": 640.0, "platform": "tpu",
+                                        "xla_add_gbps": 650.0})
+    bench._bank_stage(led, "flash", {"flash_d128_tflops": 64.0})
+
+    # same run id resumes with both stages; another id starts clean
+    led2 = bench._load_ledger("run-A")
+    assert sorted(led2["stages"]) == ["flash", "headline"]
+    assert bench._load_ledger("run-B")["stages"] == {}
+
+    # partial assembly: headline + flash present, rest reported missing
+    res = bench._assemble(led2["stages"])
+    assert res["value"] == 640.0
+    assert res["detail"]["flash_d128_tflops"] == 64.0
+    assert res["detail"]["xla_add_gbps"] == 650.0
+    assert set(res["stages_missing"]) == {"compression", "selfring",
+                                          "tpu_tests"}
+    assert res["vs_baseline"] == round(640.0 / bench.BASELINE_GBPS, 2)
+
+    # no headline -> nothing to report
+    assert bench._assemble({"flash": {"x": 1}}) is None

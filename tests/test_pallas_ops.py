@@ -83,6 +83,8 @@ def test_pallas_matmul():
 
 def test_fused_matmul_allreduce():
     P_ = 4
+    if len(jax.devices()) < P_:
+        pytest.skip("needs a 4-device mesh")
     mesh = make_mesh(tp=P_)
     x = _rand((8, P_ * 16), seed=8)
     w = _rand((P_ * 16, 32), seed=9)
@@ -108,6 +110,8 @@ NR = 4
 
 
 def _ring_mesh():
+    if len(jax.devices()) < NR:
+        pytest.skip("needs a 4-device mesh")
     return make_mesh(dp=NR)
 
 
@@ -216,3 +220,70 @@ def test_ring_reduce_scatter_segmented():
     exp = d.reshape(NR, NR, n).sum(axis=0)  # [rank chunk, n]
     for r in range(NR):
         np.testing.assert_allclose(out[r], exp[r], rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# single-device virtual self-ring (ring_size override): the compiled
+# semaphore/remote-DMA code path executable on ONE chip — the
+# reference's execute-the-artifact rung (cclo_sim.cpp:57-559).  On the
+# CPU rung these run under the interpreter; on the bench chip they run
+# COMPILED (bench.py's selfring stage and the chip worker's test leg).
+# ---------------------------------------------------------------------------
+def _one_dev_mesh():
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("r",))
+
+
+def _smap1(f):
+    mesh = _one_dev_mesh()
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(),
+                             out_specs=P(), check_vma=False))
+
+
+def test_selfring_all_gather():
+    V = 4
+    d = _rand((8, 128), seed=20)
+    f = _smap1(lambda v: ring_all_gather_pallas(v, "r", ring_size=V,
+                                                interpret=INTERP))
+    out = np.asarray(f(jnp.asarray(d)))
+    # every virtual rank is this device: out = x tiled V times
+    np.testing.assert_array_equal(out, np.broadcast_to(d, (V, 8, 128)))
+
+
+def test_selfring_reduce_scatter():
+    V = 4
+    d = _rand((V, 8, 128), seed=21)
+    f = _smap1(lambda v: ring_reduce_scatter_pallas(v, "r", ring_size=V,
+                                                    interpret=INTERP))
+    out = np.asarray(f(jnp.asarray(d)))
+    # each hop's incoming partial is our own accumulator: full fold
+    np.testing.assert_allclose(out, d.sum(axis=0), rtol=1e-4, atol=1e-4)
+
+
+def test_selfring_all_reduce():
+    V = 4
+    d = _rand((V * 8, 128), seed=22)
+    f = _smap1(lambda v: ring_all_reduce_pallas(v, "r", ring_size=V,
+                                                interpret=INTERP))
+    out = np.asarray(f(jnp.asarray(d)))
+    exp = np.broadcast_to(d.reshape(V, 8, 128).sum(axis=0),
+                          (V, 8, 128)).reshape(V * 8, 128)
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-4)
+
+
+def test_selfring_requires_single_member_axis():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = make_mesh(dp=2)
+    d = _rand((8, 128), seed=23)
+
+    def body(xb):
+        return ring_all_gather_pallas(xb[0], "dp", ring_size=4,
+                                      interpret=INTERP)[None]
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                  out_specs=P("dp", None, None, None), check_vma=False)
+    with pytest.raises(ValueError, match="ring_size"):
+        jax.jit(f)(jax.device_put(
+            np.broadcast_to(d, (2, 8, 128)).copy(),
+            NamedSharding(mesh, P("dp", None, None))))
