@@ -150,6 +150,19 @@ class TpuEngine:
 
         self.ring_threshold_bytes = int(
             _os.environ.get("ACCL_RING_THRESHOLD", str(4 << 20)))
+        # per-call completion barrier.  False (default): a collective
+        # call completes at DISPATCH — jax arrays are async futures and
+        # every consumer (the next collective's operand, sync_from_device
+        # readbacks, np.asarray) forces the dependency chain, so results
+        # are exact while rank threads overlap their next submission
+        # with device execution (the reference fast path likewise posts
+        # the descriptor and polls; fpgadevice.cpp:46-180).  True: the
+        # executor blocks until the device finishes so get_duration is
+        # the on-device perf-counter reading (fw :2280-2303) and any
+        # async execution error surfaces in THIS call's retcode instead
+        # of at the next consumer.
+        self.profile_sync = (
+            _os.environ.get("ACCL_PROFILE_SYNC", "0") == "1")
         # per-rank address -> buffer registry
         self._buffers: list[dict[int, TpuBuffer]] = [dict() for _ in range(nranks)]
         self._next_addr = [_ADDR_STRIDE] * nranks
@@ -162,6 +175,20 @@ class TpuEngine:
         self._arithcfg_ids: dict = {}
         # gang assembly: key -> deque of partial gangs
         self._gangs: dict = {}
+        # complete gangs awaiting execution, drained by ONE dedicated
+        # executor thread (see _exec_loop): if the completing submitter
+        # executed inline (r4 design), that rank thread could not
+        # submit its own member of the NEXT gang, so no second gang
+        # could ever complete behind a running dispatch and batches
+        # never formed.  A dedicated executor lets all rank threads
+        # keep submitting while a dispatch is in flight — the queue
+        # depth behind it is what the batched dispatch fuses.
+        self._ready: deque = deque()
+        self._ready_cv = threading.Condition()
+        self._shutdown = False
+        self._exec_thread = threading.Thread(
+            target=self._exec_loop, name="accl-gang-exec", daemon=True)
+        self._exec_thread.start()
         # gang signature -> resolved execution plan (see _gang_plan);
         # bounded LRU — fresh buffer addresses mint fresh signatures, so
         # an unbounded dict would pin one plan (and its buffers) per
@@ -457,7 +484,84 @@ class TpuEngine:
                     ready = gang
                     q.remove(gang)
         if ready is not None:
-            self._exec_gang(int(call.scenario), call.comm, ready)
+            self._enqueue_ready(int(call.scenario), call.comm, ready)
+
+    def _enqueue_ready(self, scenario: int, comm_id: int,
+                       gang: dict) -> None:
+        with self._ready_cv:
+            self._ready.append((scenario, comm_id, gang))
+            self._ready_cv.notify()
+
+    def shutdown(self) -> None:
+        with self._ready_cv:
+            self._shutdown = True
+            self._ready_cv.notify()
+
+    def _exec_loop(self) -> None:
+        """Dedicated gang executor (see _ready above)."""
+        while True:
+            with self._ready_cv:
+                while not self._ready and not self._shutdown:
+                    self._ready_cv.wait()
+                if not self._ready and self._shutdown:
+                    return
+                scenario, comm_id, gang = self._ready.popleft()
+            try:
+                items = self._extend_batch(scenario, comm_id, gang)
+                if items is None:
+                    self._exec_gang(scenario, comm_id, gang)
+                else:
+                    self._exec_gang_batch(items)
+            except Exception as e:  # pragma: no cover — belt and braces
+                for call, request, _k in gang.values():
+                    request.description += f" [{e}]"
+                    request.complete(int(ErrorCode.DMA_INTERNAL_ERROR),
+                                     0.0)
+
+    #: max gangs fused into one dispatch (the reference's effective
+    #: FPGAQueue depth; also bounds compiled-variant count per fn key)
+    _BATCH_CAP = 8
+
+    def _extend_batch(self, scenario: int, comm_id: int, gang: dict):
+        """Try to extend `gang` with queued compatible gangs: same
+        compiled program (fn_args) and no RAW hazard (a candidate
+        reading a buffer an earlier member writes must wait for the
+        rebind).  Only the drainer pops, so peeking then popping is
+        race-free.  Returns a list of (op, comm, gang, plan) when a
+        batch of >= 2 formed, else None."""
+        op = Operation(scenario)
+        if op in (Operation.barrier,):
+            return None
+        with self._ready_cv:
+            if not self._ready:
+                return None
+        plan = self._gang_plan(op, comm_id, gang)
+        items = [(op, comm_id, gang, plan)]
+        res_addrs = set(plan["res_addrs"])
+        while len(items) < self._BATCH_CAP:
+            with self._ready_cv:
+                if not self._ready:
+                    break
+                nscen, ncomm, ngang = self._ready[0]
+            nop = Operation(nscen)
+            if nop in (Operation.barrier,):
+                break
+            try:
+                nplan = self._gang_plan(nop, ncomm, ngang)
+            except Exception:  # noqa: BLE001 — candidate stays QUEUED:
+                # its own execution turn will surface the error to its
+                # own requests; raising here would drop already-popped
+                # gangs with their requests never completed
+                break
+            if (nplan["fn_args"] != plan["fn_args"]
+                    or nplan["opnd_addrs"] & res_addrs):
+                break
+            with self._ready_cv:
+                popped = self._ready.popleft()
+            # only the executor pops: the head cannot have changed
+            items.append((nop, ncomm, popped[2], nplan))
+            res_addrs |= nplan["res_addrs"]
+        return items if len(items) > 1 else None
 
     def _exec_gang(self, scenario: int, comm_id: int, gang: dict) -> None:
         try:
@@ -468,6 +572,41 @@ class TpuEngine:
             for call, request, _krnl in gang.values():
                 request.description += f" [{e}]"
                 request.complete(int(ErrorCode.DMA_INTERNAL_ERROR), 0.0)
+
+    def _exec_gang_batch(self, items) -> None:
+        """K same-program, RAW-independent gangs in ONE dispatch: the
+        batched compiled fn takes K sharded globals and returns K
+        results (inputs are all read before any rebind, which is
+        exactly the sequential semantics the RAW guard preserves)."""
+        import time
+
+        try:
+            xs = [self._assemble_global(plan, gang)
+                  for _op, _c, gang, plan in items]
+            fnb = _collective_fn(*items[0][3]["fn_args"],
+                                 nbatch=len(items))
+            t0 = time.perf_counter_ns()
+            ys = fnb(*xs)
+            if self.profile_sync:
+                import jax
+
+                jax.block_until_ready(ys)
+            dt_ns = time.perf_counter_ns() - t0
+            # per-call perf counter: the batch's wall time is shared by
+            # K fused dispatches, so each call's duration is its share
+            # (reporting the whole batch per call would inflate
+            # get_duration by the batch width)
+            per_call = float(dt_ns) / len(items)
+            for (op, _c, gang, plan), y in zip(items, ys):
+                self._scatter_back(plan, y)
+                for call, request, _krnl in gang.values():
+                    request.complete(0, per_call)
+        except Exception as e:
+            for _op, _c, gang, _plan in items:
+                for call, request, _krnl in gang.values():
+                    request.description += f" [{e}]"
+                    request.complete(int(ErrorCode.DMA_INTERNAL_ERROR),
+                                     0.0)
 
     def _gang_plan(self, op: Operation, comm_id: int, gang: dict):
         """Resolve one gang signature into an execution plan and cache
@@ -485,17 +624,19 @@ class TpuEngine:
         # path by setting it to 0): it shapes the compiled program, so
         # it must be part of the signature or a threshold change would
         # silently keep serving the previously-compiled lowering
-        sig = (int(op), comm_id, self.ring_threshold_bytes,
-               tuple((g,) + (lambda c: (c.addr_0, c.addr_2, c.count,
-                                        c.root_src_dst, c.function,
-                                        c.compression_flags, c.arithcfg,
-                                        c.stream_flags, c.tag))(
-                   gang[g][0]) for g in members))
-        with self._lock:
-            plan = self._gang_plans.get(sig)
-            if plan is not None:
-                self._gang_plans.move_to_end(sig)
-                return plan
+        sig = (int(op), comm_id, self.ring_threshold_bytes, tuple(
+            (g, c.addr_0, c.addr_2, c.count, c.root_src_dst, c.function,
+             c.compression_flags, c.arithcfg, c.stream_flags, c.tag)
+            for g, c in ((m, gang[m][0]) for m in members)))
+        # LOCK-FREE hit path: dict reads are GIL-atomic, and the
+        # executor contends with every submitting rank thread for
+        # self._lock — profiled at hundreds of µs/call of convoying on
+        # a busy box when the hit path took the lock.  The cost is LRU
+        # recency (no move_to_end on hits): eviction degrades to
+        # insertion order, which only matters past 256 live signatures.
+        plan = self._gang_plans.get(sig)
+        if plan is not None:
+            return plan
 
         nranks = len(members)
         mesh = self._mesh_for(tuple(members))
@@ -584,9 +725,10 @@ class TpuEngine:
 
         # compiled once per (mesh, op, shape, root, func, ...) and
         # cached (no donation — see _collective_fn)
-        compiled = (None if op == Operation.barrier else _collective_fn(
-            mesh, op, nranks, in_len, root, func, wire_dtype,
-            str(np.dtype(dtype)), ring))
+        fn_args = (mesh, op, nranks, in_len, root, func, wire_dtype,
+                   str(np.dtype(dtype)), ring)
+        compiled = (None if op == Operation.barrier
+                    else _collective_fn(*fn_args))
         plan = {
             "members": members,
             "nranks": nranks,
@@ -595,6 +737,18 @@ class TpuEngine:
             "sharding": NamedSharding(mesh, P("rank")),
             "compiled": compiled,
             "ops": ops,
+            # batching metadata: gangs with the same fn_args can share
+            # one dispatch; the address sets drive the RAW guard (a
+            # candidate whose operands intersect an earlier batch
+            # member's results must see the rebound value, so it ends
+            # the batch)
+            "fn_args": fn_args,
+            "opnd_addrs": frozenset(
+                b.address for _g, b, _o, _f, _r, _ro, _os, _rt in ops
+                if b is not None),
+            "res_addrs": frozenset(
+                r.address for _g, _b, _o, _f, r, _ro, _os, _rt in ops
+                if r is not None),
         }
         with self._lock:
             self._gang_plans[sig] = plan
@@ -625,6 +779,21 @@ class TpuEngine:
             return 0  # gang completion IS the synchronization
 
         plan = self._gang_plan(op, comm_id, gang)
+        x = self._assemble_global(plan, gang)
+
+        t0 = time.perf_counter_ns()
+        y = plan["compiled"](x)
+        if self.profile_sync:
+            # exact perf-counter mode: duration is on-device time and
+            # async errors surface here (see __init__)
+            jax.block_until_ready(y)
+        dt_ns = time.perf_counter_ns() - t0
+
+        self._scatter_back(plan, y)
+        return dt_ns
+
+    def _assemble_global(self, plan: dict, gang: dict):
+        jax, jnp, Mesh, NamedSharding, P = _import_jax()
         in_len = plan["in_len"]
         dtype = plan["dtype"]
 
@@ -660,26 +829,30 @@ class TpuEngine:
         cached = plan.get("assembled")
         if (cached is not None and len(cached[0]) == len(shards)
                 and all(a is b for a, b in zip(cached[0], shards))):
-            x = cached[1]
-        else:
-            x = jax.make_array_from_single_device_arrays(
-                (plan["nranks"] * in_len,), plan["sharding"], shards)
-            # only all-fast-path gangs can ever hit (slow-path members
-            # create fresh arrays per call), so storing anything else
-            # would just pin dead device copies between calls
-            if all(o[3] for o in plan["ops"]):
-                plan["assembled"] = (shards, x)
+            return cached[1]
+        x = jax.make_array_from_single_device_arrays(
+            (plan["nranks"] * in_len,), plan["sharding"], shards)
+        # only all-fast-path gangs can ever hit (slow-path members
+        # create fresh arrays per call), so storing anything else
+        # would just pin dead device copies between calls
+        if all(o[3] for o in plan["ops"]):
+            plan["assembled"] = (shards, x)
+        return x
 
-        t0 = time.perf_counter_ns()
-        y = plan["compiled"](x)
-        jax.block_until_ready(y)
-        dt_ns = time.perf_counter_ns() - t0
-
+    def _scatter_back(self, plan: dict, y) -> None:
         # scatter result shards back into per-rank result buffers without
         # leaving the device: each addressable shard is already a
-        # single-device jax.Array on its gang member's chip
-        out_shards = {self._dev_to_rank[s.device]: s.data
-                      for s in y.addressable_shards}
+        # single-device jax.Array on its gang member's chip.  The shard
+        # order for a given sharding is stable across calls, so it is
+        # resolved once per plan and later calls zip straight through
+        # (the dict build + Device hashing was a measured slice of the
+        # per-call budget at call rate).
+        shard_list = y.addressable_shards
+        order = plan.get("shard_order")
+        if order is None:
+            order = tuple(self._dev_to_rank[s.device] for s in shard_list)
+            plan["shard_order"] = order
+        out_shards = dict(zip(order, (s.data for s in shard_list)))
         for g, _buf, _off, _fast, res, roff, _op0s, res_tag in plan["ops"]:
             if res_tag is not None:
                 # RES_STREAM: the member's result lands in its local
@@ -689,10 +862,17 @@ class TpuEngine:
             if res is None:
                 continue
             out = out_shards[g]
+            if (roff == 0 and out.shape[0] == res.dev.shape[0]
+                    and out.dtype == res.dev.dtype):
+                # whole-buffer result already on the right device: adopt
+                # directly (the set_dev_range fast path minus its
+                # per-call device probe — a result shard lives on its
+                # member's device by construction)
+                res._dev = out
+                continue
             if out.dtype != res.dev.dtype:  # quantize to RES representation
                 out = out.astype(res.dev.dtype)
             res.set_dev_range(roff, out)
-        return dt_ns
 
     # ------------------------------------------------------------------
     # kernel streams
@@ -800,7 +980,7 @@ def _tree_gather(v, nranks: int, root: int):
 @lru_cache(maxsize=256)
 def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
                    func: int, wire_dtype: str, dtype: str,
-                   ring: bool = False) -> Callable:
+                   ring: bool = False, nbatch: int = 1) -> Callable:
     """Build + AOT-compile the SPMD program for one collective: a
     shard_map whose inner program is the XLA HLO collective (or the
     ppermute tree schedule) over ICI — or, with ``ring=True``, the
@@ -886,7 +1066,15 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
     # NO donation: the per-rank shards ARE the registered device buffers
     # on the fast path (the member may reuse its send buffer on the very
     # next call), so the input must stay alive across the dispatch
-    return jax.jit(fn).lower(arg).compile()
+    if nbatch == 1:
+        return jax.jit(fn).lower(arg).compile()
+    # batched gang dispatch (the reference's queue-depth amortization,
+    # FPGAQueue acclrequest.hpp:153-211): K independent same-shape
+    # gangs ride ONE compiled program — K inputs, K outputs, no
+    # concatenation — so the per-dispatch overhead is paid once per
+    # batch instead of once per call
+    batched = lambda *vs: tuple(fn(v) for v in vs)
+    return jax.jit(batched).lower(*([arg] * nbatch)).compile()
 
 
 class TpuDeviceView(CCLODevice):
@@ -971,6 +1159,7 @@ class TpuWorld:
         return [f.result(timeout=300) for f in futures]
 
     def close(self) -> None:
+        self.engine.shutdown()
         self._pool.shutdown(wait=False)
 
     def __enter__(self) -> "TpuWorld":

@@ -11,8 +11,24 @@ Raw ceiling: a jitted shard_map psum on an identical global array,
 called in the same loop — everything above that rate is driver
 overhead (gang assembly, buffer resolution, scatter-back).
 
+Lanes (all interleaved, see below):
+- staged: host-staged operands, per-call sync in/out (worst case);
+- resident: device-resident operands (from_fpga/to_fpga — the
+  reference zero-copy call path, accl.cpp:796-839), synchronous calls
+  so every call pays the full N-thread gang rendezvous;
+- async: resident + run_async with a bounded outstanding window,
+  drained at the end — the driver-side twin of the raw loop, which
+  also only blocks once at the end;
+- raw: the shard_map ceiling.
+
+METHODOLOGY: the lanes are measured INTERLEAVED in rounds, keeping
+each lane's best round — single-core boxes swing 2-3x between runs
+(scheduler phase, background claims), so only same-window ratios mean
+anything (the same best-of-interleaved-windows discipline as
+bench/timing.py).
+
 Usage: python -m accl_tpu.bench.callrate [--ranks N] [--count N]
-       [--iters N] [--json out.json]
+       [--iters N] [--rounds N] [--json out.json]
 """
 from __future__ import annotations
 
@@ -22,7 +38,7 @@ import time
 
 
 def run(nranks: int = 4, count: int = 1024, iters: int = 300,
-        platform: str = "cpu") -> dict:
+        platform: str = "cpu", rounds: int = 4) -> dict:
     import numpy as np
 
     import jax
@@ -39,58 +55,100 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
     from accl_tpu import ReduceFunction
     from accl_tpu.backends.tpu import TpuWorld
 
-    out: dict = {"nranks": nranks, "count": count, "iters": iters}
+    out: dict = {"nranks": nranks, "count": count, "iters": iters,
+                 "rounds": rounds}
+    si = max(10, iters // rounds)  # iterations per lane slice
+    out["slice_iters"] = si
 
     with TpuWorld(nranks) as w:
-        def worker(accl, rank):
+        bufs: dict = {}
+
+        def setup(accl, rank):
             rng = np.random.default_rng(rank)
             s = accl.create_buffer_like(
                 rng.standard_normal(count).astype(np.float32))
             r = accl.create_buffer(count, np.float32)
-            # warm the compile cache + gang path
-            for _ in range(3):
+            bufs[rank] = (s, r)
+            for _ in range(3):  # warm compile cache + gang path
                 accl.allreduce(s, r, count, ReduceFunction.SUM)
+
+        w.run(setup)
+
+        def staged(accl, rank):
+            s, r = bufs[rank]
             t0 = time.perf_counter()
-            for _ in range(iters):
+            for _ in range(si):
                 accl.allreduce(s, r, count, ReduceFunction.SUM)
-            dt_staged = time.perf_counter() - t0
-            # device-resident path (reference zero-copy call path,
-            # accl.cpp:796-839 with FPGA-resident buffers): no host
-            # staging per call — the training-loop call rate
+            return time.perf_counter() - t0
+
+        def resident(accl, rank):
+            s, r = bufs[rank]
             t0 = time.perf_counter()
-            for _ in range(iters):
+            for _ in range(si):
                 accl.allreduce(s, r, count, ReduceFunction.SUM,
                                from_fpga=True, to_fpga=True)
-            dt_res = time.perf_counter() - t0
-            return dt_staged, dt_res
+            # completion means DISPATCH since the async-completion
+            # change; force the device chain like the raw lane's final
+            # block_until_ready so both lanes time the same work
+            jax.block_until_ready(r.dev)
+            return time.perf_counter() - t0
 
-        dts = w.run(worker)
-        # ranks run concurrently; wall time is the slowest member
-        wall = max(d[0] for d in dts)
-        wall_res = max(d[1] for d in dts)
-        out["driver_calls_per_s"] = round(iters / wall, 1)
-        out["driver_latency_us"] = round(wall / iters * 1e6, 1)
-        out["driver_resident_calls_per_s"] = round(iters / wall_res, 1)
-        out["driver_resident_latency_us"] = round(wall_res / iters * 1e6, 1)
+        def resident_async(accl, rank):
+            s, r = bufs[rank]
+            window: list = []
+            t0 = time.perf_counter()
+            for _ in range(si):
+                window.append(accl.allreduce(
+                    s, r, count, ReduceFunction.SUM, from_fpga=True,
+                    to_fpga=True, run_async=True))
+                if len(window) >= 8:
+                    window.pop(0).wait()
+            for req in window:
+                req.wait()
+            jax.block_until_ready(r.dev)  # same-work guarantee as raw
+            return time.perf_counter() - t0
 
-    # raw shard_map ceiling on the same device set / payload
-    devs = jax.devices()[:nranks]
-    mesh = Mesh(np.array(devs), ("rank",))
-    x = jnp.zeros((nranks, count), jnp.float32)
-    x = jax.device_put(x, NamedSharding(mesh, P("rank", None)))
-    fn = jax.jit(jax.shard_map(
-        lambda v: jax.lax.psum(v, "rank"), mesh=mesh,
-        in_specs=P("rank", None), out_specs=P("rank", None)))
-    jax.block_until_ready(fn(x))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        y = fn(x)
-    jax.block_until_ready(y)
-    dt = time.perf_counter() - t0
-    out["raw_shardmap_calls_per_s"] = round(iters / dt, 1)
-    out["raw_latency_us"] = round(dt / iters * 1e6, 1)
-    out["driver_overhead_x"] = round(
-        out["raw_shardmap_calls_per_s"] / out["driver_calls_per_s"], 2)
+        # raw shard_map ceiling on the same device set / payload
+        devs = jax.devices()[:nranks]
+        mesh = Mesh(np.array(devs), ("rank",))
+        x = jnp.zeros((nranks, count), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P("rank", None)))
+        fn = jax.jit(jax.shard_map(
+            lambda v: jax.lax.psum(v, "rank"), mesh=mesh,
+            in_specs=P("rank", None), out_specs=P("rank", None)))
+        jax.block_until_ready(fn(x))
+
+        def raw():
+            t0 = time.perf_counter()
+            for _ in range(si):
+                y = fn(x)
+            jax.block_until_ready(y)
+            return time.perf_counter() - t0
+
+        best = {"staged": None, "resident": None, "async": None,
+                "raw": None}
+
+        def keep(lane, dt):
+            if best[lane] is None or dt < best[lane]:
+                best[lane] = dt
+
+        for _ in range(rounds):
+            keep("raw", raw())
+            keep("staged", max(w.run(staged)))
+            keep("resident", max(w.run(resident)))
+            keep("async", max(w.run(resident_async)))
+
+    out["driver_calls_per_s"] = round(si / best["staged"], 1)
+    out["driver_latency_us"] = round(best["staged"] / si * 1e6, 1)
+    out["driver_resident_calls_per_s"] = round(si / best["resident"], 1)
+    out["driver_resident_latency_us"] = round(
+        best["resident"] / si * 1e6, 1)
+    out["driver_async_calls_per_s"] = round(si / best["async"], 1)
+    out["driver_async_latency_us"] = round(best["async"] / si * 1e6, 1)
+    out["raw_shardmap_calls_per_s"] = round(si / best["raw"], 1)
+    out["raw_latency_us"] = round(best["raw"] / si * 1e6, 1)
+    out["driver_overhead_x"] = round(best["staged"] / best["raw"], 2)
+    out["resident_overhead_x"] = round(best["resident"] / best["raw"], 2)
     return out
 
 
@@ -99,10 +157,12 @@ def main() -> None:
     ap.add_argument("--ranks", type=int, default=4)
     ap.add_argument("--count", type=int, default=1024)
     ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--json", type=str, default="")
     ap.add_argument("--platform", type=str, default="cpu")
     args = ap.parse_args()
-    res = run(args.ranks, args.count, args.iters, args.platform)
+    res = run(args.ranks, args.count, args.iters, args.platform,
+              args.rounds)
     line = json.dumps(res)
     print(line)
     if args.json:

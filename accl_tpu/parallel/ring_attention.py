@@ -429,7 +429,7 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool,
 
 
 def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
-                      attn_fn=None):
+                      attn_fn=None, attn_fn_gqa_aware: bool = False):
     """DeepSpeed-Ulysses-style sequence parallelism: all-to-all reshards
     sequence↔heads so each member runs *full-sequence* attention on a
     head subset, then reshards back (built on the reference's alltoall,
@@ -438,6 +438,13 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
     q: [B, T_local, H, D], k/v: [B, T_local, H or G, D] (grouped-query
     K/V reshard their own smaller head axis — G must also divide by P)
     → out: [B, T_local, H, D]
+
+    A caller-supplied ``attn_fn`` receives EXPANDED K/V under GQA by
+    default (safe for non-GQA-aware callables; correctness beats the
+    bandwidth saving).  Pass ``attn_fn_gqa_aware=True`` when the
+    callable handles a smaller K/V head axis itself (e.g. a partial of
+    ops.flash.flash_attention) to keep the grouped layout and its
+    HBM/memory saving.
     """
     P = lax.axis_size(axis)
     B, Tl, H, D = q.shape
@@ -461,7 +468,9 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
         return x.reshape(B, Tl, H, D)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    attn_fn_wants_expansion = attn_fn is not None  # caller-supplied
+    # caller-supplied fns get expansion unless declared GQA-aware
+    attn_fn_wants_expansion = (attn_fn is not None
+                               and not attn_fn_gqa_aware)
     if attn_fn is None:
         import jax as _jax
         if _jax.default_backend() == "tpu":

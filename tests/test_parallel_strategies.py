@@ -606,3 +606,48 @@ def test_ring_gqa_permutes_grouped_shards():
 
     assert compiled_permute_shapes(H) == {f"f32[1,16,{H},16]"}
     assert compiled_permute_shapes(G) == {f"f32[1,16,{G},16]"}
+
+
+def test_ulysses_gqa_aware_attn_fn_keeps_grouped_kv():
+    """attn_fn_gqa_aware=True hands the caller's GQA-capable callable
+    the GROUPED K/V layout (no expansion — the bandwidth saving), and
+    the result still matches the expanded default path (ADVICE r4)."""
+    import functools
+
+    import jax
+
+    from accl_tpu.ops.flash import flash_attention
+    from accl_tpu.parallel.mesh import make_mesh
+    from accl_tpu.parallel.ring_attention import ulysses_attention
+
+    P_sp = 2
+    mesh = make_mesh(sp=P_sp)
+    B, Tl, H, G, D = 2, 16, 8, 4, 16
+    rng = np.random.default_rng(23)
+    q = jnp.asarray(rng.standard_normal((B, P_sp * Tl, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, P_sp * Tl, G, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, P_sp * Tl, G, D)), jnp.float32)
+    spec = P(None, "sp", None, None)
+
+    seen_kv_heads = []
+
+    def gqa_aware(qq, kk, vv):
+        seen_kv_heads.append(kk.shape[2])
+        return flash_attention(qq, kk, vv, causal=True,
+                               mxu_dtype=jnp.float32, interpret=True)
+
+    def run(**kw):
+        fn = jax.jit(jax.shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c, axis="sp",
+                                              causal=True, **kw),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False))
+        return np.asarray(fn(q, k, v))
+
+    flash_fn = functools.partial(flash_attention, causal=True,
+                                 mxu_dtype=jnp.float32, interpret=True)
+    want = run(attn_fn=flash_fn)              # default: expanded K/V
+    got = run(attn_fn=gqa_aware, attn_fn_gqa_aware=True)
+    # grouped layout reached the callable: G/P heads, not H/P
+    assert seen_kv_heads and set(seen_kv_heads) == {G // P_sp}
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
