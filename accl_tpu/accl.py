@@ -715,10 +715,20 @@ class ACCL:
         argument is supplied on every rank) or set compress_dtype, which
         pins the wire format regardless of per-rank operand layout
         (tests/test_compression_matrix.py ROOTED_COMBOS)."""
+        # each buffer contributes (address, dtype, host-only): every
+        # _build-derived field is a function of those three plus the
+        # scalar args.  dtype/host-only are IN the key because emulator
+        # backends free and first-fit-REUSE addresses (engine.cpp
+        # free_addr) — an address-only key could serve a stale arithcfg
+        # for a recycled address with a different dtype; with all three,
+        # a recycled address either matches (identical descriptor) or
+        # misses.
+        def _bkey(b):
+            return (None if b is None
+                    else (b.address, b.data_type, b.is_host_only))
+
         memo_key = (scenario, count, comm_id, root_src_dst, function, tag,
-                    None if op0 is None else op0.address,
-                    None if op1 is None else op1.address,
-                    None if res is None else res.address,
+                    _bkey(op0), _bkey(op1), _bkey(res),
                     stream_flags, compress_dtype, op0_dtype, res_dtype)
         cached = self._call_memo.get(memo_key)
         if cached is not None:

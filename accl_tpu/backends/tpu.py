@@ -604,6 +604,12 @@ class TpuEngine:
         except Exception as e:
             for _op, _c, gang, _plan in items:
                 for call, request, _krnl in gang.values():
+                    if request.done:
+                        # earlier batch members that already completed
+                        # successfully must NOT be re-completed as
+                        # errors (waiters may have observed success;
+                        # on_complete must not run twice)
+                        continue
                     request.description += f" [{e}]"
                     request.complete(int(ErrorCode.DMA_INTERNAL_ERROR),
                                      0.0)

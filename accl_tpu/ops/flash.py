@@ -886,20 +886,29 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal, bq,
                           bk, nq, nq_total, mxu_dtype, chunk_q,
-                          window=None):
+                          window=None, group=1):
     """dK/dV cell: accumulate over the q blocks of one k block.  The
     q block is processed as an UNROLLED run of chunk_q sub-chunks (the
     roles of q and k swap relative to the dq kernel, so here the chunk
     axis is q) — independent sub-chunks whose partial dK/dV
-    contributions are additive, giving Mosaic MXU/VPU overlap."""
+    contributions are additive, giving Mosaic MXU/VPU overlap.
+
+    GQA (``group`` > 1): the accumulation axis spans group * nq steps —
+    every q head of this K/V head's group folds its contribution into
+    the SAME dk/dv accumulators (the in-kernel transpose of the
+    forward's zero-copy row sharing), so K/V never expand and no
+    group-sum pass runs outside the kernel.  The q-side index maps pick
+    (q head, q block) = divmod(j, nq); the mask algebra only needs the
+    q-BLOCK index since every q head shares the same positions."""
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(1)
     j = pl.program_id(2)
+    j2 = j % nq if group > 1 else j
     # bounded q iteration under a window: the q blocks that can see
     # k-block ik start at the causal lower bound (ik*bk)//bq and end
-    # O(window) blocks later; j counts from that base
-    iq = j + ((ik * bk) // bq if window is not None else 0)
+    # O(window) blocks later; j2 counts from that base
+    iq = j2 + ((ik * bk) // bq if window is not None else 0)
 
     @pl.when(j == 0)
     def _init():
@@ -950,7 +959,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dvec_ref,
     else:
         body(masked=False)
 
-    @pl.when(j == nq - 1)
+    @pl.when(j == nq * group - 1)
     def _fin():
         # q2 carries the a*log2e prescale, so dK needs it divided back
         # out on top of its own `a` factor: a / (a*log2e) = 1/log2e
@@ -963,9 +972,11 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, _kernel, _nc, _qt,
-     _fd, window, _kvg) = cfg
+     _fd, window, kvg) = cfg
     N, T, D = qp.shape
     Tk = kp.shape[1]
+    G = kvg if kvg else 1          # q heads per K/V head (GQA group)
+    Nk = N // G                    # kp/vp rows: [Nk, Tk, D], grouped
     nq, nk = T // bq, Tk // bk
     a = 1.0 / float(D) ** 0.5
     # sub-chunk widths for the unrolled backward cells (the forward's
@@ -1007,8 +1018,10 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
 
     qb_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)
+    # GQA: q row b reads K/V row b // G — the same zero-copy row
+    # sharing as the forward's index maps; no expanded K/V exists
     kb_spec = pl.BlockSpec((1, bk, D),
-                           lambda b, i, j: (b, _kblk(i, j), 0),
+                           lambda b, i, j: (b // G, _kblk(i, j), 0),
                            memory_space=pltpu.VMEM)
     ql_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)
@@ -1029,23 +1042,36 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     )(q2, kp, vp, g_out, l2, dvec)
 
     # dK/dV: swap the roles — k blocks on the parallel axis, q blocks
-    # accumulated sequentially
+    # accumulated sequentially.  Under GQA the sequential axis spans
+    # the WHOLE q-head group (G * nq_eff steps): q row = b*G + i//nq,
+    # q block = i%nq — each K/V head's dk/dv fold their group's
+    # contributions in-kernel, expansion-free (ADVICE r4: the old path
+    # repeated K/V G x and group-summed outside, scaling backward HBM
+    # with the full q-head count)
+    def _qrow(b, i):
+        return b * G + i // nq_eff if G > 1 else b
+
+    def _qblk2(jk, i):
+        return _qblk(jk, i % nq_eff) if G > 1 else _qblk(jk, i)
+
     qs_spec = pl.BlockSpec((1, bq, D),
-                           lambda b, jk, i: (b, _qblk(jk, i), 0),
+                           lambda b, jk, i: (_qrow(b, i), _qblk2(jk, i),
+                                             0),
                            memory_space=pltpu.VMEM)
     ks_spec = pl.BlockSpec((1, bk, D), lambda b, jk, i: (b, jk, 0),
                            memory_space=pltpu.VMEM)
     ls_spec = pl.BlockSpec((1, bq, 1),
-                           lambda b, jk, i: (b, _qblk(jk, i), 0),
+                           lambda b, jk, i: (_qrow(b, i), _qblk2(jk, i),
+                                             0),
                            memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal, bq=bq,
                           bk=bk, nq=nq_eff, nq_total=nq,
                           mxu_dtype=mxu_dtype,
-                          chunk_q=ckq, window=window),
-        out_shape=(_sds((N, Tk, D), kp.dtype, vma),
-                   _sds((N, Tk, D), vp.dtype, vma)),
-        grid=(N, nk, nq_eff),
+                          chunk_q=ckq, window=window, group=G),
+        out_shape=(_sds((Nk, Tk, D), kp.dtype, vma),
+                   _sds((Nk, Tk, D), vp.dtype, vma)),
+        grid=(Nk, nk, nq_eff * G),
         in_specs=[qs_spec, ks_spec, ks_spec, qs_spec, ls_spec, ls_spec],
         out_specs=(ks_spec, ks_spec),
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
@@ -1081,24 +1107,12 @@ def _flash_diff_bwd(cfg, res, cts):
         g_lse = None
     if isinstance(g_out, SymbolicZero):  # lse-only losses (rare)
         g_out = jnp.zeros(out.shape, out.dtype)
-    kv_group = cfg[-1]
-    if kv_group == 1:
-        return _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg)
-    # GQA backward: expand K/V to one row per q head (the forward's
-    # zero-copy index maps have no transpose), run the plain backward,
-    # and fold each group's dK/dV contributions with an f32 sum — the
-    # exact transpose of the forward's row sharing
-    nk_heads = kp.shape[0]
-    kpe = jnp.repeat(kp, kv_group, axis=0)
-    vpe = jnp.repeat(vp, kv_group, axis=0)
-    dq, dk, dv = _flash_backward(qp, kpe, vpe, out, lse, g_out, g_lse,
-                                 cfg[:-1] + (1,))
-
-    def fold(d, dtype):
-        d = d.reshape(nk_heads, kv_group, *d.shape[1:])
-        return d.astype(jnp.float32).sum(axis=1).astype(dtype)
-
-    return dq, fold(dk, kp.dtype), fold(dv, vp.dtype)
+    # GQA and plain share ONE path: _flash_backward reads grouped K/V
+    # through b//G index maps (dq) and folds each group's dK/dV inside
+    # the dkv kernel's extended accumulation axis — K/V are never
+    # expanded and no group-sum pass runs outside (ADVICE r4; the
+    # forward's zero-copy row sharing, transposed)
+    return _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg)
 
 
 _flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
