@@ -602,10 +602,11 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     GROUPED-QUERY ATTENTION (GQA): when K/V arrive with FEWER packed
     heads than q — shape [Nk, Tk, D] with N % Nk == 0 — each K/V head
     serves N/Nk consecutive q heads (q row n reads K/V row
-    n // (N // Nk)).  Zero-copy on the forward path: only the kernel's
-    K/V block index maps change, no expansion touches HBM.  The
-    backward expands K/V (jnp.repeat) and lets autodiff's transpose of
-    the repeat produce the per-group dK/dV sums.
+    n // (N // Nk)).  Zero-copy on BOTH paths: the forward's K/V block
+    index maps share rows across the group, and the backward reads the
+    grouped K/V the same way (dq kernel, b//G maps) while the dkv
+    kernel folds the whole group's dK/dV on an extended accumulation
+    axis — no expansion touches HBM in either direction.
 
     Returns (out [N, T, D], lse [N, T] f32)."""
     N, T, D = qp.shape

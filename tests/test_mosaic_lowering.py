@@ -169,3 +169,57 @@ def test_flash_backward_lowers_through_mosaic():
         platforms=["tpu"])(arg, arg, arg)
     text = exp.mlir_module()
     _assert_mosaic(text)
+
+
+@pytest.mark.parametrize("which", ["allgather", "reduce_scatter",
+                                   "allreduce"])
+def test_selfring_lowers_through_mosaic(which):
+    """The single-device VIRTUAL self-ring (ring_size override — the
+    execute-the-artifact rung bench.py runs compiled on the chip) must
+    lower through Mosaic on a 1-member axis: real remote-DMA ops with
+    device_id = self, the extended V-step hop loop, and the ACK-window
+    semaphores all survive the TPU pipeline."""
+    from accl_tpu.ops import ring as R
+
+    V = 8
+    n = 512
+    mesh = AbstractMesh((1,), ("r",),
+                        axis_types=(jax.sharding.AxisType.Explicit,))
+    body = {
+        "allgather": lambda v: R.ring_all_gather_pallas(
+            v, "r", ring_size=V),
+        "reduce_scatter": lambda v: R.ring_reduce_scatter_pallas(
+            v, "r", ring_size=V),
+        "allreduce": lambda v: R.ring_all_reduce_pallas(
+            v, "r", ring_size=V),
+    }[which]
+    shape = {"allgather": (n, 128), "reduce_scatter": (V, n, 128),
+             "allreduce": (V * n, 128)}[which]
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(),
+                       out_specs=P(), check_vma=False)
+    x = jax.ShapeDtypeStruct(shape, jnp.float32,
+                             sharding=NamedSharding(mesh, P()))
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(x)
+    _assert_mosaic(exp.mlir_module())
+
+
+def test_flash_gqa_backward_lowers_through_mosaic():
+    """The r5 expansion-free GQA backward: grouped K/V via b//G index
+    maps (dq) and the G-extended accumulation axis with divmod q
+    row/block index maps (dkv) must survive the real TPU lowering."""
+    from accl_tpu.ops.flash import flash_attention_packed
+
+    N, G, T, D = 8, 2, 1024, 128
+    q = jax.ShapeDtypeStruct((N, T, D), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((G, T, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        # GQA is shape-driven on the packed entry: k/v carry G rows
+        return jnp.sum(flash_attention_packed(
+            q, k, v, causal=True,
+            kernel="resident").astype(jnp.float32))
+
+    exp = jax.export.export(
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))),
+        platforms=["tpu"])(q, kv, kv)
+    _assert_mosaic(exp.mlir_module())
