@@ -67,7 +67,12 @@ class TpuBuffer(BaseBuffer):
         self._jax_device = jax_device
         import jax
 
-        self._dev = jax.device_put(host, jax_device)
+        # copy: on the CPU rung device_put can ALIAS the host numpy
+        # (zero-copy), which would let un-synced host writes leak into
+        # "device" state — behavior real TPU HBM never has.  The copy
+        # keeps the emulation's sync semantics faithful (same reason
+        # sync_to_device copies).
+        self._dev = jax.device_put(host.copy(), jax_device)
 
     @property
     def dev(self):
