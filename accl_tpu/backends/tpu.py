@@ -120,7 +120,13 @@ class _TpuBufferSlice(BaseBuffer):
         import jax
         import jax.numpy as jnp
 
-        vals = jnp.asarray(self._parent.host[self._start:self._end])
+        # copy: jnp.asarray of a host numpy slice can ALIAS it on the
+        # CPU rung, and set_dev_range's full-overwrite path ADOPTS the
+        # array — the same fidelity hazard TpuBuffer.__init__ copies
+        # against (un-synced host writes must never leak into device
+        # state)
+        vals = jnp.asarray(
+            np.array(self._parent.host[self._start:self._end], copy=True))
         self._parent.set_dev_range(self._start, vals)
 
     def sync_from_device(self) -> None:

@@ -44,14 +44,16 @@ def matmul_context(jax, jnp):
 
 
 def make_variant(bq, bk, ck=None, qt=1, fd=False, cast=False,
-                 kernel="resident"):
-    """A schedule candidate closure over flash_attention_packed."""
+                 kernel="resident", sm=None):
+    """A schedule candidate closure over flash_attention_packed.
+    ``sm``: static_max pin (the r5 VPU-minimal schedule — drops the
+    max/alpha/clamp passes; exact within f32 range of the pin)."""
     from ..ops.flash import flash_attention_packed as fap
 
     def fn(x, kk, vv):
         return fap(x, kk, vv, causal=True, kernel=kernel, block_q=bq,
                    block_k=bk, chunk_k=ck, q_tiles=qt, fuse_denom=fd,
-                   kv_cast_scratch=cast)
+                   kv_cast_scratch=cast, static_max=sm)
     return fn
 
 

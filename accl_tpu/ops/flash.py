@@ -31,7 +31,8 @@ _LOG2E = 1.4426950408889634  # log2(e)
 _LN2 = 0.6931471805599453    # ln(2)
 
 
-def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
+def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype,
+                  static_max=None):
     """One online-softmax block fold shared by BOTH kernel schedules —
     the numerically delicate part (shift clamp so fully-masked rows
     don't produce exp(+big), masked-p zeroing, alpha rescale of the
@@ -55,14 +56,25 @@ def _softmax_fold(q, kb, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
     ones column and acc the matching accumulator column, so the row-sum
     of p rides the PV matmul on the MXU and the explicit `jnp.sum` VPU
     pass disappears — free where D pads to the same lane tile anyway
-    (D=64 -> 65 both pad to 128).  Returns (acc', m', None)."""
+    (D=64 -> 65 both pad to 128).  Returns (acc', m', None).
+
+    STATIC-MAX mode (`static_max` a float): probabilities are
+    exp2(s - static_max) with NO running max — the max reduction, the
+    shift clamp, the alpha rescale of acc/l and the masked-p re-zero
+    all disappear from the VPU budget (the fold is VPU-bound at
+    D=128: these passes are the measured ceiling).  Exact as long as
+    scaled logits stay within f32 range of the pin (|s - static_max|
+    < ~126; see flash_attention_packed docs).  m carries through
+    untouched; _finalize receives m = static_max (dead rows
+    NEG_INF)."""
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     return _fold_consume(s, vb, acc, m_prev, l_prev, mask=mask,
-                         mxu_dtype=mxu_dtype)
+                         mxu_dtype=mxu_dtype, static_max=static_max)
 
 
-def _fold_consume(s, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
+def _fold_consume(s, vb, acc, m_prev, l_prev, *, mask, mxu_dtype,
+                  static_max=None):
     """The softmax/PV half of the fold, consuming a PRECOMPUTED score
     block `s` [bq, bk] (raw, unmasked).  Split out so the skewed
     schedule can issue block j+1's QK^T before consuming block j's
@@ -81,6 +93,17 @@ def _fold_consume(s, vb, acc, m_prev, l_prev, *, mask, mxu_dtype):
             # sliding window: row r attends cols (r-window, r]
             keep = keep & (rows - cols < window)
         s = jnp.where(keep, s, NEG_INF)
+    if static_max is not None:
+        # static pin (see _softmax_fold): exp2(NEG_INF - pin) flushes
+        # to +0.0 in f32 — masked cells need no re-zero, dead rows
+        # produce l = 0 (the _finalize guard)
+        p = jnp.exp2(s - static_max)
+        l_new = (None if l_prev is None
+                 else l_prev + jnp.sum(p, axis=-1, keepdims=True))
+        acc_new = acc + jax.lax.dot_general(
+            p.astype(mxu_dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_prev, l_new
     m_blk = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_blk)
     # fully-masked block rows keep m at NEG_INF; exp2(s - NEG_INF) would
@@ -254,7 +277,8 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
 def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
                            scale: float, causal: bool, block_q: int,
                            block_k: int, chunk_k: int, T: int, mxu_dtype,
-                           q_tiles: int = 1, fuse_denom: bool = False):
+                           q_tiles: int = 1, fuse_denom: bool = False,
+                           static_max=None):
     """K/V-resident schedule: the whole K/V row for this batch-head sits
     in VMEM (fetched ONCE — the grid variant refetches it per q-block,
     which is the streaming bound at small-to-medium T).
@@ -340,7 +364,8 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
                         if masked else None)
                 nxt.append(_softmax_fold(qs[t], kb, vb, acc, m_prev,
                                          l_prev, mask=mask,
-                                         mxu_dtype=mxu_dtype))
+                                         mxu_dtype=mxu_dtype,
+                                         static_max=static_max))
             carries = tuple(nxt)
         return carries
 
@@ -355,6 +380,11 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
         acc, m, l = carry[t]
         if fuse_denom:
             acc, l = acc[:, :D], acc[:, D:]
+        if static_max is not None:
+            # the carry's m was never updated — reconstruct the value
+            # _finalize's lse/dead-row algebra expects: the pin for
+            # live rows, NEG_INF for fully-dead rows (l stayed 0)
+            m = jnp.where(l == 0.0, NEG_INF, static_max)
         _finalize(acc, m, l, o_ref, lse_ref,
                   row_off=None if q_tiles == 1 else t * tq)
 
@@ -464,7 +494,7 @@ def _snap_chunk(req: int, blk: int) -> int:
 def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
                       interpret, mxu_dtype, kernel, chunk_k,
                       kv_cast_scratch, q_tiles, fuse_denom,
-                      window=None):
+                      window=None, static_max=None):
     """Static schedule resolution shared by the head-packed and BTHD
     entries: block shrinking, chunk snapping, kernel/auto selection and
     the tuned-auto q_tiles/fuse_denom choices.  Returns the cfg tuple
@@ -587,14 +617,30 @@ def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
             raise ValueError("window is a grid-schedule option "
                              f"(kernel={kernel!r})")
         fuse_denom = False    # resident-only option can't apply
+    if static_max is not None:
+        if kernel != "resident":
+            if auto_kernel:
+                # same contract as the fuse_denom auto-drop: under
+                # kernel="auto" a tuned hint drops gracefully when the
+                # schedule lands elsewhere (distributed callers forward
+                # opts without knowing each shard's size)
+                static_max = None
+            else:
+                # explicit non-resident kernel + the resident-only
+                # option is a contradiction — silently running the
+                # dynamic-max fold would record fake sweep results
+                raise ValueError("static_max is a resident-schedule "
+                                 f"option (kernel={kernel!r})")
+        else:
+            static_max = float(static_max)
     return (causal, bq, bk, ck, interpret, mxu_dtype, kernel,
-            needs_cast, q_tiles, fuse_denom, window)
+            needs_cast, q_tiles, fuse_denom, window, static_max)
 
 
 def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
                        mxu_dtype, kernel, chunk_k=None,
                        kv_cast_scratch=False, q_tiles=None,
-                       fuse_denom=None, window=None):
+                       fuse_denom=None, window=None, static_max=None):
     """Core entry on HEAD-PACKED operands [N, T, D] (N = batch x heads
     flattened — the splash-attention layout).  This is the zero-copy
     path: no transposes touch HBM; callers that keep activations packed
@@ -625,7 +671,7 @@ def _flash_call_packed(qp, kp, vp, causal, block_q, block_k, interpret,
     cfg = _resolve_schedule(T, Tk, D, qp.dtype, causal, block_q,
                             block_k, interpret, mxu_dtype, kernel,
                             chunk_k, kv_cast_scratch, q_tiles,
-                            fuse_denom, window) + (kv_group,)
+                            fuse_denom, window, static_max) + (kv_group,)
     return _flash_packed_diff(qp, kp, vp, cfg)
 
 
@@ -636,7 +682,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, kernel, needs_cast,
-     q_tiles, fuse_denom, window, kv_group) = cfg
+     q_tiles, fuse_denom, window, static_max, kv_group) = cfg
     g = kv_group  # q-heads per K/V head (1 = plain MHA)
     N, T, D = qp.shape
     Tk = kp.shape[1]
@@ -680,7 +726,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
                 _flash_kernel_resident, scale=scale, causal=causal,
                 block_q=bq, block_k=bk, chunk_k=ck, T=Tk,
                 mxu_dtype=mxu_dtype, q_tiles=q_tiles,
-                fuse_denom=fuse_denom)
+                fuse_denom=fuse_denom, static_max=static_max)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec, kv_spec, kv_spec],
@@ -973,7 +1019,7 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
     from jax.experimental.pallas import tpu as pltpu
 
     (causal, bq, bk, ck, interpret, mxu_dtype, _kernel, _nc, _qt,
-     _fd, window, kvg) = cfg
+     _fd, window, _sm, kvg) = cfg
     N, T, D = qp.shape
     Tk = kp.shape[1]
     G = kvg if kvg else 1          # q heads per K/V head (GQA group)
@@ -1209,7 +1255,8 @@ def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
                                     "chunk_k", "kv_cast_scratch",
-                                    "q_tiles", "fuse_denom", "window"))
+                                    "q_tiles", "fuse_denom", "window",
+                                    "static_max"))
 def flash_attention_packed(q, k, v, causal: bool = False,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False,
@@ -1218,7 +1265,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
                            kv_cast_scratch: bool = False,
                            q_tiles: int | None = None,
                            fuse_denom: bool | None = None,
-                           window: int | None = None):
+                           window: int | None = None,
+                           static_max: float | None = None):
     """Zero-copy entry on HEAD-PACKED operands: q, k, v are [N, T, D]
     with N = batch x heads flattened (the splash-attention layout).
     Unlike the [B, T, H, D] wrapper this moves NO bytes outside the
@@ -1237,11 +1285,22 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     module: the plain single fold chain over whole K blocks, with the
     fused denominator exactly where its ones column is lane-tile-free;
     explicit values (incl. q_tiles=1 / fuse_denom=False) always win.
-    See the kernel docstrings."""
+
+    `static_max` (resident only, OPT-IN) pins the softmax shift to a
+    constant instead of the running row max: the max reduction, shift
+    clamp, alpha rescale and masked-p re-zero leave the VPU budget —
+    the fold's measured bottleneck at D=128.  EXACT (same p/l ratios,
+    same lse) whenever every scaled logit s = q.k * log2e/sqrt(D)
+    stays within f32 exponent range of the pin: overflow at
+    s > static_max + 127, underflow only for weights ~2^-149 below
+    the pin (numerically irrelevant).  A pin of 40 covers |logits|
+    up to ~27 nats — far beyond trained-model attention logits; it is
+    NOT safe for adversarially scaled inputs, which is why the
+    dynamic-max fold stays the default.  See the kernel docstrings."""
     out, _lse = _flash_call_packed(q, k, v, causal, block_q, block_k,
                                    interpret, mxu_dtype, kernel, chunk_k,
                                    kv_cast_scratch, q_tiles, fuse_denom,
-                                   window)
+                                   window, static_max)
     return out
 
 
@@ -1249,7 +1308,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
                                     "chunk_k", "kv_cast_scratch",
-                                    "q_tiles", "fuse_denom", "window"))
+                                    "q_tiles", "fuse_denom", "window",
+                                    "static_max"))
 def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                block_q: int = 256, block_k: int = 512,
                                interpret: bool = False,
@@ -1258,11 +1318,12 @@ def flash_attention_packed_lse(q, k, v, causal: bool = False,
                                kv_cast_scratch: bool = False,
                                q_tiles: int | None = None,
                                fuse_denom: bool | None = None,
-                               window: int | None = None):
+                               window: int | None = None,
+                               static_max: float | None = None):
     """Head-packed [N, T, D] variant returning (out [N, T, D],
     lse [N, T] fp32) — the distributed callers' entry (ring attention
     folds shard partials via the lse)."""
     return _flash_call_packed(q, k, v, causal, block_q, block_k,
                               interpret, mxu_dtype, kernel, chunk_k,
                               kv_cast_scratch, q_tiles, fuse_denom,
-                              window)
+                              window, static_max)

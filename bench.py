@@ -348,6 +348,11 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             "resident_bq512": make_variant(512, 512),
             "resident_bq512_qt2": make_variant(512, 512, qt=2),
             "resident_bq512_bk1024": make_variant(512, 1024),
+            # r5 static-max pin: drops the max/alpha/clamp VPU passes
+            # (the measured fold bottleneck) — a decomposition change,
+            # not another block shape
+            "resident_sm40": make_variant(256, 512, sm=40.0),
+            "resident_bq512_sm40": make_variant(512, 512, sm=40.0),
         }
 
         # MXU-peak context, interleaved: a big bf16 matmul is the
@@ -389,6 +394,8 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             "resident": make_variant(256, 512),
             "resident_fd": make_variant(256, 512, fd=True),
             "resident_qt2_fd": make_variant(256, 512, qt=2, fd=True),
+            # static pin + fused denom: no VPU reductions in the fold
+            "resident_fd_sm40": make_variant(256, 512, fd=True, sm=40.0),
         }
 
         # bf16-input lane: the flagship TRAINS in bf16 activations

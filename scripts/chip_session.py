@@ -61,12 +61,19 @@ D128_SPECS = {
     "bq512_bk512_cast": dict(bq=512, bk=512, cast=True),
     "bq256_bk512_skew": dict(bq=256, bk=512, kernel="resident_skew"),
     "bq512_bk512_qt2_ck256": dict(bq=512, bk=512, ck=256, qt=2),
+    # r5 static-max pin: the VPU-minimal fold (no max/alpha/clamp
+    # passes) — the decomposition change, not another block shape
+    "bq256_bk512_sm40": dict(bq=256, bk=512, sm=40.0),
+    "bq512_bk512_sm40": dict(bq=512, bk=512, sm=40.0),
+    "bq256_bk512_sm40_qt2": dict(bq=256, bk=512, sm=40.0, qt=2),
 }
 D64_SPECS = {
     "d64_resident": dict(bq=256, bk=512),
     "d64_resident_fd": dict(bq=256, bk=512, fd=True),
     "d64_bq512_fd": dict(bq=512, bk=512, fd=True),
     "d64_resident_qt2_fd": dict(bq=256, bk=512, qt=2, fd=True),
+    # static pin + fused denom: no VPU reductions left in the fold
+    "d64_resident_fd_sm40": dict(bq=256, bk=512, fd=True, sm=40.0),
 }
 
 
@@ -74,7 +81,8 @@ def _build(make_variant, specs):
     return {name: make_variant(sp["bq"], sp["bk"], ck=sp.get("ck"),
                                qt=sp.get("qt", 1), fd=sp.get("fd", False),
                                cast=sp.get("cast", False),
-                               kernel=sp.get("kernel", "resident"))
+                               kernel=sp.get("kernel", "resident"),
+                               sm=sp.get("sm"))
             for name, sp in specs.items()}
 
 

@@ -776,3 +776,71 @@ def test_flash_gqa_window_grads_match_banded_dense():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_flash_static_max_matches_dynamic():
+    """static_max (pinned softmax shift, resident schedule) must be
+    numerically interchangeable with the dynamic-max fold: same out,
+    same lse, same gradients (the backward reconstructs p from the
+    EXACT lse either way)."""
+    from accl_tpu.ops.flash import flash_attention_packed_lse
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(53)
+    q, k, v = (jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+               for _ in range(3))
+
+    def run(**kw):
+        return flash_attention_packed_lse(
+            q, k, v, causal=True, block_q=64, block_k=64,
+            interpret=True, mxu_dtype=jnp.float32, kernel="resident",
+            **kw)
+
+    o_dyn, lse_dyn = run()
+    o_st, lse_st = run(static_max=40.0)
+    np.testing.assert_allclose(np.asarray(o_st), np.asarray(o_dyn),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse_st), np.asarray(lse_dyn),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(fn_kw, q, k, v):
+        o, _ = flash_attention_packed_lse(
+            q, k, v, causal=True, block_q=64, block_k=64,
+            interpret=True, mxu_dtype=jnp.float32, kernel="resident",
+            **fn_kw)
+        return jnp.sum(o * o)
+
+    g_dyn = jax.grad(lambda *a: loss({}, *a), argnums=(0, 1, 2))(q, k, v)
+    g_st = jax.grad(lambda *a: loss({"static_max": 40.0}, *a),
+                    argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_st, g_dyn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_static_max_fused_denom_composes():
+    """static_max + fuse_denom: the row-sum rides the PV matmul AND
+    the max/alpha passes vanish — the minimal-VPU D=64 schedule."""
+    from accl_tpu.ops.flash import flash_attention_packed
+    N, T, D = 2, 256, 64
+    rng = np.random.default_rng(54)
+    q, k, v = (jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+               for _ in range(3))
+    o_dyn = flash_attention_packed(q, k, v, causal=True, block_q=64,
+                                   block_k=64, interpret=True,
+                                   mxu_dtype=jnp.float32,
+                                   kernel="resident")
+    o_st = flash_attention_packed(q, k, v, causal=True, block_q=64,
+                                  block_k=64, interpret=True,
+                                  mxu_dtype=jnp.float32,
+                                  kernel="resident", fuse_denom=True,
+                                  static_max=40.0)
+    np.testing.assert_allclose(np.asarray(o_st), np.asarray(o_dyn),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_static_max_requires_resident():
+    from accl_tpu.ops.flash import flash_attention_packed
+    q = jnp.zeros((1, 128, 32), jnp.float32)
+    with pytest.raises(ValueError, match="static_max"):
+        flash_attention_packed(q, q, q, causal=True, kernel="grid",
+                               interpret=True, static_max=40.0)

@@ -223,3 +223,16 @@ def test_flash_gqa_backward_lowers_through_mosaic():
         jax.jit(jax.grad(loss, argnums=(0, 1, 2))),
         platforms=["tpu"])(q, kv, kv)
     _assert_mosaic(exp.mlir_module())
+
+
+def test_flash_static_max_lowers_through_mosaic():
+    """The r5 static-max resident schedule (pinned softmax shift, no
+    max/alpha VPU passes) must lower for the real TPU target."""
+    from accl_tpu.ops.flash import flash_attention_packed
+
+    arg = jax.ShapeDtypeStruct((4, 2048, 128), jnp.float32)
+    exp = jax.export.export(
+        jax.jit(lambda q, k, v: flash_attention_packed(
+            q, k, v, causal=True, kernel="resident", static_max=40.0)),
+        platforms=["tpu"])(arg, arg, arg)
+    _assert_mosaic(exp.mlir_module())

@@ -516,7 +516,6 @@ def test_async_window_batches_and_raw_guard():
         sizes[len(items)] += 1
         return orig_batch(self, items)
 
-    orig = TpuEngine._exec_gang_batch
     TpuEngine._exec_gang_batch = spy
     try:
         with TpuWorld(4) as w:
@@ -556,7 +555,7 @@ def test_async_window_batches_and_raw_guard():
 
             assert all(w.run(worker))
     finally:
-        TpuEngine._exec_gang_batch = orig
+        TpuEngine._exec_gang_batch = orig_batch
     # batches must have formed in the independent window phase
     assert sum(k * v for k, v in sizes.items()) > 0, sizes
     # and no batch may have fused the dependent chain: whenever a
