@@ -832,7 +832,12 @@ def main() -> None:
         return
 
     result = None
-    run_id = f"run-{os.getpid()}-{int(time.time())}"
+    # ACCL_BENCH_RUN_ID pins the stage ledger across bench invocations:
+    # a retry loop knocking on a blocked chip accumulates stages over
+    # hours instead of restarting per invocation (each invocation's
+    # attempts already share the ledger via this id)
+    run_id = (os.environ.get("ACCL_BENCH_RUN_ID")
+              or f"run-{os.getpid()}-{int(time.time())}")
     for i, budget in enumerate(TPU_ATTEMPT_TIMEOUTS):
         print(f"[bench] TPU attempt {i + 1}/{len(TPU_ATTEMPT_TIMEOUTS)} "
               f"(budget {budget}s)", file=sys.stderr)
