@@ -1167,7 +1167,8 @@ _flash_packed_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd,
 
 
 def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
-                kernel, q_tiles=None, fuse_denom=None, window=None):
+                kernel, q_tiles=None, fuse_denom=None, window=None,
+                static_max=None):
     """BTHD-layout wrapper: packs [B,T,H,D] -> [B*H,T,D] around the
     core call (one HBM transpose per operand direction; XLA hoists the
     K/V packs out of iteration loops — callers on the hot path should
@@ -1197,7 +1198,8 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
     out, lse = _flash_call_packed(pack(q), pack(k), pack(v), causal,
                                   block_q, block_k, interpret, mxu_dtype,
                                   kernel, q_tiles=q_tiles,
-                                  fuse_denom=fuse_denom, window=window)
+                                  fuse_denom=fuse_denom, window=window,
+                                  static_max=static_max)
     return (out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
             lse.reshape(B, H, T))
 
@@ -1205,13 +1207,15 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret, mxu_dtype,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "q_tiles", "fuse_denom", "window"))
+                                    "q_tiles", "fuse_denom", "window",
+                                    "static_max"))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 512, interpret: bool = False,
                     mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                     q_tiles: int | None = None,
                     fuse_denom: bool | None = None,
-                    window: int | None = None):
+                    window: int | None = None,
+                    static_max: float | None = None):
     """q, k, v: [B, T, H, D] -> [B, T, H, D] (self-attention, optional
     causal mask).  T must be divisible by the (auto-shrunk) block sizes.
 
@@ -1236,19 +1240,22 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "mxu_dtype", "kernel",
-                                    "q_tiles", "fuse_denom", "window"))
+                                    "q_tiles", "fuse_denom", "window",
+                                    "static_max"))
 def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 256,
                         block_k: int = 512, interpret: bool = False,
                         mxu_dtype=jnp.bfloat16, kernel: str = "auto",
                         q_tiles: int | None = None,
                         fuse_denom: bool | None = None,
-                        window: int | None = None):
+                        window: int | None = None,
+                        static_max: float | None = None):
     """Like :func:`flash_attention` but also returns the log-sum-exp
     statistics: (out [B, T, H, D], lse [B, H, T] fp32).  Partial results
     over different K/V shards combine exactly via lse weighting — the
     cross-shard fold ring attention applies around the ICI ring."""
     return _flash_call(q, k, v, causal, block_q, block_k, interpret,
-                       mxu_dtype, kernel, q_tiles, fuse_denom, window)
+                       mxu_dtype, kernel, q_tiles, fuse_denom, window,
+                       static_max)
 
 
 @functools.partial(jax.jit,

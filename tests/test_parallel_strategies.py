@@ -651,3 +651,33 @@ def test_ulysses_gqa_aware_attn_fn_keeps_grouped_kv():
     # grouped layout reached the callable: G/P heads, not H/P
     assert seen_kv_heads and set(seen_kv_heads) == {G // P_sp}
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_flash_opts_static_max():
+    """static_max rides flash_opts through the SP ring path (BTHD
+    entries gained the option in r5) and matches the dynamic fold."""
+    import jax
+
+    from accl_tpu.parallel.mesh import make_mesh
+    from accl_tpu.parallel.ring_attention import ring_attention
+
+    mesh = make_mesh(sp=4)
+    B, Tl, H, D = 1, 32, 2, 32
+    rng = np.random.default_rng(61)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, 4 * Tl, H, D)),
+                             jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    spec = P(None, "sp", None, None)
+
+    def run(opts):
+        f = jax.jit(jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis="sp",
+                                           causal=True, impl="flash",
+                                           flash_opts=opts),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False))
+        return np.asarray(f(q, k, v))
+
+    base = run(None)
+    sm = run({"static_max": 40.0, "kernel": "resident"})
+    np.testing.assert_allclose(sm, base, rtol=2e-4, atol=2e-5)
