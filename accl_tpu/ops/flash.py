@@ -188,7 +188,7 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                        block_k: int, chunk_k: int, nk: int,
                        nk_total: int | None = None, mxu_dtype,
                        kv_resident: bool = False, q_tiles: int = 1,
-                       window=None):
+                       window=None, static_max=None):
     """Streaming schedule: grid (bh, q_block, k_block); K/V blocks
     arrive per grid cell; the accumulator lives in VMEM scratch across
     the sequential k steps of one (bh, q_block) cell.  Each arriving
@@ -251,7 +251,8 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                 _softmax_fold(qs[t], kb, vb, *carries[t],
                               mask=((iq * block_q + t * tq, off, window)
                                     if masked else None),
-                              mxu_dtype=mxu_dtype)
+                              mxu_dtype=mxu_dtype,
+                              static_max=static_max)
                 for t in range(q_tiles)]
         for t, (a, m, l) in enumerate(carries):
             acc[pl.ds(t * tq, tq), :] = a
@@ -271,7 +272,15 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
 
     @pl.when(j == nk - 1)
     def _fin():
-        _finalize(acc[:], m_s[:], l_s[:], o_ref, lse_ref)
+        if static_max is None:
+            m_fin = m_s[:]
+        else:
+            # the m scratch was never updated (see _softmax_fold's
+            # static mode): reconstruct the pin for live rows and
+            # NEG_INF for fully-dead ones so _finalize's lse/dead-row
+            # algebra stays shared
+            m_fin = jnp.where(l_s[:] == 0.0, NEG_INF, static_max)
+        _finalize(acc[:], m_fin, l_s[:], o_ref, lse_ref)
 
 
 def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
@@ -618,21 +627,13 @@ def _resolve_schedule(T, Tk, D, qdtype, causal, block_q, block_k,
                              f"(kernel={kernel!r})")
         fuse_denom = False    # resident-only option can't apply
     if static_max is not None:
-        if kernel != "resident":
-            if auto_kernel:
-                # same contract as the fuse_denom auto-drop: under
-                # kernel="auto" a tuned hint drops gracefully when the
-                # schedule lands elsewhere (distributed callers forward
-                # opts without knowing each shard's size)
-                static_max = None
-            else:
-                # explicit non-resident kernel + the resident-only
-                # option is a contradiction — silently running the
-                # dynamic-max fold would record fake sweep results
-                raise ValueError("static_max is a resident-schedule "
-                                 f"option (kernel={kernel!r})")
-        else:
-            static_max = float(static_max)
+        if kernel == "resident_skew":
+            # the skew schedule's carried score block assumes the
+            # dynamic fold; silently running it would record fake
+            # sweep results (same contract as its other options)
+            raise ValueError("static_max is not supported by the "
+                             "resident_skew schedule")
+        static_max = float(static_max)
     return (causal, bq, bk, ck, interpret, mxu_dtype, kernel,
             needs_cast, q_tiles, fuse_denom, window, static_max)
 
@@ -778,7 +779,8 @@ def _flash_forward_impl(qp, kp, vp, cfg):
             _flash_kernel_grid, scale=scale, causal=causal, block_q=bq,
             block_k=bk, chunk_k=ck, nk=nk_eff, nk_total=nk,
             mxu_dtype=mxu_dtype,
-            kv_resident=kv_resident, q_tiles=q_tiles, window=window)
+            kv_resident=kv_resident, q_tiles=q_tiles, window=window,
+            static_max=static_max)
         out, lse = pl.pallas_call(
             kfn, out_shape=out_shapes, grid=grid,
             in_specs=[q_spec3, kv_spec, kv_spec],
@@ -1293,7 +1295,8 @@ def flash_attention_packed(q, k, v, causal: bool = False,
     fused denominator exactly where its ones column is lane-tile-free;
     explicit values (incl. q_tiles=1 / fuse_denom=False) always win.
 
-    `static_max` (resident only, OPT-IN) pins the softmax shift to a
+    `static_max` (OPT-IN; resident and grid schedules) pins the
+    softmax shift to a
     constant instead of the running row max: the max reduction, shift
     clamp, alpha rescale and masked-p re-zero leave the VPU budget —
     the fold's measured bottleneck at D=128.  EXACT (same p/l ratios,

@@ -838,9 +838,24 @@ def test_flash_static_max_fused_denom_composes():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_static_max_requires_resident():
+def test_flash_static_max_grid_matches_and_skew_rejects():
+    # grid (the long-context/window schedule) supports the pin too;
+    # resident_skew's carried-score fold does not
     from accl_tpu.ops.flash import flash_attention_packed
-    q = jnp.zeros((1, 128, 32), jnp.float32)
+    N, T, D = 2, 256, 32
+    rng = np.random.default_rng(55)
+    q, k, v = (jnp.asarray(rng.standard_normal((N, T, D)), jnp.float32)
+               for _ in range(3))
+    o_dyn = flash_attention_packed(q, k, v, causal=True, block_q=64,
+                                   block_k=64, interpret=True,
+                                   mxu_dtype=jnp.float32, kernel="grid")
+    o_st = flash_attention_packed(q, k, v, causal=True, block_q=64,
+                                  block_k=64, interpret=True,
+                                  mxu_dtype=jnp.float32, kernel="grid",
+                                  static_max=40.0)
+    np.testing.assert_allclose(np.asarray(o_st), np.asarray(o_dyn),
+                               rtol=2e-5, atol=2e-5)
     with pytest.raises(ValueError, match="static_max"):
-        flash_attention_packed(q, q, q, causal=True, kernel="grid",
-                               interpret=True, static_max=40.0)
+        flash_attention_packed(q, k, v, causal=True,
+                               kernel="resident_skew", interpret=True,
+                               static_max=40.0)
