@@ -1235,7 +1235,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     e.g. D=64)."""
     out, _lse = _flash_call(q, k, v, causal, block_q, block_k, interpret,
                             mxu_dtype, kernel, q_tiles, fuse_denom,
-                            window)
+                            window, static_max)
     return out
 
 

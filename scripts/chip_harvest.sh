@@ -15,13 +15,13 @@ NAP=180
 bench_complete() {
   python - <<EOF
 import json, sys
+from bench import ALL_STAGES  # one completeness definition (bench.py)
 try:
     with open("bench/results/bench_stages.json") as f:
         led = json.load(f)
     stages = set(led.get("stages", {}))
     ok = (led.get("run_id") == "$RUN_ID"
-          and {"headline", "flash", "compression", "selfring",
-               "tpu_tests"} <= stages)
+          and set(ALL_STAGES) <= stages)
 except Exception:
     ok = False
 sys.exit(0 if ok else 1)
