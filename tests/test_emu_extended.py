@@ -309,3 +309,36 @@ def test_p2p_buffer_local_copy(world):
         np.testing.assert_array_equal(p2p.host, data)
 
     world.run(fn)
+
+
+def test_descriptor_memo_survives_address_reuse():
+    """The driver's _build memo keys on (address, dtype, host-only) per
+    buffer: the emulator's first-fit allocator REUSES freed addresses,
+    so an address-only key could serve a stale fp32 arithcfg for a
+    recycled address holding f16 data — silent wrong-lane reduction
+    with retcode 0 (r5 review finding; this is the regression lock)."""
+    with EmuWorld(nranks=2) as world:
+        def worker(accl, rank):
+            n = 256
+            s32 = accl.create_buffer_like(
+                np.full(n, float(rank + 1), np.float32))
+            r32 = accl.create_buffer(n, np.float32)
+            accl.allreduce(s32, r32, n, ReduceFunction.SUM)
+            np.testing.assert_allclose(r32.host, 3.0)
+            a_s, a_r = s32.address, r32.address
+            s32.free(); r32.free()
+            # the first-fit allocator hands the freed span back: the
+            # new f16 operand lands at the OLD fp32 operand's address
+            # (the half-size f16 result lands inside the span's
+            # remainder — address reuse is the hazard, exact span
+            # geometry is not)
+            s16 = accl.create_buffer_like(
+                np.full(n, float(rank + 1), np.float16))
+            r16 = accl.create_buffer(n, np.float16)
+            assert s16.address == a_s, \
+                "allocator no longer reuses addresses; test needs a new way"
+            accl.allreduce(s16, r16, n, ReduceFunction.SUM)
+            np.testing.assert_allclose(r16.host.astype(np.float32), 3.0)
+            return True
+
+        assert all(world.run(worker))
