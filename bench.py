@@ -99,7 +99,8 @@ def _bank_stage(led: dict, name: str, data: dict) -> None:
 
 #: stages every complete TPU record carries, in execution order —
 #: headline first (it is the metric of record), then the detail lanes
-ALL_STAGES = ("headline", "flash", "compression", "selfring", "tpu_tests")
+ALL_STAGES = ("headline", "flash", "flash_variants", "compression",
+              "selfring", "tpu_tests")
 
 
 def _assemble(stages: dict) -> dict | None:
@@ -253,6 +254,11 @@ def _measure(platform: str) -> dict:
                     _flash_stage(jax, jnp, timed_chain))
         print(json.dumps(_assemble(stages)), flush=True)
 
+    if "flash_variants" not in stages:
+        _bank_stage(led, "flash_variants",
+                    _flash_variants_stage(jax, jnp, timed_chain))
+        print(json.dumps(_assemble(stages)), flush=True)
+
     if "compression" not in stages:
         _bank_stage(led, "compression",
                     _compression_stage(jax, jnp, timed_chain_ab))
@@ -308,103 +314,60 @@ def _run_tpu_only_tests() -> str:
         return f"{type(e).__name__}: {e}"
 
 
+def _flash_operands(jax, jnp):
+    """Shared operand/context pack for the two flash stages (split so a
+    short claim window can bank the core record before the variant
+    sweep's extra compiles; each stage re-measures the matmul peak
+    interleaved in its OWN windows — only same-window ratios mean
+    anything on the shared chip)."""
+    B, T, H, D = 4, 2048, 8, 64
+    H2, D2 = 4, 128
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(k1, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(k2, (B, T, H, D), jnp.float32)
+    v = jax.random.normal(k3, (B, T, H, D), jnp.float32)
+    q2 = jax.random.normal(k1, (B, T, H2, D2), jnp.float32)
+    k2_ = jax.random.normal(k2, (B, T, H2, D2), jnp.float32)
+    v2 = jax.random.normal(k3, (B, T, H2, D2), jnp.float32)
+    # head-packed operands (the zero-transpose entries; transposes
+    # measured ~free on this chip, so numbers stay comparable)
+    pk = lambda x, h, d: x.transpose(0, 2, 1, 3).reshape(B * h, T, d)
+    ops = {
+        "B": B, "T": T, "H": H, "D": D, "H2": H2, "D2": D2,
+        "q": q, "k": k, "v": v, "q2": q2, "k2": k2_, "v2": v2,
+        "q2p": pk(q2, H2, D2), "k2p": pk(k2_, H2, D2),
+        "v2p": pk(v2, H2, D2),
+        "q1p": pk(q, H, D), "k1p": pk(k, H, D), "v1p": pk(v, H, D),
+        # causal: ~half of the 4*B*H*T^2*D matmul flops
+        "flops": 4 * B * H * T * T * D / 2,
+        "mm_n": 4096,
+    }
+    ka, kb = jax.random.split(jax.random.PRNGKey(7))
+    ops["ma"] = jax.random.normal(ka, (4096, 4096), jnp.bfloat16)
+    ops["mb"] = jax.random.normal(kb, (4096, 4096), jnp.bfloat16)
+    ops["mm"] = lambda x, y: (x @ y).astype(jnp.bfloat16)
+    return ops
+
+
 def _flash_stage(jax, jnp, timed_chain) -> dict:
-    """Compiled-on-TPU runs of the flash-attention kernels, measured
-    with the SAME chained-iteration + sync-subtraction methodology as
-    the headline metric (round 2 recorded single-call dispatch
-    latencies here, which looked like evidence and wasn't).
-    Best-effort — failures are recorded, not fatal."""
+    """CORE flash record: the historical BTHD entries (d64 + d128), the
+    interleaved matmul peak, the verified fwd+bwd composite, and the
+    splash-attention external anchor — measured with the SAME
+    chained-iteration + sync-subtraction methodology as the headline
+    metric (round 2 recorded single-call dispatch latencies here,
+    which looked like evidence and wasn't).  The schedule-candidate
+    sweep lives in _flash_variants_stage so a short window still banks
+    this record.  Best-effort — failures are recorded, not fatal."""
     detail: dict = {}
     try:
         from accl_tpu.ops.flash import flash_attention
-        B, T, H, D = 4, 2048, 8, 64
-        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
-        q = jax.random.normal(k1, (B, T, H, D), jnp.float32)
-        k = jax.random.normal(k2, (B, T, H, D), jnp.float32)
-        v = jax.random.normal(k3, (B, T, H, D), jnp.float32)
+
+        o = _flash_operands(jax, jnp)
+        B, T = o["B"], o["T"]
+        flops, mm_n = o["flops"], o["mm_n"]
 
         def fa(x, kk, vv):  # chained: output feeds the next queries
             return flash_attention(x, kk, vv, causal=True, interpret=False)
-
-        # D=128 candidate schedules, measured on the live chip each
-        # round: the best lands in the round record with its name, so
-        # schedule selection is tracked per chip generation instead of
-        # hardcoded.  Candidate construction is shared with the
-        # live-chip tuner scripts so methodology fixes land once
-        # (flash_sweep docstring).
-        from accl_tpu.bench.flash_sweep import make_variant
-
-        # candidate set = the honest-timing Pareto front (min-RTT
-        # harness r04 sweeps): the plain chain at bq256 and bq512, the
-        # two-chain q-tile interleave at bq512 (statistically tied with
-        # plain across windows — kept so each round's record shows the
-        # live ordering), and the bk1024 row variant.  Split folds
-        # (chunk_k < block_k), qt4, fused-denominator at D=128 (the
-        # ones-extended V pads 129 -> 256 lanes, doubling PV), and the
-        # skewed score-carry schedule all measured consistently slower
-        # under honest timing and are out.
-        d128_variants = {
-            "resident": make_variant(256, 512),
-            "resident_bq512": make_variant(512, 512),
-            "resident_bq512_qt2": make_variant(512, 512, qt=2),
-            "resident_bq512_bk1024": make_variant(512, 1024),
-            # r5 static-max pin: drops the max/alpha/clamp VPU passes
-            # (the measured fold bottleneck) — a decomposition change,
-            # not another block shape
-            "resident_sm40": make_variant(256, 512, sm=40.0),
-            "resident_bq512_sm40": make_variant(512, 512, sm=40.0),
-        }
-
-        # MXU-peak context, interleaved: a big bf16 matmul is the
-        # practical ceiling of this chip's systolic array
-        mm_n = 4096
-        ka, kb = jax.random.split(jax.random.PRNGKey(7))
-        ma = jax.random.normal(ka, (mm_n, mm_n), jnp.bfloat16)
-        mb = jax.random.normal(kb, (mm_n, mm_n), jnp.bfloat16)
-        mm = lambda x, y: (x @ y).astype(jnp.bfloat16)
-
-        # interleave manually (timed_chain_ab shares one input; the two
-        # workloads here have different operand shapes).  10 rounds:
-        # contention windows on this shared chip last minutes and can
-        # depress identical kernels several-fold (readings ABOVE peak,
-        # e.g. "557 TFLOPs" matmul, were the old median-RTT subtraction
-        # artifact — fixed in bench/timing.py), so the best-window
-        # estimator needs enough rounds to straddle a window boundary.
-        # Iteration counts put >= ~10 ms of device work in one dispatch
-        # so the RTT jitter is amortized away.
-        # D=128 variant (same flops: H halved): the MXU-native head dim —
-        # at D=64 the contraction uses half the systolic array and the
-        # softmax VPU passes dominate, so this shows the kernel's
-        # ceiling when the model shape cooperates
-        H2, D2 = 4, 128
-        q2 = jax.random.normal(k1, (B, T, H2, D2), jnp.float32)
-        k2_ = jax.random.normal(k2, (B, T, H2, D2), jnp.float32)
-        v2 = jax.random.normal(k3, (B, T, H2, D2), jnp.float32)
-        # head-packed operands for the schedule candidates (the
-        # zero-transpose entry; transposes measured ~free on this chip,
-        # so numbers stay comparable with the BTHD wrapper)
-        pk = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H2, T, D2)
-        q2p, k2p, v2p = pk(q2), pk(k2_), pk(v2)
-        # D=64 packed candidates: at this head dim the ones-extended V
-        # of fuse_denom pads to the same 128-lane tile as plain V, so
-        # the dropped jnp.sum pass is pure profit on a VPU-bound shape
-        pk1 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-        q1p, k1p, v1p = pk1(q), pk1(k), pk1(v)
-        d64_variants = {
-            "resident": make_variant(256, 512),
-            "resident_fd": make_variant(256, 512, fd=True),
-            "resident_qt2_fd": make_variant(256, 512, qt=2, fd=True),
-            # static pin + fused denom: no VPU reductions in the fold
-            "resident_fd_sm40": make_variant(256, 512, fd=True, sm=40.0),
-        }
-
-        # bf16-input lane: the flagship TRAINS in bf16 activations
-        # (models/transformer bf16 config), so the f32-input entries
-        # above pay a per-fold K/V cast and double HBM that the real
-        # training path never sees — this lane measures the kernel as
-        # the model actually calls it (cast once, outside the timing)
-        q2b, k2b, v2b = (x.astype(jnp.bfloat16) for x in (q2p, k2p, v2p))
-        fa_bf16 = make_variant(256, 512)
 
         # EXTERNAL ANCHOR: JAX's own splash-attention kernel on the
         # same packed operands, same windows — the practical same-shape
@@ -415,7 +378,8 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             from jax.experimental.pallas.ops.tpu import (
                 splash_attention as _sp)
             _mask = _sp.splash_attention_mask.MultiHeadMask(
-                [_sp.splash_attention_mask.CausalMask((T, T))] * (B * H2))
+                [_sp.splash_attention_mask.CausalMask((T, T))]
+                * (B * o["H2"]))
             _splash = _sp.make_splash_mha_single_device(_mask)
 
             def splash_fwd(x, kk, vv):
@@ -429,9 +393,6 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             splash_fwd = splash_bwd = None
             detail["splash_anchor_error"] = type(ve).__name__
 
-        best_fa, best_f2, best_mm, best_bf = None, None, None, None
-        best_pk = {name: None for name in d128_variants}
-        best_pk64 = {name: None for name in d64_variants}
         # backward pass (the custom-VJP Pallas kernels): grad over ALL
         # THREE operands, with dq+dk+dv summed into the chain carry so
         # every output is live.  r4 timed argnums=(0,) and jaxpr-level
@@ -450,127 +411,99 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
 
         try:
             n_pallas = jax.jit(fa_bwd).lower(
-                q2p, k2p, v2p).as_text().count("tpu_custom_call")
+                o["q2p"], o["k2p"], o["v2p"]).as_text().count(
+                    "tpu_custom_call")
         except Exception:  # noqa: BLE001 — lowering text is best-effort
             n_pallas = -1
         detail["flash_fwdbwd_pallas_calls"] = n_pallas
 
-        best_bwd = None
+        # forward reference for the fwd+bwd consistency gate: the SAME
+        # packed resident entry fa_bwd re-runs (the BTHD wrapper would
+        # measure a different program — transposes + auto schedule —
+        # and skew the implied backward-only residual either way)
+        from accl_tpu.bench.flash_sweep import make_variant
+
+        fa_res = make_variant(256, 512)
+
+        # interleaved best-of-rounds: contention windows on this shared
+        # chip last MINUTES and can depress identical kernels
+        # several-fold, so the best-window estimator needs enough
+        # rounds to straddle a window boundary — 12 rounds of this
+        # stage's 8 lanes keeps the stage's wall span comparable to the
+        # pre-split loop even though the variant lanes moved out.
+        # Iteration counts put >= ~10 ms of device work per dispatch so
+        # RTT jitter amortizes away.
+        best_fa = best_f2 = best_mm = best_bwd = best_res = None
         best_sp = best_sp_bwd = None
-        dead_variants: set = set()
-        for _ in range(10):
-            if splash_fwd is not None and "splash" not in dead_variants:
-                try:
-                    dv = timed_chain(splash_fwd, q2p, iters=64, trials=1,
-                                     consts=(k2p, v2p))
-                    best_sp = dv if best_sp is None else min(best_sp, dv)
-                except Exception as ve:  # noqa: BLE001
-                    dead_variants.add("splash")
-                    best_sp = None
-                    detail["splash_anchor_error"] = type(ve).__name__
-            if (splash_bwd is not None and "splash" not in dead_variants
-                    and "splash_bwd" not in dead_variants):
-                # separate lane: a backward OOM must not erase the
-                # already-valid forward ceiling number
-                try:
-                    db = timed_chain(splash_bwd, q2p, iters=24, trials=1,
-                                     consts=(k2p, v2p))
-                    best_sp_bwd = (db if best_sp_bwd is None
-                                   else min(best_sp_bwd, db))
-                except Exception as ve:  # noqa: BLE001
-                    dead_variants.add("splash_bwd")
-                    best_sp_bwd = None
-                    detail["splash_bwd_anchor_error"] = type(ve).__name__
-            d1 = timed_chain(fa, q, iters=64, trials=1, consts=(k, v))
-            d2 = timed_chain(mm, ma, iters=48, trials=1, consts=(mb,))
-            d3 = timed_chain(fa, q2, iters=64, trials=1, consts=(k2_, v2))
+        dead: set = set()
+        for _ in range(12):
+            d1 = timed_chain(fa, o["q"], iters=64, trials=1,
+                             consts=(o["k"], o["v"]))
+            d2 = timed_chain(o["mm"], o["ma"], iters=48, trials=1,
+                             consts=(o["mb"],))
+            d3 = timed_chain(fa, o["q2"], iters=64, trials=1,
+                             consts=(o["k2"], o["v2"]))
             best_fa = d1 if best_fa is None else min(best_fa, d1)
             best_mm = d2 if best_mm is None else min(best_mm, d2)
             best_f2 = d3 if best_f2 is None else min(best_f2, d3)
-            if "bf16" not in dead_variants:
+            if "res" not in dead:
                 try:
-                    db = timed_chain(fa_bf16, q2b, iters=64, trials=1,
-                                     consts=(k2b, v2b))
-                    best_bf = db if best_bf is None else min(best_bf, db)
+                    dr = timed_chain(fa_res, o["q2p"], iters=64, trials=1,
+                                     consts=(o["k2p"], o["v2p"]))
+                    best_res = (dr if best_res is None
+                                else min(best_res, dr))
                 except Exception as ve:  # noqa: BLE001
-                    # same convention as the bwd lane: the error REPLACES
-                    # the number (a half-measured best would read as
-                    # trustworthy)
-                    dead_variants.add("bf16")
-                    best_bf = None
-                    detail["flash_d128_bf16_error"] = type(ve).__name__
-            for name, vfn in d128_variants.items():
-                if name in dead_variants:
-                    continue
-                # a candidate schedule failing on this chip generation
-                # must not take down the established metrics with it
+                    dead.add("res")
+                    best_res = None
+                    detail["flash_d128_fwdref_error"] = type(ve).__name__
+            if "bwd" not in dead:
                 try:
-                    dv = timed_chain(vfn, q2p, iters=64, trials=1,
-                                     consts=(k2p, v2p))
-                except Exception as ve:  # noqa: BLE001
-                    dead_variants.add(name)
-                    best_pk[name] = f"{type(ve).__name__}"
-                    continue
-                prev = best_pk[name]
-                best_pk[name] = dv if prev is None else min(prev, dv)
-            for name, vfn in d64_variants.items():
-                if ("d64:" + name) in dead_variants:
-                    continue
-                try:
-                    dv = timed_chain(vfn, q1p, iters=64, trials=1,
-                                     consts=(k1p, v1p))
-                except Exception as ve:  # noqa: BLE001
-                    dead_variants.add("d64:" + name)
-                    best_pk64[name] = f"{type(ve).__name__}"
-                    continue
-                prev = best_pk64[name]
-                best_pk64[name] = dv if prev is None else min(prev, dv)
-            if "bwd" not in dead_variants:
-                try:
-                    dv = timed_chain(fa_bwd, q2p, iters=24, trials=1,
-                                     consts=(k2p, v2p))
+                    dv = timed_chain(fa_bwd, o["q2p"], iters=24, trials=1,
+                                     consts=(o["k2p"], o["v2p"]))
                     best_bwd = (dv if best_bwd is None
                                 else min(best_bwd, dv))
-                except Exception as ve:  # noqa: BLE001
-                    # same convention as the schedule candidates: the
-                    # error REPLACES the number (a half-measured best
-                    # would read as trustworthy)
-                    dead_variants.add("bwd")
+                except Exception as ve:  # noqa: BLE001 — the error
+                    # REPLACES the number (a half-measured best would
+                    # read as trustworthy)
+                    dead.add("bwd")
                     best_bwd = None
                     detail["flash_d128_fwdbwd_error"] = type(ve).__name__
-        # causal: ~half of the 4*B*H*T^2*D matmul flops
-        flops = 4 * B * H * T * T * D / 2
+            if splash_fwd is not None and "splash" not in dead:
+                try:
+                    dv = timed_chain(splash_fwd, o["q2p"], iters=64,
+                                     trials=1, consts=(o["k2p"], o["v2p"]))
+                    best_sp = dv if best_sp is None else min(best_sp, dv)
+                except Exception as ve:  # noqa: BLE001
+                    dead.add("splash")
+                    best_sp = None
+                    detail["splash_anchor_error"] = type(ve).__name__
+            if (splash_bwd is not None and "splash" not in dead
+                    and "splash_bwd" not in dead):
+                # separate lane: a backward OOM must not erase the
+                # already-valid forward ceiling number
+                try:
+                    db = timed_chain(splash_bwd, o["q2p"], iters=24,
+                                     trials=1, consts=(o["k2p"], o["v2p"]))
+                    best_sp_bwd = (db if best_sp_bwd is None
+                                   else min(best_sp_bwd, db))
+                except Exception as ve:  # noqa: BLE001
+                    dead.add("splash_bwd")
+                    best_sp_bwd = None
+                    detail["splash_bwd_anchor_error"] = type(ve).__name__
+
         detail["flash_attention_tflops"] = round(flops / best_fa / 1e12, 3)
-        mm_tflops = 2 * mm_n**3 / best_mm / 1e12
-        detail["matmul_bf16_tflops"] = round(mm_tflops, 2)
-        detail["flash_mxu_frac"] = round(
-            (flops / best_fa) / (2 * mm_n**3 / best_mm), 3)
+        mm_peak = 2 * mm_n**3 / best_mm
+        detail["matmul_bf16_tflops"] = round(mm_peak / 1e12, 2)
+        detail["flash_mxu_frac"] = round((flops / best_fa) / mm_peak, 3)
         # metric of record: the SAME BTHD entry as previous rounds
-        # (VERDICT's bar is against the existing methodology) — the
-        # packed-layout schedule candidates report under separate keys
+        # (VERDICT's bar is against the existing methodology)
         detail["flash_d128_tflops"] = round(flops / best_f2 / 1e12, 3)
         detail["flash_d128_mxu_frac"] = round(
-            (flops / best_f2) / (2 * mm_n**3 / best_mm), 3)
-        if best_bf is not None:
-            # the training-path number: bf16 activations like the
-            # flagship's bf16 config — no per-fold input cast, half
-            # the HBM traffic of the f32-input entries above
-            detail["flash_d128_bf16_tflops"] = round(
-                flops / best_bf / 1e12, 3)
-            detail["flash_d128_bf16_mxu_frac"] = round(
-                (flops / best_bf) / (2 * mm_n**3 / best_mm), 3)
-        live = {n: dt for n, dt in best_pk.items()
-                if isinstance(dt, float)}
-        if live:
-            win = min(live, key=lambda n: live[n])
-            detail["flash_d128_packed_tflops"] = round(
-                flops / live[win] / 1e12, 3)
-            detail["flash_d128_packed_mxu_frac"] = round(
-                (flops / live[win]) / (2 * mm_n**3 / best_mm), 3)
-            detail["flash_d128_packed_schedule"] = win
-        detail["flash_d128_packed_all"] = {
-            n: (round(flops / dt / 1e12, 2) if isinstance(dt, float)
-                else dt) for n, dt in best_pk.items()}
+            (flops / best_f2) / mm_peak, 3)
+        if best_res is not None:
+            # the gate's forward reference, reported for transparency
+            detail["flash_d128_fwdref_tflops"] = round(
+                flops / best_res / 1e12, 3)
         if best_bwd is not None:
             # the timed chain runs forward + backward per iteration
             # (jax.grad re-runs the custom-VJP forward): 2 fwd matmuls
@@ -582,11 +515,10 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             # implied backward-only rate must not exceed the matmul
             # peak (r4's DCE'd number failed exactly this test).
             bwd_flops = 4.5 * flops
-            composite_frac = (bwd_flops / best_bwd) / (2 * mm_n**3 / best_mm)
-            fwd_ref = best_pk.get("resident")
-            if isinstance(fwd_ref, float) and best_bwd > fwd_ref:
-                implied_bwd_frac = ((3.5 * flops) / (best_bwd - fwd_ref)
-                                    / (2 * mm_n**3 / best_mm))
+            composite_frac = (bwd_flops / best_bwd) / mm_peak
+            if best_res is not None and best_bwd > best_res:
+                implied_bwd_frac = ((3.5 * flops)
+                                    / (best_bwd - best_res) / mm_peak)
             else:
                 implied_bwd_frac = None
             # FAIL CLOSED: a lowering-text failure (n_pallas == -1)
@@ -617,12 +549,123 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             detail["splash_anchor_tflops"] = round(
                 flops / best_sp / 1e12, 3)
             detail["splash_anchor_mxu_frac"] = round(
-                (flops / best_sp) / (2 * mm_n**3 / best_mm), 3)
+                (flops / best_sp) / mm_peak, 3)
         if best_sp_bwd is not None:
             detail["splash_anchor_fwdbwd_tflops"] = round(
                 4.5 * flops / best_sp_bwd / 1e12, 3)
             detail["splash_anchor_fwdbwd_mxu_frac"] = round(
-                (4.5 * flops / best_sp_bwd) / (2 * mm_n**3 / best_mm), 3)
+                (4.5 * flops / best_sp_bwd) / mm_peak, 3)
+    except Exception as e:  # noqa: BLE001 — best-effort detail metric
+        detail["flash_attention_error"] = f"{type(e).__name__}: {e}"
+    return detail
+
+
+def _flash_variants_stage(jax, jnp, timed_chain) -> dict:
+    """Schedule-candidate sweep on the live chip: the packed d128/d64
+    families (incl. the r5 static-max pin) and the bf16-input lane,
+    with their OWN interleaved matmul peak.  Candidate construction is
+    shared with the live-chip tuner scripts so methodology fixes land
+    once (flash_sweep docstring).  Candidate sets follow the
+    honest-timing Pareto front of the r04 sweeps; rejected families
+    (split folds, qt4, D=128 fused denominator, the skew schedule)
+    stay in chip_session's larger sweep."""
+    detail: dict = {}
+    try:
+        from accl_tpu.bench.flash_sweep import make_variant
+
+        o = _flash_operands(jax, jnp)
+        flops, mm_n = o["flops"], o["mm_n"]
+        d128_variants = {
+            "resident": make_variant(256, 512),
+            "resident_bq512": make_variant(512, 512),
+            "resident_bq512_qt2": make_variant(512, 512, qt=2),
+            "resident_bq512_bk1024": make_variant(512, 1024),
+            # r5 static-max pin: drops the max/alpha/clamp VPU passes
+            # (the measured fold bottleneck) — a decomposition change,
+            # not another block shape
+            "resident_sm40": make_variant(256, 512, sm=40.0),
+            "resident_bq512_sm40": make_variant(512, 512, sm=40.0),
+        }
+        d64_variants = {
+            "resident": make_variant(256, 512),
+            "resident_fd": make_variant(256, 512, fd=True),
+            "resident_qt2_fd": make_variant(256, 512, qt=2, fd=True),
+            # static pin + fused denom: no VPU reductions in the fold
+            "resident_fd_sm40": make_variant(256, 512, fd=True, sm=40.0),
+        }
+        # bf16-input lane: the flagship TRAINS in bf16 activations
+        # (models/transformer bf16 config), so the f32-input entries
+        # pay a per-fold K/V cast and double HBM the real training
+        # path never sees — this lane measures the kernel as the model
+        # actually calls it (cast once, outside the timing)
+        q2b, k2b, v2b = (x.astype(jnp.bfloat16)
+                         for x in (o["q2p"], o["k2p"], o["v2p"]))
+        fa_bf16 = make_variant(256, 512)
+
+        best_mm = best_bf = None
+        best_pk = {name: None for name in d128_variants}
+        best_pk64 = {name: None for name in d64_variants}
+        dead: set = set()
+        for _ in range(10):
+            d2 = timed_chain(o["mm"], o["ma"], iters=48, trials=1,
+                             consts=(o["mb"],))
+            best_mm = d2 if best_mm is None else min(best_mm, d2)
+            if "bf16" not in dead:
+                try:
+                    db = timed_chain(fa_bf16, q2b, iters=64, trials=1,
+                                     consts=(k2b, v2b))
+                    best_bf = db if best_bf is None else min(best_bf, db)
+                except Exception as ve:  # noqa: BLE001 — the error
+                    # REPLACES the number
+                    dead.add("bf16")
+                    best_bf = None
+                    detail["flash_d128_bf16_error"] = type(ve).__name__
+            for name, vfn in d128_variants.items():
+                if name in dead:
+                    continue
+                # a candidate schedule failing on this chip generation
+                # must not take down the established metrics with it
+                try:
+                    dv = timed_chain(vfn, o["q2p"], iters=64, trials=1,
+                                     consts=(o["k2p"], o["v2p"]))
+                except Exception as ve:  # noqa: BLE001
+                    dead.add(name)
+                    best_pk[name] = f"{type(ve).__name__}"
+                    continue
+                prev = best_pk[name]
+                best_pk[name] = dv if prev is None else min(prev, dv)
+            for name, vfn in d64_variants.items():
+                if ("d64:" + name) in dead:
+                    continue
+                try:
+                    dv = timed_chain(vfn, o["q1p"], iters=64, trials=1,
+                                     consts=(o["k1p"], o["v1p"]))
+                except Exception as ve:  # noqa: BLE001
+                    dead.add("d64:" + name)
+                    best_pk64[name] = f"{type(ve).__name__}"
+                    continue
+                prev = best_pk64[name]
+                best_pk64[name] = dv if prev is None else min(prev, dv)
+
+        mm_peak = 2 * mm_n**3 / best_mm
+        detail["variants_matmul_bf16_tflops"] = round(mm_peak / 1e12, 2)
+        if best_bf is not None:
+            detail["flash_d128_bf16_tflops"] = round(
+                flops / best_bf / 1e12, 3)
+            detail["flash_d128_bf16_mxu_frac"] = round(
+                (flops / best_bf) / mm_peak, 3)
+        live = {n: dt for n, dt in best_pk.items()
+                if isinstance(dt, float)}
+        if live:
+            win = min(live, key=lambda n: live[n])
+            detail["flash_d128_packed_tflops"] = round(
+                flops / live[win] / 1e12, 3)
+            detail["flash_d128_packed_mxu_frac"] = round(
+                (flops / live[win]) / mm_peak, 3)
+            detail["flash_d128_packed_schedule"] = win
+        detail["flash_d128_packed_all"] = {
+            n: (round(flops / dt / 1e12, 2) if isinstance(dt, float)
+                else dt) for n, dt in best_pk.items()}
         live64 = {n: dt for n, dt in best_pk64.items()
                   if isinstance(dt, float)}
         if live64:
@@ -630,13 +673,13 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
             detail["flash_d64_packed_tflops"] = round(
                 flops / live64[win] / 1e12, 3)
             detail["flash_d64_packed_mxu_frac"] = round(
-                (flops / live64[win]) / (2 * mm_n**3 / best_mm), 3)
+                (flops / live64[win]) / mm_peak, 3)
             detail["flash_d64_packed_schedule"] = win
         detail["flash_d64_packed_all"] = {
             n: (round(flops / dt / 1e12, 2) if isinstance(dt, float)
                 else dt) for n, dt in best_pk64.items()}
     except Exception as e:  # noqa: BLE001 — best-effort detail metric
-        detail["flash_attention_error"] = f"{type(e).__name__}: {e}"
+        detail["flash_variants_error"] = f"{type(e).__name__}: {e}"
     return detail
 
 
