@@ -1,0 +1,170 @@
+"""Autoregressive inference for the flagship transformer: KV-cache
+prefill + single-token decode + greedy generation.
+
+The training stack (models/transformer.py) recomputes every position's
+K/V per step; serving recomputes nothing: `prefill` runs the prompt
+once and banks each layer's K/V, `decode_step` extends the cache one
+token at a time, and `generate` is a jit-compiled prefill + `lax.scan`
+over steps (static trip count — XLA-friendly control flow, no
+data-dependent Python).
+
+Design notes (TPU-first):
+- the cache stores the GROUPED K/V layout ([B, L, G, Dh] with G =
+  cfg.kv_heads): under GQA the cache is H/G x smaller — the reason the
+  Llama family uses GQA at all — and attention consumes the grouped
+  layout directly via a grouped einsum (no per-step expansion in HBM);
+- attention against the cache is a dense masked softmax: a single
+  decode query row is GEMV-bound (no MXU tiling to win).  Prefill
+  uses the same dense path over [Tp, L] scores — right for serving
+  prompt lengths; a flash-kernel prefill for very long prompts is the
+  training kernel's domain and deliberately out of scope here;
+- the cache has a STATIC capacity `max_len` (jit-stable shapes);
+  position is a traced scalar and writes use dynamic_update_slice.
+  Writing past capacity raises when the position is concrete (eager
+  callers); under jit the caller owns the budget — `generate` sizes
+  the cache exactly (Tp + max_new) by construction;
+- `tp_axis` composes exactly like the training forward (row-parallel
+  psum after the attention-out and MLP-down projections) with the
+  cache sharded over K/V heads, so a tp-sharded model serves from the
+  same shard_map mesh.
+
+The per-block projection/MLP math is SHARED with the training forward
+(transformer.block_qkv / block_attn_out / block_mlp) — a change there
+propagates here, and the parity contract (tests/test_decode.py:
+teacher-forced decode reproduces `forward` position for position, for
+every config flavor) locks the seam.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .transformer import (
+    ModelConfig,
+    _rmsnorm,
+    block_attn_out,
+    block_mlp,
+    block_qkv,
+)
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict:
+    """Empty cache: per layer K/V of [B, max_len, G, Dh] (grouped
+    heads) plus the fill position."""
+    shape = (batch, max_len, cfg.kv_heads, cfg.d_head)
+    return {
+        "pos": jnp.zeros((), jnp.int32),
+        "layers": [
+            {"k": jnp.zeros(shape, cfg.jdtype),
+             "v": jnp.zeros(shape, cfg.jdtype)}
+            for _ in range(cfg.n_layers)
+        ],
+    }
+
+
+def _grouped_cached_attention(q, kc, vc, pos, window=None):
+    """One query block against the cache, grouped-head semantics (no
+    K/V expansion).
+
+    q: [B, Tq, H, Dh] (Tq = 1 for decode); kc/vc: [B, L, G, Dh];
+    `pos` is the ABSOLUTE position of q's first row; row i attends
+    cache slots [0, pos + i] (restricted to the trailing `window`).
+    """
+    B, Tq, H, Dh = q.shape
+    L, G = kc.shape[1], kc.shape[2]
+    gr = H // G
+    scale = 1.0 / np.sqrt(Dh).astype(np.float32)
+    q5 = q.reshape(B, Tq, G, gr, Dh).astype(jnp.float32) * scale
+    s = jnp.einsum("bqgrd,blgd->bqgrl", q5, kc.astype(jnp.float32))
+    slots = lax.broadcasted_iota(jnp.int32, (Tq, L), 1)
+    rows = pos + lax.broadcasted_iota(jnp.int32, (Tq, L), 0)
+    keep = slots <= rows
+    if window is not None:
+        keep = keep & (slots > rows - window)
+    s = jnp.where(keep[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bqgrl,blgd->bqgrd", p, vc.astype(jnp.float32))
+    return out.reshape(B, Tq, H, Dh)
+
+
+def prefill(params, tokens, cache: dict, cfg: ModelConfig,
+            tp_axis: Optional[str] = None):
+    """Run the prompt once, filling the cache: tokens [B, Tp] →
+    (logits [B, Tp, vocab], cache with pos = prior pos + Tp).
+    Continuation prefills (non-zero starting pos) append after the
+    already-cached context and attend to all of it."""
+    B, Tp = tokens.shape
+    pos0 = cache["pos"]
+    L = cache["layers"][0]["k"].shape[1]
+    if Tp > L:
+        raise ValueError(f"prompt length {Tp} exceeds cache capacity {L}")
+    if not isinstance(pos0, jax.core.Tracer) and int(pos0) + Tp > L:
+        # a clamped dynamic_update_slice would silently OVERWRITE
+        # earlier context; fail loudly while the position is concrete
+        # (under jit the caller owns the capacity budget — see module
+        # docstring)
+        raise ValueError(f"prefill past cache capacity: pos {int(pos0)} "
+                         f"+ {Tp} > {L}")
+    x = params["embed"][tokens].astype(cfg.jdtype)
+    positions = (pos0 + jnp.arange(Tp)) if cfg.rope else None
+    new_layers = []
+    for li, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["ln1"])
+        q, k, v = block_qkv(h, blk, cfg, positions)
+        layer = cache["layers"][li]
+        kc = lax.dynamic_update_slice(
+            layer["k"], k.astype(cfg.jdtype), (0, pos0, 0, 0))
+        vc = lax.dynamic_update_slice(
+            layer["v"], v.astype(cfg.jdtype), (0, pos0, 0, 0))
+        new_layers.append({"k": kc, "v": vc})
+        attn = _grouped_cached_attention(
+            q, kc, vc, pos0, window=cfg.attn_window).astype(cfg.jdtype)
+        x = block_attn_out(x, attn, blk, cfg, tp_axis)
+        x = block_mlp(x, blk, cfg, tp_axis)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cfg.jdtype))
+    return logits, {"pos": pos0 + Tp, "layers": new_layers}
+
+
+def decode_step(params, token, cache: dict, cfg: ModelConfig,
+                tp_axis: Optional[str] = None):
+    """One autoregressive step: token [B] int32 → (logits [B, vocab],
+    cache advanced by one)."""
+    logits, cache = prefill(params, token[:, None], cache, cfg,
+                            tp_axis=tp_axis)
+    return logits[:, 0], cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "tp_axis"))
+def _generate_impl(params, prompt, cfg: ModelConfig, max_new: int,
+                   tp_axis):
+    B, Tp = prompt.shape
+    cache = init_kv_cache(cfg, B, Tp + max_new)
+    logits, cache = prefill(params, prompt, cache, cfg, tp_axis=tp_axis)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        token, cache = carry
+        lg, cache = decode_step(params, token, cache, cfg,
+                                tp_axis=tp_axis)
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return (nxt, cache), token
+
+    (_, _), toks = lax.scan(step, (first, cache), None, length=max_new)
+    return jnp.transpose(toks)  # [max_new, B] -> [B, max_new]
+
+
+def generate(params, prompt, cfg: ModelConfig, max_new: int,
+             tp_axis: Optional[str] = None):
+    """Greedy generation: prompt [B, Tp] int32 → generated [B, max_new]
+    int32.  The whole pipeline (prefill + the scan of decode steps) is
+    one jit-compiled program; the cache capacity is exactly
+    Tp + max_new."""
+    return _generate_impl(params, prompt, cfg, max_new, tp_axis)

@@ -192,6 +192,50 @@ def _global_positions(Tl: int, cfg: ModelConfig, sp_axis: Optional[str]):
     return idx * Tl + jnp.arange(Tl)
 
 
+
+
+def block_qkv(h, blk, cfg: ModelConfig, positions):
+    """q/k/v projections of one block's normed input (+ RoPE when
+    `positions` is given) — ONE definition shared by the training
+    forward and the serving path (models/decode.py), so a projection
+    change cannot silently break the decode parity contract."""
+    q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
+    k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
+    v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+    if positions is not None:
+        # rotate BEFORE any GQA expansion (k carries its own head
+        # count; the rotation broadcasts over heads)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def block_attn_out(x, attn, blk, cfg: ModelConfig, tp_axis):
+    """Attention-out projection + row-parallel combine + residual
+    (shared with models/decode.py)."""
+    o = jnp.einsum("bthk,hkd->btd", attn, blk["wo"].astype(cfg.jdtype))
+    if tp_axis is not None:
+        o = lax.psum(o, tp_axis)  # row-parallel combine
+    return x + o
+
+
+def block_mlp(x, blk, cfg: ModelConfig, tp_axis):
+    """Post-attention MLP (gelu or the Llama-family swiglu) + residual
+    (shared with models/decode.py)."""
+    h = _rmsnorm(x, blk["ln2"])
+    m = jnp.einsum("btd,df->btf", h, blk["w1"].astype(cfg.jdtype))
+    if cfg.mlp == "swiglu":
+        gate = jnp.einsum("btd,df->btf", h,
+                          blk["w3"].astype(cfg.jdtype))
+        m = jax.nn.silu(m) * gate
+    else:
+        m = jax.nn.gelu(m)
+    m = jnp.einsum("btf,fd->btd", m, blk["w2"].astype(cfg.jdtype))
+    if tp_axis is not None:
+        m = lax.psum(m, tp_axis)
+    return x + m
+
+
 def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             sp_axis: Optional[str] = None):
     """Token ids [B, T_local] → logits [B, T_local, vocab].
@@ -212,14 +256,7 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
 
     def block(x, blk):
         h = _rmsnorm(x, blk["ln1"])
-        q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
-        k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
-        v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
-        if rope_pos is not None:
-            # rotate BEFORE any GQA expansion (k carries its own head
-            # count; the rotation broadcasts over heads)
-            q = _rope(q, rope_pos, cfg.rope_theta)
-            k = _rope(k, rope_pos, cfg.rope_theta)
+        q, k, v = block_qkv(h, blk, cfg, rope_pos)
         if (k.shape[2] != q.shape[2] and sp_axis is None
                 and cfg.attn != "flash"):
             # only the local dense path consumes one K/V head per q
@@ -256,22 +293,8 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         else:
             attn = _dense_attention(q, k, v, causal=True,
                                     window=cfg.attn_window)
-        o = jnp.einsum("bthk,hkd->btd", attn, blk["wo"].astype(cfg.jdtype))
-        if tp_axis is not None:
-            o = lax.psum(o, tp_axis)  # row-parallel combine
-        x = x + o
-        h = _rmsnorm(x, blk["ln2"])
-        m = jnp.einsum("btd,df->btf", h, blk["w1"].astype(cfg.jdtype))
-        if cfg.mlp == "swiglu":
-            gate = jnp.einsum("btd,df->btf", h,
-                              blk["w3"].astype(cfg.jdtype))
-            m = jax.nn.silu(m) * gate
-        else:
-            m = jax.nn.gelu(m)
-        m = jnp.einsum("btf,fd->btd", m, blk["w2"].astype(cfg.jdtype))
-        if tp_axis is not None:
-            m = lax.psum(m, tp_axis)
-        return x + m
+        x = block_attn_out(x, attn, blk, cfg, tp_axis)
+        return block_mlp(x, blk, cfg, tp_axis)
 
     if cfg.remat:
         # rematerialize each block on the backward pass: only the

@@ -142,19 +142,24 @@ def test_parse_bench_results_roundtrip(tmp_path):
     assert "allreduce" in text and "1.00x" in text and "peak busbw" in text
 
 
+def _load_bench(name="bench_mod"):
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        name, _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
 def test_bench_stage_ledger_roundtrip(tmp_path, monkeypatch):
     """bench.py's per-stage banking: stages persist atomically under a
     run id, a different run id starts clean, and _assemble builds the
     result line from whatever fragments landed (r4 lost its round
     record to an all-or-nothing worker; this is the regression lock)."""
-    import importlib.util
-    import os as _os
-
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod", _os.path.join(_os.path.dirname(_os.path.dirname(
-            _os.path.abspath(__file__))), "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench()
     monkeypatch.setattr(bench, "STAGE_LEDGER",
                         str(tmp_path / "stages.json"))
 
@@ -188,19 +193,12 @@ def test_bench_stage_functions_smoke(monkeypatch):
     NameError/typo in chip-only code fails in CI instead of wasting a
     scarce claim window (r4's bf16 lane was added after the last
     successful window and had never run when the round closed)."""
-    import importlib.util
-    import os as _os
-
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    spec = importlib.util.spec_from_file_location(
-        "bench_mod2", _os.path.join(_os.path.dirname(_os.path.dirname(
-            _os.path.abspath(__file__))), "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
+    bench = _load_bench("bench_mod2")
 
     def fake_chain(fn, x0, iters, trials=1, consts=()):
         return 1e-3  # plausible per-iteration seconds; never executes
