@@ -40,3 +40,8 @@ def test_device_vadd_put_example():
 def test_collectives_tpu_gang_example():
     out = _run("collectives_tpu_gang.py")
     assert "OK" in out
+
+
+def test_generate_text_example():
+    out = _run("generate_text.py", extra_env={"ACCL_EXAMPLE_STEPS": "2"})
+    assert "decode parity OK" in out and "OK" in out
