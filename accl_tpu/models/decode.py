@@ -142,29 +142,54 @@ def decode_step(params, token, cache: dict, cfg: ModelConfig,
     return logits[:, 0], cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "tp_axis"))
-def _generate_impl(params, prompt, cfg: ModelConfig, max_new: int,
-                   tp_axis):
+def _select(lg, key, temperature: float, top_k):
+    """Next-token selection from logits [B, vocab]: greedy at
+    temperature 0, else temperature-scaled (optionally top-k-truncated)
+    categorical sampling."""
+    if temperature == 0.0:
+        return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    lg = lg.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+        lg = jnp.where(lg < kth, NEG_INF, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "tp_axis",
+                                   "temperature", "top_k"))
+def _generate_impl(params, prompt, key, cfg: ModelConfig, max_new: int,
+                   tp_axis, temperature: float, top_k):
     B, Tp = prompt.shape
     cache = init_kv_cache(cfg, B, Tp + max_new)
     logits, cache = prefill(params, prompt, cache, cfg, tp_axis=tp_axis)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    key, sub = jax.random.split(key)
+    first = _select(logits[:, -1], sub, temperature, top_k)
 
-    def step(carry, _):
+    def step(carry, skey):
         token, cache = carry
         lg, cache = decode_step(params, token, cache, cfg,
                                 tp_axis=tp_axis)
-        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        nxt = _select(lg, skey, temperature, top_k)
         return (nxt, cache), token
 
-    (_, _), toks = lax.scan(step, (first, cache), None, length=max_new)
+    (_, _), toks = lax.scan(step, (first, cache),
+                            jax.random.split(key, max_new))
     return jnp.transpose(toks)  # [max_new, B] -> [B, max_new]
 
 
 def generate(params, prompt, cfg: ModelConfig, max_new: int,
-             tp_axis: Optional[str] = None):
-    """Greedy generation: prompt [B, Tp] int32 → generated [B, max_new]
-    int32.  The whole pipeline (prefill + the scan of decode steps) is
-    one jit-compiled program; the cache capacity is exactly
-    Tp + max_new."""
-    return _generate_impl(params, prompt, cfg, max_new, tp_axis)
+             tp_axis: Optional[str] = None, temperature: float = 0.0,
+             top_k: Optional[int] = None, key=None):
+    """Autoregressive generation: prompt [B, Tp] int32 → generated
+    [B, max_new] int32.  The whole pipeline (prefill + the scan of
+    decode steps) is one jit-compiled program; the cache capacity is
+    exactly Tp + max_new.
+
+    `temperature=0` (default) is greedy argmax; a positive temperature
+    samples from the scaled distribution, optionally truncated to the
+    `top_k` most likely tokens — pass a `jax.random` key for
+    reproducible sampling (defaults to PRNGKey(0))."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return _generate_impl(params, prompt, key, cfg, max_new, tp_axis,
+                          float(temperature), top_k)

@@ -129,3 +129,27 @@ def test_decode_tp_sharded_matches_local():
     got, _ = f(sharded, tokens, cache)
     np.testing.assert_allclose(np.asarray(got), want, rtol=3e-5,
                                atol=3e-5)
+
+
+def test_generate_sampling_modes():
+    cfg, params, tokens = _setup()
+    prompt = tokens[:, :6]
+    # greedy is deterministic regardless of key
+    g1 = np.asarray(generate(params, prompt, cfg, max_new=4))
+    g2 = np.asarray(generate(params, prompt, cfg, max_new=4,
+                             key=jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(g1, g2)
+    # sampling is reproducible per key and within the vocab
+    s1 = np.asarray(generate(params, prompt, cfg, max_new=4,
+                             temperature=1.0, top_k=8,
+                             key=jax.random.PRNGKey(5)))
+    s2 = np.asarray(generate(params, prompt, cfg, max_new=4,
+                             temperature=1.0, top_k=8,
+                             key=jax.random.PRNGKey(5)))
+    np.testing.assert_array_equal(s1, s2)
+    assert s1.min() >= 0 and s1.max() < cfg.vocab
+    # top_k=1 at any temperature degenerates to greedy
+    t1 = np.asarray(generate(params, prompt, cfg, max_new=4,
+                             temperature=2.5, top_k=1,
+                             key=jax.random.PRNGKey(9)))
+    np.testing.assert_array_equal(t1, g1)
