@@ -27,7 +27,7 @@ from ..parallel.ring_attention import _dense_attention
 from .transformer import _rmsnorm, sum_count_device_step
 
 
-@dataclass
+@dataclass(frozen=True)
 class MoEConfig:
     vocab: int = 256
     d_model: int = 128

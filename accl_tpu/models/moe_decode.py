@@ -1,0 +1,80 @@
+"""KV-cache inference for the MoE family (models/moe.py).
+
+Same serving structure as models/decode.py — grouped-cache prefill +
+single-token decode — with the switch-routed expert FFN in place of
+the dense MLP.  Routing at decode time is exactly the training path's
+top-1 router on the one live token; the expert-parallel (`ep_axis`)
+dispatch/combine works unchanged because expert_dispatch is
+shape-agnostic in the token dimension.
+
+Parity contract (tests/test_decode.py::test_moe_*): teacher-forced
+decode reproduces models.moe.forward position for position.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import _grouped_cached_attention
+from .moe import MoEConfig, _moe_ffn
+from .transformer import _rmsnorm
+
+
+def init_kv_cache(cfg: MoEConfig, batch: int, max_len: int) -> dict:
+    shape = (batch, max_len, cfg.n_heads, cfg.d_head)
+    return {
+        "pos": jnp.zeros((), jnp.int32),
+        "layers": [
+            {"k": jnp.zeros(shape, cfg.jdtype),
+             "v": jnp.zeros(shape, cfg.jdtype)}
+            for _ in range(cfg.n_layers)
+        ],
+    }
+
+
+def prefill(params, tokens, cache: dict, cfg: MoEConfig,
+            ep_axis: Optional[str] = None):
+    """tokens [B, Tp] → (logits [B, Tp, vocab], aux, filled cache)."""
+    B, Tp = tokens.shape
+    pos0 = cache["pos"]
+    L = cache["layers"][0]["k"].shape[1]
+    if Tp > L:
+        raise ValueError(f"prompt length {Tp} exceeds cache capacity {L}")
+    if not isinstance(pos0, jax.core.Tracer) and int(pos0) + Tp > L:
+        raise ValueError(f"prefill past cache capacity: pos {int(pos0)} "
+                         f"+ {Tp} > {L}")
+    x = params["embed"][tokens].astype(cfg.jdtype)
+    aux_total = jnp.zeros((), jnp.float32)
+    new_layers = []
+    for li, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["ln1"])
+        q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
+        k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
+        v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+        layer = cache["layers"][li]
+        kc = lax.dynamic_update_slice(
+            layer["k"], k.astype(cfg.jdtype), (0, pos0, 0, 0))
+        vc = lax.dynamic_update_slice(
+            layer["v"], v.astype(cfg.jdtype), (0, pos0, 0, 0))
+        new_layers.append({"k": kc, "v": vc})
+        attn = _grouped_cached_attention(q, kc, vc, pos0).astype(cfg.jdtype)
+        x = x + jnp.einsum("bthk,hkd->btd", attn,
+                           blk["wo"].astype(cfg.jdtype))
+        h = _rmsnorm(x, blk["ln2"])
+        m, aux = _moe_ffn(h, blk, cfg, ep_axis)
+        aux_total = aux_total + aux
+        x = x + m
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cfg.jdtype))
+    return logits, aux_total, {"pos": pos0 + Tp, "layers": new_layers}
+
+
+def decode_step(params, token, cache: dict, cfg: MoEConfig,
+                ep_axis: Optional[str] = None):
+    """token [B] int32 → (logits [B, vocab], cache advanced by one)."""
+    logits, _aux, cache = prefill(params, token[:, None], cache, cfg,
+                                  ep_axis=ep_axis)
+    return logits[:, 0], cache

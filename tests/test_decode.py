@@ -153,3 +153,32 @@ def test_generate_sampling_modes():
                              temperature=2.5, top_k=1,
                              key=jax.random.PRNGKey(9)))
     np.testing.assert_array_equal(t1, g1)
+
+
+def test_moe_teacher_forced_decode_matches_forward():
+    """The MoE family's serving path: cached decode reproduces
+    models.moe.forward position for position (router decisions
+    included — a drifting gate shows up as a logit mismatch)."""
+    from accl_tpu.models.moe import MoEConfig, forward as moe_forward
+    from accl_tpu.models.moe import init_params as moe_init
+    from accl_tpu.models import moe_decode
+
+    cfg = MoEConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                    d_head=8, d_ff=64, n_experts=4)
+    params = moe_init(np.random.default_rng(11), cfg)
+    tokens = jnp.asarray(np.random.default_rng(12).integers(
+        0, cfg.vocab, size=(B, T), dtype=np.int32))
+    want, _aux = moe_forward(params, tokens, cfg)
+    want = np.asarray(want)
+
+    cache = moe_decode.init_kv_cache(cfg, B, T)
+    lg, _aux2, cache = jax.jit(
+        moe_decode.prefill, static_argnames=("cfg",))(
+            params, tokens[:, :8], cache, cfg)
+    np.testing.assert_allclose(np.asarray(lg), want[:, :8], rtol=3e-5,
+                               atol=3e-5)
+    step = jax.jit(moe_decode.decode_step, static_argnames=("cfg",))
+    for t in range(8, T):
+        lg, cache = step(params, tokens[:, t], cache, cfg)
+        np.testing.assert_allclose(np.asarray(lg), want[:, t],
+                                   rtol=3e-5, atol=3e-5, err_msg=f"t={t}")
