@@ -86,8 +86,15 @@ def param_specs(cfg: MoEConfig, ep: Optional[str] = "ep") -> dict:
     return specs
 
 
-def _moe_ffn(h, blk, cfg: MoEConfig, ep_axis: Optional[str]):
-    """Top-1 routed FFN.  h: [B, T, D] -> [B, T, D] + aux loss scalar."""
+def _moe_ffn(h, blk, cfg: MoEConfig, ep_axis: Optional[str],
+             capacity: Optional[int] = None):
+    """Top-1 routed FFN.  h: [B, T, D] -> [B, T, D] + aux loss scalar.
+
+    `capacity` overrides the training-time per-expert budget (ceil of
+    B*T*capacity_factor/E).  Serving callers pass the full token count:
+    at decode the per-call token count is tiny, so the training formula
+    would drop (zero out) any token beyond ~B/E routed to one expert —
+    a silent divergence from the dense reference (moe_decode.py)."""
     B, T, D = h.shape
     x = h.reshape(B * T, D)
     logits = jnp.einsum("nd,de->ne", x, blk["router"].astype(cfg.jdtype))
@@ -109,7 +116,8 @@ def _moe_ffn(h, blk, cfg: MoEConfig, ep_axis: Optional[str]):
         y = jnp.einsum("end,ne->nd", y_all, onehot.astype(cfg.jdtype))
     else:
         from ..parallel.strategies import expert_combine, expert_dispatch
-        cap = int(np.ceil(B * T * cfg.capacity_factor / cfg.n_experts))
+        cap = (capacity if capacity is not None else
+               int(np.ceil(B * T * cfg.capacity_factor / cfg.n_experts)))
         inputs, info = expert_dispatch(x, expert_idx, ep_axis, capacity=cap)
         # this member's expert bank slice: [1, D, F] under ep sharding
         w1 = blk["we1"].astype(cfg.jdtype)[0]
@@ -123,18 +131,33 @@ def _moe_ffn(h, blk, cfg: MoEConfig, ep_axis: Optional[str]):
     return y.reshape(B, T, D), aux
 
 
+
+
+def moe_block_qkv(h, blk, cfg: MoEConfig):
+    """q/k/v projections of one MoE block — shared by the training
+    forward and the serving path (moe_decode.py) so the math cannot
+    drift between them (same contract as transformer.block_qkv)."""
+    q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
+    k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
+    v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+    return q, k, v
+
+
+def moe_block_attn_out(x, attn, blk, cfg: MoEConfig):
+    """Attention-out projection + residual (shared with moe_decode)."""
+    return x + jnp.einsum("bthk,hkd->btd", attn,
+                          blk["wo"].astype(cfg.jdtype))
+
+
 def forward(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
     """Token ids [B, T] -> (logits [B, T, vocab], total aux loss)."""
     x = params["embed"][tokens].astype(cfg.jdtype)
     aux_total = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:
         h = _rmsnorm(x, blk["ln1"])
-        q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
-        k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
-        v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+        q, k, v = moe_block_qkv(h, blk, cfg)
         attn = _dense_attention(q, k, v, causal=True)
-        x = x + jnp.einsum("bthk,hkd->btd", attn,
-                           blk["wo"].astype(cfg.jdtype))
+        x = moe_block_attn_out(x, attn, blk, cfg)
         h = _rmsnorm(x, blk["ln2"])
         m, aux = _moe_ffn(h, blk, cfg, ep_axis)
         aux_total = aux_total + aux

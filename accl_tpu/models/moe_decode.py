@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .decode import _grouped_cached_attention
-from .moe import MoEConfig, _moe_ffn
+from .moe import MoEConfig, _moe_ffn, moe_block_attn_out, moe_block_qkv
 from .transformer import _rmsnorm
 
 
@@ -51,9 +51,7 @@ def prefill(params, tokens, cache: dict, cfg: MoEConfig,
     new_layers = []
     for li, blk in enumerate(params["blocks"]):
         h = _rmsnorm(x, blk["ln1"])
-        q = jnp.einsum("btd,dhk->bthk", h, blk["wq"].astype(cfg.jdtype))
-        k = jnp.einsum("btd,dhk->bthk", h, blk["wk"].astype(cfg.jdtype))
-        v = jnp.einsum("btd,dhk->bthk", h, blk["wv"].astype(cfg.jdtype))
+        q, k, v = moe_block_qkv(h, blk, cfg)
         layer = cache["layers"][li]
         kc = lax.dynamic_update_slice(
             layer["k"], k.astype(cfg.jdtype), (0, pos0, 0, 0))
@@ -61,10 +59,10 @@ def prefill(params, tokens, cache: dict, cfg: MoEConfig,
             layer["v"], v.astype(cfg.jdtype), (0, pos0, 0, 0))
         new_layers.append({"k": kc, "v": vc})
         attn = _grouped_cached_attention(q, kc, vc, pos0).astype(cfg.jdtype)
-        x = x + jnp.einsum("bthk,hkd->btd", attn,
-                           blk["wo"].astype(cfg.jdtype))
+        x = moe_block_attn_out(x, attn, blk, cfg)
         h = _rmsnorm(x, blk["ln2"])
-        m, aux = _moe_ffn(h, blk, cfg, ep_axis)
+        # drop-free serving capacity (see module docstring)
+        m, aux = _moe_ffn(h, blk, cfg, ep_axis, capacity=B * Tp)
         aux_total = aux_total + aux
         x = x + m
     x = _rmsnorm(x, params["ln_f"])
