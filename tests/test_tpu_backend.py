@@ -563,3 +563,45 @@ def test_async_window_batches_and_raw_guard():
     # numerics above came out right — the chain assertions are the
     # real guard; this records that fusion engaged at all
     assert max(sizes) >= 2, sizes
+
+
+def test_gang_executor_error_isolation():
+    """A failing compiled collective must error-complete every request
+    of ITS gang (retcode surfaces via ACCLError) without killing the
+    executor thread — the next collective on the same world succeeds."""
+    from accl_tpu.constants import ACCLError
+    from accl_tpu.backends.tpu import TpuWorld
+
+    with TpuWorld(2) as w:
+        boom = {"armed": False}
+        orig_run = type(w.engine)._run_collective
+
+        def sabotaged(self, op, comm_id, gang):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("injected dispatch failure")
+            return orig_run(self, op, comm_id, gang)
+
+        type(w.engine)._run_collective = sabotaged
+        try:
+            def worker(accl, rank):
+                n = 64
+                s = accl.create_buffer_like(np.ones(n, np.float32))
+                r = accl.create_buffer(n, np.float32)
+                if rank == 0:
+                    boom["armed"] = True
+                got_err = False
+                try:
+                    accl.allreduce(s, r, n, ReduceFunction.SUM)
+                except ACCLError:
+                    got_err = True
+                # the engine must still be alive: a fresh call works
+                accl.allreduce(s, r, n, ReduceFunction.SUM)
+                np.testing.assert_allclose(r.host, 2.0)
+                return got_err
+
+            errs = w.run(worker)
+            # the sabotaged gang completed as an error on every member
+            assert all(errs), errs
+        finally:
+            type(w.engine)._run_collective = orig_run
