@@ -547,6 +547,14 @@ class TpuEngine:
             if not self._ready:
                 return None
         plan = self._gang_plan(op, comm_id, gang)
+        if plan["fn_args"][-1]:
+            # ring=True: the Pallas ring kernels assign fixed
+            # collective_ids per segment parity; fusing two instances
+            # into one program would give data-independent rings the
+            # SAME barrier/ACK semaphores, which cross-device skew can
+            # alias into a double-buffer overrun on real hardware —
+            # ring-path gangs always dispatch alone
+            return None
         items = [(op, comm_id, gang, plan)]
         res_addrs = set(plan["res_addrs"])
         while len(items) < self._BATCH_CAP:
@@ -645,15 +653,16 @@ class TpuEngine:
             (g, c.addr_0, c.addr_2, c.count, c.root_src_dst, c.function,
              c.compression_flags, c.arithcfg, c.stream_flags, c.tag)
             for g, c in ((m, gang[m][0]) for m in members)))
-        # LOCK-FREE hit path: dict reads are GIL-atomic, and the
-        # executor contends with every submitting rank thread for
-        # self._lock — profiled at hundreds of µs/call of convoying on
-        # a busy box when the hit path took the lock.  The cost is LRU
-        # recency (no move_to_end on hits): eviction degrades to
-        # insertion order, which only matters past 256 live signatures.
-        plan = self._gang_plans.get(sig)
-        if plan is not None:
-            return plan
+        # since the executor-thread redesign, _gang_plan runs ONLY on
+        # the dedicated executor — the lock is uncontended here, so the
+        # hit path keeps proper LRU recency (an early r5 build skipped
+        # move_to_end to dodge submit-thread convoying that no longer
+        # exists; past 256 live signatures that cost re-compiles)
+        with self._lock:
+            plan = self._gang_plans.get(sig)
+            if plan is not None:
+                self._gang_plans.move_to_end(sig)
+                return plan
 
         nranks = len(members)
         mesh = self._mesh_for(tuple(members))

@@ -60,20 +60,29 @@ BASELINE_GBPS = 16.0  # reference CCLO datapath (BASELINE.md)
 LAST_TPU_JSON = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench", "results", "last_tpu_bench.json")
 
-# per-STAGE ledger: the worker banks each completed measurement stage
-# here as it lands (atomic rewrite), so a chip claim that hangs midway
+# per-STAGE ledgers: the worker banks each completed measurement stage
+# as it lands (atomic rewrite), so a chip claim that hangs midway
 # through a later stage still leaves this run's earlier stages fresh —
 # r4 lost its whole record to exactly this (three timed-out attempts,
 # stale replay).  The orchestrator assembles a partial-but-fresh result
 # from the ledger when every full attempt dies, and a retry attempt in
 # the same run skips stages the previous attempt already banked.
-STAGE_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "bench", "results", "bench_stages.json")
+# ONE FILE PER RUN ID: a shared file would let any invocation with a
+# different id (a stray `python bench.py` beside the harvest loop)
+# wipe hours of banked hardware stages wholesale.
+_LEDGER_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench", "results")
+
+
+def _ledger_path(run_id: str) -> str:
+    safe = "".join(c if (c.isalnum() or c in "-_") else "_"
+                   for c in run_id) or "default"
+    return os.path.join(_LEDGER_DIR, f"bench_stages.{safe}.json")
 
 
 def _load_ledger(run_id: str) -> dict:
     try:
-        with open(STAGE_LEDGER) as f:
+        with open(_ledger_path(run_id)) as f:
             led = json.load(f)
         if led.get("run_id") == run_id:
             return led
@@ -85,11 +94,12 @@ def _load_ledger(run_id: str) -> dict:
 def _bank_stage(led: dict, name: str, data: dict) -> None:
     led["stages"][name] = data
     led["banked_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    path = _ledger_path(led.get("run_id", ""))
     try:
-        tmp = STAGE_LEDGER + ".tmp"
+        tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(led, f)
-        os.replace(tmp, STAGE_LEDGER)
+        os.replace(tmp, path)
         print(f"[bench worker] banked stage {name!r}", file=sys.stderr,
               flush=True)
     except OSError as e:  # never sink a measurement over disk trouble
@@ -901,15 +911,24 @@ def main() -> None:
     if result is None:
         # no stages under OUR run id — but a harvest loop (another
         # invocation with its own pinned id, scripts/chip_harvest.sh)
-        # may have banked recent fresh stages in the on-disk ledger;
+        # may have banked recent fresh stages in its own ledger file;
         # those are real hardware measurements and still beat a stale
         # replay.  Recency-gated: a ledger from a previous round's
         # filesystem must not masquerade as this run's.
         try:
-            with open(STAGE_LEDGER) as f:
-                foreign = json.load(f)
             import calendar
+            import glob as _glob
 
+            cands = []
+            for p in _glob.glob(os.path.join(_LEDGER_DIR,
+                                             "bench_stages.*.json")):
+                try:
+                    with open(p) as f:
+                        cands.append(json.load(f))
+                except (OSError, ValueError):
+                    continue
+            foreign = max(cands, key=lambda d: d.get("banked_at", ""),
+                          default={})
             banked = foreign.get("banked_at", "")
             # the timestamp is UTC: timegm, not mktime (which would
             # skew the age by the host's UTC offset)

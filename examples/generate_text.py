@@ -47,10 +47,11 @@ def main() -> None:
         return s / c
 
     grad_fn = jax.jit(jax.grad(mean_loss))
-    for step in range(int(os.environ.get("ACCL_EXAMPLE_STEPS", "3"))):
+    n_steps = int(os.environ.get("ACCL_EXAMPLE_STEPS", "3"))
+    for _ in range(n_steps):
         grads = grad_fn(params, data)
         params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
-    print(f"trained {step + 1} steps")
+    print(f"trained {n_steps} steps")
 
     prompt = data[:2, :8]
     out = generate(params, prompt, cfg, max_new=6)

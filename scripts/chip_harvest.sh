@@ -15,9 +15,9 @@ NAP=180
 bench_complete() {
   python - <<EOF
 import json, sys
-from bench import ALL_STAGES  # one completeness definition (bench.py)
+from bench import ALL_STAGES, _ledger_path  # bench.py owns both
 try:
-    with open("bench/results/bench_stages.json") as f:
+    with open(_ledger_path("$RUN_ID")) as f:
         led = json.load(f)
     stages = set(led.get("stages", {}))
     ok = (led.get("run_id") == "$RUN_ID"

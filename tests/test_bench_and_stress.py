@@ -160,8 +160,7 @@ def test_bench_stage_ledger_roundtrip(tmp_path, monkeypatch):
     result line from whatever fragments landed (r4 lost its round
     record to an all-or-nothing worker; this is the regression lock)."""
     bench = _load_bench()
-    monkeypatch.setattr(bench, "STAGE_LEDGER",
-                        str(tmp_path / "stages.json"))
+    monkeypatch.setattr(bench, "_LEDGER_DIR", str(tmp_path))
 
     led = bench._load_ledger("run-A")
     assert led["stages"] == {}
