@@ -605,3 +605,56 @@ def test_gang_executor_error_isolation():
             assert all(errs), errs
         finally:
             type(w.engine)._run_collective = orig_run
+
+
+def test_ring_path_gangs_never_batch():
+    """Ring-path (Pallas) collectives must dispatch alone: fusing two
+    instances into one compiled program would alias their fixed
+    collective_ids (barrier/ACK semaphores) — r5 review finding."""
+    from collections import Counter
+
+    from accl_tpu.backends.tpu import TpuEngine, TpuWorld
+
+    sizes = Counter()
+    orig_batch = TpuEngine._exec_gang_batch
+
+    def spy(self, items):
+        for _op, _c, _g, plan in items:
+            assert not plan["fn_args"][-1], "ring gang entered a batch"
+        sizes[len(items)] += 1
+        return orig_batch(self, items)
+
+    TpuEngine._exec_gang_batch = spy
+    try:
+        # force EVERY payload onto the ring path
+        import os
+        os.environ["ACCL_RING_THRESHOLD"] = "0"
+        try:
+            with TpuWorld(4) as w:
+                assert w.engine.ring_threshold_bytes == 0
+
+                def worker(accl, rank):
+                    n = 256
+                    s = accl.create_buffer_like(
+                        np.full(n, float(rank + 1), np.float32))
+                    s.sync_to_device()
+                    r = accl.create_buffer(n, np.float32)
+                    reqs = [accl.allreduce(s, r, n, ReduceFunction.SUM,
+                                           from_fpga=True, to_fpga=True,
+                                           run_async=True)
+                            for _ in range(6)]
+                    for q in reqs:
+                        assert q.wait(120)
+                        q.check()
+                    r.sync_from_device()
+                    np.testing.assert_allclose(r.host, 10.0)
+                    return True
+
+                assert all(w.run(worker))
+        finally:
+            del os.environ["ACCL_RING_THRESHOLD"]
+    finally:
+        TpuEngine._exec_gang_batch = orig_batch
+    # every dispatch was singular (the spy asserts no ring in batches;
+    # with only ring gangs in flight no batch may have formed at all)
+    assert not sizes or set(sizes) == set(), sizes
