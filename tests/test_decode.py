@@ -236,3 +236,16 @@ def test_moe_decode_expert_parallel_matches_dense():
         lg, cache = fstep(sharded, tokens[:, t], cache)
         np.testing.assert_allclose(np.asarray(lg), want[:, t],
                                    rtol=3e-5, atol=3e-5, err_msg=f"t={t}")
+
+
+def test_decode_bf16_config_parity():
+    """bf16 activations (the real-TPU serving dtype): teacher-forced
+    decode tracks the training forward within bf16 tolerance."""
+    cfg, params, tokens = _setup(dtype="bfloat16", n_kv_heads=2)
+    want = np.asarray(forward(params, tokens, cfg), np.float32)
+    cache = init_kv_cache(cfg, B, T)
+    step = jax.jit(decode_step, static_argnames=("cfg",))
+    for t in range(T):
+        lg, cache = step(params, tokens[:, t], cache, cfg)
+        np.testing.assert_allclose(np.asarray(lg, np.float32), want[:, t],
+                                   rtol=3e-2, atol=3e-2, err_msg=f"t={t}")
