@@ -53,8 +53,10 @@ class ModelConfig:
     #: tokens.  flash bounds the grid schedules (forward AND both
     #: backward kernels) to the visible blocks — out-of-window K/V is
     #: never fetched (ops/flash.py); dense applies the band mask.
-    #: Not composable with sequence parallelism (the ring's hop
-    #: liveness does not model a window).
+    #: Under sequence parallelism (contiguous schedule; window <=
+    #: T_local) the attention collapses to the local windowed block
+    #: plus ONE neighbor hop — O(1) in the ring size
+    #: (parallel.ring_attention window= path); zigzag + window raises.
     attn_window: int | None = None
     #: MLP flavor: "gelu" (plain two-matrix) or "swiglu" (the
     #: Llama-family gated unit: silu(x W1) * (x W3) W2 — a third
@@ -267,18 +269,20 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             from ..parallel.ring_attention import expand_gqa_kv
             k, v = expand_gqa_kv(k, v, q.shape[2])
         if sp_axis is not None:
-            if cfg.attn_window is not None:
+            if cfg.attn_window is not None and cfg.sp_schedule != \
+                    "contiguous":
                 raise ValueError(
-                    "attn_window does not compose with sequence "
-                    "parallelism (the ring's hop liveness does not "
-                    "model a window)")
+                    "attn_window under sequence parallelism requires "
+                    "the contiguous schedule (the zigzag layout's "
+                    "split chunks break the one-neighbor-hop bound)")
             if cfg.attn == "flash":
                 raise ValueError(
                     "attn='flash' is the single-shard attention kernel; "
                     "with sequence parallelism the ring layer owns the "
                     "attention schedule — use attn='dense' when sp is on")
             attn = ring_attention(q, k, v, axis=sp_axis, causal=True,
-                                  schedule=cfg.sp_schedule)
+                                  schedule=cfg.sp_schedule,
+                                  window=cfg.attn_window)
         elif cfg.attn == "flash":
             from ..ops.flash import flash_attention
             # MXU input format follows the model's activation dtype:

@@ -102,7 +102,8 @@ def zigzag_indices_inverse(T: int, P: int):
 
 def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
                    impl: str | None = None, schedule: str = "contiguous",
-                   flash_opts: dict | None = None):
+                   flash_opts: dict | None = None,
+                   window: int | None = None):
     """Exact attention over the full (ring-distributed) sequence.
 
     Per-member shapes [B, T_local, H, D]; the global sequence is the
@@ -126,6 +127,12 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
     `flash_opts` forwards static schedule options to the per-hop flash
     kernel (e.g. ``{"q_tiles": 2, "fuse_denom": True}``) so distributed
     callers can run the chip-tuned schedule; ignored by the dense impl.
+
+    `window` (causal + contiguous only, window <= T_local) runs
+    SLIDING-WINDOW attention under sequence parallelism: each query's
+    visible band fits in its own shard plus the previous one, so the
+    schedule is the local windowed block + ONE neighbor hop instead of
+    a P-hop ring (see :func:`_ring_attention_windowed`).
     """
     if schedule not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring_attention schedule {schedule!r}")
@@ -142,6 +149,22 @@ def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
                 f"{q.shape[2]} for GQA")
         if impl == "dense":
             k, v = expand_gqa_kv(k, v, q.shape[2])
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (a sliding "
+                             "window is a trailing-context mask)")
+        if schedule != "contiguous":
+            raise ValueError("window composes with the contiguous "
+                             "schedule only (the zigzag layout's split "
+                             "chunks break the one-neighbor-hop bound)")
+        Tl = q.shape[1]
+        if window < 1 or window > Tl:
+            raise ValueError(
+                f"window={window} must be in [1, T_local={Tl}]: larger "
+                "windows span more than one neighbor shard (shard the "
+                "sequence into fewer, longer pieces)")
+        return _ring_attention_windowed(q, k, v, axis, window, impl,
+                                        flash_opts=flash_opts)
     if schedule == "zigzag":
         if not causal:
             raise ValueError("zigzag schedule only makes sense for causal "
@@ -226,7 +249,9 @@ def _lse_merge(o, lse, o_i, lse_i, _NI=NEG_INF):
     safe = jnp.where(m_new <= _NI / 2, 0.0, m_new)
     w_r = jnp.where(lse <= _NI / 2, 0.0, jnp.exp(lse - safe))
     w_i = jnp.where(lse_i <= _NI / 2, 0.0, jnp.exp(lse_i - safe))
-    tot = jnp.maximum(w_r + w_i, 1e-38)
+    # normal-range epsilon: 1e-38 is subnormal f32 and flushes to zero
+    # under FTZ, making the both-dead case 0/0 = NaN
+    tot = jnp.maximum(w_r + w_i, 1e-30)
     wr4 = (w_r / tot).transpose(0, 2, 1)[..., None]  # [B, T, H, 1]
     wi4 = (w_i / tot).transpose(0, 2, 1)[..., None]
     o_new = o * wr4 + o_i.astype(jnp.float32) * wi4
@@ -351,6 +376,105 @@ def _ring_attention_flash_zigzag(q, k, v, axis: str,
     o_lo, _sl, o_hi, _sh, _, _ = lax.fori_loop(
         0, P, step, (o0, lse0, o0, lse0, k, v))
     return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+
+
+
+
+def _banded_cross_lse(q, kk, vv, offset: int, window: int, live):
+    """lse-emitting dense attention of a q shard against ONE K/V shard
+    under a trailing window, in relative coordinates: q row i sits
+    `offset + i - j` positions after k row j; a cell contributes iff
+    0 <= offset + i - j < window (the >= 0 half IS causality).  `live`
+    is a traced bool gating the whole block (rank 0 has no previous
+    shard).  Returns (o [B, T, H, D] normalized, lse [B, H, T] natural
+    log) with dead rows at lse = -inf / o = 0 — the _lse_merge
+    contract, so partial blocks fold exactly."""
+    B, T, H, D = q.shape
+    Tk = kk.shape[1]
+    if kk.shape[2] != H:
+        kk, vv = expand_gqa_kv(kk, vv, H)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kk.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    d = (offset + lax.broadcasted_iota(jnp.int32, (T, Tk), 0)
+         - lax.broadcasted_iota(jnp.int32, (T, Tk), 1))
+    keep = (d >= 0) & (d < window) & live
+    s = jnp.where(keep[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    shift = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - shift)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    # epsilon must be a NORMAL f32: 1e-38 is subnormal and flushes to
+    # zero under FTZ, turning the dead-row guard into 0/0 = NaN
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30),
+                     vv.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    lse = jnp.where(l[..., 0] == 0.0, NEG_INF,
+                    shift[..., 0] + jnp.log(jnp.maximum(l[..., 0],
+                                                        1e-30)))
+    return out, lse  # o fp32, lse [B, H, T]
+
+
+def _ring_attention_windowed(q, k, v, axis: str, window: int,
+                             impl: str, flash_opts: dict | None = None):
+    """Sliding-window attention under sequence parallelism (contiguous
+    shards, causal, window <= T_local): every query's visible band
+    lies within its OWN shard plus the previous one, so the full ring
+    collapses to the local block + ONE neighbor hop — O(1) in the ring
+    size where the unwindowed ring is O(P) (the Mistral-style
+    long-context composition the r4 build rejected outright).
+
+    Local block: the shard's own causal window attention (the flash
+    grid schedule's bounded-liveness path on TPU).  Boundary block:
+    a banded dense cross against the previous shard's K/V (one block
+    per rank — it cannot dominate at scale).  Exact merge by lse."""
+    P = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+
+    if impl == "flash":
+        from ..ops.flash import flash_attention_lse
+
+        on_tpu, mxu_dt = _flash_defaults(q)
+        opts = dict(flash_opts or {})
+        opts.setdefault("interpret", not on_tpu)
+        opts.setdefault("mxu_dtype", mxu_dt)
+        o_loc, lse_loc = flash_attention_lse(q, k, v, causal=True,
+                                             window=window, **opts)
+        o_loc = o_loc.astype(jnp.float32)
+    elif impl == "dense":
+        # local block through the SAME banded helper (offset 0: the
+        # d >= 0 arm is exactly the causal mask)
+        o_loc, lse_loc = _banded_cross_lse(q, k, v, 0, window,
+                                           jnp.bool_(True))
+    else:
+        raise ValueError(f"unknown ring_attention impl {impl!r}")
+
+    Wn = window - 1  # boundary band width (window=1: self-only)
+    if Wn == 0:
+        return o_loc.astype(q.dtype)
+
+    # ONE hop, STATICALLY SLICED to the live band: only the previous
+    # shard's last Wn rows are visible to anyone here, and only this
+    # shard's first Wn queries can see them — the hop moves
+    # O(window) K/V bytes and the cross scores O(window^2) cells, not
+    # O(Tl^2) (at Tl >> window the full-shard version would dominate
+    # exactly where the windowed path is meant to win)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    ktail = lax.ppermute(k[:, Tl - Wn:], axis, perm)
+    vtail = lax.ppermute(v[:, Tl - Wn:], axis, perm)
+    # tail row j' is global position (prev shard) Tl - Wn + j', so a
+    # local query i sits i + Wn - j' positions after it
+    o_bs, lse_bs = _banded_cross_lse(q[:, :Wn], ktail, vtail, Wn,
+                                     window, idx > 0)
+    H_q = q.shape[2]
+    o_b = jnp.zeros((B, Tl, H_q, D), jnp.float32).at[:, :Wn].set(o_bs)
+    lse_b = jnp.full((B, H_q, Tl), NEG_INF,
+                     jnp.float32).at[:, :, :Wn].set(lse_bs)
+    o, _ = _lse_merge(o_loc, lse_loc, o_b, lse_b)
+    return o.astype(q.dtype)
 
 
 def _ring_attention_flash(q, k, v, axis: str, causal: bool,

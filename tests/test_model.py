@@ -24,9 +24,11 @@ def _tokens(b, t, seed=0):
         0, CFG.vocab, size=(b, t)).astype(np.int32)
 
 
-def _single_device_step(params, tokens, lr=1e-3):
+def _single_device_step(params, tokens, lr=1e-3, cfg=None):
+    cfg = cfg if cfg is not None else CFG
+
     def total_loss(p):
-        s, c = loss_fn(p, tokens, CFG)
+        s, c = loss_fn(p, tokens, cfg)
         return s, c
 
     (loss_sum, count), grads = jax.value_and_grad(total_loss,
@@ -422,15 +424,63 @@ def test_sliding_window_flash_matches_dense():
     assert np.abs(np.asarray(out_d) - np.asarray(out_full)).max() > 1e-4
 
 
-def test_sliding_window_rejects_sp():
+def test_sliding_window_sp_composition_rules():
+    """r5: window + sp COMPOSES on the contiguous schedule (covered by
+    test_windowed_sp_train_step_matches_single); the zigzag layout's
+    split chunks break the one-neighbor-hop bound and must raise, as
+    must a window wider than the local shard."""
     import dataclasses
 
-    cfg = dataclasses.replace(CFG, attn_window=8)
+    from jax.sharding import NamedSharding
+
+    cfg = dataclasses.replace(CFG, attn_window=8, sp_schedule="zigzag")
     mesh = make_mesh(sp=2)
     step, (specs, tok_spec) = make_train_step(mesh, cfg)
     p = shard_params(init_params(np.random.default_rng(1), cfg), mesh, cfg)
-    from jax.sharding import NamedSharding
     tok = jax.device_put(jnp.asarray(_tokens(2, 16)),
                          NamedSharding(mesh, tok_spec))
-    with pytest.raises(Exception, match="attn_window"):
+    with pytest.raises(Exception, match="zigzag|contiguous"):
         step(p, tok)
+
+    # window wider than the local shard: 16 tokens over sp=2 -> Tl=8 < 9
+    cfg2 = dataclasses.replace(CFG, attn_window=9)
+    step2, (_s2, tok_spec2) = make_train_step(mesh, cfg2)
+    p2 = shard_params(init_params(np.random.default_rng(1), cfg2), mesh,
+                      cfg2)
+    tok2 = jax.device_put(jnp.asarray(_tokens(2, 16)),
+                          NamedSharding(mesh, tok_spec2))
+    with pytest.raises(Exception, match="window"):
+        step2(p2, tok2)
+
+
+def test_windowed_sp_train_step_matches_single():
+    """attn_window + sequence parallelism (r5: local windowed block +
+    one neighbor hop) — the full TRAIN STEP must reproduce the
+    single-device banded run: loss and updated parameters."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+
+    B, T, W = 4, 32, 5   # T_local = 8 >= W (one-neighbor-hop bound)
+    mesh = make_mesh(sp=4)
+    cfg = dataclasses.replace(CFG, attn_window=W)
+    rng = np.random.default_rng(1)
+    params = init_params(rng, cfg)
+    tokens = _tokens(B, T, seed=2)
+
+    def single_step(params, tokens):
+        return _single_device_step(params, tokens, cfg=cfg)
+
+    ref_params, ref_loss = jax.jit(single_step)(params,
+                                                jnp.asarray(tokens))
+    step, (specs, tok_spec) = make_train_step(mesh, cfg)
+    p_sharded = shard_params(params, mesh, cfg)
+    tok_dev = jax.device_put(jnp.asarray(tokens),
+                             NamedSharding(mesh, tok_spec))
+    new_params, loss = step(p_sharded, tok_dev)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5,
+                               atol=1e-6)
+    for got, exp in zip(jax.tree_util.tree_leaves(new_params),
+                        jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   rtol=5e-4, atol=5e-5)
