@@ -779,3 +779,35 @@ def test_windowed_ring_validation():
     with pytest.raises(ValueError, match="contiguous"):
         ring_attention(q, q, q, causal=True, window=4, impl="dense",
                        schedule="zigzag")
+
+
+def test_ulysses_windowed_attn_fn_matches_banded_dense():
+    """Window + Ulysses SP: the full-sequence head-subset layout makes
+    windows compose for free via attn_fn — each member runs the banded
+    kernel over the whole sequence on its heads."""
+    import functools
+
+    import jax
+
+    from accl_tpu.ops.flash import flash_attention
+    from accl_tpu.parallel.mesh import make_mesh
+    from accl_tpu.parallel.ring_attention import (_dense_attention,
+                                                  ulysses_attention)
+
+    P_sp, B, Tl, H, D, W = 4, 1, 16, 4, 16, 9
+    mesh = make_mesh(sp=P_sp)
+    rng = np.random.default_rng(81)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, P_sp * Tl, H, D)),
+                             jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    want = np.asarray(_dense_attention(q, k, v, causal=True, window=W))
+    spec = P(None, "sp", None, None)
+    fn = functools.partial(flash_attention, causal=True, window=W,
+                           mxu_dtype=jnp.float32, interpret=True)
+    f = jax.jit(jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, axis="sp",
+                                          causal=True, attn_fn=fn),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False))
+    got = np.asarray(f(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
