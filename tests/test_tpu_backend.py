@@ -628,6 +628,7 @@ def test_ring_path_gangs_never_batch():
     try:
         # force EVERY payload onto the ring path
         import os
+        prior = os.environ.get("ACCL_RING_THRESHOLD")
         os.environ["ACCL_RING_THRESHOLD"] = "0"
         try:
             with TpuWorld(4) as w:
@@ -652,9 +653,12 @@ def test_ring_path_gangs_never_batch():
 
                 assert all(w.run(worker))
         finally:
-            del os.environ["ACCL_RING_THRESHOLD"]
+            if prior is None:
+                del os.environ["ACCL_RING_THRESHOLD"]
+            else:
+                os.environ["ACCL_RING_THRESHOLD"] = prior
     finally:
         TpuEngine._exec_gang_batch = orig_batch
     # every dispatch was singular (the spy asserts no ring in batches;
     # with only ring gangs in flight no batch may have formed at all)
-    assert not sizes or set(sizes) == set(), sizes
+    assert not sizes, sizes
