@@ -83,8 +83,14 @@ class ACCL:
         # repeated call re-derives the same flag algebra every step;
         # the memo collapses that to one dict hit (the reference keeps
         # prepare_call cheap the same way: a handful of field writes).
-        # Bounded: fresh buffer addresses mint fresh keys.
-        self._call_memo: dict = {}
+        # Bounded LRU: fresh buffer addresses mint fresh keys, and a
+        # descriptor-heavy workload cycling through > cap distinct
+        # descriptors must evict the COLDEST entry, not wholesale-clear
+        # — a clear-at-capacity memo re-derived every live call each
+        # pass exactly when the memo mattered most.
+        from collections import OrderedDict
+
+        self._call_memo: "OrderedDict" = OrderedDict()
         self._call_memo_cap = 512
 
     # ------------------------------------------------------------------
@@ -732,6 +738,7 @@ class ACCL:
                     stream_flags, compress_dtype, op0_dtype, res_dtype)
         cached = self._call_memo.get(memo_key)
         if cached is not None:
+            self._call_memo.move_to_end(memo_key)
             return cached
 
         dummy = DummyBuffer()
@@ -832,9 +839,9 @@ class ACCL:
             addr_1=op1.address,
             addr_2=res.address,
         )
-        if len(self._call_memo) >= self._call_memo_cap:
-            self._call_memo.clear()  # rare; cheaper than LRU bookkeeping
         self._call_memo[memo_key] = call
+        while len(self._call_memo) > self._call_memo_cap:
+            self._call_memo.popitem(last=False)
         return call
 
     def _config_call(self, func: CfgFunc, value: int = 0) -> None:
@@ -870,7 +877,10 @@ class ACCL:
             if not buf.is_dummy:
                 buf.slice(0, count).sync_to_device()
 
-        req = Request(desc)
+        # sync=True marks a call whose submitter blocks below: backends
+        # with a leader-dispatch fast path (backends/tpu.py) may then
+        # execute the gang inline on the last-arriving rank's thread
+        req = Request(desc, sync=not run_async)
 
         if sync_out:  # device-resident results need no completion sync
             def finish(r: Request) -> None:

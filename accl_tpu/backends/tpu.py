@@ -174,6 +174,11 @@ class TpuEngine:
         # of at the next consumer.
         self.profile_sync = (
             _os.environ.get("ACCL_PROFILE_SYNC", "0") == "1")
+        # leader-dispatch fast path for blocking gangs (_dispatch_gang);
+        # ACCL_LEADER_DISPATCH=0 forces every gang through the executor
+        # (the pre-r6 path) — the A/B lane the callrate bench reports
+        self.leader_dispatch = (
+            _os.environ.get("ACCL_LEADER_DISPATCH", "1") != "0")
         # per-rank address -> buffer registry
         self._buffers: list[dict[int, TpuBuffer]] = [dict() for _ in range(nranks)]
         self._next_addr = [_ADDR_STRIDE] * nranks
@@ -197,6 +202,23 @@ class TpuEngine:
         self._ready: deque = deque()
         self._ready_cv = threading.Condition()
         self._shutdown = False
+        # leader-dispatch fast path state (see _dispatch_gang): at most
+        # ONE gang executes at any moment — either on the executor
+        # thread (_exec_busy) or inline on the last-arriving rank's
+        # thread (_inline_busy).  Both flags live under _ready_cv so the
+        # idle check and the claim are atomic against each other.
+        self._exec_busy = False
+        self._inline_busy = False
+        #: dispatch-lane counters (observability: callrate bench lanes
+        #: and the deterministic fast-path tests read these).  Each key
+        #: has a single writer context — leader_dispatches under the
+        #: serialized inline lane, the rest on the executor thread.
+        self.stats = {
+            "leader_dispatches": 0,
+            "executor_dispatches": 0,
+            "batches": 0,
+            "batched_gangs": 0,
+        }
         self._exec_thread = threading.Thread(
             target=self._exec_loop, name="accl-gang-exec", daemon=True)
         self._exec_thread.start()
@@ -495,7 +517,65 @@ class TpuEngine:
                     ready = gang
                     q.remove(gang)
         if ready is not None:
-            self._enqueue_ready(int(call.scenario), call.comm, ready)
+            self._dispatch_gang(int(call.scenario), call.comm, ready,
+                                request)
+
+    def _dispatch_gang(self, scenario: int, comm_id: int, gang: dict,
+                       leader_req: Request) -> None:
+        """Route one complete gang to its dispatch lane.
+
+        Leader-dispatch fast path (the reference's post-and-poll call
+        economics, fpgadevice.cpp:24-33): when every member's request
+        is BLOCKING (sync-resident), the last-arriving rank runs the
+        fused program inline on its own thread — no executor wakeup on
+        the way in, and the leader's own completion needs no futex wait
+        on the way out, so the critical path loses one full thread
+        rendezvous.  Safe because every member's submitter is parked in
+        Request.wait until this very gang completes: inline execution
+        cannot stall anyone's next submission (the r4 inline design
+        failed exactly there for ASYNC submitters, which is why the
+        async lane keeps the posted-descriptor + executor path and its
+        gang batching).
+
+        The inline run is DEFERRED to the leader's Request.wait (the
+        pre_wait hook): this method is reached under the leader rank's
+        RequestQueue submission lock, and executing the gang program
+        there would stall a concurrent submission on the same handle
+        for the whole device dispatch — wait() runs microseconds later
+        on the same thread, after the lock is released.  A sync gang's
+        leader waits by definition, so the thunk always runs.
+
+        The fast path requires the engine to be otherwise IDLE — no
+        queued gangs and no dispatch in flight — so execution stays
+        globally one-at-a-time in gang-completion order, exactly the
+        executor's serialization (concurrent dispatch of two gangs
+        sharing a member's buffers would race the rebind).  Any async
+        member, or a busy engine at thunk-run time, falls back to the
+        executor queue."""
+        if self.leader_dispatch and all(
+                req.sync for _c, req, _k in gang.values()):
+
+            def run_inline() -> None:
+                with self._ready_cv:
+                    idle = (not self._ready and not self._exec_busy
+                            and not self._inline_busy)
+                    if idle:
+                        self._inline_busy = True
+                if not idle:
+                    self._enqueue_ready(scenario, comm_id, gang)
+                    return
+                try:
+                    self.stats["leader_dispatches"] += 1
+                    self._exec_gang(scenario, comm_id, gang)
+                finally:
+                    with self._ready_cv:
+                        self._inline_busy = False
+                        if self._ready or self._shutdown:
+                            self._ready_cv.notify()
+
+            leader_req.pre_wait = run_inline
+            return
+        self._enqueue_ready(scenario, comm_id, gang)
 
     def _enqueue_ready(self, scenario: int, comm_id: int,
                        gang: dict) -> None:
@@ -509,25 +589,38 @@ class TpuEngine:
             self._ready_cv.notify()
 
     def _exec_loop(self) -> None:
-        """Dedicated gang executor (see _ready above)."""
+        """Dedicated gang executor (see _ready above).  Mutually
+        exclusive with the leader-dispatch lane: while an inline
+        dispatch is in flight the executor parks, so at most one gang
+        program runs at any moment (global completion-order
+        serialization — the property both lanes rely on)."""
         while True:
             with self._ready_cv:
-                while not self._ready and not self._shutdown:
+                while True:
+                    if self._ready and not self._inline_busy:
+                        break
+                    if self._shutdown and not self._ready:
+                        return
                     self._ready_cv.wait()
-                if not self._ready and self._shutdown:
-                    return
                 scenario, comm_id, gang = self._ready.popleft()
+                self._exec_busy = True
             try:
                 items = self._extend_batch(scenario, comm_id, gang)
                 if items is None:
+                    self.stats["executor_dispatches"] += 1
                     self._exec_gang(scenario, comm_id, gang)
                 else:
+                    self.stats["batches"] += 1
+                    self.stats["batched_gangs"] += len(items)
                     self._exec_gang_batch(items)
             except Exception as e:  # pragma: no cover — belt and braces
                 for call, request, _k in gang.values():
                     request.description += f" [{e}]"
                     request.complete(int(ErrorCode.DMA_INTERNAL_ERROR),
                                      0.0)
+            finally:
+                with self._ready_cv:
+                    self._exec_busy = False
 
     #: max gangs fused into one dispatch (the reference's effective
     #: FPGAQueue depth; also bounds compiled-variant count per fn key)
@@ -542,6 +635,11 @@ class TpuEngine:
         batch of >= 2 formed, else None."""
         op = Operation(scenario)
         if op in (Operation.barrier,):
+            return None
+        if self.profile_sync:
+            # exact perf-counter mode: every gang dispatches alone so
+            # get_duration is THAT call's blocking on-device time, never
+            # an averaged share of a fused batch's wall clock
             return None
         with self._ready_cv:
             if not self._ready:
@@ -653,11 +751,13 @@ class TpuEngine:
             (g, c.addr_0, c.addr_2, c.count, c.root_src_dst, c.function,
              c.compression_flags, c.arithcfg, c.stream_flags, c.tag)
             for g, c in ((m, gang[m][0]) for m in members)))
-        # since the executor-thread redesign, _gang_plan runs ONLY on
-        # the dedicated executor — the lock is uncontended here, so the
-        # hit path keeps proper LRU recency (an early r5 build skipped
-        # move_to_end to dodge submit-thread convoying that no longer
-        # exists; past 256 live signatures that cost re-compiles)
+        # _gang_plan runs only on the dispatching context — the
+        # executor thread or (leader-dispatch lane) the one inline
+        # leader, never both at once — so the lock is effectively
+        # uncontended here and the hit path keeps proper LRU recency
+        # (an early r5 build skipped move_to_end to dodge submit-thread
+        # convoying that no longer exists; past 256 live signatures
+        # that cost re-compiles)
         with self._lock:
             plan = self._gang_plans.get(sig)
             if plan is not None:
@@ -767,13 +867,18 @@ class TpuEngine:
             # one dispatch; the address sets drive the RAW guard (a
             # candidate whose operands intersect an earlier batch
             # member's results must see the rebound value, so it ends
-            # the batch)
+            # the batch).  Keyed by (rank, address): the per-rank
+            # allocators are symmetric — every rank mints the same
+            # numeric addresses — so a raw-address set would falsely
+            # alias unrelated cross-rank buffers and end batches that
+            # have no hazard at all (e.g. disjoint sub-communicator
+            # gangs); only a same-rank overlap is a real RAW.
             "fn_args": fn_args,
             "opnd_addrs": frozenset(
-                b.address for _g, b, _o, _f, _r, _ro, _os, _rt in ops
+                (g, b.address) for g, b, _o, _f, _r, _ro, _os, _rt in ops
                 if b is not None),
             "res_addrs": frozenset(
-                r.address for _g, _b, _o, _f, r, _ro, _os, _rt in ops
+                (g, r.address) for g, _b, _o, _f, r, _ro, _os, _rt in ops
                 if r is not None),
         }
         with self._lock:
@@ -1016,8 +1121,9 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
     role)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils.compat import shard_map
 
     n = in_len if op not in (Operation.scatter, Operation.reduce_scatter,
                              Operation.alltoall) else in_len // nranks

@@ -15,10 +15,16 @@ Lanes (all interleaved, see below):
 - staged: host-staged operands, per-call sync in/out (worst case);
 - resident: device-resident operands (from_fpga/to_fpga — the
   reference zero-copy call path, accl.cpp:796-839), synchronous calls
-  so every call pays the full N-thread gang rendezvous;
+  served by the LEADER-DISPATCH fast path: the last-arriving rank runs
+  the fused gang program inline, no executor hop;
+- resident_exec: the same blocking calls with the fast path forced off
+  (ACCL_LEADER_DISPATCH=0 semantics) — every gang pays the executor
+  hand-off; the resident/resident_exec ratio isolates the dispatch-lane
+  effect from box noise;
 - async: resident + run_async with a bounded outstanding window,
   drained at the end — the driver-side twin of the raw loop, which
-  also only blocks once at the end;
+  also only blocks once at the end (served by the executor + batched
+  dispatch);
 - raw: the shard_map ceiling.
 
 METHODOLOGY: the lanes are measured INTERLEAVED in rounds, keeping
@@ -54,6 +60,7 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
 
     from accl_tpu import ReduceFunction
     from accl_tpu.backends.tpu import TpuWorld
+    from accl_tpu.utils.compat import shard_map
 
     out: dict = {"nranks": nranks, "count": count, "iters": iters,
                  "rounds": rounds}
@@ -93,6 +100,14 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
             jax.block_until_ready(r.dev)
             return time.perf_counter() - t0
 
+        # A/B twin of the resident lane with the leader-dispatch fast
+        # path forced OFF (every gang rides the executor hop — the
+        # pre-leader design), measured in the same interleaved windows:
+        # the leader/executor ratio isolates the dispatch-lane effect
+        # from box noise that moves raw and driver lanes together
+        def resident_exec(accl, rank):
+            return resident(accl, rank)
+
         def resident_async(accl, rank):
             s, r = bufs[rank]
             window: list = []
@@ -113,7 +128,7 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
         mesh = Mesh(np.array(devs), ("rank",))
         x = jnp.zeros((nranks, count), jnp.float32)
         x = jax.device_put(x, NamedSharding(mesh, P("rank", None)))
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda v: jax.lax.psum(v, "rank"), mesh=mesh,
             in_specs=P("rank", None), out_specs=P("rank", None)))
         jax.block_until_ready(fn(x))
@@ -125,19 +140,77 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
             jax.block_until_ready(y)
             return time.perf_counter() - t0
 
-        best = {"staged": None, "resident": None, "async": None,
-                "raw": None}
+        # per-ROUND times: every lane is measured once per round, so a
+        # round is one shared scheduling window — cross-lane ratios are
+        # only computed within a round (the same window-to-window
+        # discipline as bench/timing.py; a global per-lane best would
+        # pair one lane's lucky window against another's average one)
+        times: dict = {lane: [] for lane in (
+            "staged", "resident", "resident_exec", "async", "raw")}
 
-        def keep(lane, dt):
-            if best[lane] is None or dt < best[lane]:
-                best[lane] = dt
+        # dispatch-lane attribution per bench lane: the stats delta
+        # across one lane slice shows which engine lane (leader inline /
+        # executor / fused batch) actually carried its calls
+        lane_stats: dict = {}
+
+        def snap():
+            return dict(w.engine.stats)
+
+        def delta(before, after):
+            return {k: after[k] - before[k] for k in after}
 
         for _ in range(rounds):
-            keep("raw", raw())
-            keep("staged", max(w.run(staged)))
-            keep("resident", max(w.run(resident)))
-            keep("async", max(w.run(resident_async)))
+            times["raw"].append(raw())
+            s0 = snap()
+            times["staged"].append(max(w.run(staged)))
+            lane_stats["staged"] = delta(s0, snap())
+            s0 = snap()
+            times["resident"].append(max(w.run(resident)))
+            lane_stats["resident"] = delta(s0, snap())
+            w.engine.leader_dispatch = False
+            try:
+                s0 = snap()
+                times["resident_exec"].append(max(w.run(resident_exec)))
+                lane_stats["resident_exec"] = delta(s0, snap())
+            finally:
+                w.engine.leader_dispatch = True
+            s0 = snap()
+            times["async"].append(max(w.run(resident_async)))
+            lane_stats["async"] = delta(s0, snap())
 
+        best = {lane: min(ts) for lane, ts in times.items()}
+
+        def round_ratio(a, b):
+            """Best same-round a/b ratio (window-to-window)."""
+            return min(x / y for x, y in zip(times[a], times[b]))
+
+        # full per-round latencies: lets a reader audit every ratio and
+        # see the box's window-to-window swing instead of trusting the
+        # best-of summary
+        out["round_latencies_us"] = {
+            lane: [round(t / si * 1e6, 1) for t in ts]
+            for lane, ts in times.items()}
+
+    # side-by-side lane summary: the sync-resident (leader-dispatch),
+    # async (posted-descriptor + executor/batched), and raw shard_map
+    # lanes measured in the same interleaved windows, each with its
+    # call rate, per-call latency, overhead vs raw, and the engine
+    # dispatch lanes that served it
+    out["lanes"] = {}
+    for lane, label in (("staged", "driver_staged"),
+                        ("resident", "driver_sync_resident"),
+                        ("resident_exec", "driver_sync_executor_path"),
+                        ("async", "driver_async"),
+                        ("raw", "raw_shardmap")):
+        out["lanes"][label] = {
+            "calls_per_s": round(si / best[lane], 1),
+            "latency_us": round(best[lane] / si * 1e6, 1),
+            "overhead_vs_raw_x": round(round_ratio(lane, "raw"), 2),
+        }
+        if lane in lane_stats:
+            out["lanes"][label]["dispatch"] = lane_stats[lane]
+
+    # flat legacy keys (older round records / parsers read these)
     out["driver_calls_per_s"] = round(si / best["staged"], 1)
     out["driver_latency_us"] = round(best["staged"] / si * 1e6, 1)
     out["driver_resident_calls_per_s"] = round(si / best["resident"], 1)
@@ -147,8 +220,15 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
     out["driver_async_latency_us"] = round(best["async"] / si * 1e6, 1)
     out["raw_shardmap_calls_per_s"] = round(si / best["raw"], 1)
     out["raw_latency_us"] = round(best["raw"] / si * 1e6, 1)
-    out["driver_overhead_x"] = round(best["staged"] / best["raw"], 2)
-    out["resident_overhead_x"] = round(best["resident"] / best["raw"], 2)
+    out["driver_overhead_x"] = round(round_ratio("staged", "raw"), 2)
+    out["resident_overhead_x"] = round(round_ratio("resident", "raw"), 2)
+    out["async_overhead_x"] = round(round_ratio("async", "raw"), 2)
+    out["resident_vs_async_x"] = round(
+        round_ratio("resident", "async"), 2)
+    # the tentpole ratio: leader-dispatch sync lane vs the same lane
+    # forced through the executor, same interleaved windows
+    out["leader_vs_executor_x"] = round(
+        round_ratio("resident", "resident_exec"), 2)
     return out
 
 

@@ -150,6 +150,13 @@ def _select(lg, key, temperature: float, top_k):
         return jnp.argmax(lg, axis=-1).astype(jnp.int32)
     lg = lg.astype(jnp.float32) / temperature
     if top_k is not None:
+        # top_k is static and the vocab dim is a static shape, so this
+        # validates under jit: an out-of-range top_k would otherwise be
+        # index-clamped by JAX and silently degrade to plain
+        # temperature sampling
+        if not 1 <= top_k <= lg.shape[-1]:
+            raise ValueError(
+                f"top_k must be in [1, {lg.shape[-1]}], got {top_k}")
         kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
         lg = jnp.where(lg < kth, NEG_INF, lg)
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
@@ -189,6 +196,12 @@ def generate(params, prompt, cfg: ModelConfig, max_new: int,
     samples from the scaled distribution, optionally truncated to the
     `top_k` most likely tokens — pass a `jax.random` key for
     reproducible sampling (defaults to PRNGKey(0))."""
+    if top_k is not None and not 1 <= top_k <= cfg.vocab:
+        # validate eagerly (top_k is static): under jit an invalid k
+        # would be clamped and silently turn top-k sampling into plain
+        # temperature sampling
+        raise ValueError(
+            f"top_k must be in [1, vocab={cfg.vocab}], got {top_k}")
     if key is None:
         key = jax.random.PRNGKey(0)
     return _generate_impl(params, prompt, key, cfg, max_new, tp_axis,

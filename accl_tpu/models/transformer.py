@@ -19,6 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import shard_map as _shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import _dense_attention, ring_attention
@@ -187,7 +190,7 @@ def _global_positions(Tl: int, cfg: ModelConfig, sp_axis: Optional[str]):
         return jnp.arange(Tl)
     idx = lax.axis_index(sp_axis)
     if cfg.sp_schedule == "zigzag":
-        P_ = lax.axis_size(sp_axis)
+        P_ = _axis_size(sp_axis)
         C = Tl // 2
         a = jnp.arange(C)
         return jnp.concatenate([idx * C + a, (2 * P_ - 1 - idx) * C + a])
@@ -332,7 +335,7 @@ def loss_fn(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         #                      is its OWN hi chunk's first token;
         #   hi chunk 2P-1-idx -> chunk 2P-idx = rank idx-1's hi-first,
         #                      except idx==0 (the global end, masked).
-        Pn = lax.axis_size(sp_axis)
+        Pn = _axis_size(sp_axis)
         idx = lax.axis_index(sp_axis)
         C = Tl // 2
         lo, hi = tokens[:, :C], tokens[:, C:]
@@ -345,7 +348,7 @@ def loss_fn(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
             [lo[:, 1:], lo_end, hi[:, 1:], from_prev_hi], axis=1)
         valid = jnp.ones((B, Tl), bool).at[:, -1].set(idx != 0)
     elif sp_axis is not None:
-        Pn = lax.axis_size(sp_axis)
+        Pn = _axis_size(sp_axis)
         idx = lax.axis_index(sp_axis)
         nxt_first = lax.ppermute(tokens[:, :1], sp_axis,
                                  [(i, (i - 1) % Pn) for i in range(Pn)])
@@ -453,7 +456,7 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
                 lambda p: loss_fn(p, tokens, cfg, tp, sp), params,
                 data_axes, lr)
 
-        step = jax.shard_map(device_step, mesh=mesh,
+        step = _shard_map(device_step, mesh=mesh,
                              in_specs=(specs, tok_spec),
                              out_specs=(specs, P()),
                              check_vma=check_vma)
@@ -488,7 +491,7 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
         new_params = _optax.apply_updates(params, updates)
         return new_params, new_state, mean_loss
 
-    step = jax.shard_map(device_step, mesh=mesh,
+    step = _shard_map(device_step, mesh=mesh,
                          in_specs=(specs, opt_specs, tok_spec),
                          out_specs=(specs, opt_specs, P()),
                          check_vma=check_vma)

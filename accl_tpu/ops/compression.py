@@ -16,6 +16,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils.compat import tpu_compiler_params as _tpu_compiler_params
+
 # On-chip sweep (scripts/kernel_tune.py compress, 64 Mi f32 roundtrip,
 # in-jit chained interleaved-window methodology): 512-lane rows dominate
 # every other width by >2x, and 1024-row (2 MB) blocks edge out 256-row
@@ -61,7 +63,7 @@ def _cast_2d(x, seed, dtype, stochastic: bool, interpret: bool):
     out_shape = jax.ShapeDtypeStruct(x.shape, dtype)
     # every block is independent: parallel semantics let Mosaic overlap
     # the next block's DMA with the current cast
-    params = pltpu.CompilerParams(dimension_semantics=("parallel",))
+    params = _tpu_compiler_params(dimension_semantics=("parallel",))
     if stochastic:
         # scalar-prefetch index maps receive the prefetch ref as a
         # trailing argument — the specs need their own index lambdas

@@ -26,6 +26,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils.compat import tpu_compiler_params as _tpu_compiler_params
+
 NEG_INF = -1e30
 _LOG2E = 1.4426950408889634  # log2(e)
 _LN2 = 0.6931471805599453    # ln(2)
@@ -736,7 +738,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
             # with cast/fused scratch the q-blocks of one batch-head must
             # run in-order ("arbitrary") so the iq==0 build is visible to
             # the rest; without it every cell is independent ("parallel")
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_compiler_params(
                 dimension_semantics=(
                     ("parallel", "arbitrary")
                     if (needs_cast or fuse_denom)
@@ -792,7 +794,7 @@ def _flash_forward_impl(qp, kp, vp, cfg):
             ],
             # the k dimension carries the accumulator (sequential); the
             # bh/q-block dims are independent
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(qp, kp, vp)
@@ -1085,7 +1087,7 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
         in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, ql_spec, ql_spec],
         out_specs=qb_spec,
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q2, kp, vp, g_out, l2, dvec)
@@ -1125,7 +1127,7 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
         out_specs=(ks_spec, ks_spec),
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q2, kp, vp, g_out, l2, dvec)

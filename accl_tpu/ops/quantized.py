@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size as _axis_size
+
 DEFAULT_BLOCK = 256
 
 
@@ -57,7 +59,7 @@ def _ring_reduce_scatter_q(x, axis: str, block: int):
     (q, scale, n) of this member's reduced chunk — so the all-reduce can
     feed it straight into the gather phase without a dequant/requant
     round at the seam."""
-    size = lax.axis_size(axis)
+    size = _axis_size(axis)
     idx = lax.axis_index(axis)
     if x.shape[0] % size != 0:
         raise ValueError(
@@ -86,7 +88,7 @@ def _ring_all_gather_q(q, sc, n: int, axis: str):
     """Ring all-gather of an already-quantized (q, scale) pair -> flat
     [P * n] f32 (rank-major); contributions are relayed in wire form and
     dequantized once at the end."""
-    size = lax.axis_size(axis)
+    size = _axis_size(axis)
     idx = lax.axis_index(axis)
     fwd = [(i, (i + 1) % size) for i in range(size)]
 

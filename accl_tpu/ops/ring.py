@@ -24,6 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import tpu_compiler_params as _tpu_compiler_params
+
 
 def _interp(interpret: bool):
     if not interpret:
@@ -89,7 +92,7 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     V = ring_size if ring_size is not None else P
     if V != P and P != 1:
         raise ValueError("ring_size override requires a 1-member axis "
@@ -165,7 +168,7 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
             pltpu.SemaphoreType.REGULAR((2,)),
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp(interpret),
     )(x)
@@ -187,7 +190,7 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     V = ring_size if ring_size is not None else P
     if V != P and P != 1:
         raise ValueError("ring_size override requires a 1-member axis "
@@ -268,7 +271,7 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
             pltpu.SemaphoreType.REGULAR((2,)),
             pltpu.SemaphoreType.DMA,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp(interpret),
     )(x)
@@ -285,7 +288,7 @@ def ring_all_reduce_pallas(x, axis: str = "rank", op: str = "sum",
     ``ring_size`` propagates the single-device virtual self-ring mode
     (see ring_all_gather_pallas).
     """
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     V = ring_size if ring_size is not None else P
     if V != P and P != 1:
         raise ValueError("ring_size override requires a 1-member axis "
@@ -333,7 +336,7 @@ def ring_all_reduce_segmented(x, axis: str = "rank", op: str = "sum",
     kernels.  Handles ragged tails by padding the last segment up to a
     multiple of the ring size (the firmware's bulk/tail counts,
     fw :1909-1912)."""
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     if P == 1:
         return x
     N = x.shape[0]
@@ -361,7 +364,7 @@ def ring_all_gather_segmented(x, axis: str = "rank",
     """Flat per-member [n] → [P * n] (rank-major), segmented.  Each
     segment gathers to [P, s]; blocks are re-interleaved so the final
     layout matches one whole-payload all-gather."""
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     if P == 1:
         return x
     n = x.shape[0]
@@ -387,7 +390,7 @@ def ring_reduce_scatter_segmented(x, axis: str = "rank", op: str = "sum",
                                   interpret: bool = False):
     """Flat per-member [P * n] (rank-major) → member's reduced [n],
     segmented along the per-rank chunk dimension."""
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     if P == 1:
         return x
     n = x.shape[0] // P

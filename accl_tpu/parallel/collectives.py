@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size as _axis_size
+
 
 # ---------------------------------------------------------------------------
 # direct XLA lowerings
@@ -116,7 +118,7 @@ def ring_reduce_scatter(x, axis: str = "rank"):
     running partial one hop forward and folding the arriving chunk.
     `x`: [P * n, ...] per member → returns member's reduced chunk [n, ...].
     """
-    size = lax.axis_size(axis)
+    size = _axis_size(axis)
     idx = lax.axis_index(axis)
     n = x.shape[0] // size
     chunks = x.reshape((size, n) + x.shape[1:])
@@ -139,7 +141,7 @@ def ring_reduce_scatter(x, axis: str = "rank"):
 def ring_all_gather(x, axis: str = "rank"):
     """Ring all-gather (fw :1404-1502): P-1 steps, forwarding the newest
     block each step.  `x`: [n, ...] → [P * n, ...] in rank-major order."""
-    size = lax.axis_size(axis)
+    size = _axis_size(axis)
     idx = lax.axis_index(axis)
 
     def step(s, carry):

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size as _axis_size
+
 NEG_INF = -1e30
 
 
@@ -199,7 +201,7 @@ def _dense_ring_loop(q, k, v, axis: str, bias_fn):
     streaming-softmax accumulator.  `bias_fn(idx, src) -> [Tl, Tl]`
     computes the additive causal mask for the shard that originated at
     rank `src` (None = unmasked)."""
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     perm = [(i, (i + 1) % P) for i in range(P)]
@@ -265,7 +267,7 @@ def _ring_attention_dense_zigzag(q, k, v, axis: str):
     bias computed from the zigzag GLOBAL positions of the local rows
     (chunk idx and its mirror 2P-1-idx) instead of a contiguous
     offset."""
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     Tl = q.shape[1]
     if Tl % 2 != 0:
         raise ValueError(f"zigzag needs an even local length, got {Tl}")
@@ -306,7 +308,7 @@ def _ring_attention_flash_zigzag(q, k, v, axis: str,
     from ..ops.flash import NEG_INF as _NI
     from ..ops.flash import flash_attention_lse
 
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     if Tl % 2 != 0:
@@ -430,7 +432,7 @@ def _ring_attention_windowed(q, k, v, axis: str, window: int,
     grid schedule's bounded-liveness path on TPU).  Boundary block:
     a banded dense cross against the previous shard's K/V (one block
     per rank — it cannot dominate at scale).  Exact merge by lse."""
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
 
@@ -494,7 +496,7 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool,
     from ..ops.flash import NEG_INF as _NI
     from ..ops.flash import flash_attention_lse
 
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     perm = [(i, (i + 1) % P) for i in range(P)]
@@ -570,7 +572,7 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
     ops.flash.flash_attention) to keep the grouped layout and its
     HBM/memory saving.
     """
-    P = lax.axis_size(axis)
+    P = _axis_size(axis)
     B, Tl, H, D = q.shape
     G = k.shape[2]
     if H % P != 0:

@@ -25,9 +25,18 @@ class Request:
 
     _ids = itertools.count()
 
-    def __init__(self, description: str = ""):
+    def __init__(self, description: str = "", sync: bool = False):
         self.id = next(Request._ids)
         self.description = description
+        #: True when the submitter will BLOCK on this request (no
+        #: run_async): the backend may then complete the call inline on
+        #: the submitting thread (leader dispatch) instead of handing it
+        #: to an executor — the submitter cannot issue another call
+        #: until this one completes, so inline execution costs it
+        #: nothing and saves the executor hop.  False (default) keeps
+        #: the posted-descriptor path: the submitter wants its thread
+        #: back immediately.
+        self.sync = sync
         self.status = OperationStatus.QUEUED
         self.retcode: int = 0
         self.duration_ns: float = 0.0
@@ -36,6 +45,13 @@ class Request:
         #: result buffers back to the host, mirroring the async completion
         #: thread of the reference backend).
         self.on_complete: Optional[Callable[["Request"], None]] = None
+        #: optional thunk run ONCE at the top of wait(), on the waiting
+        #: thread, before blocking.  Backends use it to defer leader-
+        #: dispatch work out of the submission path: submit() runs under
+        #: the rank's RequestQueue lock, and executing a gang program
+        #: there would stall concurrent submissions on the same handle —
+        #: wait() runs after submit returns, lock released.
+        self.pre_wait: Optional[Callable[[], None]] = None
         #: exception raised by on_complete, surfaced via check()
         self.callback_error: Optional[Exception] = None
 
@@ -54,6 +70,9 @@ class Request:
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until completion; returns False on timeout
         (reference: cclo.hpp:149-150 wait w/ timeout)."""
+        thunk, self.pre_wait = self.pre_wait, None
+        if thunk is not None:
+            thunk()
         return self._done.wait(timeout)
 
     def check(self) -> None:

@@ -112,6 +112,34 @@ def _bank_stage(led: dict, name: str, data: dict) -> None:
 ALL_STAGES = ("headline", "flash", "flash_variants", "compression",
               "selfring", "tpu_tests")
 
+#: detail keys the round has formally RETRACTED in docs/performance.md
+#: (the r4 fwd+bwd composite timed a DCE'd program — only the dq kernel
+#: ran).  A stale replay predates the in-bench three-kernel consistency
+#: gate, so these keys are stripped from it and listed under
+#: "retracted": a fallback record must never re-assert a figure the
+#: docs have withdrawn (fresh measurements are unaffected — the gate
+#: already refuses to emit an unverified composite).
+RETRACTED_DETAIL_KEYS = (
+    "flash_d128_fwdbwd_tflops",
+    "flash_d128_fwdbwd_mxu_frac",
+    "flash_d128_bwdonly_mxu_frac",
+)
+
+
+def _scrub_retracted(result: dict) -> dict:
+    """Strip retracted figures from a replayed record, marking what was
+    stripped so consumers can tell silence from omission."""
+    detail = result.get("detail")
+    if not isinstance(detail, dict):
+        return result
+    hit = [k for k in RETRACTED_DETAIL_KEYS if k in detail]
+    for k in hit:
+        del detail[k]
+    if hit:
+        result["retracted"] = sorted(
+            set(result.get("retracted", [])) | set(hit))
+    return result
+
 
 def _assemble(stages: dict) -> dict | None:
     """Build the result line from banked stage fragments.  Returns None
@@ -977,6 +1005,7 @@ def main() -> None:
             result["stale"] = True
             result["note"] = ("chip claim unavailable at run time; "
                               "last persisted real-TPU measurement")
+            _scrub_retracted(result)
             print("[bench] TPU unavailable — reporting last persisted "
                   f"TPU result ({result.get('measured_at')}) marked "
                   "stale", file=sys.stderr)

@@ -118,7 +118,7 @@ def config5(out, full: bool = False, reps: int = 5):
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from accl_tpu.utils.compat import shard_map
 
     from accl_tpu.ops.fused import fused_matmul_allreduce
     from accl_tpu.utils.profiling import time_fn

@@ -12,6 +12,18 @@ artifact (BENCH_r*.json, written by the round driver).  Policy:
 Usage: python scripts/check_bench_delta.py [--tolerance 0.5]
 (the tolerance is deliberately loose: the bench chip is shared and the
 best-of-trials methodology still moves run to run).
+
+SWEEP-RUNG gate (--sweep): per-collective regression check over the
+committed tpu8 sweep CSVs.  The newest sweep_tpu8_rNN.csv is compared
+entry-by-entry — (collective, count), best duration over repetitions —
+against the committed gate baseline
+(bench/results/sweep_gate_baseline_r*.csv); any entry slower than
+--sweep-ratio (default 2.0) x baseline fails the build.  A round that
+*explains* a slowdown re-baselines by committing a new
+sweep_gate_baseline_rNN.csv — the gate forces that explanation to be a
+deliberate, reviewed act instead of silent drift (VERDICT r5 weak #2 /
+next-round #3).  With no sweep newer than the baseline the gate passes
+in record-only mode.
 """
 from __future__ import annotations
 
@@ -59,10 +71,81 @@ def last_recorded() -> dict | None:
     return None
 
 
+def _sweep_best(path: str) -> dict:
+    """Per-(collective, count) best duration_us across repetitions."""
+    import csv
+
+    best: dict = {}
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            key = (row["collective"], int(row["count"]))
+            v = float(row["duration_us"])
+            if key not in best or v < best[key]:
+                best[key] = v
+    return best
+
+
+def _round_of(path: str) -> int:
+    import re
+
+    m = re.search(r"_r(\d+)\.csv$", path)
+    return int(m.group(1)) if m else -1
+
+
+def sweep_gate(ratio: float) -> int:
+    results = os.path.join(ROOT, "bench", "results")
+    baselines = sorted(
+        glob.glob(os.path.join(results, "sweep_gate_baseline_r*.csv")),
+        key=_round_of)
+    if not baselines:
+        print("sweep gate: no committed baseline — record-only pass")
+        return 0
+    base_path = baselines[-1]
+    base_round = _round_of(base_path)
+    sweeps = [p for p in glob.glob(
+        os.path.join(results, "sweep_tpu8_r*.csv"))
+        if _round_of(p) > base_round]
+    if not sweeps:
+        print(f"sweep gate: no sweep newer than baseline r{base_round:02d}"
+              " — record-only pass")
+        return 0
+    new_path = max(sweeps, key=_round_of)
+    base = _sweep_best(base_path)
+    new = _sweep_best(new_path)
+    shared = sorted(set(base) & set(new))
+    print(f"sweep gate: {os.path.basename(new_path)} vs baseline "
+          f"{os.path.basename(base_path)} ({len(shared)} shared entries,"
+          f" fail ratio {ratio}x)")
+    bad = []
+    for key in shared:
+        r = new[key] / base[key]
+        if r > ratio:
+            bad.append((key, r))
+    for (coll, count), r in bad:
+        print(f"sweep gate: REGRESSION {coll} count={count}: "
+              f"{new[(coll, count)]:.0f}us vs {base[(coll, count)]:.0f}us "
+              f"({r:.1f}x)", file=sys.stderr)
+    if bad:
+        print(f"sweep gate: {len(bad)}/{len(shared)} entries regressed "
+              f"> {ratio}x — root-cause or re-baseline with a new "
+              "sweep_gate_baseline_rNN.csv + explanation",
+              file=sys.stderr)
+        return 1
+    print("sweep gate: OK")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=0.5)
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the per-collective sweep-rung gate "
+                         "instead of the headline bench gate")
+    ap.add_argument("--sweep-ratio", type=float, default=2.0)
     args = ap.parse_args()
+
+    if args.sweep:
+        return sweep_gate(args.sweep_ratio)
 
     try:
         proc = subprocess.run(

@@ -32,6 +32,8 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+    from accl_tpu.utils.compat import install as _compat_install
+    _compat_install(jax)  # old-jax: alias jax.shard_map to the shim
 
     if not args.tpu:
         # NEVER probe jax.default_backend() before pinning: the axon

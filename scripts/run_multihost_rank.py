@@ -26,6 +26,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main() -> None:
     import jax
+    from accl_tpu.utils.compat import install as _compat_install
+    _compat_install(jax)  # old-jax: alias jax.shard_map to the shim
 
     # the axon sitecustomize pins a hardware platform at interpreter
     # start; this test is a CPU-cluster test (see tests/conftest.py)

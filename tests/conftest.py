@@ -34,6 +34,14 @@ import jax
 if not _ON_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+# jax < 0.5 compatibility: the corpus is written against the current
+# `jax.shard_map` spelling (check_vma kwarg); alias the library's shim
+# so test modules keep the one spelling (library code imports it
+# directly)
+from accl_tpu.utils.compat import install as _compat_install
+
+_compat_install(jax)
+
 import numpy as np
 import pytest
 

@@ -249,3 +249,21 @@ def test_decode_bf16_config_parity():
         lg, cache = step(params, tokens[:, t], cache, cfg)
         np.testing.assert_allclose(np.asarray(lg, np.float32), want[:, t],
                                    rtol=3e-2, atol=3e-2, err_msg=f"t={t}")
+
+
+def test_generate_invalid_top_k_raises():
+    """Out-of-range top_k must raise eagerly: under jit the negative
+    index into jnp.sort would be clamped and top-k truncation would
+    silently degrade to plain temperature sampling (r5 ADVICE)."""
+    cfg, params, tokens = _setup()
+    prompt = tokens[:, :6]
+    for bad in (0, -3, cfg.vocab + 1):
+        with pytest.raises(ValueError, match="top_k"):
+            generate(params, prompt, cfg, max_new=2, temperature=1.0,
+                     top_k=bad, key=jax.random.PRNGKey(1))
+    # boundary values are legal
+    for ok in (1, cfg.vocab):
+        out = np.asarray(generate(params, prompt, cfg, max_new=2,
+                                  temperature=1.0, top_k=ok,
+                                  key=jax.random.PRNGKey(1)))
+        assert out.shape == (B, 2)
