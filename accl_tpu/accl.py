@@ -40,9 +40,19 @@ from .constants import (
     StreamFlags,
     TAG_ANY,
 )
+from .observability import metrics as _metrics
+from .observability import trace as _trace
 from .request import Request, RequestQueue
 
 GLOBAL_COMM = 0  # id of the world communicator, like the reference's comm 0
+
+#: scenarios that form cross-rank gangs in the engines (one instance ==
+#: one gang id in the trace); p2p and local ops are single-rank spans
+_GANG_OPS = frozenset((
+    Operation.bcast, Operation.scatter, Operation.gather,
+    Operation.allgather, Operation.reduce, Operation.allreduce,
+    Operation.reduce_scatter, Operation.alltoall, Operation.barrier,
+))
 
 
 def default_timeout() -> int:
@@ -70,6 +80,7 @@ class ACCL:
         self._queue = RequestQueue()
         self._communicators: list[Communicator] = []
         self._arith_ids: dict[tuple[DataType, DataType], int] = {}
+        self._arith_pairs: dict[int, tuple] = {}
         self._initialized = False
         self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
         self.max_rendezvous_size = DEFAULT_MAX_RENDEZVOUS_SIZE
@@ -131,6 +142,12 @@ class ACCL:
         # 4. arithmetic configs (reference: accl.cpp:1132-1141)
         for key, cfg in DEFAULT_ARITH_CONFIG.items():
             self._arith_ids[key] = self._device.upload_arithconfig(cfg)
+        # reverse map id -> (uncompressed, compressed): observability
+        # recovers each call's datapath dtype from the descriptor's
+        # arithcfg id (first pair wins on backend-deduplicated ids)
+        self._arith_pairs = {}
+        for pair, aid in self._arith_ids.items():
+            self._arith_pairs.setdefault(aid, pair)
         self._call_memo.clear()  # memoized arithcfg ids may predate this
 
         # 5. timeout + protocol thresholds (reference: accl.cpp:1112-1120).
@@ -243,9 +260,19 @@ class ACCL:
     def get_duration(self, request: Optional[Request] = None) -> float:
         """Duration in ns of a completed call, from the engine's
         performance counter (reference: accl.cpp:1387 get_duration;
-        simdevice.cpp:123 cycle→ns scaling)."""
+        simdevice.cpp:123 cycle→ns scaling).
+
+        Raises ACCLError when no call has been issued or the request is
+        still in flight — a silent 0.0 there poisoned bandwidth math
+        downstream (0 ns == infinite busbw) without any signal."""
         req = request or self._last_request
-        return req.duration_ns if req else 0.0
+        if req is None:
+            raise ACCLError("get_duration: no request issued yet")
+        if not req.done:
+            raise ACCLError(
+                f"get_duration: {req.description or 'request'} (id "
+                f"{req.id}) has not completed — wait() on it first")
+        return req.duration_ns
 
     # ------------------------------------------------------------------
     # session lifecycle (reference: open_port/open_con/close_con,
@@ -865,6 +892,11 @@ class ACCL:
         """Submit one call: sync inputs, start async, and either return the
         request handle or wait + sync outputs + check retcode
         (reference: call_async/call_sync accl.cpp:1395-1413)."""
+        # observability gate first: one module-bool read each when both
+        # are off, and t_submit marks user-call entry (operand staging
+        # below is inside the submit→queue window by design)
+        observe = _metrics.enabled() or _trace.enabled()
+        t_submit = _trace.now_ns() if observe else 0
         # size validation: the descriptor carries the full count, so a
         # short buffer would silently corrupt (the reference throws from
         # its buffer slice bounds)
@@ -881,6 +913,8 @@ class ACCL:
         # with a leader-dispatch fast path (backends/tpu.py) may then
         # execute the gang inline on the last-arriving rank's thread
         req = Request(desc, sync=not run_async)
+        if observe:
+            self._observe_call(call, desc, req, t_submit)
 
         if sync_out:  # device-resident results need no completion sync
             def finish(r: Request) -> None:
@@ -902,9 +936,61 @@ class ACCL:
         req.check()
         return req
 
+    def _observe_call(self, call: CCLOCall, desc: str, req: Request,
+                      t_submit: int) -> None:
+        """Attach the observability record(s) to one outgoing request:
+        the metrics signature (collective, dtype, size bucket — published
+        by Request.complete) and, when tracing is on, the TraceSpan with
+        its submit timestamp and gang id.  The gang-id key matches the
+        engines' FIFO pairing key (scenario, comm, tag), so rank R's Nth
+        instance joins the same gang id every engine would assemble."""
+        op = Operation(call.scenario)
+        comm = (self._communicators[call.comm]
+                if call.comm < len(self._communicators) else None)
+        nranks = comm.size if comm else 1
+        rank = comm.local_rank if comm else -1
+        pair = self._arith_pairs.get(call.arithcfg)
+        dtype_name = pair[0].name if pair else "none"
+        # DATA_TYPE_SIZE is in BITS (reference constants.hpp:268-272)
+        elem_bytes = (DATA_TYPE_SIZE.get(pair[0], 0) // 8) if pair else 0
+        nbytes = (call.count * elem_bytes
+                  * _metrics.payload_factor(op.name, nranks))
+        if _metrics.enabled():
+            req.metric = (_metrics.default_registry(), op.name, dtype_name,
+                          nbytes, nranks, t_submit)
+        if _trace.enabled():
+            span = _trace.new_span(op.name, desc, rank, call.count,
+                                   dtype_name, nbytes, nranks)
+            span.t_submit = t_submit
+            if op in _GANG_OPS:
+                span.gang_id = _trace.collector().gang_id_for(
+                    (int(op), call.comm, call.tag), rank)
+            req.trace = span
+
     # ------------------------------------------------------------------
-    # observability (reference: accl.cpp:980-1064 dump utilities)
+    # observability (reference: accl.cpp:980-1064 dump utilities, plus
+    # the accl_tpu/observability metrics registry + trace collector)
     # ------------------------------------------------------------------
+    def metrics(self) -> dict:
+        """Snapshot of the metrics registry this driver publishes into:
+        per-(collective, dtype, size-bucket) call counts, fixed-bucket
+        latency histograms, and derived algorithmic/bus bandwidth, plus
+        this device's engine counters (dispatch-lane attribution on the
+        TPU backend) merged under ``engine/``-prefixed keys.  In-process
+        worlds share one registry, so the snapshot aggregates every
+        rank's calls."""
+        snap = _metrics.default_registry().snapshot()
+        eng = getattr(self._device, "engine_metrics", None)
+        if eng is not None:
+            for k, v in eng.counters().items():
+                snap["counters"][f"engine/{k}"] = v
+        return snap
+
+    def dump_metrics(self, as_json: bool = False) -> str:
+        """Text (default) or JSON rendering of :meth:`metrics`
+        (registry side only — engine counters are in the dict form)."""
+        return _metrics.dump_metrics(as_json=as_json)
+
     def dump_communicator(self, comm_id: int = GLOBAL_COMM) -> str:
         return self._communicators[comm_id].dump()
 

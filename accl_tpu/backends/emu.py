@@ -27,6 +27,7 @@ from ..arithconfig import ArithConfig
 from ..buffer import BaseBuffer, EmuBuffer, EmuBufferP2P
 from ..communicator import Communicator, Rank
 from ..constants import ACCLError, CCLOCall
+from ..observability import trace as _trace
 from ..request import Request
 from .base import CCLODevice
 
@@ -155,6 +156,15 @@ class EmuDevice(CCLODevice):
 
     # -- call path ----------------------------------------------------
     def start(self, call: CCLOCall, request: Request) -> None:
+        # the native engine owns the session send/recv + rendezvous
+        # retry loop below this point, so the span's device window is
+        # the descriptor-post → engine-completion interval (its interior
+        # breakdown is the engine's cycle-count duration, stamped on the
+        # request as duration_ns)
+        span = request.trace
+        if span is not None:
+            span.lane = "emu"
+            span.t_dispatch = span.t_device_begin = _trace.now_ns()
         call_id = self._lib.accl_start_call(self._w, self._rank,
                                             _words(call.to_words()))
 
@@ -164,6 +174,8 @@ class EmuDevice(CCLODevice):
             ok = self._lib.accl_wait_call(self._w, self._rank, call_id,
                                           self._timeout_ms, ctypes.byref(ret),
                                           ctypes.byref(dur))
+            if span is not None:
+                span.t_device_end = _trace.now_ns()
             if ok:
                 request.complete(ret.value, dur.value)
             else:

@@ -41,6 +41,8 @@ from ..constants import (
     ReduceFunction,
     StreamFlags,
 )
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
 from ..request import Request
 from .base import CCLODevice
 
@@ -138,6 +140,29 @@ class _TpuBufferSlice(BaseBuffer):
                                self._start + end)
 
 
+def _mark_spans(gang: dict, lane: Optional[str] = None,
+                t_ready: Optional[int] = None,
+                t_dispatch: Optional[int] = None,
+                t_dev0: Optional[int] = None,
+                t_dev1: Optional[int] = None) -> None:
+    """Stamp a gang's member TraceSpans with scheduler events (no-op
+    per member when tracing is off: request.trace stays None)."""
+    for _call, req, _krnl in gang.values():
+        span = req.trace
+        if span is None:
+            continue
+        if lane is not None:
+            span.lane = lane
+        if t_ready is not None:
+            span.t_gang_ready = t_ready
+        if t_dispatch is not None:
+            span.t_dispatch = t_dispatch
+        if t_dev0 is not None:
+            span.t_device_begin = t_dev0
+        if t_dev1 is not None:
+            span.t_device_end = t_dev1
+
+
 class TpuEngine:
     """World-level gang scheduler + jitted collective executor."""
 
@@ -209,16 +234,15 @@ class TpuEngine:
         # idle check and the claim are atomic against each other.
         self._exec_busy = False
         self._inline_busy = False
-        #: dispatch-lane counters (observability: callrate bench lanes
-        #: and the deterministic fast-path tests read these).  Each key
-        #: has a single writer context — leader_dispatches under the
+        #: dispatch-lane counters live in a per-engine MetricsRegistry
+        #: (observability: callrate bench lanes and the deterministic
+        #: fast-path tests read these through the `stats` view).  Each
+        #: key has a single writer context — leader_dispatches under the
         #: serialized inline lane, the rest on the executor thread.
-        self.stats = {
-            "leader_dispatches": 0,
-            "executor_dispatches": 0,
-            "batches": 0,
-            "batched_gangs": 0,
-        }
+        self.metrics = _metrics.MetricsRegistry()
+        for k in ("leader_dispatches", "executor_dispatches", "batches",
+                  "batched_gangs"):
+            self.metrics.inc(k, 0)
         self._exec_thread = threading.Thread(
             target=self._exec_loop, name="accl-gang-exec", daemon=True)
         self._exec_thread.start()
@@ -235,6 +259,12 @@ class TpuEngine:
         self._stream_cv = threading.Condition()
         # krnl operand queues per rank (OP0_STREAM sources)
         self._krnl_in: list[deque] = [deque() for _ in range(nranks)]
+
+    @property
+    def stats(self) -> dict:
+        """Dispatch-lane counter snapshot (kept as the pre-registry
+        `stats` dict shape the bench and fast-path tests read)."""
+        return self.metrics.counters()
 
     # ------------------------------------------------------------------
     # buffers / memory
@@ -310,20 +340,28 @@ class TpuEngine:
         if scenario in (Operation.config, Operation.nop):
             request.complete(0, 0.0)
             return
+        span = request.trace
         try:
-            if scenario == Operation.copy:
-                self._exec_copy(rank, call)
+            if scenario in (Operation.copy, Operation.combine):
+                if span is not None:
+                    span.lane = "local"
+                    span.t_dispatch = span.t_device_begin = _trace.now_ns()
+                if scenario == Operation.copy:
+                    self._exec_copy(rank, call)
+                else:
+                    self._exec_combine(rank, call)
+                if span is not None:
+                    span.t_device_end = _trace.now_ns()
                 request.complete(0, 1.0)
                 return
-            if scenario == Operation.combine:
-                self._exec_combine(rank, call)
-                request.complete(0, 1.0)
-                return
-            if scenario == Operation.send:
-                self._submit_send(rank, call, request)
-                return
-            if scenario == Operation.recv:
-                self._submit_recv(rank, call, request)
+            if scenario in (Operation.send, Operation.recv):
+                if span is not None:
+                    span.lane = "p2p"
+                    span.t_dispatch = span.t_device_begin = _trace.now_ns()
+                if scenario == Operation.send:
+                    self._submit_send(rank, call, request)
+                else:
+                    self._submit_recv(rank, call, request)
                 return
             self._submit_collective(rank, call, request)
         except Exception as e:  # surface as engine error, not a hang
@@ -470,6 +508,8 @@ class TpuEngine:
                 self._push_stream(rank, call.tag, moved)
             else:
                 dst.set_dev_range(doff, moved)
+            if request.trace is not None:  # delivery == device window end
+                request.trace.t_device_end = _trace.now_ns()
             request.complete(0, 1.0)
 
     # -- collectives ---------------------------------------------------
@@ -517,6 +557,8 @@ class TpuEngine:
                     ready = gang
                     q.remove(gang)
         if ready is not None:
+            if _trace.enabled():  # last member arrived: the gang exists
+                _mark_spans(ready, t_ready=_trace.now_ns())
             self._dispatch_gang(int(call.scenario), call.comm, ready,
                                 request)
 
@@ -565,7 +607,9 @@ class TpuEngine:
                     self._enqueue_ready(scenario, comm_id, gang)
                     return
                 try:
-                    self.stats["leader_dispatches"] += 1
+                    self.metrics.inc("leader_dispatches")
+                    if _trace.enabled():
+                        _mark_spans(gang, lane="leader")
                     self._exec_gang(scenario, comm_id, gang)
                 finally:
                     with self._ready_cv:
@@ -607,11 +651,11 @@ class TpuEngine:
             try:
                 items = self._extend_batch(scenario, comm_id, gang)
                 if items is None:
-                    self.stats["executor_dispatches"] += 1
+                    self.metrics.inc("executor_dispatches")
                     self._exec_gang(scenario, comm_id, gang)
                 else:
-                    self.stats["batches"] += 1
-                    self.stats["batched_gangs"] += len(items)
+                    self.metrics.inc("batches")
+                    self.metrics.inc("batched_gangs", len(items))
                     self._exec_gang_batch(items)
             except Exception as e:  # pragma: no cover — belt and braces
                 for call, request, _k in gang.values():
@@ -681,8 +725,22 @@ class TpuEngine:
         return items if len(items) > 1 else None
 
     def _exec_gang(self, scenario: int, comm_id: int, gang: dict) -> None:
+        # NB: signature is stable API for the lock-discipline test spies
+        # (tests/test_tpu_backend.py wraps it positionally); the leader
+        # lane pre-tags its spans, everything else defaults to executor
         try:
-            dt_ns = self._run_collective(Operation(scenario), comm_id, gang)
+            if _trace.enabled():
+                td = _trace.now_ns()
+                for _c, req, _k in gang.values():
+                    span = req.trace
+                    if span is not None:
+                        if span.lane is None:
+                            span.lane = "executor"
+                        span.t_dispatch = td
+            dt_ns, t0, t1 = self._run_collective(Operation(scenario),
+                                                 comm_id, gang)
+            if _trace.enabled():
+                _mark_spans(gang, t_dev0=t0, t_dev1=t1)
             for call, request, _krnl in gang.values():
                 request.complete(0, float(dt_ns))
         except Exception as e:
@@ -698,6 +756,10 @@ class TpuEngine:
         import time
 
         try:
+            if _trace.enabled():
+                td = _trace.now_ns()
+                for _op, _c, gang, _plan in items:
+                    _mark_spans(gang, lane="batched", t_dispatch=td)
             xs = [self._assemble_global(plan, gang)
                   for _op, _c, gang, plan in items]
             fnb = _collective_fn(*items[0][3]["fn_args"],
@@ -708,7 +770,13 @@ class TpuEngine:
                 import jax
 
                 jax.block_until_ready(ys)
-            dt_ns = time.perf_counter_ns() - t0
+            t1 = time.perf_counter_ns()
+            dt_ns = t1 - t0
+            if _trace.enabled():
+                # one fused device window shared by every batched gang —
+                # the aligned cross-gang slice the timeline shows
+                for _op, _c, gang, _plan in items:
+                    _mark_spans(gang, t_dev0=t0, t_dev1=t1)
             # per-call perf counter: the batch's wall time is shared by
             # K fused dispatches, so each call's duration is its share
             # (reporting the whole batch per call would inflate
@@ -888,26 +956,31 @@ class TpuEngine:
                 self._gang_plans.popitem(last=False)
         return plan
 
-    def _run_collective(self, op: Operation, comm_id: int, gang: dict) -> int:
+    def _run_collective(self, op: Operation, comm_id: int,
+                        gang: dict) -> tuple:
         """Assemble the gang's operands into one sharded array, execute
         the AOT-compiled SPMD collective, and scatter result shards back
         into the per-rank device buffers — everything stays jax.Arrays
         on device end to end (the reference's zero-copy device-resident
-        call path, accl.cpp:796-839).  Returns execution nanoseconds
-        (dispatch + device time, compile excluded — the perf-counter
+        call path, accl.cpp:796-839).  The duration is execution
+        nanoseconds (dispatch + device time, compile excluded — the perf-counter
         role, fw :2280-2303).
 
         Hot path: the plan cache resolves everything per SIGNATURE, the
         global array is 1-D with each member's whole buffer as its
         shard, and full-length results rebind buffers — a repeated call
         costs one make_array + one compiled dispatch, no per-member jax
-        ops."""
+        ops.
+
+        Returns (duration_ns, device_begin_ns, device_end_ns) so the
+        dispatch lanes can stamp the device window on member spans."""
         import time
 
         jax, jnp, Mesh, NamedSharding, P = _import_jax()
 
         if op == Operation.barrier:
-            return 0  # gang completion IS the synchronization
+            t = time.perf_counter_ns()
+            return 0, t, t  # gang completion IS the synchronization
 
         plan = self._gang_plan(op, comm_id, gang)
         x = self._assemble_global(plan, gang)
@@ -918,10 +991,10 @@ class TpuEngine:
             # exact perf-counter mode: duration is on-device time and
             # async errors surface here (see __init__)
             jax.block_until_ready(y)
-        dt_ns = time.perf_counter_ns() - t0
+        t1 = time.perf_counter_ns()
 
         self._scatter_back(plan, y)
-        return dt_ns
+        return t1 - t0, t0, t1
 
     def _assemble_global(self, plan: dict, gang: dict):
         jax, jnp, Mesh, NamedSharding, P = _import_jax()
@@ -1220,6 +1293,12 @@ class TpuDeviceView(CCLODevice):
 
     def start(self, call: CCLOCall, request: Request) -> None:
         self._engine.submit(self._rank, call, request)
+
+    @property
+    def engine_metrics(self) -> "object":
+        """The shared engine's registry (ACCL.metrics() merges its
+        dispatch-lane counters under engine/ keys)."""
+        return self._engine.metrics
 
     # memory API kept for interface completeness; TPU buffers are opaque
     # handles, not a flat address space

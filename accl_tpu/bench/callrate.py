@@ -229,6 +229,19 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
     # forced through the executor, same interleaved windows
     out["leader_vs_executor_x"] = round(
         round_ratio("resident", "resident_exec"), 2)
+
+    # publish into the process metrics registry (observability layer):
+    # the bench lanes become queryable gauges next to the driver's own
+    # per-call histograms, so one dump_metrics() shows both
+    from accl_tpu.observability import metrics as _metrics
+
+    reg = _metrics.default_registry()
+    for label, lane in out["lanes"].items():
+        reg.set_gauge(f"callrate/{label}/calls_per_s",
+                      lane["calls_per_s"])
+        reg.set_gauge(f"callrate/{label}/latency_us", lane["latency_us"])
+        reg.set_gauge(f"callrate/{label}/overhead_vs_raw_x",
+                      lane["overhead_vs_raw_x"])
     return out
 
 

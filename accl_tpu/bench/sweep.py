@@ -21,6 +21,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from ..constants import ReduceFunction
+from ..observability import metrics as _metrics
 
 COLLECTIVES = ("sendrecv", "bcast", "scatter", "gather", "allgather",
                "reduce", "allreduce", "reduce_scatter", "alltoall")
@@ -45,25 +46,14 @@ def _resolve_dtype(name) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, str(name)))
 
 
-def _busbw_factor(coll: str, p: int) -> float:
-    """Bus-bandwidth correction factors (nccl-tests conventions)."""
-    if coll in ("allreduce",):
-        return 2.0 * (p - 1) / p
-    if coll in ("allgather", "reduce_scatter", "alltoall"):
-        return (p - 1) / p
-    return 1.0
-
-
-def _payload_factor(coll: str, p: int) -> int:
-    """Per-rank payload in units of `count` elements — the nccl-tests
-    size convention the busbw factors assume.  allgather/reduce_scatter/
-    alltoall move count*P elements per rank (the driver's count is
-    per-peer / per-chunk); every other collective moves count.  r4's
-    CSVs recorded count*itemsize for all collectives, which made the
-    x P collectives read as super-linear against byte-equal allreduce
-    rows when the real per-byte cost was BETTER (VERDICT r4 weak #4 —
-    an accounting artifact, not a lowering cost)."""
-    return p if coll in ("allgather", "reduce_scatter", "alltoall") else 1
+# bandwidth conventions (nccl-tests): one implementation, shared with
+# the metrics registry the driver publishes into.  The payload factor
+# matters: r4's CSVs recorded count*itemsize for all collectives, which
+# made the x P collectives read as super-linear against byte-equal
+# allreduce rows when the real per-byte cost was BETTER (VERDICT r4
+# weak #4 — an accounting artifact, not a lowering cost).
+_busbw_factor = _metrics.busbw_factor
+_payload_factor = _metrics.payload_factor
 
 
 def run_sweep(world, config: SweepConfig = SweepConfig(),
@@ -111,6 +101,17 @@ def run_sweep(world, config: SweepConfig = SweepConfig(),
                 rows.append(row)
                 if csv_writer:
                     csv_writer.writerow(row)
+
+    # publish per-collective peak bandwidth into the process metrics
+    # registry so `dump_metrics()` after a sweep reports the same
+    # busbw-of-record numbers the CSV carries
+    reg = _metrics.default_registry()
+    best: dict = {}
+    for row in rows:
+        best[row["collective"]] = max(best.get(row["collective"], 0.0),
+                                      row["busbw_GBps"])
+    for coll, bw in best.items():
+        reg.set_gauge(f"sweep/{coll}/busbw_peak_GBps", bw)
     return rows
 
 

@@ -1,0 +1,24 @@
+"""End-to-end observability: per-call trace spans (Perfetto export)
+and the metrics registry both backends and the bench harnesses publish
+into.  See docs/observability.md for usage."""
+
+from .trace import (  # noqa: F401
+    TraceCollector,
+    TraceSpan,
+    collector,
+    disable as disable_tracing,
+    enable as enable_tracing,
+    enabled as tracing_enabled,
+    merge_trace_files,
+    new_span,
+    traced_window,
+)
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS_US,
+    MetricsRegistry,
+    busbw_factor,
+    default_registry,
+    dump_metrics,
+    payload_factor,
+    size_bucket,
+)

@@ -1,0 +1,230 @@
+"""Metrics registry: counters, fixed-bucket latency histograms, and
+derived algorithmic/bus bandwidth per (collective, dtype, size bucket).
+
+This replaces the ad-hoc ``TpuEngine.stats`` dict: both backends, the
+driver, and the bench harnesses (bench/callrate.py, bench/sweep.py)
+publish into a :class:`MetricsRegistry`, queryable via
+``ACCL.metrics()`` / ``ACCL.dump_metrics()`` (text + JSON).  The
+bandwidth conventions (payload and busbw correction factors) are the
+nccl-tests ones HiCCL (arxiv 2408.05962) uses as the lingua franca for
+comparing collective implementations — the same factors bench/sweep.py
+records in its CSVs.
+
+Metrics are always on by default (a handful of dict ops per call);
+``ACCL_METRICS=0`` turns the driver's per-call publishing off for
+overhead-critical runs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+#: fixed histogram bucket upper bounds, microseconds (powers of 4 —
+#: 1 µs .. ~16.8 s, 13 buckets + overflow): coarse enough to stay
+#: allocation-free per observation, fine enough to separate the
+#: dispatch lanes the callrate bench distinguishes
+LATENCY_BUCKETS_US = tuple(4 ** k for k in range(13))
+
+#: collectives whose per-rank payload is count*P elements (the driver
+#: count is per-peer / per-chunk) — the nccl-tests size convention
+_XP_COLLECTIVES = ("allgather", "reduce_scatter", "alltoall")
+
+
+def payload_factor(coll: str, p: int) -> int:
+    """Per-rank payload in units of `count` elements."""
+    return p if coll in _XP_COLLECTIVES else 1
+
+
+def busbw_factor(coll: str, p: int) -> float:
+    """Bus-bandwidth correction factors (nccl-tests conventions)."""
+    if p <= 1:
+        return 1.0
+    if coll == "allreduce":
+        return 2.0 * (p - 1) / p
+    if coll in _XP_COLLECTIVES:
+        return (p - 1) / p
+    return 1.0
+
+
+def size_bucket(nbytes: int) -> str:
+    """Power-of-two size-bucket label (upper bound, human units)."""
+    if nbytes <= 0:
+        return "0B"
+    ub = 1 << max(nbytes - 1, 0).bit_length()
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if ub < 1024:
+            return f"<={ub}{unit}"
+        ub //= 1024
+    return f"<={ub}TiB"
+
+
+class _CallStats:
+    __slots__ = ("calls", "errors", "total_ns", "min_ns", "max_ns",
+                 "total_bytes", "total_engine_ns", "nranks", "hist")
+
+    def __init__(self, nbuckets: int):
+        self.calls = 0
+        self.errors = 0
+        self.total_ns = 0.0
+        self.min_ns = float("inf")
+        self.max_ns = 0.0
+        self.total_bytes = 0
+        self.total_engine_ns = 0.0
+        self.nranks = 1
+        self.hist = [0] * (nbuckets + 1)  # + overflow
+
+
+class MetricsRegistry:
+    """Thread-safe counters + gauges + per-call-signature stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._calls: dict = {}
+
+    # -- counters / gauges --------------------------------------------
+    def inc(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def counter(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    # -- per-call stats ------------------------------------------------
+    def observe_call(self, collective: str, dtype: str, nbytes: int,
+                     duration_ns: float, nranks: int = 1, ok: bool = True,
+                     engine_ns: float = 0.0) -> None:
+        """Record one completed call: count, latency histogram bucket,
+        byte volume (bandwidth is derived at snapshot time)."""
+        key = (collective, dtype, size_bucket(nbytes))
+        with self._lock:
+            st = self._calls.get(key)
+            if st is None:
+                st = self._calls[key] = _CallStats(len(LATENCY_BUCKETS_US))
+            st.calls += 1
+            st.nranks = nranks
+            if not ok:
+                st.errors += 1
+                return
+            st.total_ns += duration_ns
+            st.min_ns = min(st.min_ns, duration_ns)
+            st.max_ns = max(st.max_ns, duration_ns)
+            st.total_bytes += nbytes
+            st.total_engine_ns += engine_ns
+            us = duration_ns / 1e3
+            for i, ub in enumerate(LATENCY_BUCKETS_US):
+                if us <= ub:
+                    st.hist[i] += 1
+                    break
+            else:
+                st.hist[-1] += 1
+
+    # -- query ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Full registry state; bandwidths in GB/s (bytes/ns)."""
+        with self._lock:
+            calls = {}
+            for (coll, dtype, bucket), st in self._calls.items():
+                good = st.calls - st.errors
+                avg_ns = st.total_ns / good if good else 0.0
+                algbw = (st.total_bytes / st.total_ns
+                         if st.total_ns > 0 else 0.0)
+                calls["|".join((coll, dtype, bucket))] = {
+                    "collective": coll,
+                    "dtype": dtype,
+                    "size_bucket": bucket,
+                    "calls": st.calls,
+                    "errors": st.errors,
+                    "nranks": st.nranks,
+                    "bytes": st.total_bytes,
+                    "latency_us": {
+                        "min": round(st.min_ns / 1e3, 2) if good else 0.0,
+                        "avg": round(avg_ns / 1e3, 2),
+                        "max": round(st.max_ns / 1e3, 2),
+                    },
+                    "hist_us": {
+                        **{f"le_{ub}": n for ub, n in
+                           zip(LATENCY_BUCKETS_US, st.hist)},
+                        "inf": st.hist[-1],
+                    },
+                    # 6 decimals: a small-message call is a few µGB/s
+                    # and must not round to a flat 0.0
+                    "algbw_GBps": round(algbw, 6),
+                    "busbw_GBps": round(
+                        algbw * busbw_factor(coll, st.nranks), 6),
+                }
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
+                    "calls": calls}
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), indent=2, sort_keys=True)
+
+    def to_text(self) -> str:
+        """Aligned human-readable dump (the dump_metrics text mode)."""
+        snap = self.snapshot()
+        lines = ["== counters =="]
+        for k in sorted(snap["counters"]):
+            lines.append(f"  {k:<40} {snap['counters'][k]}")
+        if snap["gauges"]:
+            lines.append("== gauges ==")
+            for k in sorted(snap["gauges"]):
+                lines.append(f"  {k:<40} {snap['gauges'][k]:.3f}")
+        lines.append("== calls ==")
+        hdr = (f"  {'collective':<16} {'dtype':<10} {'size':<10} "
+               f"{'calls':>7} {'err':>4} {'avg_us':>10} {'min_us':>10} "
+               f"{'max_us':>10} {'algbw':>11} {'busbw':>11}")
+        lines.append(hdr)
+        for k in sorted(snap["calls"]):
+            c = snap["calls"][k]
+            lines.append(
+                f"  {c['collective']:<16} {c['dtype']:<10} "
+                f"{c['size_bucket']:<10} {c['calls']:>7} {c['errors']:>4} "
+                f"{c['latency_us']['avg']:>10.2f} "
+                f"{c['latency_us']['min']:>10.2f} "
+                f"{c['latency_us']['max']:>10.2f} "
+                f"{c['algbw_GBps']:>11.6f} {c['busbw_GBps']:>11.6f}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._calls.clear()
+
+
+_default = MetricsRegistry()
+_metrics_enabled = os.environ.get("ACCL_METRICS", "1") != "0"
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every rank's driver publishes into —
+    in-process worlds (EmuWorld/TpuWorld) aggregate across ranks, the
+    natural unit the bench harnesses report on."""
+    return _default
+
+
+def enabled() -> bool:
+    return _metrics_enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _metrics_enabled
+    _metrics_enabled = on
+
+
+def dump_metrics(registry: Optional[MetricsRegistry] = None,
+                 as_json: bool = False) -> str:
+    reg = registry if registry is not None else _default
+    return reg.to_json() if as_json else reg.to_text()

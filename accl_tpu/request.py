@@ -18,6 +18,7 @@ import threading
 from typing import Callable, Optional
 
 from .constants import ACCLError, OperationStatus, error_code_to_str
+from .observability import trace as _trace
 
 
 class Request:
@@ -54,6 +55,13 @@ class Request:
         self.pre_wait: Optional[Callable[[], None]] = None
         #: exception raised by on_complete, surfaced via check()
         self.callback_error: Optional[Exception] = None
+        #: observability (accl_tpu/observability): `trace` is this
+        #: call's TraceSpan (None when tracing is off — the
+        #: zero-allocation fast path), `metric` is the driver-attached
+        #: (registry, collective, dtype, nbytes, nranks, t_submit_ns)
+        #: tuple published at completion.  Both set by ACCL._execute.
+        self.trace: Optional[object] = None
+        self.metric: Optional[tuple] = None
 
     def complete(self, retcode: int, duration_ns: float = 0.0) -> None:
         self.retcode = retcode
@@ -65,7 +73,30 @@ class Request:
         except Exception as e:  # surface via check(), never lose the event
             self.callback_error = e
         finally:
+            self._observe()
             self._done.set()
+
+    def _observe(self) -> None:
+        """Publish this call's completion to the observability layer:
+        callback-complete timestamp on the span (the last event — the
+        result-buffer sync in on_complete has already run), metrics
+        observation keyed by the driver-attached signature.  Observer
+        failures must never lose the completion event."""
+        if self.metric is None and self.trace is None:
+            return
+        try:
+            t_end = _trace.now_ns()
+            if self.metric is not None:
+                reg, coll, dtype, nbytes, nranks, t0 = self.metric
+                reg.observe_call(coll, dtype, nbytes, t_end - t0, nranks,
+                                 ok=self.retcode == 0,
+                                 engine_ns=self.duration_ns)
+            span = self.trace
+            if span is not None:
+                span.t_complete = t_end
+                _trace.collector().add(span)
+        except Exception:  # pragma: no cover — observability is best-effort
+            pass
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until completion; returns False on timeout
@@ -111,5 +142,7 @@ class RequestQueue:
     def submit(self, request: Request, start_fn: Callable[[Request], None]) -> Request:
         with self._lock:
             request.status = OperationStatus.EXECUTING
+            if request.trace is not None:
+                request.trace.t_queue = _trace.now_ns()
             start_fn(request)
         return request

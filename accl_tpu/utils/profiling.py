@@ -5,12 +5,21 @@ as get_duration (fw :2280-2303), CSV bench pipeline, ACCL_DEBUG call
 logs.  The TPU additions here wrap the XLA profiler so collective
 timelines (ICI transfers included) can be captured and viewed in
 TensorBoard/Perfetto, plus a lightweight per-op timer for quick numbers.
+
+The structured per-call tracing + metrics layer lives in
+accl_tpu/observability (docs/observability.md); its
+`traced_window(label, xla_logdir=...)` marks a span in the ACCL trace
+AND captures an `xla_trace` of the same window.  The block timer
+`timed` is implemented on utils/timing.Timer and re-exported here for
+its historical import path.
 """
 from __future__ import annotations
 
 import contextlib
 import time
 from typing import Iterator
+
+from .timing import Timer, timed  # noqa: F401 — one implementation
 
 
 @contextlib.contextmanager
@@ -25,26 +34,27 @@ def xla_trace(logdir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
-@contextlib.contextmanager
-def timed(label: str, results: dict | None = None) -> Iterator[None]:
-    """Wall-clock block timer; appends ns to results[label] if given."""
-    t0 = time.perf_counter_ns()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter_ns() - t0
-        if results is not None:
-            results.setdefault(label, []).append(dt)
+def time_fn(fn, *args, iters: int = 10, warmup: int = 2,
+            pipelined: bool = False) -> float:
+    """Average seconds per call with device sync (bench building block).
 
-
-def time_fn(fn, *args, iters: int = 10, warmup: int = 2) -> float:
-    """Average seconds per call with device sync (bench building block)."""
+    Each iteration's output is block_until_ready'd, so the reported
+    time is true per-call latency — jax dispatch is async, and syncing
+    only the last output lets earlier iterations overlap the loop,
+    underreporting per-call time.  ``pipelined=True`` restores the
+    overlapped measurement (throughput of a dependency-free stream:
+    only the final output is synced)."""
     import jax
 
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    if pipelined:
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    else:
+        for _ in range(iters):
+            jax.block_until_ready(fn(*args))
     return (time.perf_counter() - t0) / iters

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""CI trace smoke: run a short emu-backend allreduce with ACCL_TRACE
+on, assert the dumped Perfetto JSON parses and contains >= 1 span per
+rank with the required trace_event keys, and land the dump_metrics
+JSON next to it as a build artifact (see .github/workflows/
+build-and-test.yml perf-gate job).
+
+Usage: python scripts/trace_smoke.py [--ranks N] [--trace PATH]
+       [--metrics PATH]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--trace", default="trace_smoke.json")
+    ap.add_argument("--metrics", default="metrics_smoke.json")
+    ap.add_argument("--count", type=int, default=256)
+    args = ap.parse_args()
+
+    # arm tracing exactly as a user would (env var), before any accl use
+    os.environ["ACCL_TRACE"] = args.trace
+
+    import numpy as np
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.backends.emu import EmuWorld
+    from accl_tpu.observability import metrics as obs_metrics
+    from accl_tpu.observability import trace as obs_trace
+
+    assert obs_trace.enabled(), "ACCL_TRACE did not enable tracing"
+
+    with EmuWorld(args.ranks) as world:
+        def body(accl, rank):
+            send = accl.create_buffer_like(
+                np.arange(args.count, dtype=np.float32) + rank)
+            recv = accl.create_buffer(args.count, np.float32)
+            accl.allreduce(send, recv, args.count, ReduceFunction.SUM)
+            return recv.host.copy()
+
+        outs = world.run(body)
+    expected = np.sum([np.arange(args.count, dtype=np.float32) + r
+                       for r in range(args.ranks)], axis=0)
+    for got in outs:
+        np.testing.assert_allclose(got, expected)
+
+    path = obs_trace.collector().dump(args.trace)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    slices = [ev for ev in events if ev.get("ph") == "X"]
+    for ev in events:
+        missing = [k for k in ("ph", "ts", "pid", "tid") if k not in ev]
+        if missing:
+            print(f"FAIL: event missing keys {missing}: {ev}")
+            return 1
+    per_rank = {r: sum(1 for ev in slices if ev["pid"] == r)
+                for r in range(args.ranks)}
+    if any(n < 1 for n in per_rank.values()):
+        print(f"FAIL: ranks without spans: {per_rank}")
+        return 1
+    gangs = {(ev.get("args") or {}).get("gang_id") for ev in slices}
+    gangs.discard(None)
+    if not gangs:
+        print("FAIL: no gang ids in trace")
+        return 1
+
+    with open(args.metrics, "w") as f:
+        f.write(obs_metrics.dump_metrics(as_json=True))
+    snap = obs_metrics.default_registry().snapshot()
+    if not any(v["collective"] == "allreduce" and v["calls"] >= args.ranks
+               for v in snap["calls"].values()):
+        print(f"FAIL: metrics registry missing the allreduce rows: "
+              f"{list(snap['calls'])}")
+        return 1
+
+    print(f"OK: {len(slices)} slices over {args.ranks} ranks "
+          f"({per_rank}), {len(gangs)} gang(s); trace={path} "
+          f"metrics={args.metrics}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Observability layer (accl_tpu/observability): span ordering
+invariants, disabled-mode zero-allocation fast path, multi-rank gang-id
+merge, Perfetto JSON schema validity, metrics registry content, and the
+satellite fixes riding this PR (get_duration error paths, Timer/timed
+unification, time_fn per-iteration sync)."""
+import json
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, ReduceFunction
+from accl_tpu.observability import metrics as obs_metrics
+from accl_tpu.observability import trace as obs_trace
+
+COUNT = 64
+NRANKS = 4
+
+
+@pytest.fixture
+def tracing():
+    """Tracing ON with a fresh collector; restores disabled state."""
+    col = obs_trace.enable()
+    col.clear()
+    try:
+        yield col
+    finally:
+        obs_trace.disable()
+        col.clear()
+
+
+def _tpu_world(nranks=NRANKS):
+    from accl_tpu.backends.tpu import TpuWorld
+
+    return TpuWorld(nranks)
+
+
+def _allreduce_all_ranks(world, reps=1):
+    def fn(accl, rank):
+        s = accl.create_buffer_like(
+            np.arange(COUNT, dtype=np.float32) + rank)
+        r = accl.create_buffer(COUNT, np.float32)
+        for _ in range(reps):
+            accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+        return r.host.copy()
+
+    return world.run(fn)
+
+
+# ---------------------------------------------------------------------------
+# span ordering + gang merge (TPU backend gang scheduler)
+# ---------------------------------------------------------------------------
+def test_span_ordering_invariants(tracing):
+    with _tpu_world() as w:
+        _allreduce_all_ranks(w, reps=2)
+    spans = [s for s in tracing.spans() if s.name == "allreduce"]
+    assert len(spans) == 2 * NRANKS
+    for s in spans:
+        ts = s.timestamps()
+        # every stage stamped on the gang path
+        for k in ("submit", "queue", "gang_ready", "dispatch",
+                  "device_begin", "device_end", "complete"):
+            assert ts[k] is not None, f"stage {k} missing on {s!r}"
+        assert s.t_submit <= s.t_queue <= s.t_gang_ready
+        assert s.t_gang_ready <= s.t_dispatch <= s.t_device_begin
+        assert s.t_device_begin <= s.t_device_end <= s.t_complete
+        assert s.lane in ("leader", "executor", "batched")
+        assert s.dtype == "float32"
+        assert s.nbytes == COUNT * 4
+
+
+def test_multi_rank_gang_id_merge(tracing):
+    with _tpu_world() as w:
+        _allreduce_all_ranks(w, reps=3)
+    spans = [s for s in tracing.spans() if s.name == "allreduce"]
+    by_gang = {}
+    for s in spans:
+        by_gang.setdefault(s.gang_id, []).append(s)
+    # 3 instances, each merging all four ranks under one gang id
+    assert len(by_gang) == 3
+    for gid, members in by_gang.items():
+        assert gid is not None
+        assert sorted(m.rank for m in members) == list(range(NRANKS))
+        # a fused gang program has ONE device window, so member slices
+        # are exactly aligned
+        assert len({(m.t_device_begin, m.t_device_end)
+                    for m in members}) == 1
+
+
+def test_disabled_mode_zero_allocation(tracing):
+    # flip OFF after the fixture armed a fresh collector: the driver
+    # and backends must not allocate spans nor touch the ring buffer
+    obs_trace.disable()
+    with _tpu_world() as w:
+        def fn(accl, rank):
+            s = accl.create_buffer_like(
+                np.arange(COUNT, dtype=np.float32))
+            r = accl.create_buffer(COUNT, np.float32)
+            req = accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            assert req.trace is None  # zero-allocation fast path
+            return True
+
+        w.run(fn)
+    assert obs_trace.new_span("x") is None
+    assert len(tracing) == 0
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export schema
+# ---------------------------------------------------------------------------
+def test_perfetto_json_schema(tracing, tmp_path):
+    with _tpu_world() as w:
+        _allreduce_all_ranks(w)
+    path = tracing.dump(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.loads(f.read())
+    events = doc["traceEvents"]
+    assert events
+    for ev in events:
+        for key in ("ph", "ts", "pid", "tid"):
+            assert key in ev, f"{key} missing from {ev}"
+        assert ev["ph"] in ("X", "M")
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    # per-rank process tracks with at least one slice each
+    slice_pids = {ev["pid"] for ev in events if ev["ph"] == "X"}
+    assert slice_pids == set(range(NRANKS))
+    # lane track names registered via thread_name metadata
+    names = {ev["args"]["name"] for ev in events if ev["ph"] == "M"
+             and ev["name"] == "thread_name"}
+    assert any(n.startswith("lane:") for n in names)
+    assert "queue" in names and "call" in names
+
+
+def test_emu_backend_spans_and_merge(tracing):
+    from accl_tpu.backends.emu import EmuWorld
+
+    with EmuWorld(NRANKS) as w:
+        def fn(accl, rank):
+            s = accl.create_buffer_like(
+                np.arange(COUNT, dtype=np.float32) + rank)
+            r = accl.create_buffer(COUNT, np.float32)
+            accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            return r.host.copy()
+
+        w.run(fn)
+    spans = [s for s in tracing.spans() if s.name == "allreduce"]
+    assert len(spans) == NRANKS
+    assert len({s.gang_id for s in spans}) == 1  # one merged gang
+    assert sorted(s.rank for s in spans) == list(range(NRANKS))
+    for s in spans:
+        assert s.lane == "emu"
+        assert s.t_submit <= s.t_queue <= s.t_dispatch
+        assert s.t_dispatch <= s.t_device_begin <= s.t_device_end
+        assert s.t_device_end <= s.t_complete
+
+
+def test_traced_window_and_merge_files(tracing, tmp_path):
+    with obs_trace.traced_window("unit"):
+        pass
+    spans = [s for s in tracing.spans() if s.name == "window:unit"]
+    assert len(spans) == 1 and spans[0].lane == "window"
+    # merge: two single-file traces with a shared gang id align clocks
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    def mk(path, ts):
+        ev = {"name": "g", "ph": "X", "ts": ts, "dur": 5.0, "pid": 0,
+              "tid": 0, "args": {"gang_id": 7}}
+        with open(path, "w") as f:
+            json.dump({"traceEvents": [ev]}, f)
+    mk(p1, 100.0)
+    mk(p2, 900.0)
+    doc = obs_trace.merge_trace_files([p1, p2])
+    ts = [ev["ts"] for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    assert ts == [100.0, 100.0]  # second file shifted onto the first
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+def test_metrics_registry_reports_calls_hist_and_bandwidth():
+    reg = obs_metrics.MetricsRegistry()
+    # 1 KiB allreduce over 4 ranks, 10 calls of 100 us each
+    for _ in range(10):
+        reg.observe_call("allreduce", "float32", 1024, 100e3, nranks=4)
+    reg.observe_call("allreduce", "float32", 1024, 100e3, nranks=4,
+                     ok=False)
+    snap = reg.snapshot()
+    (key,) = snap["calls"].keys()
+    st = snap["calls"][key]
+    assert st["calls"] == 11 and st["errors"] == 1
+    assert st["latency_us"]["avg"] == pytest.approx(100.0)
+    # 100 us lands in the le_256 bucket of the power-of-4 ladder
+    assert st["hist_us"]["le_256"] == 10
+    assert sum(st["hist_us"].values()) == 10  # errors not in the hist
+    # algbw = bytes/ns: 1024 B / 100e3 ns; busbw = algbw * 2(P-1)/P
+    # (snapshot rounds to 4 decimals)
+    assert st["algbw_GBps"] == pytest.approx(1024 / 100e3, abs=1e-4)
+    assert st["busbw_GBps"] == pytest.approx(
+        1024 / 100e3 * 1.5, abs=1e-4)
+    # text + JSON renderings both carry the row
+    assert "allreduce" in reg.to_text()
+    assert json.loads(reg.to_json())["calls"][key]["calls"] == 11
+
+
+def test_driver_publishes_metrics_end_to_end():
+    reg = obs_metrics.default_registry()
+    reg.reset()
+    with _tpu_world() as w:
+        _allreduce_all_ranks(w, reps=2)
+        accl = w.accls[0]
+        snap = accl.metrics()
+        text = accl.dump_metrics()
+        js = json.loads(accl.dump_metrics(as_json=True))
+    rows = [v for v in snap["calls"].values()
+            if v["collective"] == "allreduce"]
+    assert rows and rows[0]["calls"] == 2 * NRANKS
+    assert rows[0]["dtype"] == "float32"
+    assert rows[0]["nranks"] == NRANKS
+    assert rows[0]["algbw_GBps"] > 0
+    assert sum(rows[0]["hist_us"].values()) == 2 * NRANKS
+    assert "allreduce" in text
+    assert js["calls"]
+    reg.reset()
+
+
+def test_engine_stats_registry_view():
+    with _tpu_world() as w:
+        before = dict(w.engine.stats)
+        assert set(before) >= {"leader_dispatches", "executor_dispatches",
+                               "batches", "batched_gangs"}
+        _allreduce_all_ranks(w)
+        after = dict(w.engine.stats)
+        assert (after["leader_dispatches"] + after["executor_dispatches"]
+                + after["batched_gangs"]) > (
+            before["leader_dispatches"] + before["executor_dispatches"]
+            + before["batched_gangs"])
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+def test_get_duration_unfinished_raises():
+    from accl_tpu.accl import ACCL
+    from accl_tpu.request import Request
+
+    accl = ACCL(device=None)
+    with pytest.raises(ACCLError, match="no request"):
+        accl.get_duration()
+    pending = Request("inflight")
+    with pytest.raises(ACCLError, match="not completed"):
+        accl.get_duration(pending)
+    finished = Request("done")
+    finished.complete(0, 123.0)
+    assert accl.get_duration(finished) == 123.0
+
+
+def test_get_duration_completed_path_end_to_end():
+    with _tpu_world(2) as w:
+        def fn(accl, rank):
+            s = accl.create_buffer_like(
+                np.arange(COUNT, dtype=np.float32))
+            r = accl.create_buffer(COUNT, np.float32)
+            req = accl.allreduce(s, r, COUNT, ReduceFunction.SUM,
+                                 run_async=True)
+            # in-flight request raises instead of returning 0.0
+            if not req.done:
+                with pytest.raises(ACCLError):
+                    accl.get_duration(req)
+            req.wait(60)
+            return accl.get_duration(req)
+
+        durs = w.run(fn)
+    assert all(d > 0 for d in durs)
+
+
+def test_timer_and_timed_unified():
+    import time
+
+    from accl_tpu.utils import profiling, timing
+
+    # one implementation: profiling re-exports timing's
+    assert profiling.timed is timing.timed
+    assert profiling.Timer is timing.Timer
+    t = timing.Timer()
+    t.start()
+    time.sleep(0.005)
+    t.end()
+    # ns and us agree (and the reference-shaped alias still works)
+    assert t.duration_ns() == pytest.approx(t.duration_us() * 1e3)
+    assert t.durationUs() == t.duration_us()
+    results = {}
+    with timing.timed("blk", results) as timer:
+        time.sleep(0.002)
+    assert isinstance(timer, timing.Timer)
+    assert results["blk"][0] >= 1e6  # ns
+
+
+def test_time_fn_blocks_each_iteration():
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.utils.profiling import time_fn
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.ones(256)
+    per_call = time_fn(f, x, iters=3, warmup=1)
+    overlapped = time_fn(f, x, iters=3, warmup=1, pipelined=True)
+    assert per_call > 0 and overlapped > 0
