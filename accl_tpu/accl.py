@@ -40,6 +40,8 @@ from .constants import (
     StreamFlags,
     TAG_ANY,
 )
+from .observability import flight as _flight
+from .observability import health as _health
 from .observability import metrics as _metrics
 from .observability import trace as _trace
 from .request import Request, RequestQueue
@@ -103,6 +105,10 @@ class ACCL:
 
         self._call_memo: "OrderedDict" = OrderedDict()
         self._call_memo_cap = 512
+        #: always-on per-rank flight recorder (observability/flight.py):
+        #: created at initialize (the rank is known there); None only
+        #: with ACCL_FLIGHT=0
+        self.flight_recorder: Optional[_flight.FlightRecorder] = None
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -176,6 +182,14 @@ class ACCL:
         # 7. enable transport engines (reference: accl.cpp:1122-1125)
         self._config_call(CfgFunc.enable_pkt)
         self._initialized = True
+
+        # 8. observability bring-up: the always-on flight recorder (the
+        #    rank is known now) and, when ACCL_METRICS_PORT is set, the
+        #    process-wide OpenMetrics endpoint
+        if _flight.enabled():
+            self.flight_recorder = _flight.register(
+                _flight.FlightRecorder(local_rank))
+        _health.ensure_exporter_from_env()
 
     # ------------------------------------------------------------------
     # properties / config
@@ -892,10 +906,14 @@ class ACCL:
         """Submit one call: sync inputs, start async, and either return the
         request handle or wait + sync outputs + check retcode
         (reference: call_async/call_sync accl.cpp:1395-1413)."""
-        # observability gate first: one module-bool read each when both
+        # observability gate first: one module-bool read each when all
         # are off, and t_submit marks user-call entry (operand staging
-        # below is inside the submit→queue window by design)
-        observe = _metrics.enabled() or _trace.enabled()
+        # below is inside the submit→queue window by design).  The
+        # flight recorder is in the gate because it is ON by default —
+        # the always-on black box — so the no-observer fast path only
+        # exists under ACCL_FLIGHT=0 + ACCL_METRICS=0 + trace off.
+        observe = (self.flight_recorder is not None or _metrics.enabled()
+                   or _trace.enabled())
         t_submit = _trace.now_ns() if observe else 0
         # size validation: the descriptor carries the full count, so a
         # short buffer would silently corrupt (the reference throws from
@@ -930,9 +948,11 @@ class ACCL:
             return req
         if not req.wait(timeout=self.call_timeout_s):
             # disarm the result sync so a late completion can't mutate the
-            # user's host buffers after this raise
+            # user's host buffers after this raise; the flight record
+            # (seq, state, lane, age) pins WHERE the call wedged
             req.on_complete = None
-            raise ACCLError(f"{desc} timed out waiting for engine completion")
+            raise ACCLError(f"{desc} timed out waiting for engine "
+                            f"completion{req.flight_info()}")
         req.check()
         return req
 
@@ -955,6 +975,10 @@ class ACCL:
         elem_bytes = (DATA_TYPE_SIZE.get(pair[0], 0) // 8) if pair else 0
         nbytes = (call.count * elem_bytes
                   * _metrics.payload_factor(op.name, nranks))
+        if self.flight_recorder is not None and _flight.enabled():
+            req.flight = self.flight_recorder.new_record(
+                req.id, op.name, call.comm, call.tag, dtype_name,
+                call.count, nbytes, nranks, op in _GANG_OPS, t_submit)
         if _metrics.enabled():
             req.metric = (_metrics.default_registry(), op.name, dtype_name,
                           nbytes, nranks, t_submit)
@@ -990,6 +1014,30 @@ class ACCL:
         """Text (default) or JSON rendering of :meth:`metrics`
         (registry side only — engine counters are in the dict form)."""
         return _metrics.dump_metrics(as_json=as_json)
+
+    def dump_flight_recorder(self, path: Optional[str] = None,
+                             merged: bool = False) -> dict:
+        """The always-on flight recorder's ring: this rank's last N
+        collective records (seq, state, lane, timestamps) — the black
+        box to read when a collective wedges.  With ``merged=True``
+        returns every live rank's ring through
+        :func:`observability.flight.merge_flight_dumps` (desync/hang
+        analysis included); with ``path`` also writes the JSON there.
+        Also reachable without code: ``SIGUSR1`` dumps all ranks to
+        ``ACCL_FLIGHT_DUMP``, and a watchdog fire dumps automatically.
+        """
+        if self.flight_recorder is None and not merged:
+            raise ACCLError(
+                "flight recorder is off (ACCL_FLIGHT=0) or the driver "
+                "is not initialized")
+        doc = (_flight.dump_all() if merged
+               else self.flight_recorder.dump())
+        if path:
+            import json as _json
+
+            with open(path, "w") as f:
+                _json.dump(doc, f, indent=1)
+        return doc
 
     def dump_communicator(self, comm_id: int = GLOBAL_COMM) -> str:
         return self._communicators[comm_id].dump()

@@ -27,8 +27,10 @@ from ..arithconfig import ArithConfig
 from ..buffer import BaseBuffer, EmuBuffer, EmuBufferP2P
 from ..communicator import Communicator, Rank
 from ..constants import ACCLError, CCLOCall
+from ..observability import health as _health
 from ..observability import trace as _trace
 from ..request import Request
+from ..utils.logging import get_logger
 from .base import CCLODevice
 
 _LIB_PATH = os.path.join(
@@ -162,6 +164,9 @@ class EmuDevice(CCLODevice):
         # breakdown is the engine's cycle-count duration, stamped on the
         # request as duration_ns)
         span = request.trace
+        rec = request.flight
+        if rec is not None:
+            rec.mark_dispatched("emu", _trace.now_ns())
         if span is not None:
             span.lane = "emu"
             span.t_dispatch = span.t_device_begin = _trace.now_ns()
@@ -180,6 +185,11 @@ class EmuDevice(CCLODevice):
                 request.complete(ret.value, dur.value)
             else:
                 from ..constants import ErrorCode
+
+                get_logger("accl_tpu.emu", rank=self._rank).warning(
+                    "engine wait timed out after %d ms: %s%s",
+                    self._timeout_ms, request.description,
+                    request.flight_info())
                 request.complete(int(ErrorCode.DMA_TIMEOUT_ERROR), 0.0)
 
         threading.Thread(target=waiter, daemon=True).start()
@@ -428,6 +438,24 @@ class EmuWorld:
             for r, a in enumerate(self.accls):
                 a.initialize(ranks, r, n_egr_rx_bufs=n_egr_rx_bufs,
                              egr_rx_buf_size=egr_rx_buf_size, **kwargs)
+        # hang watchdog over the per-rank flight recorders: the native
+        # engine keeps its own gang state, so diagnosis here is purely
+        # flight-ring based (which ranks have an in-flight gang call,
+        # which never issued one).  Inert when ACCL_WATCHDOG_TIMEOUT=0,
+        # ACCL_FLIGHT=0, or initialize was deferred (no recorders yet).
+        self.watchdog = _health.Watchdog(
+            [a.flight_recorder for a in self.accls
+             if a.flight_recorder is not None], name="accl-emu").start()
+
+    def start_watchdog(self, **kwargs) -> "_health.Watchdog":
+        """Re-arm the watchdog with explicit settings (tests shrink
+        timeout_s; a deferred-initialize world arms it after bring-up)."""
+        self.watchdog.stop()
+        self.watchdog = _health.Watchdog(
+            [a.flight_recorder for a in self.accls
+             if a.flight_recorder is not None],
+            name="accl-emu", **kwargs).start()
+        return self.watchdog
 
     def run(self, fn: Callable, *args) -> list:
         """Run `fn(accl, rank, *args)` on every rank concurrently and
@@ -456,6 +484,7 @@ class EmuWorld:
             raise ACCLError("world has no datagram transport")
 
     def close(self) -> None:
+        self.watchdog.stop()
         self._pool.shutdown(wait=False)
         if self._handle:
             self._lib.accl_world_destroy(self._handle)

@@ -41,9 +41,12 @@ from ..constants import (
     ReduceFunction,
     StreamFlags,
 )
+from ..observability import flight as _flight
+from ..observability import health as _health
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
 from ..request import Request
+from ..utils.logging import get_logger
 from .base import CCLODevice
 
 # address space stride per buffer handle (addresses are opaque ids here,
@@ -163,6 +166,23 @@ def _mark_spans(gang: dict, lane: Optional[str] = None,
             span.t_device_end = t_dev1
 
 
+def _mark_flight(gang: dict, state: int, lane: Optional[str] = None,
+                 t: Optional[int] = None) -> None:
+    """Stamp a gang's member flight records with one scheduler state
+    transition — ALWAYS on (unlike _mark_spans): a handful of attribute
+    writes per member, the whole per-call flight budget at this layer."""
+    for _call, req, _krnl in gang.values():
+        rec = req.flight
+        if rec is None:
+            continue
+        if state == _flight.S_DISPATCHED:
+            rec.mark_dispatched(lane, t)
+        else:
+            rec.state = state
+            if state == _flight.S_GANG_READY and t is not None:
+                rec.t_gang_ready = t
+
+
 class TpuEngine:
     """World-level gang scheduler + jitted collective executor."""
 
@@ -243,6 +263,11 @@ class TpuEngine:
         for k in ("leader_dispatches", "executor_dispatches", "batches",
                   "batched_gangs"):
             self.metrics.inc(k, 0)
+        self._log = get_logger("accl_tpu.tpu")
+        #: hang watchdog (observability/health.py), armed by
+        #: start_watchdog once the world's per-rank flight recorders
+        #: exist; fires with this engine's gang-assembly snapshot
+        self._watchdog: Optional[_health.Watchdog] = None
         self._exec_thread = threading.Thread(
             target=self._exec_loop, name="accl-gang-exec", daemon=True)
         self._exec_thread.start()
@@ -341,8 +366,11 @@ class TpuEngine:
             request.complete(0, 0.0)
             return
         span = request.trace
+        rec = request.flight
         try:
             if scenario in (Operation.copy, Operation.combine):
+                if rec is not None:
+                    rec.mark_dispatched("local", _trace.now_ns())
                 if span is not None:
                     span.lane = "local"
                     span.t_dispatch = span.t_device_begin = _trace.now_ns()
@@ -355,6 +383,8 @@ class TpuEngine:
                 request.complete(0, 1.0)
                 return
             if scenario in (Operation.send, Operation.recv):
+                if rec is not None:
+                    rec.mark_dispatched("p2p", _trace.now_ns())
                 if span is not None:
                     span.lane = "p2p"
                     span.t_dispatch = span.t_device_begin = _trace.now_ns()
@@ -557,8 +587,10 @@ class TpuEngine:
                     ready = gang
                     q.remove(gang)
         if ready is not None:
-            if _trace.enabled():  # last member arrived: the gang exists
-                _mark_spans(ready, t_ready=_trace.now_ns())
+            t_ready = _trace.now_ns()  # last member arrived: gang exists
+            _mark_flight(ready, _flight.S_GANG_READY, t=t_ready)
+            if _trace.enabled():
+                _mark_spans(ready, t_ready=t_ready)
             self._dispatch_gang(int(call.scenario), call.comm, ready,
                                 request)
 
@@ -608,6 +640,8 @@ class TpuEngine:
                     return
                 try:
                     self.metrics.inc("leader_dispatches")
+                    _mark_flight(gang, _flight.S_DISPATCHED,
+                                 lane="leader", t=_trace.now_ns())
                     if _trace.enabled():
                         _mark_spans(gang, lane="leader")
                     self._exec_gang(scenario, comm_id, gang)
@@ -628,9 +662,68 @@ class TpuEngine:
             self._ready_cv.notify()
 
     def shutdown(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         with self._ready_cv:
             self._shutdown = True
             self._ready_cv.notify()
+
+    # ------------------------------------------------------------------
+    # hang diagnosis (observability/health.py watchdog integration)
+    # ------------------------------------------------------------------
+    def start_watchdog(self, recorders) -> Optional["object"]:
+        """Arm the per-engine hang watchdog over the world's per-rank
+        flight recorders (ACCL_WATCHDOG_TIMEOUT seconds; 0 disables).
+        On fire, the report embeds gang_assembly_snapshot() so the
+        partial gangs inside this scheduler are named directly."""
+        if self._watchdog is None:
+            self._watchdog = _health.Watchdog(
+                recorders, introspect=self.gang_assembly_snapshot,
+                name="accl-tpu").start()
+        return self._watchdog
+
+    def gang_assembly_snapshot(self) -> list:
+        """Introspection hook: every PARTIAL gang still assembling in
+        _gangs — which ranks arrived with what call, which members are
+        missing — the engine-level truth the watchdog report pairs with
+        the per-rank flight rings."""
+        now = _trace.now_ns()
+        out = []
+        with self._lock:
+            # copy under the lock: gang dicts mutate as ranks join, and
+            # p2p queues hold ("data"/"recv", tag, payload) tuples
+            items = [(k, ([dict(g) for g in q] if k[0] == "coll"
+                          else [(e[0], e[1]) for e in q]))
+                     for k, q in self._gangs.items() if q]
+        for key, gangs in items:
+            if key[0] == "coll":
+                _kind, scenario, comm_id, tag = key
+                members = self._comms.get(comm_id, [])
+                for gang in gangs:
+                    arrived = sorted(gang)
+                    recs = [req.flight for _c, req, _k in gang.values()
+                            if req.flight is not None]
+                    out.append({
+                        "kind": "collective",
+                        "collective": Operation(scenario).name,
+                        "comm": comm_id, "tag": tag,
+                        "arrived": arrived,
+                        "missing": [m for m in members
+                                    if m not in gang],
+                        "oldest_age_us": round(max(
+                            (r.age_ns(now) for r in recs), default=0)
+                            / 1e3, 1),
+                    })
+            elif key[0] == "p2p":
+                _kind, comm_id, src, dst = key
+                for kind, tag in gangs:
+                    out.append({
+                        "kind": kind,  # pending "data" or "recv"
+                        "comm": comm_id, "src": src, "dst": dst,
+                        "tag": tag,
+                    })
+        return out
 
     def _exec_loop(self) -> None:
         """Dedicated gang executor (see _ready above).  Mutually
@@ -658,6 +751,7 @@ class TpuEngine:
                     self.metrics.inc("batched_gangs", len(items))
                     self._exec_gang_batch(items)
             except Exception as e:  # pragma: no cover — belt and braces
+                self._log.error("executor gang dispatch failed: %s", e)
                 for call, request, _k in gang.values():
                     request.description += f" [{e}]"
                     request.complete(int(ErrorCode.DMA_INTERNAL_ERROR),
@@ -729,6 +823,8 @@ class TpuEngine:
         # (tests/test_tpu_backend.py wraps it positionally); the leader
         # lane pre-tags its spans, everything else defaults to executor
         try:
+            _mark_flight(gang, _flight.S_DISPATCHED, lane="executor",
+                         t=_trace.now_ns())
             if _trace.enabled():
                 td = _trace.now_ns()
                 for _c, req, _k in gang.values():
@@ -756,6 +852,10 @@ class TpuEngine:
         import time
 
         try:
+            tf = _trace.now_ns()
+            for _op, _c, gang, _plan in items:
+                _mark_flight(gang, _flight.S_DISPATCHED, lane="batched",
+                             t=tf)
             if _trace.enabled():
                 td = _trace.now_ns()
                 for _op, _c, gang, _plan in items:
@@ -1363,6 +1463,11 @@ class TpuWorld:
         ranks = [Rank(ip="127.0.0.1", port=0, session=r) for r in range(nranks)]
         for r, a in enumerate(self.accls):
             a.initialize(ranks, r)
+        # hang watchdog over this world's per-rank flight recorders
+        # (no-op under ACCL_WATCHDOG_TIMEOUT=0 / ACCL_FLIGHT=0)
+        self.engine.start_watchdog(
+            [a.flight_recorder for a in self.accls
+             if a.flight_recorder is not None])
 
     def run(self, fn: Callable, *args) -> list:
         futures = [self._pool.submit(fn, self.accls[r], r, *args)

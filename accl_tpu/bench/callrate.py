@@ -117,9 +117,16 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
                     s, r, count, ReduceFunction.SUM, from_fpga=True,
                     to_fpga=True, run_async=True))
                 if len(window) >= 8:
-                    window.pop(0).wait()
+                    head = window.pop(0)
+                    head.wait()
+                    head.check()
             for req in window:
                 req.wait()
+                req.check()
+            # every request is wait()ed AND check()ed: a stalled or
+            # failed call must fail the lane loudly, not be timed as if
+            # it completed (wait() has a finite default budget; check()
+            # raises with the flight record while still in flight)
             jax.block_until_ready(r.dev)  # same-work guarantee as raw
             return time.perf_counter() - t0
 

@@ -1,7 +1,25 @@
-"""End-to-end observability: per-call trace spans (Perfetto export)
-and the metrics registry both backends and the bench harnesses publish
-into.  See docs/observability.md for usage."""
+"""End-to-end observability: per-call trace spans (Perfetto export),
+the metrics registry both backends and the bench harnesses publish
+into, the always-on flight recorder, and the hang watchdog + health/
+OpenMetrics surface.  See docs/observability.md and docs/debugging.md
+for usage."""
 
+from .flight import (  # noqa: F401
+    FlightRecord,
+    FlightRecorder,
+    dump_all as dump_all_flight,
+    enabled as flight_enabled,
+    merge_flight_dumps,
+)
+from .health import (  # noqa: F401
+    HEALTH_DEGRADED,
+    HEALTH_HUNG,
+    HEALTH_OK,
+    MetricsExporter,
+    Watchdog,
+    start_exporter,
+    stop_exporter,
+)
 from .trace import (  # noqa: F401
     TraceCollector,
     TraceSpan,

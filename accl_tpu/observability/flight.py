@@ -1,0 +1,451 @@
+"""Always-on per-rank flight recorder: the collective black box.
+
+Unlike the opt-in tracer (``ACCL_TRACE``), the flight recorder is ON by
+default: every rank keeps a fixed-size, lock-cheap ring of the last N
+collective records — seq, collective, comm, dtype/shape, dispatch lane,
+state transitions (submitted → queued → gang-ready → dispatched →
+complete) and monotonic timestamps — so when a gang wedges in
+production there is always a recent history to dump, the way the
+reference CCLO's host-visible retcode/cycle-counter state machine keeps
+a wedged offload engine diagnosable (PAPER §driver/firmware; ACCL+,
+arxiv 2312.11742).
+
+Overhead discipline: one small ``__slots__`` object and a bounded
+``deque.append`` per call, plus a handful of attribute writes at each
+state transition — no locks on the record path (the per-rank seq comes
+from an atomic ``itertools.count``; ``deque`` appends are GIL-atomic).
+``ACCL_FLIGHT=0`` turns it off entirely; ``ACCL_FLIGHT_CAP`` resizes
+the ring (default 512 records per rank).
+
+Dump paths: :meth:`ACCL.dump_flight_recorder`, ``SIGUSR1`` (dumps every
+live rank to ``ACCL_FLIGHT_DUMP``), and automatically when the
+:class:`~accl_tpu.observability.health.Watchdog` fires.  Cross-rank
+dumps merge and diagnose through :func:`merge_flight_dumps` (the
+``scripts/accl_doctor.py`` engine): order/shape/dtype desyncs,
+missing gang members, stragglers.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import weakref
+from typing import Iterable, Optional
+
+from .trace import now_ns
+
+# record states, in lifecycle order (ints: one attribute write per
+# transition on the always-on path; names only materialize at dump time)
+S_SUBMITTED = 0
+S_QUEUED = 1
+S_GANG_READY = 2
+S_DISPATCHED = 3
+S_COMPLETE = 4
+S_FAILED = 5
+STATE_NAMES = ("submitted", "queued", "gang_ready", "dispatched",
+               "complete", "failed")
+
+#: record fields every dump carries — the schema the CI hang smoke and
+#: accl_doctor validate against
+RECORD_SCHEMA_KEYS = (
+    "seq", "req_id", "rank", "collective", "comm", "tag", "dtype",
+    "count", "nbytes", "nranks", "lane", "state", "gang", "retcode",
+    "age_us", "t_submit", "t_queue", "t_gang_ready", "t_dispatch",
+    "t_complete",
+)
+
+
+class FlightRecord:
+    """One collective call's black-box record (mutated in place as the
+    call moves through the stack; the ring holds the live object, so a
+    dump mid-flight shows the exact stage a wedged call reached)."""
+
+    __slots__ = ("seq", "req_id", "rank", "collective", "comm", "tag",
+                 "dtype", "count", "nbytes", "nranks", "lane", "state",
+                 "gang", "retcode", "t_submit", "t_queue", "t_gang_ready",
+                 "t_dispatch", "t_complete", "_recorder")
+
+    def __init__(self, recorder: "FlightRecorder", seq: int, req_id: int,
+                 collective: str, comm: int, tag: int, dtype: str,
+                 count: int, nbytes: int, nranks: int, gang: bool,
+                 t_submit: int):
+        self._recorder = recorder
+        self.seq = seq
+        self.req_id = req_id
+        self.rank = recorder.rank
+        self.collective = collective
+        self.comm = comm
+        self.tag = tag
+        self.dtype = dtype
+        self.count = count
+        self.nbytes = nbytes
+        self.nranks = nranks
+        self.gang = gang
+        self.lane: Optional[str] = None
+        self.state = S_SUBMITTED
+        self.retcode = 0
+        self.t_submit = t_submit
+        self.t_queue = 0
+        self.t_gang_ready = 0
+        self.t_dispatch = 0
+        self.t_complete = 0
+
+    @property
+    def in_flight(self) -> bool:
+        return self.state < S_COMPLETE
+
+    def age_ns(self, now: Optional[int] = None) -> int:
+        """Nanoseconds since submit (in flight) or submit→complete."""
+        end = self.t_complete or (now if now is not None else now_ns())
+        return max(end - self.t_submit, 0)
+
+    def mark_dispatched(self, lane: str, t: int) -> None:
+        """The one dispatch-stamp used by every lane (emu descriptor
+        post, local/p2p, gang executor/leader/batched); a lane already
+        tagged by an earlier stage (leader pre-tag) is preserved."""
+        self.state = S_DISPATCHED
+        self.t_dispatch = t
+        if self.lane is None:
+            self.lane = lane
+
+    def finish(self, retcode: int, t: int) -> None:
+        self.retcode = retcode
+        self.t_complete = t
+        self.state = S_COMPLETE if retcode == 0 else S_FAILED
+        self._recorder._note_finished(self)
+
+    def summary(self, now: Optional[int] = None) -> str:
+        """One-line human rendering, used by error embedding and logs."""
+        return (f"seq={self.seq} {self.collective} comm={self.comm} "
+                f"state={STATE_NAMES[self.state]} lane={self.lane} "
+                f"dtype={self.dtype} count={self.count} "
+                f"age={self.age_ns(now) / 1e6:.1f}ms")
+
+    def to_dict(self, now: Optional[int] = None) -> dict:
+        return {
+            "seq": self.seq, "req_id": self.req_id, "rank": self.rank,
+            "collective": self.collective, "comm": self.comm,
+            "tag": self.tag, "dtype": self.dtype, "count": self.count,
+            "nbytes": self.nbytes, "nranks": self.nranks,
+            "lane": self.lane, "state": STATE_NAMES[self.state],
+            "gang": self.gang, "retcode": self.retcode,
+            "age_us": round(self.age_ns(now) / 1e3, 1),
+            "t_submit": self.t_submit, "t_queue": self.t_queue,
+            "t_gang_ready": self.t_gang_ready,
+            "t_dispatch": self.t_dispatch, "t_complete": self.t_complete,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return f"FlightRecord(r{self.rank} {self.summary()})"
+
+
+class FlightRecorder:
+    """Fixed-size ring of one rank's last N FlightRecords."""
+
+    def __init__(self, rank: int, capacity: Optional[int] = None):
+        from collections import deque
+
+        self.rank = rank
+        self.capacity = capacity if capacity is not None else int(
+            os.environ.get("ACCL_FLIGHT_CAP", "512"))
+        self._records: "deque[FlightRecord]" = deque(maxlen=self.capacity)
+        self._seq = itertools.count()
+        #: highest seq that reached complete/failed (monotonic
+        #: best-effort: lock-free, diagnostic — not a synchronization
+        #: primitive)
+        self.last_completed_seq = -1
+        #: monotonic ns of the most recent non-zero retcode (the
+        #: watchdog's "degraded" signal)
+        self.last_error_ns = 0
+
+    # -- record path (always-on; keep it allocation + append only) -----
+    def new_record(self, req_id: int, collective: str, comm: int,
+                   tag: int, dtype: str, count: int, nbytes: int,
+                   nranks: int, gang: bool, t_submit: int) -> FlightRecord:
+        rec = FlightRecord(self, next(self._seq), req_id, collective,
+                           comm, tag, dtype, count, nbytes, nranks, gang,
+                           t_submit)
+        self._records.append(rec)
+        return rec
+
+    def _note_finished(self, rec: FlightRecord) -> None:
+        if rec.seq > self.last_completed_seq:
+            self.last_completed_seq = rec.seq
+        if rec.retcode != 0:
+            self.last_error_ns = rec.t_complete
+
+    # -- queries --------------------------------------------------------
+    def records(self) -> list:
+        """Point-in-time snapshot of the ring.  list(deque) copies in
+        one C call under the GIL; the retry covers the (not observed,
+        but not contractual) case of a mutation surfacing mid-copy —
+        a reader must never raise because a rank kept submitting."""
+        for _ in range(8):
+            try:
+                return list(self._records)
+            except RuntimeError:  # pragma: no cover — copy/append race
+                continue
+        return []
+
+    def in_flight(self) -> list:
+        # iterate the SNAPSHOT, not the live deque: a Python-level
+        # comprehension over the deque can hit "deque mutated during
+        # iteration" when another thread appends between items
+        return [r for r in self.records() if r.in_flight]
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def dump(self) -> dict:
+        now = now_ns()
+        return {
+            "rank": self.rank,
+            "capacity": self.capacity,
+            "last_completed_seq": self.last_completed_seq,
+            "records": [r.to_dict(now) for r in self.records()],
+        }
+
+
+# ---------------------------------------------------------------------------
+# module state: enable switch + live-recorder registry + SIGUSR1
+# ---------------------------------------------------------------------------
+_enabled = os.environ.get("ACCL_FLIGHT", "1") != "0"
+_registry_lock = threading.Lock()
+_recorders: list = []  # weakref.ref[FlightRecorder]
+_signal_installed = False
+
+
+def enabled() -> bool:
+    """Module-bool gate, same discipline as trace.enabled()."""
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = on
+
+
+def register(recorder: FlightRecorder) -> FlightRecorder:
+    """Track a live recorder for process-wide dumps (SIGUSR1, doctor);
+    weak refs, so closed worlds' recorders age out with GC."""
+    with _registry_lock:
+        _recorders[:] = [r for r in _recorders if r() is not None]
+        _recorders.append(weakref.ref(recorder))
+    _install_signal_handler()
+    return recorder
+
+
+def recorders() -> list:
+    """Live recorders, registration order."""
+    with _registry_lock:
+        out = [r() for r in _recorders]
+    return [r for r in out if r is not None]
+
+
+def dump_all() -> dict:
+    """Every live rank's ring, in one merged+analyzed document."""
+    return merge_flight_dumps([r.dump() for r in recorders()])
+
+
+def dump_all_to(path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(dump_all(), f, indent=1)
+    return path
+
+
+def _sigusr1(_signum, _frame) -> None:  # pragma: no cover — signal path
+    path = os.environ.get("ACCL_FLIGHT_DUMP", "accl_flight_dump.json")
+    try:
+        dump_all_to(path)
+        from ..utils.logging import get_logger
+
+        get_logger().warning("SIGUSR1: flight recorder dumped to %s", path)
+    except Exception:
+        pass  # never let the diagnostic path kill the process
+
+
+def _install_signal_handler() -> None:
+    """Arm SIGUSR1 -> dump-all (once; only possible from the main
+    thread — worker-thread registration silently skips, matching
+    signal module semantics)."""
+    global _signal_installed
+    if _signal_installed:
+        return
+    try:
+        import signal
+
+        # never steal SIGUSR1 from the application: training launchers
+        # commonly bind it (checkpoint-on-signal, log rotation) — the
+        # dump hook only claims a DEFAULT disposition
+        if signal.getsignal(signal.SIGUSR1) not in (signal.SIG_DFL,
+                                                    None):
+            _signal_installed = True  # decided: leave theirs in place
+            return
+        signal.signal(signal.SIGUSR1, _sigusr1)
+        _signal_installed = True
+    except (ValueError, AttributeError, OSError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# cross-rank merge + desync analysis (the accl_doctor engine)
+# ---------------------------------------------------------------------------
+def _load(dump) -> dict:
+    if isinstance(dump, str):
+        with open(dump) as f:
+            return json.load(f)
+    return dump
+
+
+def merge_flight_dumps(dumps: Iterable, out_path: Optional[str] = None,
+                       ) -> dict:
+    """Merge per-rank flight dumps and diagnose cross-rank failure
+    modes.  Accepts dump dicts (from :meth:`FlightRecorder.dump`) or
+    paths to their JSON files; a dict that already carries a ``ranks``
+    list (a previous merge / watchdog report) contributes every rank.
+
+    The analysis pinpoints:
+
+    - ``desyncs`` — the first seq position where two ranks issued
+      different gang collectives on one communicator (order/shape/dtype
+      mismatch: the classic collective-order bug hierarchical schedules
+      amplify, HiCCL arxiv 2408.05962);
+    - ``hangs`` — in-flight gang instances past their expected
+      membership: which ranks arrived, which are missing, and the
+      head-of-queue call each missing rank is actually blocked on;
+    - ``stragglers`` — ranks whose completed-gang progress trails the
+      furthest rank on the same communicator.
+    """
+    per_rank: dict = {}
+    for d in dumps:
+        d = _load(d)
+        for rd in (d["ranks"] if "ranks" in d else [d]):
+            per_rank[rd["rank"]] = rd
+    ranks = sorted(per_rank)
+    # a full ring has evicted its oldest records, and different ranks
+    # evict DIFFERENT amounts (gang/non-gang mixes differ): positional
+    # cross-rank comparison is then meaningless and would produce false
+    # desync/straggler findings — those analyses are gated per comm on
+    # every contributor still holding its full history
+    wrapped = {r: len(per_rank[r]["records"])
+               >= per_rank[r].get("capacity", 1 << 62) for r in ranks}
+
+    # -- per-comm, per-rank ordered gang signatures --------------------
+    def sig(rec: dict) -> tuple:
+        return (rec["collective"], rec["tag"], rec["count"], rec["dtype"])
+
+    by_comm: dict = {}
+    for r in ranks:
+        for rec in sorted(per_rank[r]["records"], key=lambda x: x["seq"]):
+            if not rec.get("gang"):
+                continue
+            by_comm.setdefault(rec["comm"], {}).setdefault(
+                r, []).append(rec)
+
+    desyncs: list = []
+    truncated_comms: list = []
+    for comm, seqs in sorted(by_comm.items()):
+        members = sorted(seqs)
+        if len(members) < 2:
+            continue
+        if any(wrapped[r] for r in members):
+            truncated_comms.append(comm)
+            continue
+        depth = max(len(v) for v in seqs.values())
+        for i in range(depth):
+            sigs = {r: (sig(seqs[r][i]) if i < len(seqs[r]) else None)
+                    for r in members}
+            distinct = {s for s in sigs.values() if s is not None}
+            if len(distinct) > 1:
+                desyncs.append({
+                    "comm": comm,
+                    "index": i,
+                    "per_rank": {
+                        str(r): (None if sigs[r] is None else {
+                            "collective": sigs[r][0], "tag": sigs[r][1],
+                            "count": sigs[r][2], "dtype": sigs[r][3],
+                            "seq": seqs[r][i]["seq"]})
+                        for r in members},
+                })
+                break  # first divergence per comm; later ones cascade
+
+    # -- hung gang instances -------------------------------------------
+    hangs: list = []
+    stuck: dict = {}
+    for r in ranks:
+        for rec in per_rank[r]["records"]:
+            if rec.get("gang") and rec["state"] not in ("complete",
+                                                        "failed"):
+                key = (rec["collective"], rec["comm"], rec["tag"],
+                       rec["count"], rec["dtype"])
+                stuck.setdefault(key, {})[r] = rec
+    for key, arrived in sorted(stuck.items()):
+        coll, comm, tag, count, dtype = key
+        nranks = max(rec["nranks"] for rec in arrived.values())
+        # communicator membership is not in the dumps (a withheld rank
+        # may have issued NOTHING on the comm): when the merged rank set
+        # is the whole world (or this is the global comm), every dumped
+        # rank is expected; for sub-comms of a larger merge, only ranks
+        # seen on that comm can be attributed
+        participants = set(by_comm.get(comm, {})) | set(arrived)
+        world = (ranks if comm == 0 or len(ranks) <= nranks
+                 else sorted(participants))
+        missing = [r for r in world if r not in arrived]
+        blocked_on = {}
+        for r in missing:
+            head = next((rec for rec in sorted(per_rank[r]["records"],
+                                               key=lambda x: x["seq"])
+                         if rec["state"] not in ("complete", "failed")),
+                        None)
+            blocked_on[str(r)] = head  # None == rank is idle / absent
+        hangs.append({
+            "collective": coll, "comm": comm, "tag": tag,
+            "count": count, "dtype": dtype, "nranks": nranks,
+            "arrived": sorted(arrived),
+            "missing": missing,
+            "oldest_age_us": max(rec["age_us"]
+                                 for rec in arrived.values()),
+            "arrived_records": {str(r): rec
+                                for r, rec in sorted(arrived.items())},
+            "missing_blocked_on": blocked_on,
+            "last_completed_seq": {
+                str(r): per_rank[r]["last_completed_seq"] for r in ranks},
+        })
+
+    # -- stragglers -----------------------------------------------------
+    stragglers: list = []
+    for comm, seqs in sorted(by_comm.items()):
+        if any(wrapped[r] for r in seqs):
+            continue  # completed-count comparison is eviction-skewed
+        done = {r: sum(1 for rec in v if rec["state"] == "complete")
+                for r, v in seqs.items()}
+        if len(done) < 2:
+            continue
+        lead = max(done.values())
+        behind = {r: n for r, n in done.items() if n < lead}
+        if behind:
+            stragglers.append({
+                "comm": comm, "completed_lead": lead,
+                "behind": {str(r): n for r, n in sorted(behind.items())},
+            })
+
+    doc = {
+        "generated_ns": now_ns(),
+        "nranks": len(ranks),
+        "ranks": [per_rank[r] for r in ranks],
+        "analysis": {
+            "desyncs": desyncs,
+            "hangs": hangs,
+            "stragglers": stragglers,
+            # comms whose order analysis was skipped because a rank's
+            # ring wrapped (uneven eviction would fake desyncs); hang
+            # detection (in-flight records only) still covers them
+            "truncated_comms": truncated_comms,
+            "ok": not desyncs and not hangs,
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return doc

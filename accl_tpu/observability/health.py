@@ -1,0 +1,335 @@
+"""Health surface: hang/desync watchdog + OpenMetrics exporter.
+
+Two always-available production facilities on top of the flight
+recorder (flight.py) and the metrics registry (metrics.py):
+
+- :class:`Watchdog` — a daemon thread per engine/world that scans the
+  per-rank flight recorders for gang collectives stuck in assembly or
+  execution past ``ACCL_WATCHDOG_TIMEOUT`` seconds.  On fire it
+  reports *which ranks arrived and which are missing*, each rank's
+  last-completed seq and the head-of-queue call every absent rank is
+  actually blocked on, writes the merged flight dump to
+  ``ACCL_WATCHDOG_DUMP``, flips the ``accl_health`` gauge to ``hung``,
+  and bumps the ``watchdog/fires`` counter.  The TPU engine
+  additionally feeds its live gang-assembly table through the
+  ``introspect`` hook (TpuEngine.gang_assembly_snapshot), so the
+  report shows the exact partial gangs inside the scheduler.
+
+- :func:`start_exporter` — an OpenMetrics endpoint on
+  ``ACCL_METRICS_PORT`` (stdlib ``http.server`` thread): ``/metrics``
+  serves :meth:`MetricsRegistry.to_openmetrics`, ``/healthz`` a JSON
+  health summary, ``/flight`` the merged flight dump — the scrape
+  surface a production serving fleet points Prometheus at.
+
+Health states (the ``accl_health`` gauge):
+``0`` ok · ``1`` degraded (a collective returned a non-zero retcode in
+the last minute) · ``2`` hung (watchdog found a stuck gang).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from typing import Callable, Iterable, Optional
+
+from . import flight as _flight
+from .metrics import MetricsRegistry, default_registry
+from .trace import now_ns
+
+HEALTH_OK = 0
+HEALTH_DEGRADED = 1
+HEALTH_HUNG = 2
+HEALTH_NAMES = ("ok", "degraded", "hung")
+
+#: window after a non-zero retcode during which health reads degraded
+DEGRADED_WINDOW_NS = 60 * 10 ** 9
+
+
+def watchdog_timeout_s() -> float:
+    """Stuck-gang threshold in seconds; ``ACCL_WATCHDOG_TIMEOUT=0``
+    disables the watchdog entirely."""
+    raw = os.environ.get("ACCL_WATCHDOG_TIMEOUT", "300")
+    try:
+        return float(raw)
+    except ValueError:
+        return 300.0
+
+
+#: live watchdogs, for health aggregation: the accl_health gauge on a
+#: registry is the MAX verdict over every live watchdog publishing into
+#: it — one hung world must not be overwritten by a healthy sibling's
+#: sweep, and a freshly-constructed watchdog must not clear a live hang
+_watchdogs_lock = threading.Lock()
+_watchdogs: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _publish_health(registry: MetricsRegistry) -> None:
+    with _watchdogs_lock:
+        verdict = max((w._health for w in _watchdogs
+                       if w._registry is registry), default=HEALTH_OK)
+    registry.set_gauge("accl_health", verdict)
+
+
+class Watchdog:
+    """Stuck-gang detector over a set of per-rank flight recorders."""
+
+    def __init__(self, recorders: Iterable, timeout_s: Optional[float] = None,
+                 introspect: Optional[Callable[[], list]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 on_fire: Optional[Callable[[dict], None]] = None,
+                 dump_path: Optional[str] = None, name: str = "accl"):
+        self._recorders = list(recorders)
+        self.timeout_s = (watchdog_timeout_s() if timeout_s is None
+                          else timeout_s)
+        self._introspect = introspect
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self._on_fire = on_fire
+        self._dump_path = dump_path if dump_path is not None else \
+            os.environ.get("ACCL_WATCHDOG_DUMP", "accl_watchdog_dump.json")
+        self._name = name
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+        #: most recent fire report (tests and doctor read this)
+        self.last_report: Optional[dict] = None
+        #: this watchdog's own verdict; the registry gauge aggregates
+        #: (max) over every live watchdog on the same registry
+        self._health = HEALTH_OK
+        with _watchdogs_lock:
+            _watchdogs.add(self)
+        _publish_health(self._registry)
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0 and bool(self._recorders) \
+            and _flight.enabled()
+
+    def start(self) -> "Watchdog":
+        if self.enabled and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"{self._name}-watchdog",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        with _watchdogs_lock:
+            _watchdogs.discard(self)
+        _publish_health(self._registry)
+
+    # -- scan loop ------------------------------------------------------
+    def _loop(self) -> None:
+        interval = min(max(self.timeout_s / 4.0, 0.05), 2.0)
+        while not self._stop.wait(interval):
+            try:
+                self.check()
+            except Exception as e:  # pragma: no cover — diagnostics
+                # must never take the workload down, but a silently
+                # dying scan would be a watchdog that cannot bark
+                try:
+                    from ..utils.logging import get_logger
+
+                    get_logger("accl_tpu.watchdog").warning(
+                        "watchdog scan failed: %s: %s",
+                        type(e).__name__, e)
+                except Exception:
+                    pass
+
+    def check(self) -> Optional[dict]:
+        """One scan; returns the fire report when a hang was detected."""
+        self._registry.inc("watchdog/checks")
+        now = now_ns()
+        budget_ns = self.timeout_s * 1e9
+        stuck = [rec for r in self._recorders for rec in r.in_flight()
+                 if rec.gang and (now - rec.t_submit) > budget_ns]
+        if stuck:
+            self._health = HEALTH_HUNG
+            _publish_health(self._registry)
+            if not self._fired:
+                self._fired = True
+                return self._fire(stuck)
+            return None
+        self._fired = False
+        degraded = any(r.last_error_ns
+                       and now - r.last_error_ns < DEGRADED_WINDOW_NS
+                       for r in self._recorders)
+        self._health = HEALTH_DEGRADED if degraded else HEALTH_OK
+        _publish_health(self._registry)
+        return None
+
+    def _fire(self, stuck: list) -> dict:
+        self._registry.inc("watchdog/fires")
+        report = _flight.merge_flight_dumps(
+            [r.dump() for r in self._recorders])
+        report["watchdog"] = {
+            "timeout_s": self.timeout_s,
+            "stuck_records": [rec.to_dict() for rec in stuck],
+        }
+        if self._introspect is not None:
+            try:
+                report["watchdog"]["engine_gangs"] = self._introspect()
+            except Exception:
+                report["watchdog"]["engine_gangs"] = None
+        self.last_report = report
+        if self._dump_path:
+            try:
+                with open(self._dump_path, "w") as f:
+                    json.dump(report, f, indent=1)
+            except OSError:
+                pass
+        self._log(report)
+        if self._on_fire is not None:
+            try:
+                self._on_fire(report)
+            except Exception:
+                pass
+        return report
+
+    def _log(self, report: dict) -> None:
+        from ..utils.logging import get_logger
+
+        log = get_logger("accl_tpu.watchdog")
+        for hang in report["analysis"]["hangs"]:
+            log.error(
+                "watchdog: %s (comm %d, count %d, %s) stuck %.1fs — "
+                "arrived ranks %s, MISSING ranks %s; missing blocked on "
+                "%s; last completed seq per rank %s; dump: %s",
+                hang["collective"], hang["comm"], hang["count"],
+                hang["dtype"], hang["oldest_age_us"] / 1e6,
+                hang["arrived"], hang["missing"],
+                {r: (rec["collective"] if rec else "idle")
+                 for r, rec in hang["missing_blocked_on"].items()},
+                hang["last_completed_seq"], self._dump_path or "<none>")
+        for d in report["analysis"]["desyncs"]:
+            log.error("watchdog: collective-order DESYNC on comm %d at "
+                      "gang index %d: %s", d["comm"], d["index"],
+                      d["per_rank"])
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics / health HTTP endpoint (stdlib http.server thread)
+# ---------------------------------------------------------------------------
+_exporter_lock = threading.Lock()
+_exporter: Optional["MetricsExporter"] = None
+
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+
+class MetricsExporter:
+    """Serves /metrics (OpenMetrics), /healthz (JSON) and /flight
+    (merged flight dump) from a daemon thread."""
+
+    def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry if registry is not None else default_registry()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                try:
+                    if self.path.startswith("/metrics"):
+                        body = reg.to_openmetrics().encode()
+                        ctype = OPENMETRICS_CONTENT_TYPE
+                    elif self.path.startswith("/healthz"):
+                        body = json.dumps(exporter.health()).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/flight"):
+                        body = json.dumps(_flight.dump_all()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # surface, don't kill the thread
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._registry = reg
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="accl-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    def health(self) -> dict:
+        snap = self._registry.snapshot()
+        code = int(snap["gauges"].get("accl_health", HEALTH_OK))
+        code = min(max(code, 0), len(HEALTH_NAMES) - 1)
+        return {
+            "health": HEALTH_NAMES[code],
+            "accl_health": code,
+            "watchdog_fires": snap["counters"].get("watchdog/fires", 0),
+            "watchdog_checks": snap["counters"].get("watchdog/checks", 0),
+        }
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_exporter(port: Optional[int] = None,
+                   registry: Optional[MetricsRegistry] = None,
+                   ) -> Optional[MetricsExporter]:
+    """Start (or return) the process-wide exporter.  With no explicit
+    `port`, reads ``ACCL_METRICS_PORT`` (unset/empty/0 = no exporter;
+    an explicit ``port=0`` binds an ephemeral port — tests use this)."""
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            return _exporter
+        if port is None:
+            raw = os.environ.get("ACCL_METRICS_PORT", "")
+            if not raw or raw == "0":
+                return None
+            port = int(raw)
+        _exporter = MetricsExporter(port, registry)
+        from ..utils.logging import get_logger
+
+        get_logger().info("OpenMetrics endpoint on http://%s:%d/metrics",
+                          _exporter.host, _exporter.port)
+        return _exporter
+
+
+def stop_exporter() -> None:
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.close()
+            _exporter = None
+
+
+def ensure_exporter_from_env() -> Optional[MetricsExporter]:
+    """Idempotent env-driven start; called from ACCL.initialize and the
+    engine bring-up paths so any entrypoint honors ACCL_METRICS_PORT.
+    Never raises: a port collision (two local ranks sharing one
+    ACCL_METRICS_PORT — only the first can bind) must not take driver
+    bring-up down with it."""
+    try:
+        return start_exporter()
+    except OSError as e:
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "OpenMetrics endpoint disabled (ACCL_METRICS_PORT=%s): %s",
+            os.environ.get("ACCL_METRICS_PORT", ""), e)
+        return None

@@ -152,6 +152,9 @@ class MetricsRegistry:
                         "min": round(st.min_ns / 1e3, 2) if good else 0.0,
                         "avg": round(avg_ns / 1e3, 2),
                         "max": round(st.max_ns / 1e3, 2),
+                        # exact sum: the OpenMetrics histogram _sum
+                        # (avg*calls would re-round)
+                        "total": round(st.total_ns / 1e3, 2),
                     },
                     "hist_us": {
                         **{f"le_{ub}": n for ub, n in
@@ -196,6 +199,71 @@ class MetricsRegistry:
                 f"{c['latency_us']['max']:>10.2f} "
                 f"{c['algbw_GBps']:>11.6f} {c['busbw_GBps']:>11.6f}")
         return "\n".join(lines)
+
+    def to_openmetrics(self) -> str:
+        """OpenMetrics text exposition (the /metrics scrape body served
+        by observability.health.start_exporter).
+
+        Layout: counters as ``accl_<name>_total``, gauges as
+        ``accl_<name>`` (names already carrying the ``accl_`` prefix —
+        e.g. the watchdog's ``accl_health`` — are not double-prefixed),
+        and the per-signature call stats as labeled families:
+        ``accl_collective_calls_total`` / ``_errors_total`` /
+        ``_bytes_total``, an ``accl_collective_latency_us`` histogram
+        with cumulative power-of-4 buckets, and ``accl_collective_
+        algbw_gbps`` / ``busbw_gbps`` gauges."""
+        import re
+
+        def name(n: str) -> str:
+            n = re.sub(r"[^a-zA-Z0-9_:]", "_", n)
+            return n if n.startswith("accl_") else f"accl_{n}"
+
+        def esc(v) -> str:
+            return str(v).replace("\\", r"\\").replace('"', r'\"')
+
+        snap = self.snapshot()
+        out = []
+        for k in sorted(snap["counters"]):
+            n = name(k)
+            out.append(f"# TYPE {n} counter")
+            out.append(f"{n}_total {snap['counters'][k]}")
+        for k in sorted(snap["gauges"]):
+            n = name(k)
+            out.append(f"# TYPE {n} gauge")
+            out.append(f"{n} {snap['gauges'][k]}")
+        if snap["calls"]:
+            out.append("# TYPE accl_collective_calls counter")
+            out.append("# TYPE accl_collective_errors counter")
+            out.append("# TYPE accl_collective_bytes counter")
+            out.append("# TYPE accl_collective_latency_us histogram")
+            out.append("# TYPE accl_collective_algbw_gbps gauge")
+            out.append("# TYPE accl_collective_busbw_gbps gauge")
+        for k in sorted(snap["calls"]):
+            c = snap["calls"][k]
+            lbl = (f'collective="{esc(c["collective"])}",'
+                   f'dtype="{esc(c["dtype"])}",'
+                   f'size_bucket="{esc(c["size_bucket"])}"')
+            out.append(f"accl_collective_calls_total{{{lbl}}} {c['calls']}")
+            out.append(
+                f"accl_collective_errors_total{{{lbl}}} {c['errors']}")
+            out.append(f"accl_collective_bytes_total{{{lbl}}} {c['bytes']}")
+            cum = 0
+            for ub in LATENCY_BUCKETS_US:
+                cum += c["hist_us"][f"le_{ub}"]
+                out.append("accl_collective_latency_us_bucket"
+                           f'{{{lbl},le="{ub}"}} {cum}')
+            cum += c["hist_us"]["inf"]
+            out.append("accl_collective_latency_us_bucket"
+                       f'{{{lbl},le="+Inf"}} {cum}')
+            out.append("accl_collective_latency_us_sum"
+                       f"{{{lbl}}} {c['latency_us']['total']}")
+            out.append(f"accl_collective_latency_us_count{{{lbl}}} {cum}")
+            out.append(
+                f"accl_collective_algbw_gbps{{{lbl}}} {c['algbw_GBps']}")
+            out.append(
+                f"accl_collective_busbw_gbps{{{lbl}}} {c['busbw_GBps']}")
+        out.append("# EOF")
+        return "\n".join(out) + "\n"
 
     def reset(self) -> None:
         with self._lock:

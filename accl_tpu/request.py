@@ -14,11 +14,32 @@ may interleave retried rendezvous calls internally.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from typing import Callable, Optional
 
 from .constants import ACCLError, OperationStatus, error_code_to_str
+from .observability import flight as _flight
 from .observability import trace as _trace
+
+#: sentinel distinguishing "no timeout passed" (resolve the configurable
+#: default) from an explicit None (block forever, the pre-r8 behavior)
+_WAIT_DEFAULT = object()
+
+
+def default_wait_timeout_s() -> float:
+    """Default Request.wait budget in seconds, derived from the same
+    ``ACCL_DEFAULT_TIMEOUT`` knob as the engine receive budget (µs,
+    accl.default_timeout) plus generous host headroom — the driver wait
+    must always fire AFTER the engine's own timeout so a stall surfaces
+    as a decodable retcode first, and a bare ``wait()`` can no longer
+    hang a production process forever."""
+    raw = os.environ.get("ACCL_DEFAULT_TIMEOUT", "1000000")
+    try:
+        engine_s = float(raw) / 1e6
+    except ValueError:
+        engine_s = 1.0
+    return engine_s + 59.0
 
 
 class Request:
@@ -62,6 +83,11 @@ class Request:
         #: tuple published at completion.  Both set by ACCL._execute.
         self.trace: Optional[object] = None
         self.metric: Optional[tuple] = None
+        #: always-on flight-recorder record (observability/flight.py);
+        #: None only when ACCL_FLIGHT=0 or the request predates
+        #: initialize.  Set by ACCL._observe_call; state transitions are
+        #: stamped in place by the queue and the backends.
+        self.flight: Optional[_flight.FlightRecord] = None
 
     def complete(self, retcode: int, duration_ns: float = 0.0) -> None:
         self.retcode = retcode
@@ -82,10 +108,13 @@ class Request:
         result-buffer sync in on_complete has already run), metrics
         observation keyed by the driver-attached signature.  Observer
         failures must never lose the completion event."""
-        if self.metric is None and self.trace is None:
+        if self.metric is None and self.trace is None \
+                and self.flight is None:
             return
         try:
             t_end = _trace.now_ns()
+            if self.flight is not None:
+                self.flight.finish(self.retcode, t_end)
             if self.metric is not None:
                 reg, coll, dtype, nbytes, nranks, t0 = self.metric
                 reg.observe_call(coll, dtype, nbytes, t_end - t0, nranks,
@@ -98,21 +127,45 @@ class Request:
         except Exception:  # pragma: no cover — observability is best-effort
             pass
 
-    def wait(self, timeout: Optional[float] = None) -> bool:
+    def wait(self, timeout=_WAIT_DEFAULT) -> bool:
         """Block until completion; returns False on timeout
-        (reference: cclo.hpp:149-150 wait w/ timeout)."""
+        (reference: cclo.hpp:149-150 wait w/ timeout).
+
+        A bare ``wait()`` uses the configurable default budget
+        (:func:`default_wait_timeout_s`, driven by ACCL_DEFAULT_TIMEOUT)
+        instead of blocking forever; pass ``timeout=None`` explicitly
+        for an unbounded wait."""
+        if timeout is _WAIT_DEFAULT:
+            timeout = default_wait_timeout_s()
         thunk, self.pre_wait = self.pre_wait, None
         if thunk is not None:
             thunk()
         return self._done.wait(timeout)
 
+    def flight_info(self) -> str:
+        """The flight-recorder view of this call, for error embedding
+        ('' when the recorder is off): seq, state, lane, age."""
+        rec = self.flight
+        if rec is None:
+            return ""
+        return f" [flight: {rec.summary()}]"
+
     def check(self) -> None:
-        """Raise if the engine reported a non-zero retcode or the
-        completion callback failed
+        """Raise if the engine reported a non-zero retcode, the
+        completion callback failed, or — called after a wait() timeout —
+        the call is still in flight, with the flight-recorder record
+        (seq, state, lane, age) embedded so a timeout is diagnosable
+        from the exception alone
         (reference: accl.cpp:1226-1250 check_return_value)."""
+        if not self.done:
+            raise ACCLError(
+                f"{self.description or 'call'} timed out: request id "
+                f"{self.id} still in flight"
+                f" (status={self.status.name}){self.flight_info()}")
         if self.retcode != 0:
             raise ACCLError(
-                f"{self.description or 'call'} failed: {error_code_to_str(self.retcode)}",
+                f"{self.description or 'call'} failed: "
+                f"{error_code_to_str(self.retcode)}{self.flight_info()}",
                 self.retcode,
             )
         if self.callback_error is not None:
@@ -142,6 +195,10 @@ class RequestQueue:
     def submit(self, request: Request, start_fn: Callable[[Request], None]) -> Request:
         with self._lock:
             request.status = OperationStatus.EXECUTING
+            rec = request.flight
+            if rec is not None:
+                rec.t_queue = _trace.now_ns()
+                rec.state = _flight.S_QUEUED
             if request.trace is not None:
                 request.trace.t_queue = _trace.now_ns()
             start_fn(request)

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import getpass
 import os
-import sys
 import tempfile
 
 
@@ -63,6 +62,8 @@ def enable(path: str | None = None) -> str | None:
                 jax.config.update(key, val)
             except Exception:  # noqa: BLE001
                 pass
-        print(f"[compile-cache] disabled: {type(e).__name__}: {e}",
-              file=sys.stderr)
+        from .logging import get_logger
+
+        get_logger().warning("compile-cache disabled: %s: %s",
+                             type(e).__name__, e)
         return None

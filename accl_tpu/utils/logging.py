@@ -1,7 +1,12 @@
-"""Leveled logging (reference: test/log/log.hpp, 5 levels + per-rank files).
+"""Structured rank-prefixed logging (reference: test/log/log.hpp, 5
+levels + per-rank files).
 
-Thin wrapper over the stdlib; honors ACCL_DEBUG like the reference
-driver's debug log switch (driver/xrt/src/common.cpp:91-135).
+Every line is prefixed ``[accl r3]`` (or ``[accl]`` when no rank is
+bound) plus a one-letter level, so interleaved multi-rank output stays
+attributable — the discipline the watchdog and backend diagnostics
+rely on.  Level comes from ``ACCL_LOG`` (debug/info/warning/error,
+default warning); ``ACCL_DEBUG=1`` keeps its reference-era meaning as
+an alias for ``ACCL_LOG=debug`` (driver/xrt/src/common.cpp:91-135).
 """
 from __future__ import annotations
 
@@ -10,20 +15,64 @@ import os
 import sys
 from typing import Optional
 
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
 _configured = False
 
 
-def get_logger(name: str = "accl_tpu", rank: Optional[int] = None) -> logging.Logger:
+def level_from_env() -> int:
+    raw = os.environ.get("ACCL_LOG", "").strip().lower()
+    if raw:
+        return _LEVELS.get(raw, logging.WARNING)
+    return logging.DEBUG if os.environ.get("ACCL_DEBUG") else logging.WARNING
+
+
+class _RankFormatter(logging.Formatter):
+    """``[accl r3] W message`` — rank recovered from the logger name's
+    ``.rankN`` suffix (how get_logger binds it), so every handler and
+    third-party emit keeps the prefix."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        rank = getattr(record, "rank", None)
+        if rank is None and ".rank" in record.name:
+            tail = record.name.rsplit(".rank", 1)[1]
+            if tail.isdigit():
+                rank = tail
+        prefix = f"[accl r{rank}]" if rank is not None else "[accl]"
+        return f"{prefix} {record.levelname[0]} {record.getMessage()}"
+
+
+def _configure() -> None:
     global _configured
-    logger = logging.getLogger(name if rank is None else f"{name}.rank{rank}")
-    if not _configured:
-        level = logging.DEBUG if os.environ.get("ACCL_DEBUG") else logging.WARNING
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(
-            logging.Formatter("[%(levelname).1s %(name)s] %(message)s")
-        )
-        root = logging.getLogger("accl_tpu")
-        root.addHandler(handler)
-        root.setLevel(level)
-        _configured = True
-    return logger
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_RankFormatter())
+    root = logging.getLogger("accl_tpu")
+    root.addHandler(handler)
+    root.setLevel(level_from_env())
+    _configured = True
+
+
+def get_logger(name: str = "accl_tpu",
+               rank: Optional[int] = None) -> logging.Logger:
+    """Rank-bound structured logger: ``get_logger(rank=3).warning(...)``
+    emits ``[accl r3] W ...`` on stderr at the ACCL_LOG level."""
+    _configure()
+    return logging.getLogger(name if rank is None else f"{name}.rank{rank}")
+
+
+def set_level(level) -> None:
+    """Programmatic override of the env-derived level (accepts a
+    logging constant or an ACCL_LOG-style name)."""
+    _configure()
+    if isinstance(level, str):
+        level = _LEVELS.get(level.strip().lower(), logging.WARNING)
+    logging.getLogger("accl_tpu").setLevel(level)

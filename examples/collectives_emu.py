@@ -43,6 +43,7 @@ def rank_main(world, r, results):
     sreq = a.send(src, COUNT, dst=peer, tag=7, run_async=True)
     a.recv(dst, COUNT, src=frm, tag=7)
     sreq.wait()
+    sreq.check()  # raises (with the flight record) on error OR timeout
     assert dst.host[0] == 1000 * frm, (r, dst.host[0])
 
     # 2. allreduce with on-path sum (the reduce_ops lane's role)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""accl_doctor: merge per-rank flight-recorder dumps and diagnose
+cross-rank failure modes — the offline half of the hang/desync
+watchdog (accl_tpu/observability/flight.py merge_flight_dumps).
+
+Feed it per-rank dump files (ACCL.dump_flight_recorder(path),
+SIGUSR1's ACCL_FLIGHT_DUMP, one per process of a multihost run) or an
+already-merged watchdog dump; it prints a human report of
+
+- HANGS    — stuck gang instances: which ranks arrived, which are
+             missing, and the head-of-queue call each missing rank is
+             actually blocked on;
+- DESYNCS  — the first seq position where ranks issued different
+             collectives on one communicator (order/shape/dtype
+             mismatch);
+- STRAGGLERS — ranks whose completed-gang progress trails the lead.
+
+Usage: python scripts/accl_doctor.py dump_rank*.json [--out merged.json]
+       [--fail-on-findings]
+
+Exit code: 0 on a clean bill of health (or findings with the default
+flags), 1 with --fail-on-findings when any hang/desync was found.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accl_tpu.observability.flight import merge_flight_dumps  # noqa: E402
+
+
+def fmt_record(rec) -> str:
+    if rec is None:
+        return "idle (no in-flight call)"
+    return (f"seq={rec['seq']} {rec['collective']} comm={rec['comm']} "
+            f"count={rec['count']} {rec['dtype']} "
+            f"state={rec['state']} lane={rec['lane']} "
+            f"age={rec['age_us'] / 1e3:.1f}ms")
+
+
+def report(doc: dict, out=sys.stdout) -> bool:
+    """Print the human report; returns True when findings exist."""
+    an = doc["analysis"]
+    w = out.write
+    w(f"accl_doctor: {doc['nranks']} rank(s), "
+      f"{sum(len(r['records']) for r in doc['ranks'])} record(s)\n")
+    for r in doc["ranks"]:
+        inflight = [x for x in r["records"]
+                    if x["state"] not in ("complete", "failed")]
+        w(f"  rank {r['rank']}: last_completed_seq="
+          f"{r['last_completed_seq']}, {len(inflight)} in flight\n")
+
+    for h in an["hangs"]:
+        w(f"\nHANG: {h['collective']} (comm {h['comm']}, tag {h['tag']}, "
+          f"count {h['count']}, {h['dtype']}) — stuck "
+          f"{h['oldest_age_us'] / 1e6:.1f}s\n")
+        w(f"  arrived ranks: {h['arrived']}\n")
+        w(f"  MISSING ranks: {h['missing']}\n")
+        for r, rec in h["missing_blocked_on"].items():
+            w(f"    rank {r} blocked on: {fmt_record(rec)}\n")
+        w(f"  last completed seq per rank: {h['last_completed_seq']}\n")
+
+    for d in an["desyncs"]:
+        w(f"\nDESYNC on comm {d['comm']} at gang index {d['index']} — "
+          f"ranks disagree on the collective issued:\n")
+        for r, s in sorted(d["per_rank"].items(), key=lambda kv: int(kv[0])):
+            if s is None:
+                w(f"    rank {r}: <no call at this position>\n")
+            else:
+                w(f"    rank {r}: seq={s['seq']} {s['collective']} "
+                  f"tag={s['tag']} count={s['count']} {s['dtype']}\n")
+
+    for s in an["stragglers"]:
+        w(f"\nSTRAGGLER(s) on comm {s['comm']}: lead rank completed "
+          f"{s['completed_lead']} gang call(s); behind: {s['behind']}\n")
+
+    for comm in an.get("truncated_comms", []):
+        w(f"\nnote: order analysis skipped on comm {comm} — a rank's "
+          f"flight ring wrapped (uneven eviction would fake desyncs; "
+          f"raise ACCL_FLIGHT_CAP for full-history analysis)\n")
+
+    if an["ok"] and not an["stragglers"]:
+        w("\nno hangs, desyncs or stragglers — all ranks in sync\n")
+    return not an["ok"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dumps", nargs="+",
+                    help="per-rank flight dump JSON files (or one "
+                         "merged/watchdog dump)")
+    ap.add_argument("--out", default="",
+                    help="also write the merged+analyzed JSON here")
+    ap.add_argument("--fail-on-findings", action="store_true",
+                    help="exit 1 when any hang or desync is detected "
+                         "(CI / alerting mode)")
+    args = ap.parse_args()
+
+    doc = merge_flight_dumps(args.dumps, out_path=args.out or None)
+    findings = report(doc)
+    if args.out:
+        print(f"merged dump written to {args.out}")
+    return 1 if (findings and args.fail_on_findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

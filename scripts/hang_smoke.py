@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""CI hang-injection smoke: prove the always-on black box end to end.
+
+Scenario (the acceptance drill for the flight-recorder/watchdog layer):
+a 4-rank emu world where ranks 1..N-1 issue an allreduce and rank 0
+withholds its gang member past ACCL_WATCHDOG_TIMEOUT.  Asserts, in
+order:
+
+1. the watchdog fires within the timeout and its merged flight dump
+   (a) matches the RECORD_SCHEMA_KEYS schema and (b) names the missing
+   rank AND the blocked collective;
+2. the OpenMetrics endpoint (ACCL_METRICS_PORT, here an ephemeral
+   port) flips ``accl_health`` to hung (2) — the curl-able signal;
+3. after the withheld rank finally joins, the collective completes
+   with correct results and health returns to ok (0) — a watchdog fire
+   is a diagnosis, not a failure;
+4. scripts/accl_doctor.py reads the dump and reports the same hang.
+
+Artifacts (uploaded by CI next to the trace smoke): the watchdog dump
+and the per-rank flight dumps.
+
+Usage: python scripts/hang_smoke.py [--ranks N] [--timeout S]
+       [--dump PATH] [--report PATH]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--count", type=int, default=256)
+    ap.add_argument("--timeout", type=float, default=1.0,
+                    help="watchdog stuck-gang threshold (s)")
+    ap.add_argument("--dump", default="hang_flight_dump.json",
+                    help="watchdog dump artifact path")
+    ap.add_argument("--report", default="hang_doctor_report.txt",
+                    help="accl_doctor output artifact path")
+    args = ap.parse_args()
+
+    # arm everything exactly as a production user would: env, before
+    # any accl import.  Engine receive budget far above the hang length
+    # so the stall is diagnosed by the WATCHDOG, not an engine timeout.
+    os.environ["ACCL_WATCHDOG_TIMEOUT"] = str(args.timeout)
+    os.environ["ACCL_WATCHDOG_DUMP"] = args.dump
+    os.environ.setdefault("ACCL_DEFAULT_TIMEOUT", "60000000")
+
+    import numpy as np
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.backends.emu import EmuWorld
+    from accl_tpu.observability import health as obs_health
+    from accl_tpu.observability.flight import RECORD_SCHEMA_KEYS
+
+    exporter = obs_health.start_exporter(port=0)  # the ACCL_METRICS_PORT path
+    base = f"http://{exporter.host}:{exporter.port}"
+
+    def scrape_health() -> int:
+        body = urllib.request.urlopen(base + "/metrics", timeout=10
+                                      ).read().decode()
+        for line in body.splitlines():
+            if line.startswith("accl_health "):
+                return int(float(line.split()[1]))
+        raise AssertionError("accl_health gauge missing from /metrics")
+
+    with EmuWorld(args.ranks) as world:
+        bufs = {}
+
+        def setup(accl, rank):
+            s = accl.create_buffer_like(
+                np.arange(args.count, dtype=np.float32) + rank)
+            r = accl.create_buffer(args.count, np.float32)
+            bufs[rank] = (s, r)
+
+        world.run(setup)
+
+        # -- inject the hang: rank 0 withholds its gang member --------
+        reqs = {}
+
+        def issue(accl, rank):
+            if rank == 0:
+                return None  # the delayed rank
+            s, r = bufs[rank]
+            reqs[rank] = accl.allreduce(s, r, args.count,
+                                        ReduceFunction.SUM, run_async=True)
+            return True
+
+        world.run(issue)
+
+        deadline = time.time() + args.timeout * 10 + 10
+        while world.watchdog.last_report is None:
+            if time.time() > deadline:
+                print("FAIL: watchdog never fired")
+                return 1
+            time.sleep(0.05)
+        report = world.watchdog.last_report
+
+        # -- 1a. dump schema ------------------------------------------
+        for rd in report["ranks"]:
+            for key in ("rank", "capacity", "last_completed_seq",
+                        "records"):
+                if key not in rd:
+                    print(f"FAIL: rank dump missing {key!r}")
+                    return 1
+            for rec in rd["records"]:
+                missing = [k for k in RECORD_SCHEMA_KEYS if k not in rec]
+                if missing:
+                    print(f"FAIL: record missing keys {missing}: {rec}")
+                    return 1
+        if not os.path.exists(args.dump):
+            print(f"FAIL: watchdog did not write {args.dump}")
+            return 1
+
+        # -- 1b. the hang names the missing rank + collective ---------
+        hangs = report["analysis"]["hangs"]
+        if not hangs:
+            print("FAIL: fired report carries no hang analysis")
+            return 1
+        h = hangs[0]
+        if h["collective"] != "allreduce" or h["missing"] != [0] \
+                or h["arrived"] != list(range(1, args.ranks)):
+            print(f"FAIL: wrong diagnosis: {h}")
+            return 1
+
+        # -- 2. OpenMetrics endpoint shows hung -----------------------
+        if scrape_health() != obs_health.HEALTH_HUNG:
+            print("FAIL: accl_health gauge did not flip to hung")
+            return 1
+        hz = json.loads(urllib.request.urlopen(base + "/healthz",
+                                               timeout=10).read())
+        if hz["health"] != "hung" or hz["watchdog_fires"] < 1:
+            print(f"FAIL: /healthz disagrees: {hz}")
+            return 1
+
+        # -- 3. the withheld rank joins; everything completes ---------
+        def join(accl, rank):
+            if rank != 0:
+                return None
+            s, r = bufs[rank]
+            accl.allreduce(s, r, args.count, ReduceFunction.SUM)
+            return r.host.copy()
+
+        outs = world.run(join)
+        for rank in range(1, args.ranks):
+            assert reqs[rank].wait(60), f"rank {rank} never completed"
+            reqs[rank].check()
+            bufs[rank][1].slice(0, args.count).sync_from_device()
+        expected = np.sum([np.arange(args.count, dtype=np.float32) + r
+                           for r in range(args.ranks)], axis=0)
+        np.testing.assert_allclose(outs[0], expected)
+        for rank in range(1, args.ranks):
+            np.testing.assert_allclose(bufs[rank][1].host, expected)
+
+        deadline = time.time() + 20
+        while scrape_health() != obs_health.HEALTH_OK:
+            if time.time() > deadline:
+                print("FAIL: health never recovered to ok")
+                return 1
+            time.sleep(0.1)
+
+    # -- 4. accl_doctor reads the dump back -----------------------------
+    doctor = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "accl_doctor.py"), args.dump],
+        capture_output=True, text=True)
+    with open(args.report, "w") as f:
+        f.write(doctor.stdout + doctor.stderr)
+    if doctor.returncode != 0 or "MISSING ranks: [0]" not in doctor.stdout:
+        print(f"FAIL: accl_doctor did not report the hang:\n"
+              f"{doctor.stdout}\n{doctor.stderr}")
+        return 1
+
+    obs_health.stop_exporter()
+    print(f"OK: watchdog fired in <= {args.timeout}s, named missing "
+          f"rank 0 on allreduce; accl_health flipped hung->ok; "
+          f"dump={args.dump} doctor={args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

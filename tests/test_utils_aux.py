@@ -69,9 +69,30 @@ def test_debug_logging_env(capsys, monkeypatch):
     log = alog.get_logger(rank=3)
     log.debug("hello-debug")
     err = capsys.readouterr().err
-    assert "hello-debug" in err and "rank3" in err
+    # structured rank prefix: "[accl r3] D hello-debug"
+    assert "hello-debug" in err and "[accl r3]" in err
     # restore: unconfigured module state for later tests
     monkeypatch.delenv("ACCL_DEBUG")
+    stdlog.getLogger("accl_tpu").handlers.clear()
+    importlib.reload(alog)
+
+
+def test_accl_log_level_env(capsys, monkeypatch):
+    import importlib
+    import logging as stdlog
+
+    from accl_tpu.utils import logging as alog
+
+    monkeypatch.setenv("ACCL_LOG", "info")
+    importlib.reload(alog)
+    stdlog.getLogger("accl_tpu").handlers.clear()
+    log = alog.get_logger(rank=1)
+    log.info("at-info")
+    log.debug("below-level")
+    err = capsys.readouterr().err
+    assert "[accl r1] I at-info" in err
+    assert "below-level" not in err
+    monkeypatch.delenv("ACCL_LOG")
     stdlog.getLogger("accl_tpu").handlers.clear()
     importlib.reload(alog)
 
