@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .analysis import sanitizer as _san
 from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
 from .backends.base import CCLODevice
 from .buffer import BaseBuffer, DummyBuffer
@@ -34,6 +35,7 @@ from .constants import (
     DEFAULT_EAGER_RX_BUF_SIZE,
     DEFAULT_MAX_EAGER_SIZE,
     DEFAULT_MAX_RENDEZVOUS_SIZE,
+    GANG_OPERATIONS,
     HostFlags,
     Operation,
     ReduceFunction,
@@ -45,16 +47,15 @@ from .observability import health as _health
 from .observability import metrics as _metrics
 from .observability import trace as _trace
 from .request import Request, RequestQueue
+from .utils.logging import get_logger
 
 GLOBAL_COMM = 0  # id of the world communicator, like the reference's comm 0
 
 #: scenarios that form cross-rank gangs in the engines (one instance ==
-#: one gang id in the trace); p2p and local ops are single-rank spans
-_GANG_OPS = frozenset((
-    Operation.bcast, Operation.scatter, Operation.gather,
-    Operation.allgather, Operation.reduce, Operation.allreduce,
-    Operation.reduce_scatter, Operation.alltoall, Operation.barrier,
-))
+#: one gang id in the trace); p2p and local ops are single-rank spans.
+#: Shared with the flight-recorder analyzer and the collective
+#: sanitizer via constants.GANG_OPERATIONS.
+_GANG_OPS = GANG_OPERATIONS
 
 
 def default_timeout() -> int:
@@ -109,6 +110,12 @@ class ACCL:
         #: created at initialize (the rank is known there); None only
         #: with ACCL_FLIGHT=0
         self.flight_recorder: Optional[_flight.FlightRecorder] = None
+        #: collective sanitizer state (analysis/sanitizer.py): per-comm
+        #: gang instance counters for the cross-rank fingerprint
+        #: exchange, and weak handles on run_async requests so deinit
+        #: can name anything the caller never waited
+        self._sanitize_seq: dict = {}
+        self._async_pending: list = []
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -211,7 +218,21 @@ class ACCL:
         return self.comm.size
 
     def communicator(self, comm_id: int) -> Communicator:
-        return self._communicators[comm_id]
+        """The communicator table for an id, or a decodable ACCLError —
+        a bad id must not surface as a bare IndexError deep inside a
+        collective (the lookup contract the collective sanitizer and
+        accl_lint formalize)."""
+        if isinstance(comm_id, int) and \
+                0 <= comm_id < len(self._communicators):
+            return self._communicators[comm_id]
+        if not self._communicators:
+            raise ACCLError(
+                f"unknown communicator id {comm_id!r}: driver not "
+                f"initialized (call initialize() first)")
+        raise ACCLError(
+            f"unknown communicator id {comm_id!r}: this rank has ids "
+            f"0..{len(self._communicators) - 1} (create_communicator "
+            f"must run in the same order on every member rank)")
 
     def arithcfg_id(self, uncompressed: DataType,
                     compressed: Optional[DataType] = None) -> int:
@@ -237,6 +258,12 @@ class ACCL:
         its sub-communicators in the same order so the ids align across
         the group — the same discipline the reference needs for its
         exchange-memory communicator addresses (communicator.cpp:23)."""
+        size = self.comm.size
+        bad = [i for i in indices if not 0 <= i < size]
+        if bad:
+            raise ACCLError(
+                f"create_communicator: rank indices {bad} outside the "
+                f"world (size {size})")
         new_id = len(self._communicators)
         sub = self.comm.split(indices, new_id)
         self._device.upload_communicator(sub)
@@ -511,7 +538,7 @@ class ACCL:
         run_async: bool = False,
     ):
         """Broadcast from root (reference: accl.cpp:418)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         is_root = comm.local_rank == root
         call = self._build(
             Operation.bcast, count, comm_id, root_src_dst=root,
@@ -537,7 +564,7 @@ class ACCL:
     ):
         """Scatter `count` elements to each rank from root
         (reference: accl.cpp:464)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         is_root = comm.local_rank == root
         call = self._build(
             Operation.scatter, count, comm_id, root_src_dst=root,
@@ -564,7 +591,7 @@ class ACCL:
     ):
         """Gather `count` elements from each rank at root
         (reference: accl.cpp:513)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         is_root = comm.local_rank == root
         call = self._build(
             Operation.gather, count, comm_id, root_src_dst=root,
@@ -589,7 +616,7 @@ class ACCL:
         run_async: bool = False,
     ):
         """All-gather (reference: accl.cpp:571)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         call = self._build(
             Operation.allgather, count, comm_id,
             op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
@@ -622,7 +649,7 @@ class ACCL:
         `device.push_krnl`), RES_STREAM delivers the root's result to local
         compute stream `stream_id` (`recvbuf` may be None; read it with
         `device.pop_stream`)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         is_root = comm.local_rank == root
         op_stream = bool(stream_flags & StreamFlags.OP0_STREAM)
         res_stream = bool(stream_flags & StreamFlags.RES_STREAM)
@@ -680,7 +707,7 @@ class ACCL:
     ):
         """Reduce-scatter: each rank ends with `count` reduced elements
         (reference: accl.cpp:844)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         call = self._build(
             Operation.reduce_scatter, count, comm_id, function=int(function),
             op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
@@ -701,7 +728,7 @@ class ACCL:
         run_async: bool = False,
     ):
         """All-to-all personalized exchange (reference: accl.cpp:892)."""
-        comm = self._communicators[comm_id]
+        comm = self.communicator(comm_id)
         call = self._build(Operation.alltoall, count, comm_id,
                            op0=sendbuf, res=recvbuf)
         return self._execute(call,
@@ -770,6 +797,15 @@ class ACCL:
         # for a recycled address with a different dtype; with all three,
         # a recycled address either matches (identical descriptor) or
         # misses.
+        # a bad comm id must fail HERE with a decodable error, not as a
+        # backend IndexError (or a silent engine hang) later; the slow
+        # path is one len() + compare, the raise is delegated.  The
+        # world comm on an uninitialized driver stays permissive:
+        # local-op descriptors (copy/nop) are buildable pre-bring-up
+        if (comm_id < 0 or comm_id >= len(self._communicators)) and \
+                (self._communicators or comm_id != GLOBAL_COMM):
+            self.communicator(comm_id)  # raises the naming ACCLError
+
         def _bkey(b):
             return (None if b is None
                     else (b.address, b.data_type, b.is_host_only))
@@ -933,6 +969,14 @@ class ACCL:
         req = Request(desc, sync=not run_async)
         if observe:
             self._observe_call(call, desc, req, t_submit)
+        # collective sanitizer lane (analysis/sanitizer.py): off-path
+        # cost is this one module-bool read; with ACCL_SANITIZE=1 the
+        # call is validated (comm/root/peer/operand-overlap) and, on
+        # in-process worlds, fingerprint-matched against its gang peers
+        # BEFORE dispatch — raising here instead of hanging there.  A
+        # shadow CaptureSession records the descriptor the same way.
+        if _san.active():
+            _san.on_call(self, call, desc, req, run_async)
 
         if sync_out:  # device-resident results need no completion sync
             def finish(r: Request) -> None:
@@ -945,6 +989,15 @@ class ACCL:
         self._queue.submit(req, lambda r: self._device.start(call, r))
         self._last_request = req
         if run_async:
+            # weak handle only: deinit() names still-pending async
+            # requests, but tracking must never extend their lifetime
+            import weakref
+
+            self._async_pending.append(weakref.ref(req))
+            if len(self._async_pending) > 256:
+                self._async_pending = [
+                    ref for ref in self._async_pending
+                    if (r := ref()) is not None and not r.done]
             return req
         if not req.wait(timeout=self.call_timeout_s):
             # disarm the result sync so a late completion can't mutate the
@@ -1040,13 +1093,33 @@ class ACCL:
         return doc
 
     def dump_communicator(self, comm_id: int = GLOBAL_COMM) -> str:
-        return self._communicators[comm_id].dump()
+        return self.communicator(comm_id).dump()
 
     def dump_rx_buffers(self) -> str:
         dump = getattr(self._device, "dump_rx_buffers", None)
         return dump() if dump else "<backend has no rx buffer table>"
 
     def deinit(self) -> None:
+        """Tear down the backend.  Async requests still in flight are
+        named (flight-recorder seq/state included) through the
+        structured logger first — silently dropping them hid both lost
+        completions and the leaked-request bug class accl_lint flags."""
+        pending = [r for ref in self._async_pending
+                   if (r := ref()) is not None and not r.done]
+        if pending:
+            rank = (self._communicators[GLOBAL_COMM].local_rank
+                    if self._communicators else None)
+            log = get_logger("accl_tpu.driver", rank=rank)
+            log.warning(
+                "deinit with %d async request(s) still pending — their "
+                "completions (and any engine errors) are dropped:",
+                len(pending))
+            for r in pending:
+                info = r.flight_info() or (
+                    f" (id {r.id}, status={r.status.name})")
+                log.warning("  pending: %s%s", r.description or "call",
+                            info)
+        self._async_pending.clear()
         self._device.close()
 
     def __enter__(self) -> "ACCL":

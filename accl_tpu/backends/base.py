@@ -75,5 +75,16 @@ class CCLODevice(ABC):
         """Pull one message from a compute output stream."""
         raise NotImplementedError(f"{type(self).__name__} has no kernel streams")
 
+    def sanitizer_domain(self):
+        """Identity of the in-process world this device's ranks share,
+        or None.  The collective sanitizer (``ACCL_SANITIZE=1``,
+        accl_tpu/analysis/sanitizer.py) keys its cross-rank call-
+        fingerprint exchange on this: every rank of one gang must
+        return the same hashable value *within one process* for the
+        pre-dispatch mismatch check to pair them.  Backends whose ranks
+        live in different processes must return None — the sanitizer
+        then applies single-rank checks only."""
+        return None
+
     def close(self) -> None:
         """Tear down the backend (join threads, close sockets)."""

@@ -155,6 +155,19 @@ class EmuDevice(CCLODevice):
         self._rank = rank
         self._lib = lib
         self._timeout_ms = int(call_timeout_s * 1000)
+        #: True while every rank of this world lives in this process
+        #: (EmuWorld); EmuRankTcp clears it — its peers are other
+        #: processes (or sibling worlds) the in-process sanitizer
+        #: exchange can never pair with
+        self.shares_process_world = True
+
+    def sanitizer_domain(self):
+        """The native world handle identifies the in-process gang for
+        the sanitizer's cross-rank fingerprint exchange (one EmuWorld ==
+        one engine world == one domain)."""
+        if self.shares_process_world and self._w:
+            return ("emu", int(self._w))
+        return None
 
     # -- call path ----------------------------------------------------
     def start(self, call: CCLOCall, request: Request) -> None:
@@ -359,6 +372,10 @@ class EmuRankTcp:
         call_timeout_s = max(call_timeout_s, default_timeout() / 1e6 + 5.0)
         self.device = EmuDevice(self._handle, rank, self._lib,
                                 call_timeout_s=call_timeout_s)
+        # one world handle per rank here (peers are separate processes
+        # or sibling worlds): the in-process sanitizer exchange cannot
+        # pair them — fall back to single-rank checks
+        self.device.shares_process_world = False
         self.accl = ACCL(self.device)
         self.accl.call_timeout_s = call_timeout_s
         ranks = [Rank(ip="127.0.0.1", port=base_port + r, session=r,

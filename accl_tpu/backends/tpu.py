@@ -1394,6 +1394,13 @@ class TpuDeviceView(CCLODevice):
     def start(self, call: CCLOCall, request: Request) -> None:
         self._engine.submit(self._rank, call, request)
 
+    def sanitizer_domain(self):
+        """All ranks of a TpuWorld share one in-process TpuEngine, so
+        the engine's identity is the sanitizer exchange domain: a
+        mismatched gang raises at submit instead of assembling two
+        forever-partial gangs in the scheduler."""
+        return ("tpu", id(self._engine))
+
     @property
     def engine_metrics(self) -> "object":
         """The shared engine's registry (ACCL.metrics() merges its

@@ -16,6 +16,8 @@ TPU-native mapping:
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from .arithconfig import NUMPY_TO_DATATYPE
@@ -86,6 +88,20 @@ class BaseBuffer:
         """A sub-span sharing host storage, with device address advanced by
         the byte offset (reference: buffer.hpp slice())."""
         raise NotImplementedError
+
+    def free(self) -> None:
+        """Release the device residence (reference: Buffer::free_buffer,
+        buffer.hpp).  Backends with an allocator override this
+        (EmuBuffer, LintBuffer); for the rest it is a no-op so
+        lifecycle-conscious user code — the kind the collective
+        sanitizer's use-after-free checker audits — stays portable."""
+
+    def byte_range(self, count: Optional[int] = None) -> tuple:
+        """``(address, nbytes)`` of the first `count` elements (whole
+        buffer by default) — the operand extent the sanitizer's overlap
+        checks reason about."""
+        n = self.length if count is None else count
+        return (self._address, n * int(self._host.itemsize))
 
     # -- convenience --------------------------------------------------
     def __len__(self) -> int:

@@ -38,6 +38,17 @@ class Operation(enum.IntEnum):
     nop = 255
 
 
+#: scenarios that form cross-rank gangs in the engines (one instance ==
+#: one gang id in the trace); p2p and local ops are single-rank.  Shared
+#: by the driver's observability gate (accl.py), the flight-recorder
+#: analyzer and the collective sanitizer (accl_tpu/analysis).
+GANG_OPERATIONS = frozenset((
+    Operation.bcast, Operation.scatter, Operation.gather,
+    Operation.allgather, Operation.reduce, Operation.allreduce,
+    Operation.reduce_scatter, Operation.alltoall, Operation.barrier,
+))
+
+
 class CfgFunc(enum.IntEnum):
     """Sub-functions of Operation.config
     (reference: constants.hpp:179-185)."""
@@ -159,6 +170,12 @@ ERROR_CODE_BITS = 27
 #: NOT_READY_ERROR retry path (reference: ccl_offload_control.c:2460-2479).
 NOT_READY_ERROR = 1 << 31
 
+#: Driver-internal retcode stamped on a flight record when the collective
+#: sanitizer (analysis/sanitizer.py, ACCL_SANITIZE=1) aborts the call
+#: BEFORE dispatch: the record must leave the watchdog's in-flight scan
+#: (the call will never complete) without claiming engine success.
+SANITIZER_ABORT_ERROR = 1 << 30
+
 
 class OperationStatus(enum.IntEnum):
     """Lifecycle of an async request (reference: constants.hpp:226-230)."""
@@ -270,6 +287,8 @@ def error_code_to_str(code: int) -> str:
     names = [e.name for e in ErrorCode if e.value and code & e.value]
     if code & NOT_READY_ERROR:
         names.append("NOT_READY_ERROR")
+    if code & SANITIZER_ABORT_ERROR:
+        names.append("SANITIZER_ABORT_ERROR")
     return " | ".join(names) if names else f"UNKNOWN_ERROR({code:#x})"
 
 

@@ -291,6 +291,30 @@ def _install_signal_handler() -> None:
 # ---------------------------------------------------------------------------
 # cross-rank merge + desync analysis (the accl_doctor engine)
 # ---------------------------------------------------------------------------
+def first_divergence(seqs: dict, sig_fn) -> Optional[dict]:
+    """First position where per-rank ordered sequences disagree.
+
+    ``seqs`` maps rank -> ordered list; ``sig_fn(item)`` projects each
+    item to a comparable signature.  A position diverges when two ranks
+    hold DIFFERENT non-None signatures there (a rank that simply ran
+    out contributes None — uneven depth alone is a hang/straggler
+    question, not an order question).  Returns ``{"index", "per_rank"}``
+    (rank -> signature or None) or None.  Shared by the post-mortem
+    analyzer (:func:`merge_flight_dumps`) and the pre-dispatch static
+    checkers (accl_tpu/analysis/checks.py) so both report the same
+    first-divergent-seq semantics.
+    """
+    members = sorted(seqs)
+    depth = max((len(v) for v in seqs.values()), default=0)
+    for i in range(depth):
+        sigs = {r: (sig_fn(seqs[r][i]) if i < len(seqs[r]) else None)
+                for r in members}
+        distinct = {s for s in sigs.values() if s is not None}
+        if len(distinct) > 1:
+            return {"index": i, "per_rank": sigs}
+    return None
+
+
 def _load(dump) -> dict:
     if isinstance(dump, str):
         with open(dump) as f:
@@ -352,23 +376,19 @@ def merge_flight_dumps(dumps: Iterable, out_path: Optional[str] = None,
         if any(wrapped[r] for r in members):
             truncated_comms.append(comm)
             continue
-        depth = max(len(v) for v in seqs.values())
-        for i in range(depth):
-            sigs = {r: (sig(seqs[r][i]) if i < len(seqs[r]) else None)
-                    for r in members}
-            distinct = {s for s in sigs.values() if s is not None}
-            if len(distinct) > 1:
-                desyncs.append({
-                    "comm": comm,
-                    "index": i,
-                    "per_rank": {
-                        str(r): (None if sigs[r] is None else {
-                            "collective": sigs[r][0], "tag": sigs[r][1],
-                            "count": sigs[r][2], "dtype": sigs[r][3],
-                            "seq": seqs[r][i]["seq"]})
-                        for r in members},
-                })
-                break  # first divergence per comm; later ones cascade
+        div = first_divergence(seqs, sig)
+        if div is not None:  # first divergence per comm; later ones cascade
+            i, sigs = div["index"], div["per_rank"]
+            desyncs.append({
+                "comm": comm,
+                "index": i,
+                "per_rank": {
+                    str(r): (None if sigs[r] is None else {
+                        "collective": sigs[r][0], "tag": sigs[r][1],
+                        "count": sigs[r][2], "dtype": sigs[r][3],
+                        "seq": seqs[r][i]["seq"]})
+                    for r in members},
+            })
 
     # -- hung gang instances -------------------------------------------
     hangs: list = []

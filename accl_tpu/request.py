@@ -88,6 +88,11 @@ class Request:
         #: initialize.  Set by ACCL._observe_call; state transitions are
         #: stamped in place by the queue and the backends.
         self.flight: Optional[_flight.FlightRecord] = None
+        #: True once a wait() observed completion — the signal the
+        #: collective sanitizer's leaked-request checker and
+        #: ACCL.deinit() use to tell a drained async call from one
+        #: whose completion (and retcode) nobody ever looked at
+        self.waited = False
 
     def complete(self, retcode: int, duration_ns: float = 0.0) -> None:
         self.retcode = retcode
@@ -140,7 +145,10 @@ class Request:
         thunk, self.pre_wait = self.pre_wait, None
         if thunk is not None:
             thunk()
-        return self._done.wait(timeout)
+        ok = self._done.wait(timeout)
+        if ok:
+            self.waited = True
+        return ok
 
     def flight_info(self) -> str:
         """The flight-recorder view of this call, for error embedding
@@ -157,6 +165,11 @@ class Request:
         (seq, state, lane, age) embedded so a timeout is diagnosable
         from the exception alone
         (reference: accl.cpp:1226-1250 check_return_value)."""
+        if self.done:
+            # checking a completed request IS observing its outcome:
+            # poll-then-check drains a call as thoroughly as wait(), so
+            # the sanitizer's leaked-request checker must not flag it
+            self.waited = True
         if not self.done:
             raise ACCLError(
                 f"{self.description or 'call'} timed out: request id "
