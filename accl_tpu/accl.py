@@ -35,6 +35,7 @@ from .constants import (
     DEFAULT_EAGER_RX_BUF_SIZE,
     DEFAULT_MAX_EAGER_SIZE,
     DEFAULT_MAX_RENDEZVOUS_SIZE,
+    ErrorCode,
     GANG_OPERATIONS,
     HostFlags,
     Operation,
@@ -116,6 +117,11 @@ class ACCL:
         #: can name anything the caller never waited
         self._sanitize_seq: dict = {}
         self._async_pending: list = []
+        #: communicator ids this driver has aborted (resilience layer):
+        #: new calls on them fail fast at submit instead of reaching a
+        #: fenced engine; cleared by reset_errors().  The off-path cost
+        #: is one falsy check per call.
+        self._aborted_comms: set = set()
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -314,6 +320,72 @@ class ACCL:
                 f"get_duration: {req.description or 'request'} (id "
                 f"{req.id}) has not completed — wait() on it first")
         return req.duration_ns
+
+    # ------------------------------------------------------------------
+    # fault tolerance (accl_tpu/resilience; docs/fault_tolerance.md) —
+    # the detect -> recover bridge over the failure-detection machinery
+    # (seqn discipline + receive timeouts + flight recorder + watchdog)
+    # ------------------------------------------------------------------
+    def abort(self, comm_id: int = GLOBAL_COMM, error: int = 0) -> None:
+        """Abort a communicator (ULFM revoke analog): bump its epoch,
+        propagate the abort through the control plane, and fail every
+        pending request on all live ranks fast with ``COMM_ABORTED``
+        (OR ``error`` in — the watchdog's abort action passes
+        ``RANK_FAILED``).  Blocked :meth:`Request.wait` callers wake as
+        their engine finalizes them — immediately, not after the
+        ``ACCL_DEFAULT_TIMEOUT`` budget.  Stale traffic from the dead
+        epoch is fenced at the pool boundary; recover with
+        :meth:`shrink_communicator` (dead peer) or
+        :meth:`reset_errors` (transient fault)."""
+        self.communicator(comm_id)  # raises the naming error on bad ids
+        err = int(error) | int(ErrorCode.COMM_ABORTED)
+        self._aborted_comms.add(comm_id)
+        handled = self._device.abort_comm(comm_id, err)
+        if not handled:
+            # backend has no engine-side abort: fail the driver-tracked
+            # pending async requests directly so waiters still wake.
+            # Only THIS comm's requests — a healthy sibling comm's
+            # in-flight calls must not report COMM_ABORTED (the flight
+            # record carries the comm; without one — ACCL_FLIGHT=0 —
+            # the comm is unknowable and the conservative choice is to
+            # fail the request rather than strand its waiter forever)
+            for ref in self._async_pending:
+                r = ref()
+                if r is not None and not r.done and \
+                        (r.flight is None or r.flight.comm == comm_id):
+                    r.complete(err)
+
+    def shrink_communicator(self, comm_id: int = GLOBAL_COMM,
+                            window_s: float = 1.0) -> int:
+        """ULFM-style shrink: agree on the surviving rank set of
+        ``comm_id`` (liveness from control-plane heartbeats + the
+        probe window) and build a fresh communicator excluding dead
+        ranks; returns the new comm id.  Collective over the survivors
+        — every live rank must call it in the same order, exactly like
+        :meth:`create_communicator`.  The usual recovery sequence after
+        a rank death is ``abort() -> shrink_communicator() -> re-run
+        the collective on the returned comm``."""
+        from .resilience.membership import shrink as _shrink
+
+        return _shrink(self, comm_id, window_s)
+
+    def reset_errors(self) -> None:
+        """Recover a world poisoned by a CLASSIFIED transient fault
+        (seqn skew after a drop/corruption that exhausted recovery):
+        resynchronize sequence state, drain transient receive and
+        retransmit state, clear driver-side abort fencing.  Collective:
+        every rank of a quiesced world must call it — after which the
+        next collective on the same world must succeed (the
+        fixture-reuse contract in tests/test_fault_injection.py)."""
+        self._aborted_comms.clear()
+        self._device.reset_errors()
+
+    def resilience_stats(self) -> dict:
+        """Engine-side recovery counters (retransmitted segments, NACKs
+        sent/received, epoch-fenced drops), or an empty dict for
+        backends without the retransmission lane."""
+        fn = getattr(self._device, "resilience_stats", None)
+        return fn() if fn is not None else {}
 
     # ------------------------------------------------------------------
     # session lifecycle (reference: open_port/open_con/close_con,
@@ -942,6 +1014,15 @@ class ACCL:
         """Submit one call: sync inputs, start async, and either return the
         request handle or wait + sync outputs + check retcode
         (reference: call_async/call_sync accl.cpp:1395-1413)."""
+        # abort fast-fail (resilience): a call on an aborted comm must
+        # not burn a receive budget against a fenced engine — one falsy
+        # set check when no abort ever happened (the off-path case)
+        if self._aborted_comms and call.comm in self._aborted_comms:
+            raise ACCLError(
+                f"{desc}: communicator {call.comm} is aborted "
+                f"(COMM_ABORTED) — shrink_communicator() or "
+                f"reset_errors() to recover",
+                int(ErrorCode.COMM_ABORTED))
         # observability gate first: one module-bool read each when all
         # are off, and t_submit marks user-call entry (operand staging
         # below is inside the submit→queue window by design).  The

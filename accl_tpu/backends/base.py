@@ -75,6 +75,32 @@ class CCLODevice(ABC):
         """Pull one message from a compute output stream."""
         raise NotImplementedError(f"{type(self).__name__} has no kernel streams")
 
+    # -- resilience (accl_tpu/resilience; docs/fault_tolerance.md) ----
+    def set_resilience(self, retry_max: int, retry_base_us: int) -> None:
+        """Configure the eager NACK-retransmission lane (0 retries =
+        off).  Backends without a wire protocol (record-mode lint
+        devices, the in-process TPU engine) have nothing to retransmit
+        and accept the call as a no-op."""
+
+    def abort_comm(self, comm_id: int, err_bits: int) -> bool:
+        """Epoch-tagged communicator abort: finalize every pending call
+        on `comm_id` fast with `err_bits` and propagate to peers where
+        a control plane exists.  Returns True when the backend handled
+        pending-call finalization itself; False lets the driver fall
+        back to failing its own tracked requests."""
+        return False
+
+    def reset_errors(self) -> None:
+        """Seqn resync + transient-state drain after a classified
+        fault (collective: every rank of a quiesced world calls it)."""
+
+    def probe_liveness(self, comm_id: int, size: int,
+                       window_s: float = 1.0) -> Optional[list]:
+        """Per-comm-local-rank liveness via the backend's heartbeat
+        plane, or None when the backend has no liveness signal (the
+        shrink machinery then treats every rank as alive)."""
+        return None
+
     def sanitizer_domain(self):
         """Identity of the in-process world this device's ranks share,
         or None.  The collective sanitizer (``ACCL_SANITIZE=1``,

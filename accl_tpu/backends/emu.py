@@ -137,6 +137,23 @@ def _load_lib() -> ctypes.CDLL:
                                     ctypes.POINTER(u64), i32]
     lib.accl_dump_rx.argtypes = [p, i32, ctypes.c_char_p, i32]
     lib.accl_inject_fault.argtypes = [p, i32, u32]
+    # resilience control plane (retransmission / abort / shrink / chaos)
+    lib.accl_set_resilience.restype = i32
+    lib.accl_set_resilience.argtypes = [p, i32, u32, u32]
+    lib.accl_abort.restype = i32
+    lib.accl_abort.argtypes = [p, i32, i32, u32]
+    lib.accl_reset_errors.restype = i32
+    lib.accl_reset_errors.argtypes = [p, i32]
+    lib.accl_set_chaos.restype = i32
+    lib.accl_set_chaos.argtypes = [p, i32, u64, u32, u32, u32, u32, u32, u32]
+    lib.accl_chaos_kill.restype = i32
+    lib.accl_chaos_kill.argtypes = [p, i32]
+    lib.accl_probe_liveness.restype = i32
+    lib.accl_probe_liveness.argtypes = [p, i32, i32, u32, ctypes.POINTER(u64)]
+    lib.accl_resilience_stats.argtypes = [p, i32, ctypes.POINTER(u64),
+                                          ctypes.POINTER(u64),
+                                          ctypes.POINTER(u64),
+                                          ctypes.POINTER(u64)]
     _lib = lib
     return lib
 
@@ -155,6 +172,12 @@ class EmuDevice(CCLODevice):
         self._rank = rank
         self._lib = lib
         self._timeout_ms = int(call_timeout_s * 1000)
+        # arm the NACK retransmission lane from the env policy
+        # (ACCL_RETRY_MAX / ACCL_RETRY_BASE_US; worlds may override)
+        from ..resilience.retry import RetryPolicy
+
+        pol = RetryPolicy.from_env()
+        self.set_resilience(pol.max_retries, pol.base_us)
         #: True while every rank of this world lives in this process
         #: (EmuWorld); EmuRankTcp clears it — its peers are other
         #: processes (or sibling worlds) the in-process sanitizer
@@ -331,16 +354,74 @@ class EmuDevice(CCLODevice):
     FAULT_DROP = 1
     FAULT_DUPLICATE = 2
     FAULT_CORRUPT_SEQ = 3
+    FAULT_DELAY = 4
 
     def inject_fault(self, kind: int) -> None:
-        """Arm a one-shot egress fault on this rank's engine — the
-        fault-injection hook of the failure-detection subsystem
-        (SURVEY §5; the reference's closest analog is its segmentation
-        edge tests)."""
+        """Arm a one-shot egress fault on this rank's engine — sugar
+        over the seeded chaos funnel (forces its next draw): drop /
+        duplicate / corrupt-seqn / delay, resolved in the same engine
+        switch the probabilistic plan uses (SURVEY §5)."""
         rc = self._lib.accl_inject_fault(self._w, self._rank, kind)
         if rc != 0:
             raise ACCLError(f"inject_fault({kind}) failed for rank "
                             f"{self._rank}")
+
+    # -- resilience (accl_tpu/resilience; docs/fault_tolerance.md) ----
+    def set_resilience(self, retry_max: int, retry_base_us: int) -> None:
+        """Configure the NACK retransmission lane (0 retries = off)."""
+        self._lib.accl_set_resilience(self._w, self._rank,
+                                      max(0, int(retry_max)),
+                                      max(1, int(retry_base_us)))
+
+    def abort_comm(self, comm_id: int, err_bits: int) -> bool:
+        """Epoch-tagged abort of a communicator, propagated to every
+        peer through the control plane; returns True (engine handled
+        the fan-out and pending-call finalization)."""
+        rc = self._lib.accl_abort(self._w, self._rank, comm_id,
+                                  err_bits & 0xFFFFFFFF)
+        if rc != 0:
+            raise ACCLError(f"abort(comm {comm_id}) failed for rank "
+                            f"{self._rank}")
+        return True
+
+    def reset_errors(self) -> None:
+        """Seqn resync + transient-state drain after a classified fault
+        (collective: every rank of a quiesced world calls it)."""
+        self._lib.accl_reset_errors(self._w, self._rank)
+
+    def set_chaos(self, seed: int, drop_ppm: int, dup_ppm: int,
+                  delay_ppm: int, delay_us: int, corrupt_ppm: int,
+                  slow_us: int) -> None:
+        """Arm the seeded probabilistic chaos plan on this rank."""
+        self._lib.accl_set_chaos(self._w, self._rank, seed, drop_ppm,
+                                 dup_ppm, delay_ppm, delay_us, corrupt_ppm,
+                                 slow_us)
+
+    def kill(self) -> None:
+        """Kill-rank chaos: this engine goes silent (egress dropped,
+        ingress deaf) and aborts its local comms with RANK_FAILED."""
+        self._lib.accl_chaos_kill(self._w, self._rank)
+
+    def probe_liveness(self, comm_id: int, size: int,
+                       window_s: float = 1.0) -> list:
+        """Heartbeat-probe every peer of a communicator; returns a
+        per-comm-local-rank alive list (local rank always True)."""
+        bm = ctypes.c_uint64(0)
+        rc = self._lib.accl_probe_liveness(
+            self._w, self._rank, comm_id, int(window_s * 1e6),
+            ctypes.byref(bm))
+        if rc != 0:
+            raise ACCLError(f"probe_liveness(comm {comm_id}) failed")
+        return [bool(bm.value >> i & 1) for i in range(size)]
+
+    def resilience_stats(self) -> dict:
+        """Engine-side recovery counters: retransmitted segments, NACKs
+        sent/received, epoch-fenced ingress drops."""
+        vals = [ctypes.c_uint64(0) for _ in range(4)]
+        self._lib.accl_resilience_stats(self._w, self._rank,
+                                        *[ctypes.byref(v) for v in vals])
+        keys = ("retrans_sent", "nacks_tx", "nacks_rx", "fenced_drops")
+        return dict(zip(keys, (int(v.value) for v in vals)))
 
     def close(self) -> None:
         pass  # world teardown owns the native handle
@@ -424,7 +505,10 @@ class EmuWorld:
                  max_eager_size: Optional[int] = None,
                  max_rendezvous_size: Optional[int] = None,
                  initialize: bool = True, transport: str = "inproc",
-                 mtu: int = 256, reorder_window: int = 8):
+                 mtu: int = 256, reorder_window: int = 8,
+                 retry_max: Optional[int] = None,
+                 retry_base_us: Optional[int] = None,
+                 chaos=None):
         self._lib = _load_lib()
         self.nranks = nranks
         if transport == "dgram":
@@ -439,6 +523,28 @@ class EmuWorld:
             raise ACCLError(f"unknown transport {transport!r}")
         self.devices = [EmuDevice(self._handle, r, self._lib)
                         for r in range(nranks)]
+        # retransmission policy: explicit args > ACCL_RETRY_* env >
+        # defaults (the env policy was applied at device construction)
+        if retry_max is not None or retry_base_us is not None:
+            from ..resilience.retry import RetryPolicy
+
+            env = RetryPolicy.from_env()
+            rm = env.max_retries if retry_max is None else retry_max
+            rb = env.base_us if retry_base_us is None else retry_base_us
+            for d in self.devices:
+                d.set_resilience(rm, rb)
+        # seeded chaos plan: a ChaosPlan, a grammar string, or (by
+        # default) whatever ACCL_CHAOS carries
+        from ..resilience.chaos import ChaosPlan
+
+        if isinstance(chaos, str):
+            chaos = ChaosPlan.parse(chaos)
+        if chaos is None:
+            chaos = ChaosPlan.from_env()
+        self.chaos_plan = chaos
+        if chaos is not None:
+            for r, d in enumerate(self.devices):
+                chaos.apply(d, r)
         self.accls = [ACCL(d) for d in self.devices]
         self._pool = ThreadPoolExecutor(max_workers=nranks)
         if initialize:
@@ -460,19 +566,63 @@ class EmuWorld:
         # flight-ring based (which ranks have an in-flight gang call,
         # which never issued one).  Inert when ACCL_WATCHDOG_TIMEOUT=0,
         # ACCL_FLIGHT=0, or initialize was deferred (no recorders yet).
+        # With ACCL_WATCHDOG_ACTION=abort a fire additionally aborts the
+        # hung communicator (initiated from an arrived survivor) instead
+        # of only dumping — the detect -> recover bridge.
         self.watchdog = _health.Watchdog(
             [a.flight_recorder for a in self.accls
-             if a.flight_recorder is not None], name="accl-emu").start()
+             if a.flight_recorder is not None], name="accl-emu",
+            abort_hook=self._watchdog_abort).start()
 
     def start_watchdog(self, **kwargs) -> "_health.Watchdog":
         """Re-arm the watchdog with explicit settings (tests shrink
         timeout_s; a deferred-initialize world arms it after bring-up)."""
         self.watchdog.stop()
+        kwargs.setdefault("abort_hook", self._watchdog_abort)
         self.watchdog = _health.Watchdog(
             [a.flight_recorder for a in self.accls
              if a.flight_recorder is not None],
             name="accl-emu", **kwargs).start()
         return self.watchdog
+
+    def _watchdog_abort(self, comm_id: int, report: dict) -> None:
+        """ACCL_WATCHDOG_ACTION=abort hook: abort the hung communicator
+        with RANK_FAILED, initiated from a rank that actually ARRIVED
+        at the stuck gang (the missing rank may be dead and unable to
+        propagate anything)."""
+        from ..constants import ErrorCode
+
+        hangs = report.get("analysis", {}).get("hangs", [])
+        # the hook fires once per hung comm: pick THIS comm's arrived
+        # set (hangs[0] may describe a different comm whose arrived
+        # ranks include the very rank that is dead here)
+        arrived = next((h["arrived"] for h in hangs
+                        if h.get("comm") == comm_id), [])
+        for r in list(arrived) or list(range(self.nranks)):
+            try:
+                self.accls[r].abort(comm_id,
+                                    error=int(ErrorCode.RANK_FAILED))
+                return
+            except Exception:  # noqa: BLE001 — try the next survivor
+                continue
+
+    def kill_rank(self, rank: int) -> None:
+        """Kill-rank chaos: rank's engine goes silent mid-run (egress
+        dropped, ingress deaf, local comms aborted with RANK_FAILED)."""
+        self.devices[rank].kill()
+
+    def reset_errors(self) -> None:
+        """Collective seqn resync after a classified fault: every
+        rank's driver + engine state is cleared so the world is
+        reusable (the fixture-reuse contract of
+        tests/test_fault_injection.py)."""
+        for a in self.accls:
+            a.reset_errors()
+
+    def resilience_stats(self) -> list:
+        """Per-rank engine recovery counters (retransmits, NACKs,
+        fenced drops) — the observability of the retransmission lane."""
+        return [d.resilience_stats() for d in self.devices]
 
     def run(self, fn: Callable, *args) -> list:
         """Run `fn(accl, rank, *args)` on every rank concurrently and

@@ -236,6 +236,9 @@ class TpuEngine:
         self._arithcfg_ids: dict = {}
         # gang assembly: key -> deque of partial gangs
         self._gangs: dict = {}
+        # aborted communicators (resilience): comm id -> error bits;
+        # submits on them complete immediately, partial gangs drain fast
+        self._aborted_comms: dict = {}
         # complete gangs awaiting execution, drained by ONE dedicated
         # executor thread (see _exec_loop): if the completing submitter
         # executed inline (r4 design), that rank thread could not
@@ -365,6 +368,13 @@ class TpuEngine:
         if scenario in (Operation.config, Operation.nop):
             request.complete(0, 0.0)
             return
+        # abort fence (resilience): calls on an aborted comm finalize
+        # fast instead of assembling a gang that can never complete
+        if self._aborted_comms:
+            err = self._aborted_comms.get(call.comm)
+            if err is not None:
+                request.complete(err, 0.0)
+                return
         span = request.trace
         rec = request.flight
         try:
@@ -660,6 +670,34 @@ class TpuEngine:
         with self._ready_cv:
             self._ready.append((scenario, comm_id, gang))
             self._ready_cv.notify()
+
+    def abort_comm(self, comm_id: int, err_bits: int) -> bool:
+        """Epoch-analog abort for the in-process TPU engine: mark the
+        comm aborted (future submits finalize immediately) and drain
+        every PARTIAL gang and pending p2p recv on it, completing their
+        requests with `err_bits` — blocked waiters on every rank wake
+        at once.  Complete gangs already queued for dispatch run to
+        completion (they have all members; executing them is safe)."""
+        drained = []
+        with self._lock:
+            self._aborted_comms[comm_id] = err_bits
+            for key in list(self._gangs):
+                if key[0] == "coll" and key[2] == comm_id:
+                    for gang in self._gangs.pop(key):
+                        drained.extend(req for _c, req, _k in gang.values())
+                elif key[0] == "p2p" and key[1] == comm_id:
+                    for entry in self._gangs.pop(key):
+                        if entry[0] == "recv":
+                            drained.append(entry[2][2])
+        for req in drained:
+            if not req.done:
+                req.complete(err_bits, 0.0)
+        return True
+
+    def reset_comm_errors(self) -> None:
+        """Clear abort fencing (driver reset_errors path)."""
+        with self._lock:
+            self._aborted_comms.clear()
 
     def shutdown(self) -> None:
         if self._watchdog is not None:
@@ -1452,6 +1490,14 @@ class TpuDeviceView(CCLODevice):
     def pop_stream(self, strm: int, nbytes: int, timeout_s: float = 10.0):
         arr = self._engine.pop_stream(self._rank, strm, timeout_s)
         return None if arr is None else arr.tobytes()[:nbytes]
+
+    # -- resilience: every rank shares one in-process engine, so a
+    # single abort covers the whole world (no wire propagation needed)
+    def abort_comm(self, comm_id: int, err_bits: int) -> bool:
+        return self._engine.abort_comm(comm_id, err_bits)
+
+    def reset_errors(self) -> None:
+        self._engine.reset_comm_errors()
 
     def close(self) -> None:
         pass

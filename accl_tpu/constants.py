@@ -160,10 +160,20 @@ class ErrorCode(enum.IntFlag):
     KRNL_STS_COUNT_ERROR = 1 << 24
     SEGMENTER_EXPECTED_BTT_ERROR = 1 << 25
     DMA_TAG_MISMATCH_ERROR = 1 << 26
+    # fault-tolerance extension (no reference analog; mirrored in
+    # native/src/common.hpp): the communicator this call ran on was
+    # aborted — every pending call on all live ranks finalizes fast
+    # with this bit, epoch-fenced against stragglers
+    COMM_ABORTED = 1 << 27
+    # the abort was triggered by a peer declared dead (watchdog
+    # ACCL_WATCHDOG_ACTION=abort or a liveness probe), not by an
+    # application-initiated ACCL.abort()
+    RANK_FAILED = 1 << 28
 
 
-#: Bits occupied by engine error codes (bit 0 .. bit 26 inclusive).
-ERROR_CODE_BITS = 27
+#: Bits occupied by engine error codes (bit 0 .. bit 28 inclusive;
+#: 27/28 are the fault-tolerance extension).
+ERROR_CODE_BITS = 29
 
 #: Internal (non-user-visible) signal used by the engine to re-queue a call
 #: whose rendezvous peer has not arrived yet; mirrors the firmware's

@@ -43,8 +43,20 @@ S_GANG_READY = 2
 S_DISPATCHED = 3
 S_COMPLETE = 4
 S_FAILED = 5
+S_ABORTED = 6  # finalized by a communicator abort (COMM_ABORTED), not
+#              # an engine fault — a terminal state, never "in flight"
 STATE_NAMES = ("submitted", "queued", "gang_ready", "dispatched",
-               "complete", "failed")
+               "complete", "failed", "aborted")
+
+#: states that mean "this record is retired" — the hang analyzer and
+#: the watchdog must treat all three alike (an abort in flight is a
+#: recovery action, not a phantom hang)
+TERMINAL_STATE_NAMES = ("complete", "failed", "aborted")
+
+#: retcode bit marking an abort-finalized call (constants.ErrorCode.
+#: COMM_ABORTED; kept as a literal here so the always-on record path
+#: adds no import edge)
+_COMM_ABORTED_BIT = 1 << 27
 
 #: record fields every dump carries — the schema the CI hang smoke and
 #: accl_doctor validate against
@@ -112,7 +124,12 @@ class FlightRecord:
     def finish(self, retcode: int, t: int) -> None:
         self.retcode = retcode
         self.t_complete = t
-        self.state = S_COMPLETE if retcode == 0 else S_FAILED
+        if retcode == 0:
+            self.state = S_COMPLETE
+        elif retcode & _COMM_ABORTED_BIT:
+            self.state = S_ABORTED
+        else:
+            self.state = S_FAILED
         self._recorder._note_finished(self)
 
     def summary(self, now: Optional[int] = None) -> str:
@@ -158,6 +175,9 @@ class FlightRecorder:
         #: monotonic ns of the most recent non-zero retcode (the
         #: watchdog's "degraded" signal)
         self.last_error_ns = 0
+        #: monotonic ns of the most recent COMM_ABORTED finalization
+        #: (the watchdog's "aborted" health signal)
+        self.last_abort_ns = 0
 
     # -- record path (always-on; keep it allocation + append only) -----
     def new_record(self, req_id: int, collective: str, comm: int,
@@ -174,6 +194,8 @@ class FlightRecorder:
             self.last_completed_seq = rec.seq
         if rec.retcode != 0:
             self.last_error_ns = rec.t_complete
+        if rec.state == S_ABORTED:
+            self.last_abort_ns = rec.t_complete
 
     # -- queries --------------------------------------------------------
     def records(self) -> list:
@@ -395,8 +417,7 @@ def merge_flight_dumps(dumps: Iterable, out_path: Optional[str] = None,
     stuck: dict = {}
     for r in ranks:
         for rec in per_rank[r]["records"]:
-            if rec.get("gang") and rec["state"] not in ("complete",
-                                                        "failed"):
+            if rec.get("gang") and rec["state"] not in TERMINAL_STATE_NAMES:
                 key = (rec["collective"], rec["comm"], rec["tag"],
                        rec["count"], rec["dtype"])
                 stuck.setdefault(key, {})[r] = rec
@@ -416,7 +437,7 @@ def merge_flight_dumps(dumps: Iterable, out_path: Optional[str] = None,
         for r in missing:
             head = next((rec for rec in sorted(per_rank[r]["records"],
                                                key=lambda x: x["seq"])
-                         if rec["state"] not in ("complete", "failed")),
+                         if rec["state"] not in TERMINAL_STATE_NAMES),
                         None)
             blocked_on[str(r)] = head  # None == rank is idle / absent
         hangs.append({

@@ -23,7 +23,16 @@ recorder (flight.py) and the metrics registry (metrics.py):
 
 Health states (the ``accl_health`` gauge):
 ``0`` ok · ``1`` degraded (a collective returned a non-zero retcode in
-the last minute) · ``2`` hung (watchdog found a stuck gang).
+the last minute) · ``2`` hung (watchdog found a stuck gang) · ``3``
+aborted (a communicator abort finalized calls in the last minute — a
+recovery action in progress, NOT a phantom hang: abort-finalized
+flight records are terminal and never re-trigger the stuck-gang scan).
+
+``ACCL_WATCHDOG_ACTION`` selects what a watchdog fire DOES: ``dump``
+(default — diagnose only, the pre-r10 behavior) or ``abort`` — the
+watchdog additionally aborts the hung communicator through the
+backend's abort hook, turning a detected hang into fast COMM_ABORTED/
+RANK_FAILED failures every waiter can recover from (shrink + re-run).
 """
 from __future__ import annotations
 
@@ -40,7 +49,8 @@ from .trace import now_ns
 HEALTH_OK = 0
 HEALTH_DEGRADED = 1
 HEALTH_HUNG = 2
-HEALTH_NAMES = ("ok", "degraded", "hung")
+HEALTH_ABORTED = 3
+HEALTH_NAMES = ("ok", "degraded", "hung", "aborted")
 
 #: window after a non-zero retcode during which health reads degraded
 DEGRADED_WINDOW_NS = 60 * 10 ** 9
@@ -78,11 +88,18 @@ class Watchdog:
                  introspect: Optional[Callable[[], list]] = None,
                  registry: Optional[MetricsRegistry] = None,
                  on_fire: Optional[Callable[[dict], None]] = None,
-                 dump_path: Optional[str] = None, name: str = "accl"):
+                 dump_path: Optional[str] = None, name: str = "accl",
+                 abort_hook: Optional[Callable[[int, dict], None]] = None,
+                 action: Optional[str] = None):
         self._recorders = list(recorders)
         self.timeout_s = (watchdog_timeout_s() if timeout_s is None
                           else timeout_s)
         self._introspect = introspect
+        #: fire action: "dump" (diagnose only) or "abort" (additionally
+        #: abort each hung comm via abort_hook(comm_id, report))
+        self.action = (action if action is not None else
+                       os.environ.get("ACCL_WATCHDOG_ACTION", "dump"))
+        self._abort_hook = abort_hook
         self._registry = registry if registry is not None \
             else default_registry()
         self._on_fire = on_fire
@@ -158,10 +175,14 @@ class Watchdog:
                 return self._fire(stuck)
             return None
         self._fired = False
+        aborted = any(r.last_abort_ns
+                      and now - r.last_abort_ns < DEGRADED_WINDOW_NS
+                      for r in self._recorders)
         degraded = any(r.last_error_ns
                        and now - r.last_error_ns < DEGRADED_WINDOW_NS
                        for r in self._recorders)
-        self._health = HEALTH_DEGRADED if degraded else HEALTH_OK
+        self._health = (HEALTH_ABORTED if aborted
+                        else HEALTH_DEGRADED if degraded else HEALTH_OK)
         _publish_health(self._registry)
         return None
 
@@ -186,6 +207,21 @@ class Watchdog:
             except OSError:
                 pass
         self._log(report)
+        # ACCL_WATCHDOG_ACTION=abort: turn the diagnosis into recovery —
+        # abort every hung communicator so stuck waiters fail fast with
+        # COMM_ABORTED|RANK_FAILED instead of hanging forever.  Runs
+        # AFTER the dump: the black box records the pre-abort truth.
+        if self.action == "abort" and self._abort_hook is not None:
+            aborted_comms = set()
+            for hang in report["analysis"]["hangs"]:
+                comm = hang["comm"]
+                if comm in aborted_comms:
+                    continue
+                aborted_comms.add(comm)
+                try:
+                    self._abort_hook(comm, report)
+                except Exception:  # the recovery path must not kill
+                    pass           # the watchdog thread
         if self._on_fire is not None:
             try:
                 self._on_fire(report)

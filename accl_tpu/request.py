@@ -191,6 +191,16 @@ class Request:
     def done(self) -> bool:
         return self._done.is_set()
 
+    @property
+    def aborted(self) -> bool:
+        """True when this call was finalized by a communicator abort
+        (COMM_ABORTED) rather than completing or failing on its own —
+        the signal recovery code branches on (shrink + re-run) without
+        string-matching the error text."""
+        from .constants import ErrorCode
+
+        return self.done and bool(self.retcode & int(ErrorCode.COMM_ABORTED))
+
     def __repr__(self) -> str:
         return f"Request(id={self.id}, {self.description!r}, status={self.status.name})"
 

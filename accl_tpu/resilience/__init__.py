@@ -1,0 +1,44 @@
+"""Fault-tolerant collectives: the detect -> recover bridge.
+
+Three cooperating layers over the failure-*detection* machinery the
+stack already ships (per-peer sequence numbers, sticky error codes,
+receive timeouts — SURVEY §5; flight recorder + watchdog; sanitizer):
+
+1. **Retransmission** (:mod:`.retry`): eager senders keep a bounded
+   retransmit store keyed by ``(peer, tag, seqn)``; on a seek miss the
+   receiver NACKs and the sender resends, with exponential backoff +
+   deterministic jitter on the receiver's NACK cadence
+   (``ACCL_RETRY_MAX`` / ``ACCL_RETRY_BASE_US``).  A dropped, duplicated
+   or seqn-corrupted segment heals transparently inside the unchanged
+   receive budget.
+
+2. **Abort + epoch fencing** (:meth:`accl_tpu.ACCL.abort`): an
+   epoch-tagged abort propagates through the control plane so every
+   pending request on all live ranks fails fast with ``COMM_ABORTED``
+   (``RANK_FAILED`` when a dead peer triggered it); traffic from the
+   dead epoch is fenced at the pool boundary.
+
+3. **Shrink** (:mod:`.membership`, ULFM ``MPI_Comm_shrink`` analog,
+   ACCL+ arxiv 2312.11742 / HiCCL arxiv 2408.05962 direction): agree on
+   the surviving rank set via control-plane heartbeats and build a
+   fresh communicator excluding dead ranks, so the caller re-runs the
+   collective on the smaller world.
+
+A seeded chaos injector (:mod:`.chaos`, ``ACCL_CHAOS``) drives all of
+it in CI: probabilistic drop/dup/delay/corrupt plus slow-rank and
+kill-rank, reproducible from one seed (``scripts/chaos_smoke.py``).
+
+See docs/fault_tolerance.md for semantics and knobs.
+"""
+from .chaos import ChaosPlan
+from .membership import probe_alive, shrink
+from .retry import DEFAULT_RETRY_BASE_US, DEFAULT_RETRY_MAX, RetryPolicy
+
+__all__ = [
+    "ChaosPlan",
+    "RetryPolicy",
+    "DEFAULT_RETRY_MAX",
+    "DEFAULT_RETRY_BASE_US",
+    "probe_alive",
+    "shrink",
+]

@@ -1,0 +1,140 @@
+"""Seeded chaos plans: the generalized fault injector.
+
+Replaces the one-shot ``inject_fault`` harness as the way to exercise
+the resilience stack: a :class:`ChaosPlan` arms the native engine's
+egress funnel with a *probabilistic, seeded* fault schedule — every
+eager dataplane segment draws drop / duplicate / delay / corrupt-seqn
+from a deterministic xorshift stream, so a failing CI run replays
+bit-for-bit from its seed.  Slow-rank (per-message egress stall) and
+kill-rank (engine goes silent, local comms abort with ``RANK_FAILED``)
+round out the failure modes.
+
+Plan grammar (``ACCL_CHAOS`` env var or :meth:`ChaosPlan.parse`)::
+
+    seed=42,drop=0.01,dup=0.01,delay=0.02,delay_us=2000,corrupt=0.005,
+    slow_rank=2:500,kill_rank=3
+
+- ``seed``      — RNG seed (per-rank streams decorrelate off it)
+- ``drop``/``dup``/``delay``/``corrupt`` — per-segment probabilities
+  (floats in [0, 1); applied to eager data segments only — the
+  rendezvous/NACK/abort control plane is never a chaos target, so
+  recovery stays deterministic)
+- ``delay_us``  — how long a delayed segment is held (default 2000);
+  delayed segments are RE-ORDERED past their siblings, opening real
+  sequence gaps for the NACK lane to close
+- ``slow_rank=R:US`` — rank R stalls its egress writer US µs/message
+  (repeatable for several ranks)
+- ``kill_rank=R``    — rank R is marked for :meth:`kill set <kills>`;
+  harnesses decide WHEN (usually mid-run) via ``EmuWorld.kill_rank``
+
+One-shot ``inject_fault`` remains as sugar: it forces the next draw of
+the same funnel, so both paths exercise identical recovery machinery.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..constants import ACCLError
+
+_PROB_KEYS = ("drop", "dup", "delay", "corrupt")
+
+
+def _ppm(p: float) -> int:
+    """Probability -> parts-per-million (the engine's integer domain)."""
+    return max(0, min(1_000_000, int(round(p * 1_000_000))))
+
+
+@dataclass
+class ChaosPlan:
+    """One parsed chaos plan; ``apply(device)`` arms a rank's engine."""
+
+    seed: int = 1
+    drop: float = 0.0
+    dup: float = 0.0
+    delay: float = 0.0
+    delay_us: int = 2000
+    corrupt: float = 0.0
+    #: rank -> per-message egress stall in µs (slow-rank)
+    slow: dict = field(default_factory=dict)
+    #: ranks marked for a kill (the harness triggers the WHEN)
+    kills: list = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosPlan":
+        """Parse the ``k=v,...`` grammar (see module docstring)."""
+        plan = cls()
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ACCLError(f"ACCL_CHAOS item {item!r}: expected k=v")
+            key, val = (s.strip() for s in item.split("=", 1))
+            try:
+                if key == "seed":
+                    plan.seed = int(val, 0)
+                elif key in _PROB_KEYS:
+                    p = float(val)
+                    if not 0.0 <= p < 1.0:
+                        raise ValueError("probability must be in [0, 1)")
+                    setattr(plan, key, p)
+                elif key == "delay_us":
+                    plan.delay_us = int(val)
+                elif key == "slow_rank":
+                    rank_s, _, us_s = val.partition(":")
+                    plan.slow[int(rank_s)] = int(us_s) if us_s else 500
+                elif key == "kill_rank":
+                    plan.kills.append(int(val))
+                else:
+                    raise ValueError("unknown key")
+            except ValueError as e:
+                raise ACCLError(
+                    f"ACCL_CHAOS item {item!r}: {e} (grammar: seed=N,"
+                    f"drop=P,dup=P,delay=P,delay_us=N,corrupt=P,"
+                    f"slow_rank=R:US,kill_rank=R)") from e
+        return plan
+
+    @classmethod
+    def from_env(cls) -> Optional["ChaosPlan"]:
+        """The ``ACCL_CHAOS`` plan, or None when unset/empty."""
+        spec = os.environ.get("ACCL_CHAOS", "").strip()
+        return cls.parse(spec) if spec else None
+
+    @property
+    def probabilistic(self) -> bool:
+        return any(getattr(self, k) > 0 for k in _PROB_KEYS)
+
+    def apply(self, device, rank: int) -> None:
+        """Arm one rank's engine with this plan (kills NOT included —
+        the harness triggers those explicitly, usually mid-run)."""
+        set_chaos = getattr(device, "set_chaos", None)
+        if set_chaos is None:
+            raise ACCLError(
+                f"{type(device).__name__} has no chaos injector "
+                f"(chaos plans drive the emulator rungs)")
+        set_chaos(
+            seed=self.seed,
+            drop_ppm=_ppm(self.drop),
+            dup_ppm=_ppm(self.dup),
+            delay_ppm=_ppm(self.delay),
+            delay_us=self.delay_us,
+            corrupt_ppm=_ppm(self.corrupt),
+            slow_us=int(self.slow.get(rank, 0)),
+        )
+
+    def spec(self) -> str:
+        """Round-trippable rendering of this plan (parse(spec()) == it)."""
+        parts = [f"seed={self.seed}"]
+        for k in _PROB_KEYS:
+            v = getattr(self, k)
+            if v > 0:
+                parts.append(f"{k}={v:g}")
+        if self.delay > 0 or self.delay_us != 2000:
+            parts.append(f"delay_us={self.delay_us}")
+        for r, us in sorted(self.slow.items()):
+            parts.append(f"slow_rank={r}:{us}")
+        for r in self.kills:
+            parts.append(f"kill_rank={r}")
+        return ",".join(parts)

@@ -1,0 +1,66 @@
+"""Membership recovery: liveness probing + ULFM-style shrink.
+
+After a rank dies mid-job the surviving ranks need (1) agreement on who
+is still alive and (2) a fresh communicator excluding the dead, so the
+collective can re-run on the smaller world — the ULFM
+``MPI_Comm_shrink`` recovery pattern, applied to this stack's
+communicator model (ACCL+ arxiv 2312.11742 motivates exactly this for
+long-running distributed apps).
+
+Liveness comes from the control plane: an explicit ping/pong probe
+(:func:`probe_alive`) plus heartbeats piggybacked on the resilience
+control messages (NACKs/aborts count as proof of life — the data hot
+path stays stamp-free), cross-checked against the watchdog's last-seen
+stamps when a flight recorder is live.  Agreement is probabilistic-
+by-construction (every survivor probes the same world with the same
+window); the deterministic kill scenarios CI drives always agree, and
+a disagreement surfaces as the usual create_communicator ordering
+error rather than silent corruption.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..constants import ACCLError, ErrorCode
+
+
+def probe_alive(accl, comm_id: int = 0, window_s: float = 1.0) -> List[bool]:
+    """Per-comm-local-rank liveness, via the backend's heartbeat probe.
+    The local rank is always alive.  Backends without a liveness plane
+    (record-mode lint devices) report everyone alive — shrink then
+    degenerates to a copy, never to a wrong exclusion."""
+    comm = accl.communicator(comm_id)
+    probe = getattr(accl.device, "probe_liveness", None)
+    alive: Optional[List[bool]] = None
+    if probe is not None:
+        alive = probe(comm_id, comm.size, window_s)
+    if alive is None:
+        alive = [True] * comm.size
+    if len(alive) != comm.size:
+        alive = list(alive)[:comm.size] + [False] * (comm.size - len(alive))
+    alive[comm.local_rank] = True
+    return alive
+
+
+def shrink(accl, comm_id: int = 0, window_s: float = 1.0) -> int:
+    """Build a fresh communicator over the surviving ranks of
+    ``comm_id`` and return its id (ULFM shrink).
+
+    Collective: every surviving rank must call it (same probe window),
+    in the same create_communicator order as always.  The dead ranks'
+    old traffic stays fenced behind the aborted comm's epoch; the new
+    communicator starts with clean sequence state on every member.
+    """
+    comm = accl.communicator(comm_id)
+    alive = probe_alive(accl, comm_id, window_s)
+    # map surviving comm-local ranks to WORLD indices (the session field
+    # carries the global rank on the emulator rungs and the device index
+    # mapping on the TPU rung — the same convention create_communicator
+    # and the engines' comm tables already share)
+    survivors = [comm.ranks[i].session for i, ok in enumerate(alive) if ok]
+    if not survivors:
+        raise ACCLError(
+            f"shrink(comm {comm_id}): no survivors", int(ErrorCode.RANK_FAILED))
+    # a shrink with no dead ranks still mints a fresh comm: the call is
+    # collective, so every member's id sequence must advance identically
+    return accl.create_communicator(survivors)

@@ -1,0 +1,83 @@
+"""Retransmission policy: the driver-side knobs of the NACK lane.
+
+The mechanism itself lives in the native engine (native/src/engine.cpp
+``seek_recover`` / ``handle_nack``): senders keep a bounded store of
+sent eager segments keyed by ``(comm, peer, tag, seqn)``; a receiver
+whose seek misses NACKs the sender for everything from the first
+missing seqn and re-seeks with exponential backoff + deterministic
+jitter.  This module only resolves the policy (env -> numbers) and
+mirrors the backoff math so tests and docs can state the schedule
+without reaching into C++.
+
+Knobs:
+
+- ``ACCL_RETRY_MAX`` — NACK rounds per seek (default 4; ``0`` disables
+  the whole lane: no store, no NACKs — the pure detect-and-classify
+  behavior fault-classification tests rely on).
+- ``ACCL_RETRY_BASE_US`` — backoff base in microseconds (default 200);
+  round *k* waits ``base * 2**k + jitter`` with ``jitter < base/2 + 1``
+  derived deterministically from (rank, seqn, round).
+
+The TOTAL receive budget is unchanged: retransmission slices the same
+``ACCL_DEFAULT_TIMEOUT``-driven window the blocking seek always had, so
+an unrecoverable loss still classifies on the same clock.  The lane is
+self-disabled on lossy transports (the datagram rung has its own
+loss-hole resync semantics).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..constants import ACCLError
+
+DEFAULT_RETRY_MAX = 4
+DEFAULT_RETRY_BASE_US = 200
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(float(raw))
+    except ValueError as e:
+        raise ACCLError(f"{name}={raw!r} is not a number") from e
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Resolved retransmission policy, applied to a backend at
+    :meth:`accl_tpu.ACCL.initialize` via ``device.set_resilience``."""
+
+    max_retries: int = DEFAULT_RETRY_MAX
+    base_us: int = DEFAULT_RETRY_BASE_US
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            max_retries=max(0, _env_int("ACCL_RETRY_MAX",
+                                        DEFAULT_RETRY_MAX)),
+            base_us=max(1, _env_int("ACCL_RETRY_BASE_US",
+                                    DEFAULT_RETRY_BASE_US)),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_retries > 0
+
+    def backoff_us(self, attempt: int, rank: int = 0, seqn: int = 0) -> int:
+        """The engine's backoff schedule, mirrored bit-for-bit
+        (native/src/engine.cpp seek_recover): exponential in the
+        attempt with a deterministic jitter keyed by (rank, seqn,
+        attempt) so concurrent receivers decorrelate while a seeded
+        run replays identically."""
+        base = self.base_us
+        us = base << attempt
+        j = ((rank + 1) * 2654435761) ^ ((seqn + 1) * 40503) ^ attempt
+        return us + (j & 0xFFFFFFFFFFFFFFFF) % (base // 2 + 1)
+
+    def worst_case_recovery_us(self) -> int:
+        """Upper bound on the backoff portion of a fully-exhausted
+        recovery (excluding the post-recovery abort-wake slices)."""
+        return sum(self.backoff_us(a) for a in range(self.max_retries))

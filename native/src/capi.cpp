@@ -157,6 +157,77 @@ int accl_inject_fault(void* wp, int rank, uint32_t kind) {
   return 0;
 }
 
+// ---- resilience control plane (retransmission / abort / shrink /
+// chaos; the driver-side knobs live in accl_tpu/resilience) ----
+
+// Eager retransmission config: retry_max NACK rounds with exponential
+// backoff from retry_base_us (0 rounds = the lane is off).
+int accl_set_resilience(void* wp, int rank, uint32_t retry_max,
+                        uint32_t retry_base_us) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->set_resilience(retry_max, retry_base_us);
+  return 0;
+}
+
+// Epoch-tagged communicator abort (ULFM-style revoke): every pending
+// call on all live ranks finalizes fast with err_bits | COMM_ABORTED.
+int accl_abort(void* wp, int rank, int comm_id, uint32_t err_bits) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->abort_comm(uint32_t(comm_id), err_bits, true) : -1;
+}
+
+// Seqn resync + transient-state drain after a classified fault; a
+// collective recovery op — every rank of a quiesced world calls it.
+int accl_reset_errors(void* wp, int rank) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->reset_errors();
+  return 0;
+}
+
+// Seeded chaos plan (probabilities in parts-per-million; slow_us stalls
+// this rank's egress writer per message).
+int accl_set_chaos(void* wp, int rank, uint64_t seed, uint32_t drop_ppm,
+                   uint32_t dup_ppm, uint32_t delay_ppm, uint32_t delay_us,
+                   uint32_t corrupt_ppm, uint32_t slow_us) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->set_chaos(seed, drop_ppm, dup_ppm, delay_ppm, delay_us, corrupt_ppm,
+               slow_us);
+  return 0;
+}
+
+// Kill-rank chaos: the engine goes silent and aborts its own comms
+// with RANK_FAILED so local pending calls finalize fast.
+int accl_chaos_kill(void* wp, int rank) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->kill();
+  return 0;
+}
+
+// Liveness probe: heartbeat every peer of a communicator, collect
+// proof-of-life for up to window_us; alive_bitmap bit i = comm-local
+// rank i responded (the local rank is always alive).
+int accl_probe_liveness(void* wp, int rank, int comm_id, uint32_t window_us,
+                        uint64_t* alive_bitmap) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  uint64_t bm = e->probe_liveness(uint32_t(comm_id), window_us);
+  if (alive_bitmap) *alive_bitmap = bm;
+  return 0;
+}
+
+// Resilience observability: retransmitted segments, NACKs sent/received,
+// epoch-fenced ingress drops.
+void accl_resilience_stats(void* wp, int rank, uint64_t* retrans_sent,
+                           uint64_t* nacks_tx, uint64_t* nacks_rx,
+                           uint64_t* fenced_drops) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (e) e->resilience_stats(retrans_sent, nacks_tx, nacks_rx, fenced_drops);
+}
+
 uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
   Engine* e = static_cast<World*>(wp)->get(rank);
   return e ? e->alloc(nbytes, align) : 0;

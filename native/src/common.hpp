@@ -58,7 +58,8 @@ enum class CfgFunc : uint32_t {
   SetMaxRendezvousMsgSize = 4,
 };
 
-// Error bits (reference: constants.hpp:355-387).
+// Error bits (reference: constants.hpp:355-387; bits 27/28 are this
+// build's fault-tolerance extension, mirrored in accl_tpu/constants.py).
 enum Err : uint32_t {
   OK = 0,
   RECEIVE_TIMEOUT_ERROR = 1u << 11,
@@ -70,14 +71,32 @@ enum Err : uint32_t {
   PACK_SEQ_NUMBER_ERROR = 1u << 21,
   COMPRESSION_ERROR = 1u << 22,
   SEGMENTER_EXPECTED_BTT_ERROR = 1u << 25,
+  // the communicator this call ran on was aborted (epoch fenced); every
+  // pending call on all live ranks finalizes fast with this bit
+  COMM_ABORTED = 1u << 27,
+  // the abort was triggered by a peer declared dead (watchdog action or
+  // liveness probe) rather than an application-initiated abort
+  RANK_FAILED = 1u << 28,
 };
 
-// Wire message types (reference: eth_intf.h:42-45).
+// Wire message types (reference: eth_intf.h:42-45; types >= 4 are this
+// build's resilience control plane — no reference analog).
 enum class MsgType : uint8_t {
   EgrMsg = 0,
   RndzvsMsg = 1,
   RndzvsInit = 2,
   RndzvsWrDone = 3,
+  // receiver -> sender: "resend eager segments of (comm, tag) from seqn"
+  // (hdr.seqn = first missing sequence number); answered from the
+  // sender's bounded retransmit store
+  Nack = 4,
+  // liveness ping/pong piggybacked on the control plane (hdr.count = 1
+  // requests a reply; 0 is the reply); any ingress traffic also counts
+  // as proof of life for the sending peer
+  Heartbeat = 5,
+  // epoch-tagged communicator abort: hdr.epoch carries the NEW epoch,
+  // hdr.count the error bits every pending call must finalize with
+  Abort = 6,
 };
 
 constexpr uint32_t TAG_ANY = 0xFFFFFFFFu;
@@ -117,7 +136,11 @@ struct WireHeader {
                             // derive the wire format from their OWN
                             // arithcfg + flags, like the reference's
                             // marker-free eth header)
-  uint8_t pad[64 - 40] = {0};
+  uint32_t epoch = 0;  // communicator epoch (abort fencing): ingress
+                       // drops data messages whose epoch trails the
+                       // receiver's, so traffic from a dead epoch can
+                       // never land after an abort
+  uint8_t pad[64 - 44] = {0};
 };
 static_assert(sizeof(WireHeader) == 64, "wire header must be 64 bytes");
 

@@ -35,6 +35,7 @@ Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
   transport_->start([this](Message&& m) { ingress(std::move(m)); });
   loop_thread_ = std::thread([this] { loop(); });
   egress_thread_ = std::thread([this] { egress_loop(); });
+  delay_thread_ = std::thread([this] { delay_loop(); });
 }
 
 Engine::~Engine() {
@@ -43,6 +44,15 @@ Engine::~Engine() {
   completions_.close();
   pending_addrs_.close();
   if (loop_thread_.joinable()) loop_thread_.join();
+  {
+    // chaos-delayed messages still pending at teardown are dropped (the
+    // world is going away; the peer's receive machinery is too)
+    std::lock_guard<std::mutex> g(delay_mu_);
+    delay_running_ = false;
+    delayed_.clear();
+  }
+  delay_cv_.notify_all();
+  if (delay_thread_.joinable()) delay_thread_.join();
   {
     // drain staged segments so tail messages of completed calls are not
     // lost, then stop the writer
@@ -258,11 +268,22 @@ bool Engine::pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap,
 // against the detection machinery (SURVEY §5 failure detection)
 // ---------------------------------------------------------------------------
 void Engine::send_out(uint32_t session, Message&& msg) {
+  // kill-rank chaos: a dead engine transmits nothing — its peers see
+  // exactly what a crashed process would leave behind
+  if (killed_.load()) return;
   // egress accounting (tx_stats): proves in tests whether a payload
   // actually crossed the wire (the p2p direct path must not add here)
   tx_msgs_.fetch_add(1);
   tx_payload_bytes_.fetch_add(msg.payload.size());
-  switch (fault_.exchange(0)) {
+  // fault resolution: the one-shot injector forces the draw for the
+  // next message (legacy inject_fault semantics, any message type); the
+  // seeded chaos plan draws probabilistically for eager dataplane
+  // segments only — rendezvous/abort/NACK control is not a chaos target,
+  // so recovery under a seeded plan stays deterministic.
+  uint32_t kind = fault_.exchange(0);
+  if (kind == 0 && msg.hdr.msg_type == uint8_t(MsgType::EgrMsg))
+    kind = chaos_draw();
+  switch (kind) {
     case 1:  // drop: the message never reaches the wire
       return;
     case 2: {  // duplicate: deliver twice with identical header/seqn
@@ -275,10 +296,102 @@ void Engine::send_out(uint32_t session, Message&& msg) {
     case 3:  // corrupt the sequence number
       msg.hdr.seqn += 7;
       break;
+    case 4: {  // delay: hold the message past its siblings (reordering)
+      uint32_t us;
+      {
+        std::lock_guard<std::mutex> g(chaos_mu_);
+        us = chaos_.delay_us ? chaos_.delay_us : 2000;
+      }
+      std::lock_guard<std::mutex> g(delay_mu_);
+      if (delay_running_) {
+        delayed_.push_back(Delayed{
+            steady_clock::now() + microseconds(us), session,
+            std::move(msg)});
+        delay_cv_.notify_all();
+        return;
+      }
+      break;  // teardown already underway: deliver immediately
+    }
     default:
       break;
   }
   stage_egress(session, std::move(msg));
+}
+
+// Background releaser for chaos-delayed messages: re-stages each held
+// segment once its deadline passes, producing REAL reordering on the
+// wire (a FIFO stall would delay everything behind it and never open a
+// sequence gap for the NACK path to close).
+void Engine::delay_loop() {
+  std::unique_lock<std::mutex> lk(delay_mu_);
+  while (delay_running_) {
+    if (delayed_.empty()) {
+      delay_cv_.wait(lk);
+      continue;
+    }
+    auto it = std::min_element(
+        delayed_.begin(), delayed_.end(),
+        [](const Delayed& a, const Delayed& b) { return a.release < b.release; });
+    auto now = steady_clock::now();
+    if (it->release > now) {
+      delay_cv_.wait_until(lk, it->release);
+      continue;
+    }
+    Delayed d = std::move(*it);
+    delayed_.erase(it);
+    lk.unlock();
+    stage_egress(d.session, std::move(d.msg));
+    lk.lock();
+  }
+}
+
+uint32_t Engine::chaos_draw() {
+  std::lock_guard<std::mutex> g(chaos_mu_);
+  if (!chaos_.armed) return 0;
+  // xorshift64*: deterministic per (seed, draw index) — a seeded plan
+  // replays the same fault schedule run after run
+  uint64_t x = chaos_.rng;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  chaos_.rng = x;
+  uint32_t u = uint32_t((x * 0x2545F4914F6CDD1Dull) >> 40) % 1000000u;
+  if (u < chaos_.drop_ppm) return 1;
+  u -= chaos_.drop_ppm;
+  if (u < chaos_.dup_ppm) return 2;
+  u -= chaos_.dup_ppm;
+  if (u < chaos_.corrupt_ppm) return 3;
+  u -= chaos_.corrupt_ppm;
+  if (u < chaos_.delay_ppm) return 4;
+  return 0;
+}
+
+void Engine::set_chaos(uint64_t seed, uint32_t drop_ppm, uint32_t dup_ppm,
+                       uint32_t delay_ppm, uint32_t delay_us,
+                       uint32_t corrupt_ppm, uint32_t slow_us) {
+  std::lock_guard<std::mutex> g(chaos_mu_);
+  chaos_.drop_ppm = drop_ppm;
+  chaos_.dup_ppm = dup_ppm;
+  chaos_.delay_ppm = delay_ppm;
+  chaos_.delay_us = delay_us;
+  chaos_.corrupt_ppm = corrupt_ppm;
+  chaos_.rng = seed ? seed : 0x9E3779B97F4A7C15ull;
+  // each rank folds its id in so per-rank streams decorrelate while the
+  // whole world stays reproducible from one seed
+  chaos_.rng ^= (uint64_t(global_rank_) + 1) * 0xA24BAED4963EE407ull;
+  chaos_.armed = drop_ppm || dup_ppm || delay_ppm || corrupt_ppm;
+  slow_us_.store(slow_us);
+}
+
+void Engine::kill() {
+  killed_.store(true);
+  // local abort of every comm (no propagation — a dead rank cannot
+  // send): this rank's own pending calls finalize fast with RANK_FAILED
+  // instead of burning their receive budget against silence
+  for (uint32_t c = 0; c < comms_.size() && c < kMaxComms; ++c) {
+    comm_epoch_[c].fetch_add(1);
+    comm_abort_[c].fetch_or(COMM_ABORTED | RANK_FAILED);
+  }
 }
 
 // Stage one wire message into the bounded egress window; blocks while
@@ -310,6 +423,10 @@ void Engine::egress_loop() {
       egress_q_.pop_front();
     }
     egress_cv_.notify_all();  // wake staging waiters + the drain in ~Engine
+    // slow-rank chaos: stall the egress writer per message so this rank
+    // lags the gang without dropping anything
+    uint32_t stall = slow_us_.load();
+    if (stall) std::this_thread::sleep_for(microseconds(stall));
     try {
       transport_->send(item.first, std::move(item.second));
     } catch (const std::exception& e) {
@@ -329,6 +446,54 @@ void Engine::egress_loop() {
 // strm routing :136-147, rdma_depacketizer notification routing)
 // ---------------------------------------------------------------------------
 void Engine::ingress(Message&& msg) {
+  // kill-rank chaos: a dead engine hears nothing — no pongs, no
+  // completions, no deposits (the peer-visible half of kill())
+  if (killed_.load()) return;
+  switch (static_cast<MsgType>(msg.hdr.msg_type)) {
+    case MsgType::Nack:
+      nacks_rx_.fetch_add(1);
+      note_alive(msg.hdr.comm_id, msg.hdr.src);
+      handle_nack(msg.hdr);
+      return;
+    case MsgType::Heartbeat:
+      // liveness control plane: epoch-agnostic (survivors probe the
+      // ABORTED comm while agreeing on the shrink set)
+      note_alive(msg.hdr.comm_id, msg.hdr.src);
+      if (msg.hdr.count == 1) {  // ping: pong back (count = 0)
+        std::lock_guard<std::mutex> g(cfg_mu_);
+        if (msg.hdr.comm_id < comms_.size()) {
+          const CommTable& t = comms_[msg.hdr.comm_id];
+          if (msg.hdr.src < t.rows.size()) {
+            Message pong;
+            pong.hdr.msg_type = uint8_t(MsgType::Heartbeat);
+            pong.hdr.comm_id = msg.hdr.comm_id;
+            pong.hdr.src = t.local;
+            pong.hdr.count = 0;
+            pong.hdr.dst_session = uint16_t(t.rows[msg.hdr.src].session);
+            stage_egress(t.rows[msg.hdr.src].session, std::move(pong));
+          }
+        }
+      }
+      return;
+    case MsgType::Abort:
+      note_alive(msg.hdr.comm_id, msg.hdr.src);
+      handle_abort(msg.hdr);
+      return;
+    default:
+      break;
+  }
+  // epoch fence: data/rendezvous traffic stamped with a dead epoch is
+  // dropped at the pool boundary — after an abort, stragglers from the
+  // old world can neither land in memory nor satisfy a future seek
+  if (msg.hdr.comm_id < kMaxComms &&
+      msg.hdr.epoch != comm_epoch_[msg.hdr.comm_id].load()) {
+    fenced_drops_.fetch_add(1);
+    return;
+  }
+  // NB: no note_alive here — liveness piggybacks on the CONTROL plane
+  // only (Heartbeat/Nack/Abort above).  The probe actively pings, so
+  // stamping every data segment would buy nothing and cost the hot
+  // ingress path a mutex + map walk per message.
   switch (static_cast<MsgType>(msg.hdr.msg_type)) {
     case MsgType::EgrMsg:
       if (msg.hdr.strm >= FIRST_KRNL_STREAM) {
@@ -395,7 +560,200 @@ void Engine::ingress(Message&& msg) {
       completions_.push(RndzvDone{msg.hdr.comm_id, msg.hdr.src, msg.hdr.tag,
                                   msg.hdr.vaddr});
       break;
+    default:  // control types handled above
+      break;
   }
+}
+
+// ---------------------------------------------------------------------------
+// resilience: retransmission lane (NACK-driven eager resend)
+// ---------------------------------------------------------------------------
+void Engine::store_retrans(uint32_t comm, uint32_t dst, const Message& msg) {
+  std::lock_guard<std::mutex> g(retrans_mu_);
+  if (retrans_ring_.empty()) retrans_ring_.resize(kRetransCap);
+  RetransSlot& s = retrans_ring_[retrans_pos_];
+  retrans_pos_ = (retrans_pos_ + 1) % kRetransCap;
+  s.used = true;
+  s.comm = comm;
+  s.dst = dst;
+  s.msg.hdr = msg.hdr;
+  // assign() reuses the recycled slot's capacity: the steady-state
+  // per-segment cost is one bounded memcpy, no allocator traffic
+  s.msg.payload.assign(msg.payload.begin(), msg.payload.end());
+}
+
+void Engine::send_nack(uint32_t comm, uint32_t src, uint32_t tag,
+                       uint32_t seqn) {
+  if (comm >= comms_.size()) return;
+  CommTable& t = comms_[comm];
+  if (src >= t.rows.size()) return;
+  Message m;
+  m.hdr.msg_type = uint8_t(MsgType::Nack);
+  m.hdr.comm_id = comm;
+  m.hdr.tag = tag;
+  m.hdr.seqn = seqn;
+  m.hdr.src = t.local;
+  m.hdr.epoch = epoch_of(comm);
+  m.hdr.dst_session = uint16_t(t.rows[src].session);
+  nacks_tx_.fetch_add(1);
+  // control plane: staged directly (not a chaos target, see send_out)
+  stage_egress(t.rows[src].session, std::move(m));
+}
+
+void Engine::handle_nack(const WireHeader& hdr) {
+  // resend every stored segment on (comm, requester, tag) from the
+  // requested seqn on, in seqn order — one NACK round closes a
+  // multi-segment hole (the receiver evicted its suspect window).
+  // Linear ring scan: this is the fault path; the no-fault store stays
+  // index-free so the hot path pays nothing for our convenience here.
+  std::vector<Message> out;
+  {
+    std::lock_guard<std::mutex> g(retrans_mu_);
+    for (const RetransSlot& s : retrans_ring_) {
+      // a wildcard-tag NACK (a TAG_ANY recv's seek pairs with any
+      // tag, so its solicitation must too) matches the whole route —
+      // tag-exact matching there would strand concretely-tagged
+      // segments the receiver evicted and is now waiting for
+      if (s.used && s.comm == hdr.comm_id && s.dst == hdr.src &&
+          (hdr.tag == TAG_ANY || s.msg.hdr.tag == hdr.tag) &&
+          int32_t(s.msg.hdr.seqn - hdr.seqn) >= 0)
+        out.push_back(s.msg);  // copy: the store keeps serving NACKs
+    }
+  }
+  std::sort(out.begin(), out.end(),
+            [](const Message& a, const Message& b) {
+              return int32_t(a.hdr.seqn - b.hdr.seqn) < 0;
+            });
+  for (auto& m : out) {
+    retrans_sent_.fetch_add(1);
+    // clean stored copy, staged directly: a retransmit is the recovery
+    // path and must not re-enter the chaos funnel
+    if (!killed_.load()) stage_egress(m.hdr.dst_session, std::move(m));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// resilience: abort + epoch fencing
+// ---------------------------------------------------------------------------
+int Engine::abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate) {
+  if (comm_id >= comms_.size() || comm_id >= kMaxComms) return -1;
+  uint32_t new_epoch = comm_epoch_[comm_id].fetch_add(1) + 1;
+  comm_abort_[comm_id].fetch_or(err_bits | COMM_ABORTED);
+  // reclaim pool buffers pinned by the dead epoch's traffic
+  rx_.evict_comm(comm_id);
+  if (propagate && !killed_.load()) {
+    const CommTable& t = comms_[comm_id];
+    for (uint32_t i = 0; i < t.rows.size(); ++i) {
+      if (i == t.local) continue;
+      Message m;
+      m.hdr.msg_type = uint8_t(MsgType::Abort);
+      m.hdr.comm_id = comm_id;
+      m.hdr.src = t.local;
+      m.hdr.count = err_bits | COMM_ABORTED;
+      m.hdr.epoch = new_epoch;
+      m.hdr.dst_session = uint16_t(t.rows[i].session);
+      stage_egress(t.rows[i].session, std::move(m));
+    }
+  }
+  return 0;
+}
+
+void Engine::handle_abort(const WireHeader& hdr) {
+  uint32_t comm = hdr.comm_id;
+  if (comm >= kMaxComms || comm >= comms_.size()) return;
+  // adopt the highest epoch seen (monotonic: a replayed abort cannot
+  // roll the fence back)
+  uint32_t cur = comm_epoch_[comm].load();
+  while (int32_t(hdr.epoch - cur) > 0 &&
+         !comm_epoch_[comm].compare_exchange_weak(cur, hdr.epoch)) {
+  }
+  comm_abort_[comm].fetch_or(hdr.count | COMM_ABORTED);
+  rx_.evict_comm(comm);
+  // pending calls on this comm finalize on the engine loop's next
+  // sweep; blocked eager seeks notice within one recovery slice
+}
+
+void Engine::reset_errors() {
+  // collective recovery op on a QUIESCED world: zero both directions'
+  // sequence counters (every rank does the same, so the world agrees),
+  // drain transient receive/retransmit state, clear armed faults and
+  // abort flags.  Epochs stay bumped: old-epoch stragglers remain
+  // fenced forever.
+  {
+    std::lock_guard<std::mutex> g(cfg_mu_);
+    for (auto& t : comms_) {
+      std::fill(t.inbound_seq.begin(), t.inbound_seq.end(), 0);
+      std::fill(t.outbound_seq.begin(), t.outbound_seq.end(), 0);
+    }
+  }
+  rx_.clear_pending();
+  {
+    std::lock_guard<std::mutex> g(retrans_mu_);
+    for (RetransSlot& s : retrans_ring_) s.used = false;
+    retrans_pos_ = 0;
+  }
+  {
+    std::lock_guard<std::mutex> g(strm_seq_mu_);
+    strm_in_seq_.clear();
+    strm_holdback_.clear();
+  }
+  fault_.store(0);
+  for (uint32_t c = 0; c < kMaxComms; ++c) comm_abort_[c].store(0);
+}
+
+// ---------------------------------------------------------------------------
+// resilience: liveness
+// ---------------------------------------------------------------------------
+void Engine::note_alive(uint32_t comm, uint32_t src) {
+  uint64_t now = uint64_t(
+      duration_cast<nanoseconds>(steady_clock::now().time_since_epoch())
+          .count());
+  std::lock_guard<std::mutex> g(live_mu_);
+  last_heard_ns_[{comm, src}] = now;
+}
+
+uint64_t Engine::probe_liveness(uint32_t comm_id, uint32_t window_us) {
+  if (comm_id >= comms_.size()) return 0;
+  uint32_t local, nranks;
+  std::vector<uint32_t> sessions;
+  {
+    std::lock_guard<std::mutex> g(cfg_mu_);
+    const CommTable& t = comms_[comm_id];
+    local = t.local;
+    nranks = t.size;
+    for (const auto& r : t.rows) sessions.push_back(r.session);
+  }
+  uint64_t start_ns = uint64_t(
+      duration_cast<nanoseconds>(steady_clock::now().time_since_epoch())
+          .count());
+  uint64_t alive = nranks < 64 ? (1ull << local) : 0;
+  if (killed_.load()) return alive;
+  for (uint32_t i = 0; i < nranks; ++i) {
+    if (i == local) continue;
+    Message m;
+    m.hdr.msg_type = uint8_t(MsgType::Heartbeat);
+    m.hdr.comm_id = comm_id;
+    m.hdr.src = local;
+    m.hdr.count = 1;  // ping: reply requested
+    m.hdr.dst_session = uint16_t(sessions[i]);
+    stage_egress(sessions[i], std::move(m));
+  }
+  auto deadline = steady_clock::now() + microseconds(window_us);
+  uint64_t want = nranks < 64 ? (1ull << nranks) - 1 : ~0ull;
+  for (;;) {
+    {
+      std::lock_guard<std::mutex> g(live_mu_);
+      for (uint32_t i = 0; i < nranks && i < 64; ++i) {
+        if (i == local) continue;
+        auto it = last_heard_ns_.find({comm_id, i});
+        if (it != last_heard_ns_.end() && it->second >= start_ns)
+          alive |= 1ull << i;
+      }
+    }
+    if (alive == want || steady_clock::now() >= deadline) break;
+    std::this_thread::sleep_for(microseconds(500));
+  }
+  return alive;
 }
 
 // Shared landing for one-sided writes (wire ingress AND direct p2p).
@@ -513,6 +871,16 @@ uint8_t* Engine::raw_mem(uint64_t addr, uint64_t bytes) {
 
 void Engine::land_p2p(const WireHeader& hdr, const uint8_t* payload,
                       uint64_t payload_bytes) {
+  // same gates as wire ingress: a killed rank hears nothing, and
+  // dead-epoch traffic is fenced (the posted-record requirement below
+  // already drops writes for torn-down calls; this keeps the two
+  // ingress paths gate-for-gate identical)
+  if (killed_.load()) return;
+  if (hdr.comm_id < kMaxComms &&
+      hdr.epoch != comm_epoch_[hdr.comm_id].load()) {
+    fenced_drops_.fetch_add(1);
+    return;
+  }
   land_one_sided(hdr, payload, payload_bytes);
 }
 
@@ -539,6 +907,24 @@ void Engine::loop() {
 
     if (c.first_try_ns == 0)
       retry_idle_sweeps_ = 0;  // new call admitted: reset retry pacing
+
+    // abort fence: a call on an aborted communicator finalizes fast with
+    // the abort's error bits — whether it was freshly admitted or came
+    // back through the retry queue (this is what wakes a rendezvous
+    // blocked on a dead peer within one retry sweep).  Config/Nop stay
+    // executable: bring-up and soft reset must work on any comm state.
+    if (c.scenario() != Op::Config && c.scenario() != Op::Nop) {
+      uint32_t ab = abort_err(c.comm());
+      if (ab) {
+        teardown_call(c);
+        std::lock_guard<std::mutex> g(results_mu_);
+        auto& r = results_[c.id];
+        r.retcode = ab;
+        r.duration_ns = 0.0;
+        r.done = true;
+        continue;
+      }
+    }
 
     auto t0 = steady_clock::now();
     if (c.first_try_ns == 0)
@@ -574,34 +960,7 @@ void Engine::loop() {
                         .count() -
                     int64_t(c.first_try_ns);
       if (waited > timeout_budget().count()) {
-        // tear down the call's rendezvous protocol state: erase the
-        // landing records it advertised (a late one-sided write must
-        // NOT land into memory about to be reused) and drain any
-        // completions already surfaced for them (a future call reusing
-        // the address must not see a stale success).  posted_mu_ is held
-        // across BOTH so a landing racing with expiry either completes
-        // fully before the drain (ingress holds the same lock through
-        // consume-write-complete) or finds no record and drops; the
-        // drain matches the exact posted vaddr so a concurrent healthy
-        // call's completion on the same (comm, src, tag) survives.
-        {
-          std::lock_guard<std::mutex> g(posted_mu_);
-          for (const auto& k : c.rndzv_posts) {
-            posted_.erase(PostedKey{uint32_t(k[0]), uint32_t(k[1]),
-                                    uint32_t(k[2]), k[3]});
-            while (completions_.pop_match(
-                [&](const RndzvDone& d) {
-                  return d.comm == uint32_t(k[0]) &&
-                         d.src == uint32_t(k[1]) &&
-                         d.tag == uint32_t(k[2]) && d.vaddr == k[3];
-                },
-                nanoseconds(0))) {
-            }
-          }
-        }
-        // release scratch leases the retries kept alive
-        if (c.scratch0) { free_addr(c.scratch0); c.scratch0 = 0; }
-        if (c.scratch1) { free_addr(c.scratch1); c.scratch1 = 0; }
+        teardown_call(c);
         std::lock_guard<std::mutex> g(results_mu_);
         auto& r = results_[c.id];
         r.retcode = sticky_err_ | RECEIVE_TIMEOUT_ERROR;
@@ -627,6 +986,36 @@ void Engine::loop() {
       }
     }
   }
+}
+
+// Tear down one call's rendezvous protocol state + scratch leases —
+// shared by retry-budget expiry and abort finalization: erase the
+// landing records it advertised (a late one-sided write must NOT land
+// into memory about to be reused) and drain any completions already
+// surfaced for them (a future call reusing the address must not see a
+// stale success).  posted_mu_ is held across BOTH so a landing racing
+// with teardown either completes fully before the drain (ingress holds
+// the same lock through consume-write-complete) or finds no record and
+// drops; the drain matches the exact posted vaddr so a concurrent
+// healthy call's completion on the same (comm, src, tag) survives.
+void Engine::teardown_call(CallDesc& c) {
+  {
+    std::lock_guard<std::mutex> g(posted_mu_);
+    for (const auto& k : c.rndzv_posts) {
+      posted_.erase(PostedKey{uint32_t(k[0]), uint32_t(k[1]),
+                              uint32_t(k[2]), k[3]});
+      while (completions_.pop_match(
+          [&](const RndzvDone& d) {
+            return d.comm == uint32_t(k[0]) && d.src == uint32_t(k[1]) &&
+                   d.tag == uint32_t(k[2]) && d.vaddr == k[3];
+          },
+          nanoseconds(0))) {
+      }
+    }
+  }
+  // release scratch leases the retries kept alive
+  if (c.scratch0) { free_addr(c.scratch0); c.scratch0 = 0; }
+  if (c.scratch1) { free_addr(c.scratch1); c.scratch1 = 0; }
 }
 
 void Engine::set_tuning(uint32_t key, uint32_t value) {
@@ -1098,8 +1487,81 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
     msg.hdr.dst_session = uint16_t(t.rows[dst].session);
     msg.hdr.msg_type = uint8_t(MsgType::EgrMsg);
     msg.hdr.comm_id = c.comm();
+    msg.hdr.epoch = epoch_of(c.comm());
+    // retransmission lane: capture the clean copy BEFORE the chaos
+    // funnel (the wire may drop/corrupt it; the source data survives).
+    // Stream-destined messages bypass the rx pool and its NACK
+    // machinery, so only pool-routed segments are stored.
+    if (to_strm < FIRST_KRNL_STREAM && retrans_enabled())
+      store_retrans(c.comm(), dst, msg);
     send_out(t.rows[dst].session, std::move(msg));
     off += chunk;
+  }
+}
+
+// Seek with recovery: the receive budget is sliced so (a) an abort
+// wakes a blocked receiver within one slice instead of after the whole
+// budget, and (b) with retransmission enabled a miss NACKs the sender
+// and backs off exponentially (base ACCL_RETRY_BASE_US, deterministic
+// jitter from (rank, seqn, attempt)) up to ACCL_RETRY_MAX rounds.  The
+// TOTAL budget is unchanged: a peer that never sent anything still
+// classifies exactly like today, on the same clock.
+std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
+                                                   uint32_t tag,
+                                                   int* evicted_out) {
+  CommTable& t = comms_[c.comm()];
+  auto budget = timeout_budget();
+  auto deadline = steady_clock::now() + budget;
+  uint32_t retry_max = retrans_enabled() ? retry_max_.load() : 0;
+  uint32_t attempts = 0;  // fast-phase NACK rounds consumed
+  uint32_t chunks = 0;    // steady-state 50 ms slices elapsed
+  for (;;) {
+    uint32_t ab = abort_err(c.comm());
+    if (ab) {
+      sticky_err_ |= ab;
+      return std::nullopt;
+    }
+    uint32_t expect = t.inbound_seq[src];
+    auto now = steady_clock::now();
+    if (now >= deadline) return std::nullopt;
+    nanoseconds slice;
+    bool fast_phase = attempts < retry_max;
+    if (fast_phase) {
+      // exponential backoff with deterministic jitter: reproducible
+      // under a seeded chaos plan, decorrelated across ranks/seqns
+      uint64_t base = retry_base_us_.load();
+      uint64_t us = base << attempts;
+      uint64_t j = (uint64_t(global_rank_ + 1) * 2654435761u) ^
+                   (uint64_t(expect + 1) * 40503u) ^ attempts;
+      us += j % (base / 2 + 1);
+      slice = std::min<nanoseconds>(microseconds(us), deadline - now);
+    } else {
+      // fast phase exhausted (or lane disabled): 50 ms slices keep the
+      // abort-wake latency bounded for the rest of the budget
+      slice = std::min<nanoseconds>(milliseconds(50), deadline - now);
+    }
+    auto note = rx_.seek(c.comm(), src, tag, expect, slice);
+    if (note) return note;
+    // Solicit a retransmission: the fast phase NACKs after every miss
+    // (µs-scale recovery for a drop that already happened); afterwards
+    // a steady-state NACK every ~200 ms covers a segment dropped LATER
+    // than the fast phase — e.g. a slow sender whose first message hit
+    // the chaos funnel after our backoff rounds were spent.  Without
+    // the steady phase, recovery would race sender start time.
+    bool steady_nack = retry_max > 0 && !fast_phase && (++chunks % 4 == 0);
+    if ((fast_phase && retry_max) || steady_nack) {
+      // a same-route entry sitting in the pool while the expected seqn
+      // is missing is untrustworthy once a wire fault is in play (a
+      // corrupt-seqn copy must never be consumable as future data):
+      // evict the route — anything legitimate comes back with the
+      // retransmission the NACK is about to trigger
+      if (rx_.has_route_entry(c.comm(), src, tag)) {
+        int n = rx_.evict_route(c.comm(), src, tag);
+        if (evicted_out) *evicted_out += n;
+      }
+      send_nack(c.comm(), src, tag, expect);
+      if (fast_phase) ++attempts;
+    }
   }
 }
 
@@ -1122,9 +1584,12 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
   while (off < elems || (first && elems == 0)) {
     first = false;
     uint64_t chunk = std::min(seg_elems, elems - off);
-    auto note = rx_.seek(c.comm(), src, tag, t.inbound_seq[src],
-                         timeout_budget());
+    int evicted_in_recovery = 0;
+    auto note = seek_recover(c, src, tag, &evicted_in_recovery);
     if (!note) {
+      // abort-wake: seek_recover already stamped the abort bits; this
+      // call is fenced, not timed out — no fault classification
+      if (sticky_err_ & COMM_ABORTED) return;
       // distinguish "nothing arrived" from "a segment with the wrong
       // sequence number is sitting in the pool" (out-of-order /
       // corrupted wire traffic — the reference's PACK_SEQ error class).
@@ -1133,8 +1598,10 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
       // stay queued — they may legally match a recv posted later in a
       // different tag order — but their presence on this route still
       // classifies the failure as a sequence error, not a bare timeout.
+      // Entries the NACK recovery evicted count the same way: they WERE
+      // on the route when the expected seqn went missing.
       int stale = rx_.drop_stale(c.comm(), src, tag, t.inbound_seq[src] - 1);
-      bool mismatched = stale > 0 ||
+      bool mismatched = stale > 0 || evicted_in_recovery > 0 ||
                         rx_.has_route_entry(c.comm(), src, tag);
       // reclamation bound: if the pool is exhausted, the broken route's
       // pinned segments would starve every other route (deposit() parks
@@ -1251,6 +1718,7 @@ void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
     msg.hdr.vaddr = addr;
     msg.hdr.msg_type = uint8_t(MsgType::RndzvsInit);
     msg.hdr.comm_id = c.comm();
+    msg.hdr.epoch = epoch_of(c.comm());
     send_out(t.rows[src].session, std::move(msg));
   }
   p.done();
@@ -1314,7 +1782,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
     // for an unrelated later message) by the wire bypass — faulted sends
     // take the wire path where send_out applies the injection
     if (peer_hook_ && !d.eth && !src_c && !(addr & HOST_ADDR_BIT) &&
-        fault_.load() == 0) {
+        fault_.load() == 0 && !killed_.load()) {
       Engine* peer = peer_hook_(t.rows[dst].session);
       uint64_t nbytes = elems * d.ub;
       if (peer && peer != this && peer->p2p_covers(a->vaddr, nbytes)) {
@@ -1331,6 +1799,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
           hdr.vaddr = a->vaddr;
           hdr.msg_type = uint8_t(MsgType::RndzvsMsg);
           hdr.comm_id = c.comm();
+          hdr.epoch = epoch_of(c.comm());
           hdr.compressed = 0;
           peer->land_p2p(hdr, pdata, nbytes);
           p.done();
@@ -1344,6 +1813,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
     msg.hdr.vaddr = a->vaddr;
     msg.hdr.msg_type = uint8_t(MsgType::RndzvsMsg);
     msg.hdr.comm_id = c.comm();
+    msg.hdr.epoch = epoch_of(c.comm());
     {
       // convert the operand into OUR wire representation (own arithcfg +
       // ETH flag, same rule as eager); the receiver's depacketizer

@@ -99,11 +99,67 @@ class Engine {
   uint32_t rank() const { return global_rank_; }
 
   // ---- fault injection (test harness; SURVEY §5 failure detection) ----
-  // Applied to the NEXT egress message only: 1=drop, 2=duplicate,
-  // 3=corrupt sequence number.  Exercises the detection machinery
-  // (seqn discipline, receive timeout, retry) the way the reference's
-  // segmentation edge tests probe its engines.
+  // Forces the chaos funnel's NEXT egress draw: 1=drop, 2=duplicate,
+  // 3=corrupt sequence number, 4=delay.  One-shot sugar over the seeded
+  // chaos plan below — both resolve in the same send_out switch, so the
+  // detection/recovery machinery (seqn discipline, receive timeout,
+  // NACK retransmission) is exercised identically either way.
   void inject_fault(uint32_t kind) { fault_.store(kind); }
+
+  // ---- resilience: retransmission + abort/epoch + liveness + chaos ----
+  // Eager retransmission config: on a seek miss the receiver NACKs the
+  // sender and retries with exponential backoff + deterministic jitter,
+  // up to retry_max NACK rounds inside the unchanged receive budget
+  // (ACCL_RETRY_MAX / ACCL_RETRY_BASE_US on the driver side).
+  // retry_max = 0 disables the whole lane (no store, no NACKs) and
+  // restores the pure detect-and-classify behavior.
+  void set_resilience(uint32_t retry_max, uint32_t retry_base_us) {
+    retry_max_.store(retry_max);
+    if (retry_base_us) retry_base_us_.store(retry_base_us);
+  }
+  void resilience_stats(uint64_t* retrans_sent, uint64_t* nacks_tx,
+                        uint64_t* nacks_rx, uint64_t* fenced_drops) const {
+    if (retrans_sent) *retrans_sent = retrans_sent_.load();
+    if (nacks_tx) *nacks_tx = nacks_tx_.load();
+    if (nacks_rx) *nacks_rx = nacks_rx_.load();
+    if (fenced_drops) *fenced_drops = fenced_drops_.load();
+  }
+
+  // Epoch-tagged communicator abort: bump the epoch, mark the comm
+  // aborted with `err_bits` (COMM_ABORTED is always OR'd in), finalize
+  // every pending call on it fast, and — when propagate — send an Abort
+  // control message to every peer so THEIR pending calls fail fast too.
+  // Returns 0, or -1 for an unknown comm id.
+  int abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate);
+
+  // Seqn resync + transient-state drain after a CLASSIFIED fault: zero
+  // both directions' sequence counters, drain the rx pool and the
+  // retransmit store, clear armed one-shot faults and abort flags
+  // (epochs stay bumped — old-epoch stragglers remain fenced).  A
+  // collective recovery op: every rank of a quiesced world must call it.
+  void reset_errors();
+
+  // Chaos plan (seeded, probabilistic, dataplane-targeted): each eager
+  // egress segment draws drop/dup/delay/corrupt with the given
+  // per-million probabilities from a deterministic xorshift stream;
+  // slow_us stalls this rank's egress writer per message (slow-rank).
+  void set_chaos(uint64_t seed, uint32_t drop_ppm, uint32_t dup_ppm,
+                 uint32_t delay_ppm, uint32_t delay_us,
+                 uint32_t corrupt_ppm, uint32_t slow_us);
+
+  // Kill this rank (chaos kill-rank): the engine goes silent — egress
+  // drops everything, ingress hears nothing — and every local comm is
+  // aborted with RANK_FAILED so the rank's own pending calls finalize
+  // fast instead of burning their receive budget.
+  void kill();
+  bool is_killed() const { return killed_.load(); }
+
+  // Liveness probe over one communicator: ping every peer with a
+  // Heartbeat and collect proof-of-life (a pong, or any control-plane
+  // traffic — NACK/abort ingress also stamps last-heard; the data hot
+  // path deliberately does not) for up to window_us.  Returns a bitmap
+  // of alive comm-local ranks (the local rank is always alive).
+  uint64_t probe_liveness(uint32_t comm_id, uint32_t window_us);
 
   // Lossy-transport mode (set by datagram worlds): a seek timeout with
   // the expected seqn absent but later seqns queued is treated as an
@@ -344,6 +400,91 @@ class Engine {
   std::atomic<uint32_t> fault_{0};
   //: egress funnel applying any injected fault before the transport
   void send_out(uint32_t session, Message&& msg);
+
+  // ---- retransmission lane (resilience layer 1) ----
+  // Bounded ring of sent eager segments keyed by (comm, dst comm-local
+  // rank, tag, seqn); the clean copy is captured BEFORE the chaos
+  // funnel, modeling a real sender whose source data survives a wire
+  // fault.  A NACK for (comm, tag, seqn) resends every stored segment
+  // on the route from that seqn on (one round recovers a multi-segment
+  // hole).  Retransmits bypass the chaos funnel — the recovery path
+  // stays deterministic under seeded chaos.
+  // Hot-path discipline: the no-fault cost per segment is ONE payload
+  // copy into a RECYCLED slot (vector::assign reuses capacity — zero
+  // steady-state allocation) under an uncontended mutex; there is no
+  // index structure to churn.  The NACK handler pays a linear ring
+  // scan instead — it only runs on the fault path.
+  struct RetransSlot {
+    bool used = false;
+    uint32_t comm = 0, dst = 0;
+    Message msg;
+  };
+  static constexpr size_t kRetransCap = 1024;
+  std::vector<RetransSlot> retrans_ring_;
+  size_t retrans_pos_ = 0;
+  std::mutex retrans_mu_;
+  std::atomic<uint32_t> retry_max_{4};
+  std::atomic<uint32_t> retry_base_us_{200};
+  std::atomic<uint64_t> retrans_sent_{0}, nacks_tx_{0}, nacks_rx_{0};
+  std::atomic<uint64_t> fenced_drops_{0};
+  bool retrans_enabled() const {
+    return retry_max_.load() > 0 && !lossy_transport_.load();
+  }
+  void store_retrans(uint32_t comm, uint32_t dst, const Message& msg);
+  void send_nack(uint32_t comm, uint32_t src, uint32_t tag, uint32_t seqn);
+  void handle_nack(const WireHeader& hdr);
+  // Seek with recovery: slices the receive budget so an abort wakes a
+  // blocked receiver promptly, and (retransmission on) NACKs the sender
+  // with exponential backoff + deterministic jitter on each miss.
+  // `evicted_out` counts suspicious same-route entries evicted during
+  // recovery (they classify a final failure as PACK_SEQ, like the
+  // entries themselves would have).
+  std::optional<RxNotification> seek_recover(CallDesc& c, uint32_t src,
+                                             uint32_t tag, int* evicted_out);
+
+  // ---- abort + epoch fencing (resilience layer 2) ----
+  static constexpr uint32_t kMaxComms = 64;  // comms_.reserve(64) twin
+  std::array<std::atomic<uint32_t>, kMaxComms> comm_epoch_{};
+  std::array<std::atomic<uint32_t>, kMaxComms> comm_abort_{};
+  uint32_t epoch_of(uint32_t comm) const {
+    return comm < kMaxComms ? comm_epoch_[comm].load() : 0;
+  }
+  uint32_t abort_err(uint32_t comm) const {
+    return comm < kMaxComms ? comm_abort_[comm].load() : 0;
+  }
+  // rendezvous/scratch teardown shared by retry expiry and abort
+  void teardown_call(CallDesc& c);
+  void handle_abort(const WireHeader& hdr);
+
+  // ---- liveness (resilience layer 3) ----
+  mutable std::mutex live_mu_;
+  std::map<std::pair<uint32_t, uint32_t>, uint64_t> last_heard_ns_;
+  void note_alive(uint32_t comm, uint32_t src);
+
+  // ---- seeded chaos (generalized injector) ----
+  struct Chaos {
+    bool armed = false;
+    uint32_t drop_ppm = 0, dup_ppm = 0, delay_ppm = 0, delay_us = 0;
+    uint32_t corrupt_ppm = 0;
+    uint64_t rng = 0x9E3779B97F4A7C15ull;
+  };
+  Chaos chaos_;
+  std::mutex chaos_mu_;
+  std::atomic<uint32_t> slow_us_{0};
+  std::atomic<bool> killed_{false};
+  uint32_t chaos_draw();  // fault kind for this message (0 = none)
+  // delayed-egress releaser (chaos delay = real reordering, not a stall)
+  struct Delayed {
+    std::chrono::steady_clock::time_point release;
+    uint32_t session;
+    Message msg;
+  };
+  std::deque<Delayed> delayed_;
+  std::mutex delay_mu_;
+  std::condition_variable delay_cv_;
+  bool delay_running_ = true;  // guarded by delay_mu_
+  std::thread delay_thread_;
+  void delay_loop();
 
   // ---- egress pipeline: bounded outstanding-segment window ----
   // The engine loop stages each prepared segment here and immediately

@@ -10,6 +10,8 @@
 // TCP/RDMA stacks when no spare buffer is free.
 #pragma once
 
+#include <algorithm>
+
 #include "common.hpp"
 #include "transport.hpp"
 
@@ -148,6 +150,38 @@ class RxPool {
       release(n->index);
       ++evicted;
     }
+  }
+
+  // Evict EVERY queued entry belonging to one communicator (any src,
+  // any tag, any seqn) — abort/epoch-bump reclamation: once a comm is
+  // fenced, nothing queued on it can legally match a future seek, and
+  // pinned buffers must return to the pool.  Returns the number evicted.
+  int evict_comm(uint32_t comm) {
+    int evicted = 0;
+    for (;;) {
+      auto n = notif_.pop_match(
+          [=](const RxNotification& x) { return x.comm == comm; },
+          std::chrono::nanoseconds(0));
+      if (!n) return evicted;
+      release(n->index);
+      ++evicted;
+    }
+  }
+
+  // Drain everything transient: queued notifications, reserved buffers,
+  // staged overflow (reset_errors seqn-resync support — the pool starts
+  // from a clean slate, matching the zeroed sequence counters).
+  void clear_pending() {
+    for (;;) {
+      auto n = notif_.pop_match(
+          [](const RxNotification&) { return true; },
+          std::chrono::nanoseconds(0));
+      if (!n) break;
+      release(n->index);
+    }
+    std::lock_guard<std::mutex> g(m_);
+    staging_.clear();
+    std::fill(status_.begin(), status_.end(), Status::IDLE);
   }
 
   // Is at least one buffer IDLE right now?  (pressure probe)

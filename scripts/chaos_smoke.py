@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""CI chaos smoke: prove the fault-tolerance stack end to end.
+
+Two drills (the acceptance criteria of the resilience layer,
+docs/fault_tolerance.md):
+
+1. **Retransmission under seeded chaos** — a 4-rank emu allreduce loop
+   under probabilistic drop/dup/delay (fixed seed, so a failure replays
+   bit-for-bit) must produce results BITWISE IDENTICAL to the same
+   loop on a clean world: every lost/duplicated/reordered segment is
+   healed by the NACK lane inside the receive budget.  The engine's
+   recovery counters must show the lane actually worked.
+
+2. **Kill -> abort -> shrink -> finish** — mid-loop, one rank is
+   killed.  Every survivor classifies the failure on its own clock,
+   revokes the communicator (``ACCL.abort`` — the propagated abort
+   wakes slower ranks immediately, no watchdog-timeout exit path),
+   agrees on the surviving set (``shrink_communicator``), and finishes
+   the loop on the 3-rank communicator with bitwise-correct results.
+
+Artifacts (uploaded by CI next to the hang smoke): the merged flight
+dump after the kill drill (rank 3's records must show ``aborted``/
+``failed`` terminal states, no in-flight stragglers) and the per-rank
+resilience counters.
+
+Usage: python scripts/chaos_smoke.py [--ranks N] [--count N]
+       [--iters N] [--seed N] [--dump PATH] [--stats PATH]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _clean_reference(nranks, count, iters, make_data):
+    """The same loop on a fault-free world: the bitwise oracle."""
+    import numpy as np
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.backends.emu import EmuWorld
+
+    with EmuWorld(nranks) as world:
+        def fn(accl, rank):
+            outs = []
+            for it in range(iters):
+                s = accl.create_buffer_like(make_data(rank, it))
+                r = accl.create_buffer(count, np.float32)
+                accl.allreduce(s, r, count, ReduceFunction.SUM)
+                outs.append(r.host.copy())
+            return outs
+
+        return world.run(fn)[0]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--count", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=2026)
+    ap.add_argument("--dump", default="chaos_flight_dump.json")
+    ap.add_argument("--stats", default="chaos_stats.json")
+    args = ap.parse_args()
+
+    # generous engine budget: recovery must win long before a timeout
+    os.environ.setdefault("ACCL_DEFAULT_TIMEOUT", "30000000")
+
+    import numpy as np
+
+    from accl_tpu import ACCLError, ErrorCode, ReduceFunction
+    from accl_tpu.backends.emu import EmuWorld
+    from accl_tpu.observability import flight as obs_flight
+
+    def make_data(rank, it):
+        rng = np.random.default_rng(1000 * rank + it)
+        return rng.standard_normal(args.count).astype(np.float32)
+
+    # ---- drill 1: seeded drop/dup/delay, bitwise via retransmission --
+    plan = (f"seed={args.seed},drop=0.02,dup=0.02,delay=0.03,"
+            f"delay_us=2000")
+    reference = _clean_reference(args.ranks, args.count, args.iters,
+                                 make_data)
+    with EmuWorld(args.ranks, chaos=plan) as world:
+        def loop(accl, rank):
+            outs = []
+            for it in range(args.iters):
+                s = accl.create_buffer_like(make_data(rank, it))
+                r = accl.create_buffer(args.count, np.float32)
+                accl.allreduce(s, r, args.count, ReduceFunction.SUM)
+                outs.append(r.host.copy())
+            return outs
+
+        chaos_outs = world.run(loop)
+        stats1 = world.resilience_stats()
+
+    for rank in range(args.ranks):
+        for it in range(args.iters):
+            if not np.array_equal(chaos_outs[rank][it], reference[it]):
+                print(f"FAIL: drill 1 rank {rank} iter {it} diverged "
+                      f"from the clean-world reference (not bitwise)")
+                return 1
+    recovered = sum(s["retrans_sent"] for s in stats1)
+    nacks = sum(s["nacks_tx"] for s in stats1)
+    if recovered < 1 or nacks < 1:
+        print(f"FAIL: chaos plan {plan!r} never exercised the "
+              f"retransmission lane (retrans={recovered}, nacks={nacks})")
+        return 1
+    print(f"drill 1 OK: {args.iters} allreduce iters x {args.ranks} "
+          f"ranks bitwise-correct under {plan!r} "
+          f"(retransmits={recovered}, nacks={nacks})")
+
+    # ---- drill 2: mid-run kill -> abort -> shrink -> finish ----------
+    # ULFM recovery, the real shape: survivors may be aborted at
+    # DIFFERENT iterations (a lagging rank's in-flight call is revoked
+    # too), so after the shrink they AGREE on the restart point — an
+    # allreduce(MAX) of each survivor's negated first-incomplete
+    # iteration on the fresh comm — discard anything at/after it, and
+    # redo from there, keeping every gang aligned.
+    kill_at = args.iters // 2
+    victim = args.ranks - 1
+    survivors = args.ranks - 1
+    ref3 = _clean_reference(survivors, args.count, args.iters, make_data)
+    with EmuWorld(args.ranks) as world:
+        for a in world.accls:
+            a.set_timeout(3_000_000)  # 3 s classification clock
+
+        def loop2(accl, rank):
+            comm_id = 0
+            outs = {}
+            restart = None
+            it = 0
+            while it < args.iters:
+                if rank == victim and it == kill_at:
+                    world.kill_rank(victim)  # the engine goes silent
+                s = accl.create_buffer_like(make_data(rank, it))
+                r = accl.create_buffer(args.count, np.float32)
+                try:
+                    accl.allreduce(s, r, args.count, ReduceFunction.SUM,
+                                   comm_id=comm_id)
+                    outs[it] = r.host.copy()
+                    it += 1
+                except ACCLError as e:
+                    if rank == victim:
+                        return ("dead", it, int(e.code))
+                    # classify -> revoke -> shrink -> agree -> redo
+                    assert restart is None, "second failure after shrink"
+                    accl.abort(comm_id,
+                               error=int(ErrorCode.RANK_FAILED))
+                    comm_id = accl.shrink_communicator(comm_id,
+                                                       window_s=2.0)
+                    if accl.communicator(comm_id).size != survivors:
+                        raise AssertionError(
+                            f"shrink produced size "
+                            f"{accl.communicator(comm_id).size}, "
+                            f"wanted {survivors}")
+                    sb = accl.create_buffer_like(
+                        np.array([-it], np.float32))
+                    rb = accl.create_buffer(1, np.float32)
+                    accl.allreduce(sb, rb, 1, ReduceFunction.MAX,
+                                   comm_id=comm_id)
+                    restart = int(-rb.host[0])  # MIN over survivors
+                    for k in range(restart, it):
+                        outs.pop(k, None)
+                    it = restart
+            return ("alive", outs, restart, comm_id)
+
+        t0 = time.time()
+        results = world.run(loop2)
+        drill2_s = time.time() - t0
+        merged = obs_flight.merge_flight_dumps(
+            [a.flight_recorder.dump() for a in world.accls],
+            out_path=args.dump)
+        stats2 = world.resilience_stats()
+
+    # the victim died with a classified abort, not a silent hang
+    dead = results[victim]
+    if dead[0] != "dead" or not (dead[2] & int(ErrorCode.COMM_ABORTED)):
+        print(f"FAIL: victim rank {victim} did not die aborted: {dead}")
+        return 1
+    # every survivor aborted, agreed on one restart point, and finished
+    # ALL iterations; pre-restart results are bitwise vs the 4-rank
+    # reference, the rest bitwise vs the 3-rank reference
+    restarts = {results[r][2] for r in range(survivors)}
+    comms = {results[r][3] for r in range(survivors)}
+    if len(restarts) != 1 or None in restarts or len(comms) != 1:
+        print(f"FAIL: survivors disagreed: restarts={restarts} "
+              f"comms={comms}")
+        return 1
+    restart = restarts.pop()
+    if restart > kill_at:
+        print(f"FAIL: restart {restart} is past the kill at {kill_at}")
+        return 1
+    for rank in range(survivors):
+        state, outs, _, _ = results[rank]
+        if state != "alive" or sorted(outs) != list(range(args.iters)):
+            print(f"FAIL: survivor {rank} state={state} iters="
+                  f"{sorted(outs)}")
+            return 1
+        for it in range(args.iters):
+            expected = (reference[it] if it < restart else ref3[it])
+            if not np.array_equal(outs[it], expected):
+                print(f"FAIL: drill 2 rank {rank} iter {it} not bitwise "
+                      f"vs the {'4' if it < restart else '3'}-rank "
+                      f"reference")
+                return 1
+    # no watchdog-timeout exit path: the whole drill rides the abort
+    # clock (3 s classification + abort wake + shrink window), never a
+    # watchdog or driver-wait expiry
+    if drill2_s > 25.0:
+        print(f"FAIL: drill 2 took {drill2_s:.1f}s — recovery leaned on "
+              f"a timeout path, not the abort clock")
+        return 1
+    # the merged flight dump is the artifact: no in-flight stragglers
+    hangs = merged["analysis"]["hangs"]
+    if hangs:
+        print(f"FAIL: flight analysis reports hangs after recovery: "
+              f"{hangs}")
+        return 1
+
+    with open(args.stats, "w") as f:
+        json.dump({"drill1": {"plan": plan, "per_rank": stats1,
+                              "retransmits": recovered, "nacks": nacks},
+                   "drill2": {"victim": victim, "kill_at_iter": kill_at,
+                              "wall_s": round(drill2_s, 2),
+                              "per_rank": stats2}}, f, indent=1)
+    print(f"drill 2 OK: rank {victim} killed at iter {kill_at}; "
+          f"survivors aborted (RANK_FAILED), shrank to {survivors} "
+          f"ranks, finished bitwise in {drill2_s:.1f}s; "
+          f"dump={args.dump} stats={args.stats}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

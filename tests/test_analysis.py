@@ -526,6 +526,45 @@ def test_sanitizer_abort_retires_flight_record(sanitize, emu_world):
     emu_world.run(fn)
 
 
+def test_comm_abort_retires_flight_record_like_sanitizer(emu_world):
+    """COMM_ABORTED is handled exactly like SANITIZER_ABORT_ERROR by
+    the observability stack: an abort-finalized call's flight record is
+    TERMINAL ("aborted"), leaves the watchdog's in-flight scan, and the
+    merged cross-rank analysis reports no phantom hang while the abort
+    propagates (the r10 abort/epoch satellite)."""
+    import time
+
+    from accl_tpu.constants import ErrorCode, error_code_to_str
+    from accl_tpu.observability import flight as obs_flight
+
+    reqs = {}
+
+    def issue(a, r):
+        if r == 1:
+            d = a.create_buffer(64, np.float32)
+            reqs[r] = a.recv(d, 64, 0, tag=77, run_async=True)
+        return None
+
+    emu_world.run(issue)
+    time.sleep(0.1)
+    emu_world.accls[0].abort(0)
+    assert reqs[1].wait(30.0)
+    rec = reqs[1].flight
+    assert rec is not None and not rec.in_flight
+    assert obs_flight.STATE_NAMES[rec.state] == "aborted"
+    assert "COMM_ABORTED" in error_code_to_str(rec.retcode)
+    # no phantom hang anywhere in the merged analysis during/after the
+    # abort — aborted records are terminal for the hang scanner
+    merged = obs_flight.merge_flight_dumps(
+        [a.flight_recorder.dump() for a in emu_world.accls])
+    assert merged["analysis"]["hangs"] == []
+    # the world must stay usable for the remaining sanitizer tests
+    # sharing this fixture (abort fencing cleared by reset_errors)
+    for a in emu_world.accls:
+        a.reset_errors()
+    assert int(ErrorCode.COMM_ABORTED) != int(ErrorCode.RANK_FAILED)
+
+
 def test_shadow_capture_session(emu_world):
     from accl_tpu.analysis.sanitizer import CaptureSession
 

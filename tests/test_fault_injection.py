@@ -5,11 +5,21 @@ numbers checked at seek time, sticky error codes, and receive timeouts —
 but ships no fault injector.  This harness injects one-shot egress
 faults (drop / duplicate / seqn corruption) and asserts the detection
 paths fire with the right error class.
+
+The worlds here run with the retransmission lane OFF (``retry_max=0``):
+these tests pin the DETECTION contract — which error class each fault
+surfaces as.  With the lane on (the default), the same faults heal
+transparently; that recovery matrix lives in tests/test_resilience.py.
+
+The world is module-scoped and REUSED across tests: classified faults
+no longer poison it permanently — ``reset_errors()`` resynchronizes the
+sequence state after each test (the r10 recovery satellite), and
+``test_world_reusable_after_classified_fault`` pins exactly that.
 """
 import numpy as np
 import pytest
 
-from accl_tpu import ACCLError
+from accl_tpu import ACCLError, ReduceFunction
 from accl_tpu.backends.emu import EmuDevice, EmuWorld
 from accl_tpu.constants import ErrorCode
 
@@ -17,12 +27,21 @@ NRANKS = 2
 COUNT = 64
 
 
-@pytest.fixture()
-def world():
-    # function-scoped: faults poison comm state (seqn skew), so each
-    # test gets a fresh world
-    with EmuWorld(NRANKS) as w:
+@pytest.fixture(scope="module")
+def _world():
+    # retransmission off: detection semantics (error classes), not
+    # recovery, are under test here
+    with EmuWorld(NRANKS, retry_max=0) as w:
         yield w
+
+
+@pytest.fixture()
+def world(_world):
+    # module-world reuse: a classified fault skews seqn state, so every
+    # test hands the world back resynchronized (ACCL.reset_errors —
+    # zeroed seqn counters both directions, drained pools/stores)
+    yield _world
+    _world.reset_errors()
 
 
 def _data(count, salt=0):
@@ -142,6 +161,37 @@ def test_seq_error_classified_and_other_routes_survive(world):
     world.run(fn)
 
 
+def test_world_reusable_after_classified_fault(world):
+    # the r10 recovery satellite: a classified fault + reset_errors
+    # leaves the world fully usable — the next collective succeeds with
+    # bitwise-correct results (no permanent seqn poisoning, which is
+    # what used to force function-scoped fixtures here)
+    def poison(accl, rank):
+        accl.set_timeout(1_000_000)
+        if rank == 0:
+            src = accl.create_buffer_like(_data(COUNT, salt=31))
+            accl.device.inject_fault(EmuDevice.FAULT_DROP)
+            accl.send(src, COUNT, 1, tag=41)  # vanishes; seqn burned
+        else:
+            dst = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError):
+                accl.recv(dst, COUNT, 0, tag=41)
+
+    world.run(poison)
+    world.reset_errors()  # collective resync on the quiesced world
+
+    def after(accl, rank):
+        s = accl.create_buffer_like(_data(COUNT, salt=rank))
+        r = accl.create_buffer(COUNT, np.float32)
+        accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+        return r.host.copy()
+
+    outs = world.run(after)
+    expected = _data(COUNT, salt=0) + _data(COUNT, salt=1)
+    for out in outs:
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
 def test_pool_exhaustion_reclaims_broken_route():
     # reclamation bound: when a corrupted stream's ahead-of-sequence
     # segments fill the whole pool, the sequence-error path must
@@ -149,7 +199,7 @@ def test_pool_exhaustion_reclaims_broken_route():
     import time
 
     from accl_tpu.backends.emu import EmuWorld as W
-    with W(NRANKS, n_egr_rx_bufs=4) as world:
+    with W(NRANKS, n_egr_rx_bufs=4, retry_max=0) as world:
         def fn(accl, rank):
             if rank == 0:
                 accl.device.inject_fault(EmuDevice.FAULT_CORRUPT_SEQ)

@@ -1,0 +1,485 @@
+"""Fault-tolerant collectives: retransmission, abort + epoch fencing,
+ULFM-style shrink, and the seeded chaos harness (accl_tpu/resilience).
+
+Complements tests/test_fault_injection.py: that file pins which error
+class each fault is DETECTED as (retransmission off); this one pins
+that the same faults are RECOVERED from (retransmission on — the
+default), that an abort wakes every blocked waiter fast, and that a
+dead rank is survivable via shrink + re-run.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, ChaosPlan, ReduceFunction, RetryPolicy
+from accl_tpu.backends.emu import EmuDevice, EmuWorld
+from accl_tpu.constants import ErrorCode
+from accl_tpu.observability import flight as obs_flight
+from accl_tpu.observability import health as obs_health
+
+COUNT = 32
+
+
+def _data(count, salt=0):
+    rng = np.random.default_rng(910 + salt)
+    return rng.standard_normal(count).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: NACK retransmission (one-shot faults heal transparently)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("fault", [
+    EmuDevice.FAULT_DROP, EmuDevice.FAULT_DUPLICATE,
+    EmuDevice.FAULT_CORRUPT_SEQ, EmuDevice.FAULT_DELAY,
+], ids=["drop", "dup", "corrupt", "delay"])
+def test_p2p_recovers_from_one_shot_fault(fault):
+    with EmuWorld(2) as world:
+        def fn(accl, rank):
+            accl.set_timeout(10_000_000)
+            if rank == 0:
+                a = accl.create_buffer_like(_data(COUNT, salt=1))
+                b = accl.create_buffer_like(_data(COUNT, salt=2))
+                accl.device.inject_fault(fault)
+                accl.send(a, COUNT, 1, tag=7)
+                accl.send(b, COUNT, 1, tag=8)  # post-fault stream stays clean
+            else:
+                da = accl.create_buffer(COUNT, np.float32)
+                db = accl.create_buffer(COUNT, np.float32)
+                accl.recv(da, COUNT, 0, tag=7)
+                accl.recv(db, COUNT, 0, tag=8)
+                np.testing.assert_array_equal(da.host, _data(COUNT, salt=1))
+                np.testing.assert_array_equal(db.host, _data(COUNT, salt=2))
+
+        world.run(fn)
+        # the recovery really went through the NACK lane (except dup,
+        # which seqn-dedup absorbs without soliciting a resend)
+        if fault in (EmuDevice.FAULT_DROP, EmuDevice.FAULT_CORRUPT_SEQ):
+            stats = world.resilience_stats()
+            assert sum(s["nacks_tx"] for s in stats) >= 1
+            assert sum(s["retrans_sent"] for s in stats) >= 1
+
+
+@pytest.mark.parametrize("fault", [
+    EmuDevice.FAULT_DROP, EmuDevice.FAULT_DUPLICATE,
+], ids=["drop", "dup"])
+def test_allreduce_recovers_from_one_shot_fault(fault):
+    with EmuWorld(2) as world:
+        def fn(accl, rank):
+            accl.set_timeout(10_000_000)
+            s = accl.create_buffer_like(_data(COUNT, salt=rank))
+            r = accl.create_buffer(COUNT, np.float32)
+            if rank == 0:
+                accl.device.inject_fault(fault)
+            accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            return r.host.copy()
+
+        outs = world.run(fn)
+        expected = _data(COUNT, salt=0) + _data(COUNT, salt=1)
+        for out in outs:
+            np.testing.assert_allclose(out, expected, rtol=1e-6, atol=1e-5)
+
+
+def test_wildcard_recv_recovers_dropped_tagged_send():
+    # regression: a TAG_ANY recv's NACK is a wildcard solicitation —
+    # it must resend the concretely-tagged segment it is waiting for
+    # (tag-exact NACK matching stranded this exact pairing)
+    with EmuWorld(2) as world:
+        def fn(accl, rank):
+            accl.set_timeout(10_000_000)
+            if rank == 0:
+                a = accl.create_buffer_like(_data(COUNT, salt=9))
+                accl.device.inject_fault(EmuDevice.FAULT_DROP)
+                accl.send(a, COUNT, 1, tag=5)  # concrete tag, dropped
+            else:
+                da = accl.create_buffer(COUNT, np.float32)
+                accl.recv(da, COUNT, 0)  # wildcard TAG_ANY recv
+                np.testing.assert_array_equal(da.host, _data(COUNT, salt=9))
+
+        world.run(fn)
+        assert sum(s["retrans_sent"]
+                   for s in world.resilience_stats()) >= 1
+
+
+def test_retry_disabled_restores_detection():
+    # retry_max=0 is the pure detect-and-classify contract
+    with EmuWorld(2, retry_max=0) as world:
+        def fn(accl, rank):
+            accl.set_timeout(1_000_000)
+            if rank == 0:
+                src = accl.create_buffer_like(_data(COUNT))
+                accl.device.inject_fault(EmuDevice.FAULT_DROP)
+                accl.send(src, COUNT, 1, tag=1)
+            else:
+                dst = accl.create_buffer(COUNT, np.float32)
+                with pytest.raises(ACCLError) as e:
+                    accl.recv(dst, COUNT, 0, tag=1)
+                assert e.value.code & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+
+        world.run(fn)
+
+
+def test_retry_policy_env(monkeypatch):
+    monkeypatch.setenv("ACCL_RETRY_MAX", "7")
+    monkeypatch.setenv("ACCL_RETRY_BASE_US", "333")
+    pol = RetryPolicy.from_env()
+    assert pol.max_retries == 7 and pol.base_us == 333 and pol.enabled
+    # backoff: exponential envelope, deterministic jitter
+    assert pol.backoff_us(3) >= 333 << 3
+    assert pol.backoff_us(2, rank=1, seqn=5) == pol.backoff_us(2, rank=1,
+                                                               seqn=5)
+    monkeypatch.setenv("ACCL_RETRY_MAX", "0")
+    assert not RetryPolicy.from_env().enabled
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos matrix: all collectives under probabilistic drop/dup/delay
+# ---------------------------------------------------------------------------
+def _run_collective_matrix(world, nranks):
+    """Every collective once, results asserted bitwise/allclose."""
+    def fn(accl, rank):
+        got = {}
+        s = accl.create_buffer_like(_data(COUNT, salt=rank))
+        r = accl.create_buffer(COUNT, np.float32)
+        big_s = accl.create_buffer_like(
+            np.concatenate([_data(COUNT, salt=100 * rank + i)
+                            for i in range(nranks)]))
+        big_r = accl.create_buffer(COUNT * nranks, np.float32)
+
+        # p2p ring: rank -> rank+1
+        nxt, prv = (rank + 1) % nranks, (rank - 1) % nranks
+        if rank % 2 == 0:
+            accl.send(s, COUNT, nxt, tag=50)
+            accl.recv(r, COUNT, prv, tag=50)
+        else:
+            accl.recv(r, COUNT, prv, tag=50)
+            accl.send(s, COUNT, nxt, tag=50)
+        got["sendrecv"] = r.host.copy()
+
+        accl.bcast(s if rank == 0 else r, COUNT, root=0)
+        got["bcast"] = (s if rank == 0 else r).host.copy()
+
+        accl.scatter(big_s, r, COUNT, root=0)
+        got["scatter"] = r.host.copy()
+        accl.gather(s, big_r, COUNT, root=0)
+        got["gather"] = big_r.host.copy() if rank == 0 else None
+        accl.allgather(s, big_r, COUNT)
+        got["allgather"] = big_r.host.copy()
+        accl.reduce(s, r, COUNT, root=0)
+        got["reduce"] = r.host.copy() if rank == 0 else None
+        accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+        got["allreduce"] = r.host.copy()
+        accl.reduce_scatter(big_s, r, COUNT, ReduceFunction.SUM)
+        got["reduce_scatter"] = r.host.copy()
+        accl.alltoall(big_s, big_r, COUNT)
+        got["alltoall"] = big_r.host.copy()
+        accl.barrier()
+        return got
+
+    outs = world.run(fn)
+    ranks = range(nranks)
+    srcs = [_data(COUNT, salt=r) for r in ranks]
+    bigs = [np.concatenate([_data(COUNT, salt=100 * r + i)
+                            for i in range(nranks)]) for r in ranks]
+    total = np.sum(srcs, axis=0)
+    for r in ranks:
+        np.testing.assert_array_equal(outs[r]["sendrecv"],
+                                      srcs[(r - 1) % nranks])
+        np.testing.assert_array_equal(outs[r]["bcast"], srcs[0])
+        np.testing.assert_array_equal(
+            outs[r]["scatter"], bigs[0][r * COUNT:(r + 1) * COUNT])
+        np.testing.assert_array_equal(outs[r]["allgather"],
+                                      np.concatenate(srcs))
+        np.testing.assert_allclose(outs[r]["allreduce"], total, rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(
+            outs[r]["reduce_scatter"],
+            np.sum([bigs[i][r * COUNT:(r + 1) * COUNT] for i in ranks],
+                   axis=0), rtol=1e-6, atol=1e-5)
+        np.testing.assert_array_equal(
+            outs[r]["alltoall"],
+            np.concatenate([bigs[i][r * COUNT:(r + 1) * COUNT]
+                            for i in ranks]))
+    np.testing.assert_array_equal(outs[0]["gather"], np.concatenate(srcs))
+    np.testing.assert_allclose(outs[0]["reduce"], total, rtol=1e-6, atol=1e-5)
+
+
+@pytest.mark.parametrize("plan", [
+    "seed=11,drop=0.05", "seed=12,dup=0.05",
+    "seed=13,delay=0.08,delay_us=3000",
+    "seed=14,drop=0.03,dup=0.03,delay=0.03,delay_us=2000",
+], ids=["drop", "dup", "delay", "mixed"])
+def test_chaos_matrix_bitwise_correct(plan):
+    # deterministic seeded chaos: every collective completes with
+    # correct results via the retransmission lane (fixed seeds => the
+    # fault schedule replays identically run after run)
+    nranks = 3
+    with EmuWorld(nranks, chaos=plan) as world:
+        for a in world.accls:
+            a.set_timeout(15_000_000)
+        _run_collective_matrix(world, nranks)
+        if "drop" in plan:
+            stats = world.resilience_stats()
+            assert sum(s["retrans_sent"] for s in stats) >= 1
+
+
+def test_chaos_plan_grammar():
+    plan = ChaosPlan.parse("seed=42,drop=0.01,dup=0.02,delay=0.03,"
+                           "delay_us=500,corrupt=0.004,slow_rank=2:750,"
+                           "kill_rank=3")
+    assert plan.seed == 42 and plan.drop == 0.01 and plan.dup == 0.02
+    assert plan.delay == 0.03 and plan.delay_us == 500
+    assert plan.corrupt == 0.004
+    assert plan.slow == {2: 750} and plan.kills == [3]
+    assert plan.probabilistic
+    # spec() round-trips through parse()
+    again = ChaosPlan.parse(plan.spec())
+    assert again == plan
+    for bad in ("drop", "drop=2.0", "wat=1", "slow_rank=x"):
+        with pytest.raises(ACCLError):
+            ChaosPlan.parse(bad)
+    assert ChaosPlan.from_env() is None  # unset => no plan
+
+
+# ---------------------------------------------------------------------------
+# layer 2: abort + epoch fencing
+# ---------------------------------------------------------------------------
+def test_abort_wakes_blocked_waiter_immediately():
+    # the bare-wait satellite: a receiver blocked on a dead peer used to
+    # exit only via the ACCL_DEFAULT_TIMEOUT budget; abort must wake it
+    # now (engine finalization -> Request event), not at budget expiry
+    with EmuWorld(2) as world:
+        def fn(accl, rank):
+            accl.set_timeout(60_000_000)  # 60 s receive budget
+            if rank == 1:
+                dst = accl.create_buffer(COUNT, np.float32)
+                t0 = time.time()
+                with pytest.raises(ACCLError) as e:
+                    accl.recv(dst, COUNT, 0, tag=3)  # peer never sends
+                assert time.time() - t0 < 10.0  # woke early, not at 60 s
+                assert e.value.code & int(ErrorCode.COMM_ABORTED)
+            else:
+                time.sleep(0.5)
+                accl.abort(0)
+
+        world.run(fn)
+
+
+def test_abort_wakes_bare_request_wait():
+    # async flavor: a bare Request.wait() parked on the completion event
+    # wakes the moment the engine finalizes the aborted call
+    with EmuWorld(2) as world:
+        reqs = {}
+
+        def issue(accl, rank):
+            if rank == 1:
+                dst = accl.create_buffer(COUNT, np.float32)
+                reqs[rank] = accl.recv(dst, COUNT, 0, tag=4,
+                                       run_async=True)
+            return None
+
+        world.run(issue)
+        waker = threading.Timer(
+            0.5, lambda: world.accls[0].abort(
+                0, error=int(ErrorCode.RANK_FAILED)))
+        waker.start()
+        t0 = time.time()
+        assert reqs[1].wait(timeout=30.0)
+        assert time.time() - t0 < 10.0
+        assert reqs[1].aborted
+        assert reqs[1].retcode & int(ErrorCode.RANK_FAILED)
+        with pytest.raises(ACCLError):
+            reqs[1].check()
+        waker.join()
+
+
+def test_aborted_comm_fails_fast_and_fenced_epoch_drops():
+    with EmuWorld(2) as world:
+        # a chaos delay holds rank 0's segment in flight across the
+        # abort: when it finally releases it carries the DEAD epoch and
+        # must be fenced at rank 1's ingress, not delivered
+        world.devices[0].set_chaos(seed=1, drop_ppm=0, dup_ppm=0,
+                                   delay_ppm=0, delay_us=700_000,
+                                   corrupt_ppm=0, slow_us=0)
+
+        def fn(accl, rank):
+            accl.set_timeout(2_000_000)
+            if rank == 0:
+                src = accl.create_buffer_like(_data(COUNT))
+                accl.device.inject_fault(EmuDevice.FAULT_DELAY)
+                accl.send(src, COUNT, 1, tag=6)  # held for 0.7 s
+                time.sleep(0.2)
+                accl.abort(0)
+                # driver-side fast fail: new calls on the aborted comm
+                # never reach the engine
+                with pytest.raises(ACCLError) as e:
+                    accl.send(src, COUNT, 1, tag=7)
+                assert e.value.code & int(ErrorCode.COMM_ABORTED)
+            else:
+                time.sleep(1.5)  # outlive the delayed release
+            return None
+
+        world.run(fn)
+        stats = world.resilience_stats()
+        assert stats[1]["fenced_drops"] >= 1  # the stale-epoch segment
+
+
+def test_abort_flight_record_terminal_state_and_health():
+    # flight records finalized by an abort retire as "aborted" — the
+    # watchdog must see a recovery action, not a phantom hang — and the
+    # accl_health gauge gains the aborted value
+    with EmuWorld(2) as world:
+        reqs = {}
+
+        def issue(accl, rank):
+            if rank == 1:
+                dst = accl.create_buffer(COUNT, np.float32)
+                reqs[rank] = accl.recv(dst, COUNT, 0, tag=5,
+                                       run_async=True)
+            return None
+
+        world.run(issue)
+        time.sleep(0.2)
+        world.accls[0].abort(0)
+        assert reqs[1].wait(30.0)
+        rec = reqs[1].flight
+        assert rec is not None
+        assert obs_flight.STATE_NAMES[rec.state] == "aborted"
+        assert not rec.in_flight
+        # merged analysis: an aborted record is terminal, never a hang
+        merged = obs_flight.merge_flight_dumps(
+            [a.flight_recorder.dump() for a in world.accls])
+        assert not any(
+            h for h in merged["analysis"]["hangs"]
+            if h["tag"] == 5), merged["analysis"]["hangs"]
+        # health: the watchdog's next sweep reads aborted (3)
+        wd = world.watchdog
+        wd.check()
+        assert wd._health == obs_health.HEALTH_ABORTED
+        assert obs_health.HEALTH_NAMES[obs_health.HEALTH_ABORTED] == \
+            "aborted"
+
+
+def test_watchdog_action_abort_recovers_hang():
+    # ACCL_WATCHDOG_ACTION=abort: the PR3 watchdog now triggers recovery
+    # instead of only dumping — a withheld gang member turns into fast
+    # COMM_ABORTED|RANK_FAILED failures on every arrived rank
+    with EmuWorld(3) as world:
+        world.start_watchdog(timeout_s=1.0, action="abort",
+                             dump_path="")
+        reqs = {}
+
+        def issue(accl, rank):
+            if rank == 0:
+                return None  # withheld: never joins the gang
+            s = accl.create_buffer_like(_data(COUNT, salt=rank))
+            r = accl.create_buffer(COUNT, np.float32)
+            reqs[rank] = accl.allreduce(s, r, COUNT, ReduceFunction.SUM,
+                                        run_async=True)
+            return None
+
+        world.run(issue)
+        deadline = time.time() + 30
+        for rank in (1, 2):
+            assert reqs[rank].wait(timeout=max(0.1, deadline - time.time()))
+            assert reqs[rank].aborted
+            assert reqs[rank].retcode & int(ErrorCode.RANK_FAILED)
+        assert world.watchdog.last_report is not None
+
+
+# ---------------------------------------------------------------------------
+# layer 3: liveness + ULFM shrink
+# ---------------------------------------------------------------------------
+def test_probe_liveness_names_dead_rank():
+    with EmuWorld(3) as world:
+        world.kill_rank(2)
+
+        def fn(accl, rank):
+            if rank == 2:
+                return None
+            return accl.device.probe_liveness(0, 3, window_s=2.0)
+
+        outs = world.run(fn)
+        assert outs[0] == [True, True, False]
+        assert outs[1] == [True, True, False]
+
+
+def test_kill_abort_shrink_rerun():
+    # the full recovery drill (the chaos_smoke acceptance path): a rank
+    # dies mid-run; survivors classify the failure, revoke the comm,
+    # agree on the surviving set, and finish on the shrunk world
+    nranks = 4
+    with EmuWorld(nranks) as world:
+        world.kill_rank(3)
+
+        def fn(accl, rank):
+            if rank == 3:
+                return "dead"
+            accl.set_timeout(1_500_000)
+            s = accl.create_buffer_like(_data(COUNT, salt=rank))
+            r = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError):
+                accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            # ULFM pattern: whoever classifies a failure revokes; the
+            # propagated abort wakes slower ranks' calls immediately
+            accl.abort(0, error=int(ErrorCode.RANK_FAILED))
+            new_comm = accl.shrink_communicator(0, window_s=2.0)
+            assert accl.communicator(new_comm).size == nranks - 1
+            accl.allreduce(s, r, COUNT, ReduceFunction.SUM,
+                           comm_id=new_comm)
+            return r.host.copy()
+
+        outs = world.run(fn)
+        expected = np.sum([_data(COUNT, salt=r) for r in range(3)], axis=0)
+        for r in range(3):
+            np.testing.assert_allclose(outs[r], expected, rtol=1e-6, atol=1e-5)
+
+
+def test_shrink_without_deaths_is_a_fresh_comm():
+    with EmuWorld(2) as world:
+        def fn(accl, rank):
+            nc = accl.shrink_communicator(0, window_s=1.0)
+            assert accl.communicator(nc).size == 2
+            s = accl.create_buffer_like(_data(8, salt=rank))
+            r = accl.create_buffer(8, np.float32)
+            accl.allreduce(s, r, 8, ReduceFunction.SUM, comm_id=nc)
+            return r.host.copy()
+
+        outs = world.run(fn)
+        expected = _data(8, salt=0) + _data(8, salt=1)
+        np.testing.assert_allclose(outs[0], expected, rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(outs[1], expected, rtol=1e-6, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# soak (slow-marked: excluded from tier-1, run by the nightly lane)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_soak_60s():
+    # 60 s of mixed seeded chaos over a 3-rank allreduce/bcast loop:
+    # every iteration must stay bitwise correct; any hang fails via the
+    # receive budget
+    nranks = 3
+    plan = "seed=777,drop=0.02,dup=0.02,delay=0.03,delay_us=2000"
+    with EmuWorld(nranks, chaos=plan) as world:
+        for a in world.accls:
+            a.set_timeout(20_000_000)
+        deadline = time.time() + 60
+
+        def fn(accl, rank):
+            it = 0
+            while time.time() < deadline:
+                s = accl.create_buffer_like(_data(COUNT, salt=rank + it))
+                r = accl.create_buffer(COUNT, np.float32)
+                accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+                expected = np.sum([_data(COUNT, salt=q + it)
+                                   for q in range(nranks)], axis=0)
+                np.testing.assert_allclose(r.host, expected, rtol=1e-6, atol=1e-5)
+                accl.bcast(s if rank == 0 else r, COUNT, root=0)
+                np.testing.assert_array_equal(
+                    (s if rank == 0 else r).host, _data(COUNT, salt=it))
+                it += 1
+            return it
+
+        iters = world.run(fn)
+        assert min(iters) >= 3  # the loop really looped under chaos
