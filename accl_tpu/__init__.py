@@ -33,6 +33,12 @@ from .constants import (  # noqa: F401
 )
 from .device_api import ACCLCommand, ACCLData, DeviceCollectives  # noqa: F401
 from .request import Request  # noqa: F401
-from .resilience import ChaosPlan, RetryPolicy  # noqa: F401
+from .resilience import (  # noqa: F401
+    ChaosPlan,
+    MembershipBoard,
+    RecoveryPolicy,
+    RecoverySupervisor,
+    RetryPolicy,
+)
 
 __version__ = "0.1.0"

@@ -91,6 +91,10 @@ class ACCL:
         #: host-side wait budget for synchronous calls; raise it alongside
         #: set_timeout for long-running collectives on slow emulator hosts
         self.call_timeout_s: float = 60.0
+        #: engine receive budget (µs) as last written by set_timeout /
+        #: initialize — read (and temporarily raised) by the recovery
+        #: supervisor so an admission wait can't trip a peer's budget
+        self.engine_timeout_us: int = default_timeout()
         self._last_request: Optional[Request] = None
         # descriptor memo: _build is a pure function of its scalar args
         # plus immutable per-buffer facts (address — never reused, the
@@ -122,6 +126,14 @@ class ACCL:
         #: fenced engine; cleared by reset_errors().  The off-path cost
         #: is one falsy check per call.
         self._aborted_comms: set = set()
+        #: placeholder comm ids (elastic join protocol): dead slots a
+        #: joiner minted to align its id space with the survivors' —
+        #: calls on them fail fast with the same falsy-set discipline
+        self._placeholder_comms: set = set()
+        #: recovery supervisor, armed by supervise() / ACCL_SUPERVISE=1
+        #: at initialize; None adds ZERO per-call code (loop-level, not
+        #: call-level — the hot path never consults it)
+        self.supervisor = None
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -176,6 +188,7 @@ class ACCL:
         if timeout is None:
             timeout = default_timeout()
         self._config_call(CfgFunc.set_timeout, value=timeout)
+        self.engine_timeout_us = int(timeout)
         if max_eager_size is None:
             max_eager_size = egr_rx_buf_size
         self.set_max_eager_msg_size(max_eager_size)
@@ -204,6 +217,13 @@ class ACCL:
                 _flight.FlightRecorder(local_rank))
         _health.ensure_exporter_from_env()
 
+        # 9. resilience bring-up: ACCL_SUPERVISE=1 arms the recovery
+        #    supervisor (resilience/supervisor.py) on this rank — a
+        #    loop-level state machine, so the per-call hot path gains
+        #    nothing when it is off (the default)
+        if os.environ.get("ACCL_SUPERVISE", "0") == "1":
+            self.supervisor = self.supervise()
+
     # ------------------------------------------------------------------
     # properties / config
     # ------------------------------------------------------------------
@@ -230,7 +250,14 @@ class ACCL:
         accl_lint formalize)."""
         if isinstance(comm_id, int) and \
                 0 <= comm_id < len(self._communicators):
-            return self._communicators[comm_id]
+            comm = self._communicators[comm_id]
+            if comm.is_placeholder:
+                raise ACCLError(
+                    f"communicator {comm_id} is a placeholder slot on "
+                    f"this rank — it marks a world this rank joined "
+                    f"AFTER (elastic membership); only communicators "
+                    f"minted at or after the join are usable here")
+            return comm
         if not self._communicators:
             raise ACCLError(
                 f"unknown communicator id {comm_id!r}: driver not "
@@ -289,6 +316,9 @@ class ACCL:
 
     def set_timeout(self, timeout: int) -> None:
         self._config_call(CfgFunc.set_timeout, value=timeout)
+        #: last engine receive budget written (µs) — the recovery
+        #: supervisor raises it for an episode and restores it after
+        self.engine_timeout_us = int(timeout)
 
     # flat-tree schedule thresholds (reference exchange-memory tuning
     # registers, accl.cpp:1214-1224 / ccl_offload_control.h:86-90)
@@ -367,7 +397,65 @@ class ACCL:
         the collective on the returned comm``."""
         from .resilience.membership import shrink as _shrink
 
-        return _shrink(self, comm_id, window_s)
+        new_id = _shrink(self, comm_id, window_s)
+        if _metrics.enabled():
+            _metrics.default_registry().inc("membership/shrinks")
+        return new_id
+
+    def grow_communicator(self, new_ranks, comm_id: int = GLOBAL_COMM,
+                          window_s: float = 1.0) -> int:
+        """Elastic grow, the mirror of :meth:`shrink_communicator`:
+        mint a fresh communicator over the LIVE members of ``comm_id``
+        plus ``new_ranks`` (:class:`~accl_tpu.communicator.Rank` rows
+        for ranks joining the world — e.g. a replacement for a killed
+        member).  Collective over the survivors, in create order; each
+        joiner adopts the identical table through
+        :func:`accl_tpu.resilience.elastic.join_grown_world` (its
+        engine state-synced from a sponsor first, so epochs, abort
+        fences and comm-id spaces align).  In-flight traffic on other
+        communicators is untouched — the dead world stays fenced
+        behind its bumped epoch, it is never drained."""
+        from .resilience.elastic import grow as _grow
+
+        return _grow(self, new_ranks, comm_id, window_s)
+
+    def supervise(self, policy=None, board=None, registry=None):
+        """Arm (and return) a recovery supervisor for this rank — the
+        automated detect -> abort -> probe -> shrink-or-grow -> agree
+        -> resume loop (resilience/supervisor.py; policy via
+        ``ACCL_RECOVERY`` / ``ACCL_JOIN_WAIT_S`` /
+        ``ACCL_RECOVERY_MAX_ROUNDS`` or an explicit RecoveryPolicy).
+        Also armed automatically by ``ACCL_SUPERVISE=1`` at
+        :meth:`initialize`."""
+        from .resilience.supervisor import RecoverySupervisor
+
+        self.supervisor = RecoverySupervisor(self, policy=policy,
+                                             board=board,
+                                             registry=registry)
+        return self.supervisor
+
+    def _install_communicator(self, comm: Communicator) -> int:
+        """Append + upload an explicitly-built communicator (the elastic
+        grow/join path, where rows do NOT come from world-comm indices).
+        Enforces the id-alignment contract: the object's id must be the
+        next slot on this rank."""
+        if comm.id != len(self._communicators):
+            raise ACCLError(
+                f"_install_communicator: comm id {comm.id} is not the "
+                f"next slot ({len(self._communicators)}) on this rank — "
+                f"the group's create/grow order has diverged")
+        self._device.upload_communicator(comm)
+        self._communicators.append(comm)
+        return comm.id
+
+    def _pad_communicators(self, count: int) -> None:
+        """Pad this driver's comm-id space with placeholder slots up to
+        ``count`` (elastic join: the engine side is padded by the
+        Join/Welcome/StateSync exchange; this is the driver half)."""
+        while len(self._communicators) < count:
+            cid = len(self._communicators)
+            self._communicators.append(Communicator.placeholder(cid))
+            self._placeholder_comms.add(cid)
 
     def reset_errors(self) -> None:
         """Recover a world poisoned by a CLASSIFIED transient fault
@@ -1023,6 +1111,9 @@ class ACCL:
                 f"(COMM_ABORTED) — shrink_communicator() or "
                 f"reset_errors() to recover",
                 int(ErrorCode.COMM_ABORTED))
+        # placeholder fast-fail (elastic join): same falsy-set cost
+        if self._placeholder_comms and call.comm in self._placeholder_comms:
+            self.communicator(call.comm)  # raises the naming ACCLError
         # observability gate first: one module-bool read each when all
         # are off, and t_submit marks user-call entry (operand staging
         # below is inside the submit→queue window by design).  The

@@ -154,6 +154,17 @@ def _load_lib() -> ctypes.CDLL:
                                           ctypes.POINTER(u64),
                                           ctypes.POINTER(u64),
                                           ctypes.POINTER(u64)]
+    # elastic membership (r11): live rank join
+    lib.accl_world_add_rank.restype = i32
+    lib.accl_world_add_rank.argtypes = [p]
+    lib.accl_join_sync.restype = i32
+    lib.accl_join_sync.argtypes = [p, i32, u32, i32]
+    lib.accl_comm_count.restype = i32
+    lib.accl_comm_count.argtypes = [p, i32]
+    lib.accl_comm_epoch.restype = u32
+    lib.accl_comm_epoch.argtypes = [p, i32, i32]
+    lib.accl_join_stats.argtypes = [p, i32, ctypes.POINTER(u64),
+                                    ctypes.POINTER(u64)]
     _lib = lib
     return lib
 
@@ -423,6 +434,37 @@ class EmuDevice(CCLODevice):
         keys = ("retrans_sent", "nacks_tx", "nacks_rx", "fenced_drops")
         return dict(zip(keys, (int(v.value) for v in vals)))
 
+    # -- elastic membership (r11): join control plane -----------------
+    def join_sync(self, sponsor_session: int,
+                  timeout_s: float = 10.0) -> int:
+        """Joiner side of the Join/Welcome/StateSync exchange: sync
+        per-comm epochs/abort fences + the comm-slot count from a live
+        sponsor.  Returns 0, or -1 when the sponsor never answered
+        (dead/killed — pick another survivor and retry)."""
+        return int(self._lib.accl_join_sync(
+            self._w, self._rank, sponsor_session,
+            int(timeout_s * 1000)))
+
+    def comm_count(self) -> int:
+        """Comm slots (real + placeholder) this engine knows — lets the
+        join path assert its id space really aligned."""
+        return int(self._lib.accl_comm_count(self._w, self._rank))
+
+    def comm_epoch(self, comm_id: int) -> int:
+        """Current epoch of a comm slot (abort-fence introspection)."""
+        return int(self._lib.accl_comm_epoch(self._w, self._rank,
+                                             comm_id))
+
+    def join_stats(self) -> dict:
+        """Joins answered as sponsor / completed as joiner."""
+        sponsored = ctypes.c_uint64(0)
+        joined = ctypes.c_uint64(0)
+        self._lib.accl_join_stats(self._w, self._rank,
+                                  ctypes.byref(sponsored),
+                                  ctypes.byref(joined))
+        return {"sponsored": int(sponsored.value),
+                "joined": int(joined.value)}
+
     def close(self) -> None:
         pass  # world teardown owns the native handle
 
@@ -511,6 +553,8 @@ class EmuWorld:
                  chaos=None):
         self._lib = _load_lib()
         self.nranks = nranks
+        self._n_egr_rx_bufs = n_egr_rx_bufs
+        self._egr_rx_buf_size = egr_rx_buf_size
         if transport == "dgram":
             self._handle = self._lib.accl_world_create_dgram(
                 nranks, devmem_bytes, mtu, reorder_window)
@@ -573,6 +617,13 @@ class EmuWorld:
             [a.flight_recorder for a in self.accls
              if a.flight_recorder is not None], name="accl-emu",
             abort_hook=self._watchdog_abort).start()
+        # elastic membership (r11): the in-process join rendezvous —
+        # replacement ranks announce here, the recovery supervisor's
+        # grow policy discovers them (resilience/elastic.py)
+        from ..resilience.elastic import MembershipBoard
+
+        self.board = MembershipBoard()
+        self.joiners: list = []
 
     def start_watchdog(self, **kwargs) -> "_health.Watchdog":
         """Re-arm the watchdog with explicit settings (tests shrink
@@ -610,6 +661,40 @@ class EmuWorld:
         """Kill-rank chaos: rank's engine goes silent mid-run (egress
         dropped, ingress deaf, local comms aborted with RANK_FAILED)."""
         self.devices[rank].kill()
+
+    def spawn_replacement(self, announce: bool = True) -> "EmuJoiner":
+        """Elastic membership: spawn a REAL replacement rank — a fresh
+        native engine wired into the live world's hub at the next
+        session id, with its own driver brought up on a self-world —
+        and (by default) announce it on the membership board so a
+        grow-policy recovery supervisor admits it.  The joiner then
+        completes the handshake with :meth:`EmuJoiner.join` (engine
+        state sync from a sponsor + adoption of the grown comm).
+        In-flight traffic on the existing ranks is untouched: the new
+        engine only ever speaks the join control plane until the grown
+        communicator exists."""
+        new_rank = int(self._lib.accl_world_add_rank(self._handle))
+        if new_rank < 0:
+            raise ACCLError(
+                "spawn_replacement: this world's transport cannot grow "
+                "(only inproc worlds support live join) or the join "
+                "headroom is exhausted")
+        device = EmuDevice(self._handle, new_rank, self._lib)
+        accl = ACCL(device)
+        row = Rank(ip="127.0.0.1", port=0, session=new_rank,
+                   max_segment_size=self._egr_rx_buf_size)
+        # the joiner brings up on a self-world: its comm 0 is a 1-rank
+        # table (usable for local ops only); the join state sync then
+        # pads/fences its id space to match the survivors'
+        accl.initialize([row], 0, n_egr_rx_bufs=self._n_egr_rx_bufs,
+                        egr_rx_buf_size=self._egr_rx_buf_size)
+        joiner = EmuJoiner(self, accl, device, new_rank, row)
+        if announce:
+            joiner.offer = self.board.announce(new_rank, row)
+        self.joiners.append(joiner)
+        if accl.flight_recorder is not None:
+            self.watchdog.add_recorder(accl.flight_recorder)
+        return joiner
 
     def reset_errors(self) -> None:
         """Collective seqn resync after a classified fault: every
@@ -662,3 +747,31 @@ class EmuWorld:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class EmuJoiner:
+    """Handle on a replacement rank spawned into a live EmuWorld
+    (:meth:`EmuWorld.spawn_replacement`): its own native engine,
+    driver, and membership-board offer.  The world owns the native
+    handle; the joiner's lifetime ends with the world's."""
+
+    def __init__(self, world: EmuWorld, accl: ACCL, device: EmuDevice,
+                 rank: int, row: Rank):
+        self.world = world
+        self.accl = accl
+        self.device = device
+        self.rank = rank  # session id == global engine index
+        self.row = row
+        self.offer = None
+
+    def join(self, timeout_s: float = 30.0) -> int:
+        """Complete the join handshake (blocks until a survivor's
+        admit/grow round claims this offer): engine state sync from
+        the sponsor, driver comm-id padding, adoption of the grown
+        communicator.  Returns the grown comm id — the first
+        communicator this rank can collectively use."""
+        from ..resilience.elastic import join_grown_world
+
+        if self.offer is None:
+            self.offer = self.world.board.announce(self.rank, self.row)
+        return join_grown_world(self.accl, self.offer, timeout_s)

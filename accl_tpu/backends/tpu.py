@@ -677,7 +677,10 @@ class TpuEngine:
         every PARTIAL gang and pending p2p recv on it, completing their
         requests with `err_bits` — blocked waiters on every rank wake
         at once.  Complete gangs already queued for dispatch run to
-        completion (they have all members; executing them is safe)."""
+        completion (they have all members; executing them is safe).
+        The gang-table rebuild half of elastic recovery starts here:
+        the dead comm's cached execution plans are evicted so a grown
+        successor never pins the old world's buffers or meshes."""
         drained = []
         with self._lock:
             self._aborted_comms[comm_id] = err_bits
@@ -689,10 +692,72 @@ class TpuEngine:
                     for entry in self._gangs.pop(key):
                         if entry[0] == "recv":
                             drained.append(entry[2][2])
+            for sig in [s for s in self._gang_plans if s[1] == comm_id]:
+                del self._gang_plans[sig]
         for req in drained:
             if not req.done:
                 req.complete(err_bits, 0.0)
         return True
+
+    # ------------------------------------------------------------------
+    # elastic membership (r11): sponsor-side state sync + rebuild
+    # ------------------------------------------------------------------
+    def comm_count(self) -> int:
+        """Comm slots this world-level scheduler knows (the in-process
+        twin of the native engine's comm_count): the join path pads a
+        late rank's driver table to this before the grown upload."""
+        with self._lock:
+            return (max(self._comms) + 1) if self._comms else 0
+
+    def export_join_state(self, comm_id: int = 0) -> dict:
+        """Sponsor-side state sync for an in-process joiner: the
+        world's comm-slot count, the abort fence table, and the
+        members of the comm being recovered — everything a replacement
+        rank's driver needs to align before adopting a grown comm.
+        (The wire Join/Welcome/StateSync exchange of the emulator rung
+        collapses to this dict: the scheduler IS the control plane.)"""
+        with self._lock:
+            return {
+                "comm_count": (max(self._comms) + 1) if self._comms
+                else 0,
+                "aborted": dict(self._aborted_comms),
+                "members": list(self._comms.get(comm_id, [])),
+            }
+
+    def rebuild_gang_tables(self, comm_id: int) -> int:
+        """Drop every partial gang and cached plan referencing
+        ``comm_id`` (grow path: a successor comm must assemble against
+        a clean table — a stale partial gang from the dead world could
+        otherwise swallow a new member's first call).  Returns how many
+        entries were evicted; their requests finalize with the comm's
+        abort bits (or COMM_ABORTED when it was never aborted)."""
+        err = None
+        drained = []
+        with self._lock:
+            err = self._aborted_comms.get(
+                comm_id, int(ErrorCode.COMM_ABORTED))
+            evicted = 0
+            for key in [k for k in self._gangs
+                        if (k[0] == "coll" and k[2] == comm_id)
+                        or (k[0] == "p2p" and k[1] == comm_id)]:
+                for gang in self._gangs.pop(key):
+                    evicted += 1
+                    if isinstance(gang, dict):  # coll: rank -> entry
+                        drained.extend(
+                            req for _c, req, _k in gang.values())
+                    elif gang[0] == "recv":  # p2p pending recv tuple
+                        # ("recv", tag, (rank, call, request)) — same
+                        # shape abort_comm finalizes: the blocked
+                        # waiter must wake NOW, not at the driver
+                        # budget ("data" entries carry no request)
+                        drained.append(gang[2][2])
+            for sig in [s for s in self._gang_plans if s[1] == comm_id]:
+                del self._gang_plans[sig]
+                evicted += 1
+        for req in drained:
+            if not req.done:
+                req.complete(err, 0.0)
+        return evicted
 
     def reset_comm_errors(self) -> None:
         """Clear abort fencing (driver reset_errors path)."""
@@ -1495,6 +1560,25 @@ class TpuDeviceView(CCLODevice):
     # single abort covers the whole world (no wire propagation needed)
     def abort_comm(self, comm_id: int, err_bits: int) -> bool:
         return self._engine.abort_comm(comm_id, err_bits)
+
+    # -- elastic membership (r11) -------------------------------------
+    def join_sync(self, sponsor_session: int,
+                  timeout_s: float = 10.0) -> int:
+        """In-process join state sync: the world-level scheduler IS the
+        control plane, so the wire exchange of the emulator rung
+        collapses to a gang-table rebuild for any comm this view's
+        driver will re-adopt — epochs/fences are already shared.
+        Always succeeds (0): the sponsor cannot be deaf in-process."""
+        return 0
+
+    def comm_count(self) -> int:
+        return self._engine.comm_count()
+
+    def export_join_state(self, comm_id: int = 0) -> dict:
+        return self._engine.export_join_state(comm_id)
+
+    def rebuild_gang_tables(self, comm_id: int) -> int:
+        return self._engine.rebuild_gang_tables(comm_id)
 
     def reset_errors(self) -> None:
         self._engine.reset_comm_errors()

@@ -42,12 +42,32 @@ class Communicator:
     descriptors (word 2 of the ABI).
     """
 
+    #: True only for the dead-slot markers the elastic join protocol
+    #: mints (see :meth:`placeholder`); class attribute so every real
+    #: communicator answers False with zero per-instance cost
+    is_placeholder = False
+
     def __init__(self, ranks: Sequence[Rank], local_rank: int, comm_id: int = 0):
         if not 0 <= local_rank < len(ranks):
             raise ValueError(f"local_rank {local_rank} out of range for {len(ranks)} ranks")
         self._ranks = list(ranks)
         self._local_rank = local_rank
         self._id = comm_id
+
+    @classmethod
+    def placeholder(cls, comm_id: int) -> "Communicator":
+        """Dead-slot marker for the elastic join protocol: a joiner
+        pads its comm-id space with these so its NEXT upload lands at
+        the same id as the survivors' (the create_communicator ordering
+        discipline, applied across a membership change).  Zero ranks;
+        the driver fast-fails any call on it and the engine finalizes
+        strays with ``COMM_ABORTED | RANK_FAILED``."""
+        c = cls.__new__(cls)
+        c._ranks = []
+        c._local_rank = 0
+        c._id = comm_id
+        c.is_placeholder = True
+        return c
 
     @property
     def id(self) -> int:

@@ -309,3 +309,38 @@ class ACCLError(RuntimeError):
     def __init__(self, message: str, code: int = 0):
         super().__init__(message)
         self.code = code
+
+
+def env_int(name: str, default: int, minimum: int = None) -> int:
+    """Integer env knob with the decodable-error contract: a malformed
+    value raises ACCLError NAMING the knob instead of a bare ValueError
+    from int() deep inside bring-up.  Scientific notation is accepted
+    ("3e7") since operators write budgets that way."""
+    import os as _os
+
+    raw = _os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = int(float(raw))
+    except ValueError as e:
+        raise ACCLError(f"{name}={raw!r} is not a number") from e
+    if minimum is not None and val < minimum:
+        raise ACCLError(f"{name}={raw!r} must be >= {minimum}")
+    return val
+
+
+def env_float(name: str, default: float, minimum: float = None) -> float:
+    """Float twin of :func:`env_int` (same clear-error contract)."""
+    import os as _os
+
+    raw = _os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError as e:
+        raise ACCLError(f"{name}={raw!r} is not a number") from e
+    if minimum is not None and val < minimum:
+        raise ACCLError(f"{name}={raw!r} must be >= {minimum}")
+    return val

@@ -45,8 +45,11 @@ S_COMPLETE = 4
 S_FAILED = 5
 S_ABORTED = 6  # finalized by a communicator abort (COMM_ABORTED), not
 #              # an engine fault — a terminal state, never "in flight"
+S_RECOVERING = 7  # a recovery-supervisor phase record (non-gang): the
+#              # rank is mid detect->abort->probe->shrink/grow->resume;
+#              # finish() retires it complete/failed like any record
 STATE_NAMES = ("submitted", "queued", "gang_ready", "dispatched",
-               "complete", "failed", "aborted")
+               "complete", "failed", "aborted", "recovering")
 
 #: states that mean "this record is retired" — the hang analyzer and
 #: the watchdog must treat all three alike (an abort in flight is a
@@ -105,7 +108,10 @@ class FlightRecord:
 
     @property
     def in_flight(self) -> bool:
-        return self.state < S_COMPLETE
+        # a recovery-phase record is a live episode until finish()
+        # retires it (it is never gang=True, so the watchdog's
+        # stuck-GANG scan and the merge hang analysis both skip it)
+        return self.state < S_COMPLETE or self.state == S_RECOVERING
 
     def age_ns(self, now: Optional[int] = None) -> int:
         """Nanoseconds since submit (in flight) or submit→complete."""
@@ -120,6 +126,15 @@ class FlightRecord:
         self.t_dispatch = t
         if self.lane is None:
             self.lane = lane
+
+    def mark_recovering(self, t: int) -> None:
+        """Flip a supervisor phase record into the live `recovering`
+        state (resilience/supervisor.py publishes one record per
+        state-machine transition; finish() retires it)."""
+        self.state = S_RECOVERING
+        self.t_dispatch = t
+        if self.lane is None:
+            self.lane = "supervisor"
 
     def finish(self, retcode: int, t: int) -> None:
         self.retcode = retcode
@@ -163,9 +178,13 @@ class FlightRecorder:
     def __init__(self, rank: int, capacity: Optional[int] = None):
         from collections import deque
 
+        from ..constants import env_int
+
         self.rank = rank
-        self.capacity = capacity if capacity is not None else int(
-            os.environ.get("ACCL_FLIGHT_CAP", "512"))
+        # env_int raises the naming ACCLError on a malformed knob (the
+        # clear-error contract) — construction time, not the record path
+        self.capacity = capacity if capacity is not None else env_int(
+            "ACCL_FLIGHT_CAP", 512, minimum=1)
         self._records: "deque[FlightRecord]" = deque(maxlen=self.capacity)
         self._seq = itertools.count()
         #: highest seq that reached complete/failed (monotonic

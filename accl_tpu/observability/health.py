@@ -50,7 +50,11 @@ HEALTH_OK = 0
 HEALTH_DEGRADED = 1
 HEALTH_HUNG = 2
 HEALTH_ABORTED = 3
-HEALTH_NAMES = ("ok", "degraded", "hung", "aborted")
+#: a recovery supervisor is mid-episode (detect -> abort -> probe ->
+#: shrink/grow -> agree -> resume); distinct from ``aborted`` because a
+#: supervised world is actively healing, not merely revoked
+HEALTH_RECOVERING = 4
+HEALTH_NAMES = ("ok", "degraded", "hung", "aborted", "recovering")
 
 #: window after a non-zero retcode during which health reads degraded
 DEGRADED_WINDOW_NS = 60 * 10 ** 9
@@ -58,12 +62,13 @@ DEGRADED_WINDOW_NS = 60 * 10 ** 9
 
 def watchdog_timeout_s() -> float:
     """Stuck-gang threshold in seconds; ``ACCL_WATCHDOG_TIMEOUT=0``
-    disables the watchdog entirely."""
-    raw = os.environ.get("ACCL_WATCHDOG_TIMEOUT", "300")
-    try:
-        return float(raw)
-    except ValueError:
-        return 300.0
+    disables the watchdog entirely.  Malformed values raise the naming
+    ACCLError (constants.env_float) — a watchdog silently falling back
+    to 300 s because of a typo is a watchdog that fires 5 minutes after
+    the operator expected it."""
+    from ..constants import env_float
+
+    return env_float("ACCL_WATCHDOG_TIMEOUT", 300.0, minimum=0.0)
 
 
 #: live watchdogs, for health aggregation: the accl_health gauge on a
@@ -72,12 +77,34 @@ def watchdog_timeout_s() -> float:
 #: sweep, and a freshly-constructed watchdog must not clear a live hang
 _watchdogs_lock = threading.Lock()
 _watchdogs: "weakref.WeakSet" = weakref.WeakSet()
+#: registries with at least one recovery supervisor mid-episode
+#: (resilience/supervisor.py note_recovering): id(registry) -> count.
+#: A supervised recovery outranks every watchdog verdict — the world
+#: is actively healing, and a scrape must say so even while a sibling
+#: watchdog still reads the pre-recovery hang.
+_recovering: dict = {}
+
+
+def note_recovering(registry: MetricsRegistry, active: bool) -> None:
+    """Mark (or clear) an active recovery episode on a registry; the
+    ``accl_health`` gauge reads ``recovering`` (4) while any episode is
+    live, then falls back to the watchdog aggregation."""
+    key = id(registry)
+    with _watchdogs_lock:
+        n = _recovering.get(key, 0) + (1 if active else -1)
+        if n > 0:
+            _recovering[key] = n
+        else:
+            _recovering.pop(key, None)
+    _publish_health(registry)
 
 
 def _publish_health(registry: MetricsRegistry) -> None:
     with _watchdogs_lock:
         verdict = max((w._health for w in _watchdogs
                        if w._registry is registry), default=HEALTH_OK)
+        if _recovering.get(id(registry), 0) > 0:
+            verdict = HEALTH_RECOVERING
     registry.set_gauge("accl_health", verdict)
 
 
@@ -131,6 +158,13 @@ class Watchdog:
                 daemon=True)
             self._thread.start()
         return self
+
+    def add_recorder(self, recorder) -> None:
+        """Fold a late-joining rank's flight recorder into the scan
+        (elastic membership: a replacement spawned mid-run must be
+        watched too).  Append is safe against a concurrent sweep —
+        CPython list iteration simply starts seeing the new tail."""
+        self._recorders.append(recorder)
 
     def stop(self) -> None:
         self._stop.set()
@@ -337,7 +371,9 @@ def start_exporter(port: Optional[int] = None,
             raw = os.environ.get("ACCL_METRICS_PORT", "")
             if not raw or raw == "0":
                 return None
-            port = int(raw)
+            from ..constants import env_int
+
+            port = env_int("ACCL_METRICS_PORT", 0, minimum=1)
         _exporter = MetricsExporter(port, registry)
         from ..utils.logging import get_logger
 

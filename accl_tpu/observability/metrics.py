@@ -31,6 +31,32 @@ LATENCY_BUCKETS_US = tuple(4 ** k for k in range(13))
 #: count is per-peer / per-chunk) — the nccl-tests size convention
 _XP_COLLECTIVES = ("allgather", "reduce_scatter", "alltoall")
 
+#: HELP text for the exporter's well-known families (OpenMetrics `# HELP`
+#: lines) — the schema contract exporter consumers (dashboards,
+#: alerting) pin in tests/test_flight_recorder.py.  Keys are the FINAL
+#: metric names after prefixing/sanitizing (see to_openmetrics name()).
+METRIC_HELP = {
+    "accl_health": ("world health gauge: 0=ok 1=degraded 2=hung "
+                    "3=aborted 4=recovering"),
+    "accl_watchdog_checks": "watchdog scan sweeps executed",
+    "accl_watchdog_fires": "watchdog hang detections (one per episode)",
+    "accl_membership_joins": ("replacement ranks admitted through the "
+                              "elastic join protocol"),
+    "accl_membership_grows": ("communicators grown back toward full "
+                              "size (ACCL.grow_communicator)"),
+    "accl_membership_shrinks": ("ULFM-style shrinks to a survivor set "
+                                "(ACCL.shrink_communicator)"),
+    "accl_membership_rank_deaths": ("peer ranks declared dead by a "
+                                    "recovery supervisor probe"),
+    "accl_recovery_rounds": "recovery-supervisor episodes entered",
+    "accl_recovery_halts": ("recovery episodes that gave up (halt "
+                            "policy or max rounds exhausted)"),
+    "accl_recovery_latency_us": ("end-to-end recovery episode latency, "
+                                 "detect -> resume"),
+    "accl_join_wait_us": ("time a grow-policy supervisor spent waiting "
+                          "for a replacement to announce itself"),
+}
+
 
 def payload_factor(coll: str, p: int) -> int:
     """Per-rank payload in units of `count` elements."""
@@ -84,11 +110,34 @@ class MetricsRegistry:
         self._counters: dict = {}
         self._gauges: dict = {}
         self._calls: dict = {}
+        #: named value histograms (power-of-4 µs buckets, same shape as
+        #: the per-call latency histograms): recovery latency, join
+        #: wait — anything that is a distribution but not a collective
+        self._values: dict = {}
 
     # -- counters / gauges --------------------------------------------
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + value
+
+    # -- named value histograms ---------------------------------------
+    def observe_value(self, name: str, value_us: float) -> None:
+        """One observation into the named histogram (µs domain, fixed
+        power-of-4 buckets — allocation-free after the first)."""
+        with self._lock:
+            st = self._values.get(name)
+            if st is None:
+                st = self._values[name] = {
+                    "count": 0, "sum_us": 0.0,
+                    "hist": [0] * (len(LATENCY_BUCKETS_US) + 1)}
+            st["count"] += 1
+            st["sum_us"] += value_us
+            for i, ub in enumerate(LATENCY_BUCKETS_US):
+                if value_us <= ub:
+                    st["hist"][i] += 1
+                    break
+            else:
+                st["hist"][-1] += 1
 
     def counter(self, name: str) -> int:
         return self._counters.get(name, 0)
@@ -169,6 +218,10 @@ class MetricsRegistry:
                 }
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges),
+                    "values": {k: {"count": v["count"],
+                                   "sum_us": round(v["sum_us"], 2),
+                                   "hist": list(v["hist"])}
+                               for k, v in self._values.items()},
                     "calls": calls}
 
     def to_json(self) -> str:
@@ -184,6 +237,12 @@ class MetricsRegistry:
             lines.append("== gauges ==")
             for k in sorted(snap["gauges"]):
                 lines.append(f"  {k:<40} {snap['gauges'][k]:.3f}")
+        if snap["values"]:
+            lines.append("== value histograms (us) ==")
+            for k in sorted(snap["values"]):
+                v = snap["values"][k]
+                avg = v["sum_us"] / v["count"] if v["count"] else 0.0
+                lines.append(f"  {k:<40} n={v['count']} avg={avg:.1f}")
         lines.append("== calls ==")
         hdr = (f"  {'collective':<16} {'dtype':<10} {'size':<10} "
                f"{'calls':>7} {'err':>4} {'avg_us':>10} {'min_us':>10} "
@@ -223,14 +282,34 @@ class MetricsRegistry:
 
         snap = self.snapshot()
         out = []
+
+        def describe(n: str) -> None:
+            if n in METRIC_HELP:
+                out.append(f"# HELP {n} {METRIC_HELP[n]}")
+
         for k in sorted(snap["counters"]):
             n = name(k)
+            describe(n)
             out.append(f"# TYPE {n} counter")
             out.append(f"{n}_total {snap['counters'][k]}")
         for k in sorted(snap["gauges"]):
             n = name(k)
+            describe(n)
             out.append(f"# TYPE {n} gauge")
             out.append(f"{n} {snap['gauges'][k]}")
+        for k in sorted(snap["values"]):
+            n = name(k)
+            v = snap["values"][k]
+            describe(n)
+            out.append(f"# TYPE {n} histogram")
+            cum = 0
+            for ub, cnt in zip(LATENCY_BUCKETS_US, v["hist"]):
+                cum += cnt
+                out.append(f'{n}_bucket{{le="{ub}"}} {cum}')
+            cum += v["hist"][-1]
+            out.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+            out.append(f"{n}_sum {v['sum_us']}")
+            out.append(f"{n}_count {v['count']}")
         if snap["calls"]:
             out.append("# TYPE accl_collective_calls counter")
             out.append("# TYPE accl_collective_errors counter")
@@ -270,6 +349,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._calls.clear()
+            self._values.clear()
 
 
 _default = MetricsRegistry()

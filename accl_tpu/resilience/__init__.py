@@ -24,21 +24,45 @@ receive timeouts — SURVEY §5; flight recorder + watchdog; sanitizer):
    fresh communicator excluding dead ranks, so the caller re-runs the
    collective on the smaller world.
 
+4. **Elastic membership** (:mod:`.elastic`, r11): the upward half of
+   recovery — a replacement rank joins a LIVE world (native-engine
+   Join/Welcome/StateSync control plane syncs epochs + comm-id space
+   from a sponsor) and the survivors mint a grown communicator
+   (``ACCL.grow_communicator``, mirroring ``shrink_communicator``)
+   without draining in-flight traffic on other comms.
+
+5. **Recovery supervisor** (:mod:`.supervisor`, ``ACCL.supervise()`` /
+   ``ACCL_SUPERVISE=1``): the automated detect -> abort -> probe ->
+   shrink-or-grow -> agree-on-restart -> resume state machine, with
+   policy knobs (``ACCL_RECOVERY=shrink|grow|halt``,
+   ``ACCL_JOIN_WAIT_S``, ``ACCL_RECOVERY_MAX_ROUNDS``) and every
+   transition published through the flight recorder (``recovering``
+   state), the ``accl_health`` gauge (``recovering=4``) and the
+   metrics registry (membership counters, recovery-latency histogram).
+
 A seeded chaos injector (:mod:`.chaos`, ``ACCL_CHAOS``) drives all of
-it in CI: probabilistic drop/dup/delay/corrupt plus slow-rank and
-kill-rank, reproducible from one seed (``scripts/chaos_smoke.py``).
+it in CI: probabilistic drop/dup/delay/corrupt plus slow-rank,
+kill-rank and join-rank, reproducible from one seed
+(``scripts/chaos_smoke.py``).
 
 See docs/fault_tolerance.md for semantics and knobs.
 """
 from .chaos import ChaosPlan
+from .elastic import MembershipBoard, grow, join_grown_world
 from .membership import probe_alive, shrink
 from .retry import DEFAULT_RETRY_BASE_US, DEFAULT_RETRY_MAX, RetryPolicy
+from .supervisor import RecoveryPolicy, RecoverySupervisor
 
 __all__ = [
     "ChaosPlan",
+    "MembershipBoard",
+    "RecoveryPolicy",
+    "RecoverySupervisor",
     "RetryPolicy",
     "DEFAULT_RETRY_MAX",
     "DEFAULT_RETRY_BASE_US",
+    "grow",
+    "join_grown_world",
     "probe_alive",
     "shrink",
 ]

@@ -26,6 +26,10 @@ Plan grammar (``ACCL_CHAOS`` env var or :meth:`ChaosPlan.parse`)::
   (repeatable for several ranks)
 - ``kill_rank=R``    — rank R is marked for :meth:`kill set <kills>`;
   harnesses decide WHEN (usually mid-run) via ``EmuWorld.kill_rank``
+- ``join_rank=R``    — rank R's death should be healed by a
+  REPLACEMENT join: the harness spawns a joiner
+  (``EmuWorld.spawn_replacement``) racing the plan's other faults, so
+  the elastic join control plane is chaos-tested too
 
 One-shot ``inject_fault`` remains as sugar: it forces the next draw of
 the same funnel, so both paths exercise identical recovery machinery.
@@ -60,6 +64,12 @@ class ChaosPlan:
     slow: dict = field(default_factory=dict)
     #: ranks marked for a kill (the harness triggers the WHEN)
     kills: list = field(default_factory=list)
+    #: ranks whose death should be healed by a REPLACEMENT join
+    #: (elastic membership): the harness spawns a joiner for each —
+    #: usually racing the probabilistic faults above, so the join
+    #: control plane is exercised under the same chaos the data plane
+    #: rides (EmuWorld.spawn_replacement + a grow-policy supervisor)
+    joins: list = field(default_factory=list)
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosPlan":
@@ -87,13 +97,15 @@ class ChaosPlan:
                     plan.slow[int(rank_s)] = int(us_s) if us_s else 500
                 elif key == "kill_rank":
                     plan.kills.append(int(val))
+                elif key == "join_rank":
+                    plan.joins.append(int(val))
                 else:
                     raise ValueError("unknown key")
             except ValueError as e:
                 raise ACCLError(
                     f"ACCL_CHAOS item {item!r}: {e} (grammar: seed=N,"
                     f"drop=P,dup=P,delay=P,delay_us=N,corrupt=P,"
-                    f"slow_rank=R:US,kill_rank=R)") from e
+                    f"slow_rank=R:US,kill_rank=R,join_rank=R)") from e
         return plan
 
     @classmethod
@@ -137,4 +149,6 @@ class ChaosPlan:
             parts.append(f"slow_rank={r}:{us}")
         for r in self.kills:
             parts.append(f"kill_rank={r}")
+        for r in self.joins:
+            parts.append(f"join_rank={r}")
         return ",".join(parts)

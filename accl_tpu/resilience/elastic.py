@@ -1,0 +1,293 @@
+"""Elastic membership: live rank join + communicator grow.
+
+r10 closed the detect -> recover loop *downward* only: a dead rank
+meant abort -> :func:`~accl_tpu.resilience.membership.shrink` -> finish
+forever on a smaller world.  This module is the missing upward half
+(ROADMAP item 5; ACCL+ arxiv 2312.11742 motivates it for long-running
+apps that outlive individual members, EQuARX-style serving fleets
+arxiv 2506.17615 assume worlds that heal back to full size):
+
+- **grow** (:func:`grow`, surfaced as ``ACCL.grow_communicator``) —
+  the survivor-side collective mirroring ``shrink_communicator``: agree
+  on the live membership of an existing communicator, splice in the
+  new ranks' rows, and mint a FRESH communicator over the union.  Like
+  shrink, the dead world stays fenced behind its bumped epoch
+  (r10), so in-flight traffic on unrelated comms is never drained.
+
+- **join** (:func:`join_grown_world`) — the joiner side: sync engine
+  state from a live sponsor over the native control plane's
+  Join/Welcome/StateSync messages (adopt every comm's epoch + abort
+  fence; pad the comm-id space with placeholder slots so the next
+  upload lands at the same id on every member), then adopt the grown
+  communicator the survivors minted.
+
+- **MembershipBoard** — the in-process rendezvous where joiners
+  announce themselves and the survivors' recovery supervisor discovers
+  them.  Cross-rank *agreement* on who joins does NOT come from the
+  board (per-rank reads of shared state race): the lowest-rank
+  survivor claims a batch and broadcasts the admitted session list
+  over the data plane (:func:`admit_pending`), so every survivor
+  splices in exactly the same rows.  A production deployment would
+  back the board with its cluster manager; the emulator rungs share a
+  process, so a plain object suffices.
+
+Id-alignment invariant (the subtle part): communicator ids are
+per-rank upload indices that must agree numerically across the group
+(the ``create_communicator`` ordering discipline).  A joiner starts
+with ONE communicator (its self-world), while survivors carry the full
+history — so the join protocol pads the joiner's driver AND engine
+comm tables with placeholders up to the sponsor's count *before* the
+grown comm is uploaded anywhere, and the sponsor defers its own grow
+upload until the joiner confirms the sync (otherwise the sponsor's
+live count already includes the grown comm and the joiner pads one
+too far).  Placeholder slots are dead: the driver fast-fails calls on
+them and the engine finalizes them ``COMM_ABORTED | RANK_FAILED``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..communicator import Communicator, Rank
+from ..constants import ACCLError
+from ..observability import metrics as _metrics
+from .membership import probe_alive
+
+#: cap on joiners admitted per recovery round (the bcast payload is a
+#: fixed small buffer; more pending joiners ride the next round)
+MAX_JOINS_PER_ROUND = 16
+
+#: default engine-side wait for the Join/Welcome/StateSync answer
+JOIN_SYNC_TIMEOUT_S = 10.0
+
+
+class JoinOffer:
+    """One joiner's announcement on the membership board."""
+
+    def __init__(self, session: int, rank_row: Rank):
+        self.session = int(session)
+        self.rank_row = rank_row
+        self.announced_ns = time.monotonic_ns()
+        self.claimed = False
+        #: leader -> joiner: sync instructions are ready
+        self.fulfilled = threading.Event()
+        #: joiner -> leader: engine state sync done, comm ids aligned
+        self.synced = threading.Event()
+        # written by the claiming leader (valid once `fulfilled`):
+        self.sponsor_session: Optional[int] = None
+        self.rows: Optional[List[Rank]] = None  # full grown-comm rows
+        self.grow_id: Optional[int] = None      # the grown comm's id
+        self.pad_count: Optional[int] = None    # comm slots before grow
+        self.local_rank: Optional[int] = None   # joiner's row index
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return (f"JoinOffer(session={self.session}, "
+                f"claimed={self.claimed})")
+
+
+class MembershipBoard:
+    """In-process join rendezvous: joiners announce, the recovery
+    leader claims.  Only :meth:`claim_pending` mutates membership, and
+    it runs on exactly one rank per round — the agreement itself
+    travels over the data plane (see :func:`admit_pending`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._offers: List[JoinOffer] = []
+
+    def announce(self, session: int, rank_row: Rank) -> JoinOffer:
+        offer = JoinOffer(session, rank_row)
+        with self._lock:
+            self._offers.append(offer)
+        return offer
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(1 for o in self._offers if not o.claimed)
+
+    def claim_pending(self, max_n: int = MAX_JOINS_PER_ROUND,
+                      ) -> List[JoinOffer]:
+        """Atomically claim up to max_n unclaimed offers, in session
+        order (deterministic membership for the round)."""
+        with self._lock:
+            avail = sorted((o for o in self._offers if not o.claimed),
+                           key=lambda o: o.session)[:max_n]
+            for o in avail:
+                o.claimed = True
+        return avail
+
+    def offer_for(self, session: int) -> Optional[JoinOffer]:
+        with self._lock:
+            for o in self._offers:
+                if o.session == session:
+                    return o
+        return None
+
+
+# ---------------------------------------------------------------------------
+# survivor side
+# ---------------------------------------------------------------------------
+def grow(accl, new_ranks: Sequence[Rank], comm_id: int = 0,
+         window_s: float = 1.0) -> int:
+    """Mint a grown communicator: the live members of ``comm_id`` plus
+    ``new_ranks`` (rows for ranks joining the world — sessions the
+    transport can already reach).  Collective over the SURVIVORS of
+    ``comm_id`` — every live member must call it with the same rows in
+    the same create order; each joiner adopts the identical table
+    through :func:`join_grown_world`.  Returns the new comm id."""
+    comm = accl.communicator(comm_id)
+    new_rows = list(new_ranks)
+    if not new_rows:
+        raise ACCLError(
+            f"grow_communicator(comm {comm_id}): no new ranks given — "
+            f"use shrink_communicator/create_communicator for "
+            f"same-membership rebuilds")
+    alive = probe_alive(accl, comm_id, window_s)
+    rows = [comm.ranks[i] for i, ok in enumerate(alive) if ok] + new_rows
+    sessions = [r.session for r in rows]
+    if len(set(sessions)) != len(sessions):
+        raise ACCLError(
+            f"grow_communicator(comm {comm_id}): duplicate sessions in "
+            f"the grown membership {sessions} — a replacement must join "
+            f"with a FRESH session, not a dead rank's")
+    # the local row's position among the survivors of comm_id
+    local = [i for i, ok in enumerate(alive) if ok].index(comm.local_rank)
+    new_id = accl._install_communicator(
+        Communicator(rows, local, comm_id=len(accl._communicators)))
+    if _metrics.enabled():
+        _metrics.default_registry().inc("membership/grows")
+    return new_id
+
+
+def admit_pending(accl, comm_id: int, board: MembershipBoard,
+                  wait_s: float = 5.0, window_s: float = 1.0,
+                  registry=None) -> tuple:
+    """Admit pending joiners into a grown communicator — collective
+    over the members of ``comm_id`` (typically the freshly-shrunk
+    survivor comm).  Returns ``(new_comm_id, n_admitted)``; with no
+    joiner inside ``wait_s`` the comm is returned unchanged.
+
+    Protocol (every transition is data-plane-agreed, the board is only
+    a discovery surface):
+
+    1. the lowest-rank member (leader) waits up to ``wait_s`` for an
+       announcement, claims a batch, and writes each offer's sync
+       instructions (sponsor session, grown rows, pad count, grow id);
+    2. the leader broadcasts the admitted session list over
+       ``comm_id`` — the agreement point: every member splices in the
+       same rows in the same order;
+    3. the leader waits for each joiner's engine state sync (the
+       joiner must pad its comm-id space BEFORE any member's grow
+       upload bumps the sponsor's count);
+    4. everyone mints the grown communicator via :func:`grow`.
+    """
+    comm = accl.communicator(comm_id)
+    leader = comm.local_rank == 0
+    reg = registry if registry is not None else _metrics.default_registry()
+    t0 = time.monotonic()
+    claimed: List[JoinOffer] = []
+    if leader:
+        deadline = t0 + wait_s
+        while time.monotonic() < deadline and board.pending_count() == 0:
+            time.sleep(0.01)
+        claimed = board.claim_pending()
+        if _metrics.enabled():
+            reg.observe_value("join_wait_us",
+                              (time.monotonic() - t0) * 1e6)
+        pad_count = len(accl._communicators)
+        rows = list(comm.ranks) + [o.rank_row for o in claimed]
+        for i, offer in enumerate(claimed):
+            offer.sponsor_session = comm.ranks[comm.local_rank].session
+            offer.rows = rows
+            offer.pad_count = pad_count
+            offer.grow_id = pad_count
+            offer.local_rank = comm.size + i
+            offer.fulfilled.set()
+    # agreement point: the admitted session list travels the data plane
+    msg = accl.create_buffer(1 + MAX_JOINS_PER_ROUND, np.int32)
+    if leader:
+        msg.host[:] = 0
+        msg.host[0] = len(claimed)
+        for i, o in enumerate(claimed):
+            msg.host[1 + i] = o.session
+    accl.bcast(msg, 1 + MAX_JOINS_PER_ROUND, root=0, comm_id=comm_id)
+    n = int(msg.host[0])
+    if n == 0:
+        return comm_id, 0
+    sessions = [int(s) for s in msg.host[1:1 + n]]
+    if leader:
+        # the joiner pads to OUR comm count; it must finish before the
+        # SPONSOR's grow upload bumps it (see the id-alignment
+        # invariant above).  A joiner that dies mid-sync must NOT make
+        # the leader diverge from the non-leaders (who are already past
+        # the bcast and will mint the grown id regardless): log, keep
+        # growing with the dead joiner in the table — the next recovery
+        # episode shrinks it away — and let the late/dead joiner's own
+        # join_grown_world fail its pad-count check cleanly.
+        from ..utils.logging import get_logger
+
+        for o in claimed:
+            if not o.synced.wait(timeout=JOIN_SYNC_TIMEOUT_S):
+                get_logger("accl_tpu.elastic").warning(
+                    "admit_pending(comm %d): joiner session %d never "
+                    "completed its state sync inside %.0fs — growing "
+                    "anyway (the agreement bcast already committed "
+                    "every survivor to this membership); a dead "
+                    "joiner will be shrunk away next episode",
+                    comm_id, o.session, JOIN_SYNC_TIMEOUT_S)
+        new_rows = [o.rank_row for o in claimed]
+    else:
+        offers = [board.offer_for(s) for s in sessions]
+        missing = [s for s, o in zip(sessions, offers) if o is None]
+        if missing:
+            raise ACCLError(
+                f"admit_pending(comm {comm_id}): leader admitted "
+                f"sessions {missing} unknown to this rank's board — "
+                f"the membership boards have diverged")
+        new_rows = [o.rank_row for o in offers]
+    new_id = grow(accl, new_rows, comm_id=comm_id, window_s=window_s)
+    return new_id, n
+
+
+# ---------------------------------------------------------------------------
+# joiner side
+# ---------------------------------------------------------------------------
+def join_grown_world(accl, offer: JoinOffer,
+                     timeout_s: float = 30.0) -> int:
+    """Complete a join from the replacement rank's side: wait for the
+    leader's sync instructions, run the engine-level Join/Welcome/
+    StateSync exchange against the sponsor, pad the driver's comm-id
+    space, and adopt the grown communicator.  Returns the grown comm
+    id — the first communicator this rank can collectively use."""
+    if not offer.fulfilled.wait(timeout=timeout_s):
+        raise ACCLError(
+            f"join(session {offer.session}): no survivor claimed this "
+            f"offer inside {timeout_s:.0f}s — is a grow-policy "
+            f"supervisor (or admit_pending) running on the survivors?")
+    join_sync = getattr(accl.device, "join_sync", None)
+    if join_sync is not None:
+        if join_sync(offer.sponsor_session,
+                     timeout_s=JOIN_SYNC_TIMEOUT_S) != 0:
+            raise ACCLError(
+                f"join(session {offer.session}): state sync against "
+                f"sponsor session {offer.sponsor_session} timed out "
+                f"(sponsor dead?)")
+        count = getattr(accl.device, "comm_count", lambda: None)()
+        if count is not None and count != offer.pad_count:
+            raise ACCLError(
+                f"join(session {offer.session}): engine synced "
+                f"{count} comm slots but the leader promised "
+                f"{offer.pad_count} — the sponsor grew mid-sync; "
+                f"re-announce and retry")
+    accl._pad_communicators(offer.pad_count)
+    offer.synced.set()
+    local = next(i for i, r in enumerate(offer.rows)
+                 if r.session == offer.session)
+    new_id = accl._install_communicator(
+        Communicator(list(offer.rows), local, comm_id=offer.grow_id))
+    if _metrics.enabled():
+        _metrics.default_registry().inc("membership/joins")
+    return new_id

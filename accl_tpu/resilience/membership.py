@@ -28,16 +28,36 @@ def probe_alive(accl, comm_id: int = 0, window_s: float = 1.0) -> List[bool]:
     """Per-comm-local-rank liveness, via the backend's heartbeat probe.
     The local rank is always alive.  Backends without a liveness plane
     (record-mode lint devices) report everyone alive — shrink then
-    degenerates to a copy, never to a wrong exclusion."""
+    degenerates to a copy, never to a wrong exclusion.
+
+    Validation contract: a non-positive probe window and a backend list
+    LONGER than the communicator both raise a decodable ACCLError
+    naming the comm — the overlong case used to be silently truncated,
+    which would mint a shrunk communicator from a probe of the wrong
+    world (a backend handing back world-sized liveness for a sub-comm).
+    A SHORT list still pads with dead: a backend that answered for
+    fewer ranks proved nothing about the rest."""
     comm = accl.communicator(comm_id)
+    if not window_s > 0:
+        raise ACCLError(
+            f"probe_alive(comm {comm_id}): window_s={window_s!r} must be "
+            f"> 0 (a zero/negative window can never collect a pong)")
     probe = getattr(accl.device, "probe_liveness", None)
     alive: Optional[List[bool]] = None
     if probe is not None:
         alive = probe(comm_id, comm.size, window_s)
     if alive is None:
         alive = [True] * comm.size
-    if len(alive) != comm.size:
-        alive = list(alive)[:comm.size] + [False] * (comm.size - len(alive))
+    alive = list(alive)
+    if len(alive) > comm.size:
+        raise ACCLError(
+            f"probe_alive(comm {comm_id}): backend returned liveness for "
+            f"{len(alive)} ranks but the communicator has {comm.size} — "
+            f"the probe answered for a different world; refusing to "
+            f"truncate (a shrink built from it could exclude the wrong "
+            f"ranks)")
+    if len(alive) < comm.size:
+        alive = alive + [False] * (comm.size - len(alive))
     alive[comm.local_rank] = True
     return alive
 

@@ -26,23 +26,12 @@ loss-hole resync semantics).
 """
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
-from ..constants import ACCLError
+from ..constants import env_int as _env_int
 
 DEFAULT_RETRY_MAX = 4
 DEFAULT_RETRY_BASE_US = 200
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return int(float(raw))
-    except ValueError as e:
-        raise ACCLError(f"{name}={raw!r} is not a number") from e
 
 
 @dataclass(frozen=True)
@@ -55,11 +44,13 @@ class RetryPolicy:
 
     @classmethod
     def from_env(cls) -> "RetryPolicy":
+        # a negative knob is a typo, not a policy: raise the naming
+        # ACCLError (constants.env_int) instead of silently clamping
         return cls(
-            max_retries=max(0, _env_int("ACCL_RETRY_MAX",
-                                        DEFAULT_RETRY_MAX)),
-            base_us=max(1, _env_int("ACCL_RETRY_BASE_US",
-                                    DEFAULT_RETRY_BASE_US)),
+            max_retries=_env_int("ACCL_RETRY_MAX", DEFAULT_RETRY_MAX,
+                                 minimum=0),
+            base_us=_env_int("ACCL_RETRY_BASE_US", DEFAULT_RETRY_BASE_US,
+                             minimum=1),
         )
 
     @property

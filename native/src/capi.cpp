@@ -23,6 +23,7 @@ struct World {
   std::shared_ptr<RdmaHub> rdma_hub;
   std::vector<RdmaTransport*> rdma_transports;  // borrowed, engine-owned
   bool tcp = false;
+  uint64_t devmem_bytes = 64ull << 20;  // per-engine, for elastic joins
 
   Engine* get(int rank) {
     if (tcp) return engines.empty() ? nullptr : engines[0].get();
@@ -40,6 +41,11 @@ extern "C" {
 void* accl_world_create(int nranks, uint64_t devmem_bytes) {
   auto* w = new World();
   w->hub = std::make_shared<InprocHub>(nranks);
+  // headroom for elastic joins: accl_world_add_rank push_backs must
+  // never reallocate the vector while peer hooks walk it from engine
+  // threads (the same live-write discipline as comms_.reserve(64))
+  w->engines.reserve(size_t(nranks) + 64);
+  w->devmem_bytes = devmem_bytes;
   for (int r = 0; r < nranks; ++r) {
     w->engines.push_back(std::make_unique<Engine>(
         uint32_t(r), devmem_bytes,
@@ -217,6 +223,57 @@ int accl_probe_liveness(void* wp, int rank, int comm_id, uint32_t window_us,
   uint64_t bm = e->probe_liveness(uint32_t(comm_id), window_us);
   if (alive_bitmap) *alive_bitmap = bm;
   return 0;
+}
+
+// ---- elastic membership (r11): live rank join ----
+
+// Mint a NEW rank in a live inproc world: a fresh engine wired to the
+// shared hub at the next session id (the replacement process of the
+// emulator rung — on hardware this is a new host joining the fabric).
+// Returns the new global rank / session id, or -1 when the world's
+// transport cannot grow (TCP/dgram/RDMA rungs, or join headroom
+// exhausted — see the engines.reserve in accl_world_create).
+int accl_world_add_rank(void* wp) {
+  auto* w = static_cast<World*>(wp);
+  if (!w->hub) return -1;
+  if (w->engines.size() >= w->engines.capacity()) return -1;
+  int r = w->hub->add_rank();
+  w->engines.push_back(std::make_unique<Engine>(
+      uint32_t(r), w->devmem_bytes,
+      std::make_unique<InprocTransport>(w->hub, r)));
+  w->engines.back()->set_peer_hook([w](uint32_t session) -> Engine* {
+    return session < w->engines.size() ? w->engines[session].get() : nullptr;
+  });
+  return r;
+}
+
+// Joiner side of the Join/Welcome/StateSync exchange (see Engine::
+// join_sync): sync epochs/abort fences + comm-slot count from a live
+// sponsor session.  0 on success, -1 on timeout (sponsor deaf/dead).
+int accl_join_sync(void* wp, int rank, uint32_t sponsor_session,
+                   int timeout_ms) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->join_sync(sponsor_session, timeout_ms) : -1;
+}
+
+// Introspection: number of comm slots (real + placeholder) an engine
+// knows, and a comm's current epoch — lets the driver and tests assert
+// that a joiner's id space and fences really aligned.
+int accl_comm_count(void* wp, int rank) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? int(e->comm_count()) : -1;
+}
+
+uint32_t accl_comm_epoch(void* wp, int rank, int comm_id) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->comm_epoch(uint32_t(comm_id)) : 0;
+}
+
+// Membership counters: joins answered as sponsor / completed as joiner.
+void accl_join_stats(void* wp, int rank, uint64_t* sponsored,
+                     uint64_t* joined) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (e) e->join_stats(sponsored, joined);
 }
 
 // Resilience observability: retransmitted segments, NACKs sent/received,

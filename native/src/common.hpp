@@ -97,6 +97,23 @@ enum class MsgType : uint8_t {
   // epoch-tagged communicator abort: hdr.epoch carries the NEW epoch,
   // hdr.count the error bits every pending call must finalize with
   Abort = 6,
+  // ---- elastic membership (r11): the join control plane ----
+  // joiner -> sponsor: "I am session hdr.src, send me your world state"
+  // (the joiner is in NO communicator table yet, so it is addressed by
+  // raw session id — the one piece of addressing that predates comms)
+  Join = 7,
+  // sponsor -> joiner: join accepted; hdr.count = number of comm slots
+  // the StateSync payload will describe
+  Welcome = 8,
+  // sponsor -> joiner: serialized per-comm recovery state (see
+  // Engine::ingress Join handling for the word layout): comm count,
+  // then per comm {size, epoch, abort_bits} + the sponsor's per-peer
+  // inbound/outbound seqn rows.  The joiner adopts the epoch/abort
+  // fence table (so dead-epoch traffic can never land on it and its
+  // comm-id space aligns with the survivors') and records the seqn
+  // rows for introspection — fresh comms it joins start with clean
+  // pairwise seqn state on every member by construction.
+  StateSync = 9,
 };
 
 constexpr uint32_t TAG_ANY = 0xFFFFFFFFu;

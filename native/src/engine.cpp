@@ -479,6 +479,23 @@ void Engine::ingress(Message&& msg) {
       note_alive(msg.hdr.comm_id, msg.hdr.src);
       handle_abort(msg.hdr);
       return;
+    case MsgType::Join:
+      // elastic membership: a joiner (addressed by raw session in
+      // hdr.src — it is in no comm table yet) asks for a state sync
+      handle_join(msg.hdr);
+      return;
+    case MsgType::Welcome:
+      // informational ack; the payload-bearing StateSync is the apply
+      // point (ordering vs StateSync is not guaranteed on every rung,
+      // so the joiner keys on StateSync alone)
+      return;
+    case MsgType::StateSync: {
+      std::vector<uint32_t> words(msg.payload.size() / 4);
+      if (!words.empty())
+        std::memcpy(words.data(), msg.payload.data(), words.size() * 4);
+      join_state_.push(std::move(words));
+      return;
+    }
     default:
       break;
   }
@@ -702,6 +719,96 @@ void Engine::reset_errors() {
 }
 
 // ---------------------------------------------------------------------------
+// elastic membership (r11): Join/Welcome/StateSync
+// ---------------------------------------------------------------------------
+// Sponsor side: serialize this engine's per-comm recovery state and send
+// it to the joiner.  Word layout (all u32):
+//   [ncomms, then per comm: size, epoch, abort_bits,
+//    then size x {inbound_seq[i], outbound_seq[i]}]
+// The epoch/abort columns are the load-bearing state (the joiner must
+// fence the dead world's traffic and align its comm-id space); the seqn
+// rows document the sponsor's pairwise view — a comm the joiner becomes
+// a member of is always a FRESH id, whose pairwise seqn state starts at
+// zero on every member by construction.
+void Engine::handle_join(const WireHeader& hdr) {
+  joins_sponsored_.fetch_add(1);
+  uint32_t joiner = hdr.src;  // raw session id, pre-communicator
+  std::vector<uint32_t> words;
+  {
+    std::lock_guard<std::mutex> g(cfg_mu_);
+    words.push_back(uint32_t(comms_.size()));
+    for (uint32_t ci = 0; ci < comms_.size(); ++ci) {
+      const CommTable& t = comms_[ci];
+      words.push_back(t.size);
+      words.push_back(epoch_of(ci));
+      words.push_back(abort_err(ci));
+      for (uint32_t i = 0; i < t.size; ++i) {
+        words.push_back(i < t.inbound_seq.size() ? t.inbound_seq[i] : 0);
+        words.push_back(i < t.outbound_seq.size() ? t.outbound_seq[i] : 0);
+      }
+    }
+  }
+  Message wel;
+  wel.hdr.msg_type = uint8_t(MsgType::Welcome);
+  wel.hdr.src = global_rank_;
+  wel.hdr.count = words[0];
+  wel.hdr.dst_session = uint16_t(joiner);
+  stage_egress(joiner, std::move(wel));
+  Message ss;
+  ss.hdr.msg_type = uint8_t(MsgType::StateSync);
+  ss.hdr.src = global_rank_;
+  ss.hdr.count = uint32_t(words.size() * 4);
+  ss.hdr.dst_session = uint16_t(joiner);
+  ss.payload.resize(words.size() * 4);
+  std::memcpy(ss.payload.data(), words.data(), ss.payload.size());
+  stage_egress(joiner, std::move(ss));
+}
+
+int Engine::join_sync(uint32_t sponsor_session, int timeout_ms) {
+  if (killed_.load()) return -1;
+  Message m;
+  m.hdr.msg_type = uint8_t(MsgType::Join);
+  m.hdr.src = global_rank_;
+  m.hdr.count = 1;
+  m.hdr.dst_session = uint16_t(sponsor_session);
+  stage_egress(sponsor_session, std::move(m));
+  auto words = join_state_.pop_wait(milliseconds(timeout_ms));
+  if (!words) return -1;  // sponsor deaf/dead inside the wait budget
+  apply_state_sync(*words);
+  joins_completed_.fetch_add(1);
+  return 0;
+}
+
+void Engine::apply_state_sync(const std::vector<uint32_t>& w) {
+  if (w.empty()) return;
+  uint32_t ncomms = w[0];
+  size_t i = 1;
+  std::lock_guard<std::mutex> g(cfg_mu_);
+  for (uint32_t ci = 0; ci < ncomms && ci < kMaxComms; ++ci) {
+    if (i >= w.size()) break;
+    uint32_t size = w[i++];
+    uint32_t epoch = i < w.size() ? w[i++] : 0;
+    uint32_t abort = i < w.size() ? w[i++] : 0;
+    i += size_t(size) * 2;  // sponsor's pairwise seqn rows (diagnostic)
+    // pad with placeholder slots so the NEXT set_comm on this engine
+    // lands at the same index as the survivors' next create; a call on
+    // a placeholder finalizes fast in loop() instead of scheduling
+    while (comms_.size() <= ci) comms_.push_back(CommTable{});
+    // adopt the fence monotonically (a replayed sync cannot roll back)
+    uint32_t cur = comm_epoch_[ci].load();
+    while (int32_t(epoch - cur) > 0 &&
+           !comm_epoch_[ci].compare_exchange_weak(cur, epoch)) {
+    }
+    comm_abort_[ci].fetch_or(abort);
+  }
+}
+
+uint32_t Engine::comm_count() const {
+  std::lock_guard<std::mutex> g(cfg_mu_);
+  return uint32_t(comms_.size());
+}
+
+// ---------------------------------------------------------------------------
 // resilience: liveness
 // ---------------------------------------------------------------------------
 void Engine::note_alive(uint32_t comm, uint32_t src) {
@@ -915,6 +1022,14 @@ void Engine::loop() {
     // executable: bring-up and soft reset must work on any comm state.
     if (c.scenario() != Op::Config && c.scenario() != Op::Nop) {
       uint32_t ab = abort_err(c.comm());
+      // elastic membership: a placeholder comm slot (minted by a join
+      // state sync to align comm-id spaces, size 0) carries no rank
+      // table — a call on it must finalize as a fenced/dead comm, not
+      // divide a collective schedule by zero.  Local ops (copy/combine)
+      // never consult the table and stay executable.
+      if (!ab && c.scenario() != Op::Copy && c.scenario() != Op::Combine &&
+          comm_for(c).size == 0)
+        ab = COMM_ABORTED | RANK_FAILED;
       if (ab) {
         teardown_call(c);
         std::lock_guard<std::mutex> g(results_mu_);

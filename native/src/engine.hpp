@@ -161,6 +161,26 @@ class Engine {
   // of alive comm-local ranks (the local rank is always alive).
   uint64_t probe_liveness(uint32_t comm_id, uint32_t window_us);
 
+  // ---- elastic membership (r11): the join control plane ----
+  // Joiner side of the Join/Welcome/StateSync exchange: ask the sponsor
+  // session for its world state and apply it — adopt every comm's
+  // epoch + abort fence (so dead-epoch traffic can never land here and
+  // a replayed abort stays fenced) and pad the comm table with
+  // placeholder slots so this engine's comm-id space aligns with the
+  // survivors' before the grown communicator is uploaded.  Returns 0,
+  // or -1 when the sponsor never answered inside timeout_ms (a dead or
+  // killed sponsor is deaf — pick another and retry).
+  int join_sync(uint32_t sponsor_session, int timeout_ms);
+  // Introspection for the driver/tests: comm slots this engine knows
+  // (real + placeholder) and a comm's current epoch.
+  uint32_t comm_count() const;
+  uint32_t comm_epoch(uint32_t comm) const { return epoch_of(comm); }
+  // membership counters: joins answered as sponsor / completed as joiner
+  void join_stats(uint64_t* sponsored, uint64_t* joined) const {
+    if (sponsored) *sponsored = joins_sponsored_.load();
+    if (joined) *joined = joins_completed_.load();
+  }
+
   // Lossy-transport mode (set by datagram worlds): a seek timeout with
   // the expected seqn absent but later seqns queued is treated as an
   // unrecoverable loss hole and the route cursor resyncs.  On reliable
@@ -461,6 +481,12 @@ class Engine {
   std::map<std::pair<uint32_t, uint32_t>, uint64_t> last_heard_ns_;
   void note_alive(uint32_t comm, uint32_t src);
 
+  // ---- elastic membership (r11): join control plane ----
+  Fifo<std::vector<uint32_t>> join_state_;  // StateSync payloads (joiner)
+  std::atomic<uint64_t> joins_sponsored_{0}, joins_completed_{0};
+  void handle_join(const WireHeader& hdr);            // sponsor side
+  void apply_state_sync(const std::vector<uint32_t>& words);  // joiner
+
   // ---- seeded chaos (generalized injector) ----
   struct Chaos {
     bool armed = false;
@@ -526,7 +552,7 @@ class Engine {
 
   std::vector<CommTable> comms_;
   std::vector<ArithCfgN> arithcfgs_;
-  std::mutex cfg_mu_;
+  mutable std::mutex cfg_mu_;
 
   std::atomic<bool> lossy_transport_{false};
   uint64_t timeout_ = 1'000'000;  // in emulated cycles; 1 cycle = 1us here

@@ -57,6 +57,19 @@ class Transport {
 class InprocHub {
  public:
   explicit InprocHub(int nranks) : sinks_(nranks) {}
+  // Elastic membership: mint a delivery slot for a joining rank.  The
+  // slot exists (deliver() can route to it) before the engine attaches,
+  // so a survivor's early message to the joiner is dropped — exactly a
+  // not-yet-listening process — rather than out-of-bounds.
+  int add_rank() {
+    std::lock_guard<std::mutex> g(m_);
+    sinks_.emplace_back();
+    return int(sinks_.size()) - 1;
+  }
+  int size() const {
+    std::lock_guard<std::mutex> g(m_);
+    return int(sinks_.size());
+  }
   void attach(int rank, Transport::Sink sink) {
     std::lock_guard<std::mutex> g(m_);
     sinks_[rank] = std::move(sink);
@@ -75,7 +88,7 @@ class InprocHub {
   }
 
  private:
-  std::mutex m_;
+  mutable std::mutex m_;
   std::vector<Transport::Sink> sinks_;
 };
 

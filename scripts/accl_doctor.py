@@ -15,8 +15,16 @@ already-merged watchdog dump; it prints a human report of
              mismatch);
 - STRAGGLERS — ranks whose completed-gang progress trails the lead.
 
+Live mode (``--live host:port``) scrapes a RUNNING world's r8
+exporter endpoints (``/metrics``, ``/healthz``, ``/flight`` on
+``ACCL_METRICS_PORT``) and prints the same merged report plus the
+health/membership summary — no SIGUSR1, no dump-file collection:
+
+    python scripts/accl_doctor.py --live 127.0.0.1:9100
+
 Usage: python scripts/accl_doctor.py dump_rank*.json [--out merged.json]
        [--fail-on-findings]
+       python scripts/accl_doctor.py --live host:port [--out merged.json]
 
 Exit code: 0 on a clean bill of health (or findings with the default
 flags), 1 with --fail-on-findings when any hang/desync was found.
@@ -86,11 +94,61 @@ def report(doc: dict, out=sys.stdout) -> bool:
     return not an["ok"]
 
 
+def scrape_live(target: str, timeout_s: float = 10.0) -> dict:
+    """Fetch /flight, /healthz and /metrics from a running world's
+    exporter (observability/health.py start_exporter).  Returns
+    {"flight": merged-dump-doc, "healthz": dict, "metrics": text}."""
+    import urllib.request
+
+    if "://" not in target:
+        target = f"http://{target}"
+    target = target.rstrip("/")
+    out = {}
+    for path in ("flight", "healthz", "metrics"):
+        try:
+            with urllib.request.urlopen(f"{target}/{path}",
+                                        timeout=timeout_s) as resp:
+                body = resp.read()
+        except OSError as e:
+            raise SystemExit(
+                f"accl_doctor: cannot scrape {target}/{path}: {e} — is "
+                f"the world running with ACCL_METRICS_PORT set?")
+        out[path] = (body.decode() if path == "metrics"
+                     else json.loads(body))
+    return out
+
+
+def report_live(scraped: dict, out=sys.stdout) -> bool:
+    """Health + membership summary in front of the merged report."""
+    w = out.write
+    hz = scraped["healthz"]
+    w(f"live world health: {hz.get('health', '?')} "
+      f"(accl_health={hz.get('accl_health', '?')}, watchdog fires="
+      f"{hz.get('watchdog_fires', 0)}, checks="
+      f"{hz.get('watchdog_checks', 0)})\n")
+    # surface the membership/recovery counter families from /metrics
+    interesting = ("accl_membership_", "accl_recovery_",
+                   "accl_join_wait_us_count", "accl_health ")
+    lines = [ln for ln in scraped["metrics"].splitlines()
+             if ln and not ln.startswith("#")
+             and any(ln.startswith(p) for p in interesting)]
+    if lines:
+        w("membership / recovery metrics:\n")
+        for ln in lines:
+            w(f"  {ln}\n")
+    w("\n")
+    return report(scraped["flight"], out)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("dumps", nargs="+",
+    ap.add_argument("dumps", nargs="*",
                     help="per-rank flight dump JSON files (or one "
                          "merged/watchdog dump)")
+    ap.add_argument("--live", default="",
+                    help="scrape a running world's exporter instead of "
+                         "reading dump files (host:port of "
+                         "ACCL_METRICS_PORT)")
     ap.add_argument("--out", default="",
                     help="also write the merged+analyzed JSON here")
     ap.add_argument("--fail-on-findings", action="store_true",
@@ -98,8 +156,17 @@ def main() -> int:
                          "(CI / alerting mode)")
     args = ap.parse_args()
 
-    doc = merge_flight_dumps(args.dumps, out_path=args.out or None)
-    findings = report(doc)
+    if bool(args.dumps) == bool(args.live):
+        ap.error("pass either dump files or --live host:port")
+    if args.live:
+        scraped = scrape_live(args.live)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(scraped["flight"], f, indent=1)
+        findings = report_live(scraped)
+    else:
+        doc = merge_flight_dumps(args.dumps, out_path=args.out or None)
+        findings = report(doc)
     if args.out:
         print(f"merged dump written to {args.out}")
     return 1 if (findings and args.fail_on_findings) else 0

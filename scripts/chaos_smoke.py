@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI chaos smoke: prove the fault-tolerance stack end to end.
 
-Two drills (the acceptance criteria of the resilience layer,
+Three drills (the acceptance criteria of the resilience layer,
 docs/fault_tolerance.md):
 
 1. **Retransmission under seeded chaos** — a 4-rank emu allreduce loop
@@ -18,13 +18,24 @@ docs/fault_tolerance.md):
    agrees on the surviving set (``shrink_communicator``), and finishes
    the loop on the 3-rank communicator with bitwise-correct results.
 
+3. **Elastic join drill** (r11) — mid-loop, rank 2 is killed; the
+   per-rank RECOVERY SUPERVISORS (not this harness) drive every
+   transition: abort -> probe -> shrink to 3 -> admit the replacement
+   announced on the membership board (the ``join_rank`` chaos event)
+   -> grow back to 4 ranks -> agree on the restart iteration ->
+   resume.  The world must finish at its ORIGINAL size with results
+   bitwise identical to a clean 4-rank world, the replacement fully
+   participating, and the whole episode riding the abort clock.  The
+   supervisors' state logs are written as a CI artifact.
+
 Artifacts (uploaded by CI next to the hang smoke): the merged flight
 dump after the kill drill (rank 3's records must show ``aborted``/
-``failed`` terminal states, no in-flight stragglers) and the per-rank
-resilience counters.
+``failed`` terminal states, no in-flight stragglers), the per-rank
+resilience counters, and the join drill's supervisor logs.
 
 Usage: python scripts/chaos_smoke.py [--ranks N] [--count N]
        [--iters N] [--seed N] [--dump PATH] [--stats PATH]
+       [--supervisor-log PATH]
 """
 import argparse
 import json
@@ -63,6 +74,7 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=2026)
     ap.add_argument("--dump", default="chaos_flight_dump.json")
     ap.add_argument("--stats", default="chaos_stats.json")
+    ap.add_argument("--supervisor-log", default="chaos_supervisor_log.json")
     args = ap.parse_args()
 
     # generous engine budget: recovery must win long before a timeout
@@ -220,16 +232,187 @@ def main() -> int:
               f"{hangs}")
         return 1
 
+    print(f"drill 2 OK: rank {victim} killed at iter {kill_at}; "
+          f"survivors aborted (RANK_FAILED), shrank to {survivors} "
+          f"ranks, finished bitwise in {drill2_s:.1f}s; "
+          f"dump={args.dump}")
+
+    # ---- drill 3: elastic join — kill -> shrink -> join -> grow ------
+    # The supervisors drive EVERY transition; this harness only plays
+    # the cluster manager (kills the victim's engine, spawns the
+    # replacement process the join_rank chaos event names).  Data is
+    # keyed by COMM-LOCAL rank so the 4-rank clean-world reference
+    # stays the bitwise oracle across the membership change (the ring
+    # schedule is local-rank-based: same locals, same arithmetic).
+    import threading
+
+    from accl_tpu.resilience.chaos import ChaosPlan
+    from accl_tpu.resilience.supervisor import RecoveryPolicy
+
+    jplan = ChaosPlan.parse(f"seed={args.seed},kill_rank=2,join_rank=2")
+    j_victim = jplan.kills[0]
+    assert jplan.joins == [j_victim], "join drill heals the killed rank"
+    kill3_at = args.iters // 2
+    sup_logs: dict = {}
+    join_info: dict = {}
+
+    def local_data(accl, comm_id, it):
+        comm = accl.communicator(comm_id)
+        return make_data(comm.local_rank, it), comm.size
+
+    with EmuWorld(args.ranks) as world:
+        for a in world.accls:
+            a.set_timeout(3_000_000)  # 3 s classification clock
+        policy_kw = dict(mode="grow", join_wait_s=10.0,
+                         probe_window_s=1.5, max_rounds=2)
+
+        def supervised(accl, rank):
+            sup = accl.supervise(policy=RecoveryPolicy(**policy_kw),
+                                 board=world.board)
+            outs = {}
+
+            def step(a, comm_id, it):
+                if rank == j_victim and it == kill3_at:
+                    world.kill_rank(j_victim)  # engine goes silent
+                data, size = local_data(a, comm_id, it)
+                s = a.create_buffer_like(data)
+                r = a.create_buffer(args.count, np.float32)
+                a.allreduce(s, r, args.count, ReduceFunction.SUM,
+                            comm_id=comm_id)
+                outs[it] = (size, r.host.copy())
+
+            def on_restart(restart):
+                for k in list(outs):
+                    if k >= restart:
+                        outs.pop(k)
+
+            try:
+                summary = sup.run_loop(step, args.iters, comm_id=0,
+                                       on_restart=on_restart)
+            except ACCLError as e:
+                sup_logs[rank] = sup.state_log
+                if rank == j_victim:
+                    return ("dead", int(getattr(e, "code", 0)))
+                raise
+            sup_logs[rank] = summary["state_log"]
+            return ("alive", outs, summary)
+
+        def replacement():
+            # the cluster manager notices the death and supplies a
+            # replacement; everything after spawn is supervisor-driven
+            time.sleep(1.0)
+            j = world.spawn_replacement()
+            comm_id = j.join(timeout_s=40.0)
+            j.accl.set_timeout(40_000_000)  # cover survivor skew
+            sup = j.accl.supervise(policy=RecoveryPolicy(**policy_kw),
+                                   board=world.board)
+            sup.comm_id = comm_id
+            restart = sup.agree_restart(0, fresh=True)
+            outs = {}
+
+            def step(a, cid, it):
+                data, size = local_data(a, cid, it)
+                s = a.create_buffer_like(data)
+                r = a.create_buffer(args.count, np.float32)
+                a.allreduce(s, r, args.count, ReduceFunction.SUM,
+                            comm_id=cid)
+                outs[it] = (size, r.host.copy())
+
+            summary = sup.run_loop(step, args.iters, comm_id=comm_id,
+                                   start_iteration=restart)
+            join_info.update(outs=outs, restart=restart,
+                             summary=summary, rank=j.rank,
+                             stats=j.device.join_stats())
+            sup_logs[f"joiner:{j.rank}"] = summary["state_log"]
+
+        t0 = time.time()
+        jt = threading.Thread(target=replacement, daemon=True)
+        jt.start()
+        results3 = world.run(supervised)
+        jt.join(timeout=60)
+        drill3_s = time.time() - t0
+        merged3 = obs_flight.merge_flight_dumps(
+            [a.flight_recorder.dump() for a in world.accls]
+            + [j.accl.flight_recorder.dump() for j in world.joiners])
+
+    with open(args.supervisor_log, "w") as f:
+        json.dump({str(k): [(round(t, 3), s, d) for t, s, d in v]
+                   for k, v in sup_logs.items()}, f, indent=1)
+
+    if jt.is_alive() or "outs" not in join_info:
+        print("FAIL: drill 3 replacement never finished its loop")
+        return 1
+    dead3 = results3[j_victim]
+    if dead3[0] != "dead":
+        print(f"FAIL: drill 3 victim survived its own kill: {dead3}")
+        return 1
+    surv3 = [r for r in range(args.ranks) if r != j_victim]
+    for rank in surv3:
+        state, outs, summary = results3[rank]
+        if state != "alive" or sorted(outs) != list(range(args.iters)):
+            print(f"FAIL: drill 3 survivor {rank} state={state} "
+                  f"iters={sorted(outs)}")
+            return 1
+        # the supervisor (not the harness) must have driven the episode
+        states = [s for _t, s, _d in sup_logs[rank]]
+        for needed in ("abort", "probe", "shrink", "grow", "resume"):
+            if needed not in states:
+                print(f"FAIL: drill 3 rank {rank} supervisor never "
+                      f"entered {needed!r} (log: {states})")
+                return 1
+        final_comm = summary["comm_id"]
+        # world restored to original size, replacement participating
+        sizes = {outs[k][0] for k in outs}
+        if sizes != {args.ranks}:
+            print(f"FAIL: drill 3 rank {rank} ran iterations at sizes "
+                  f"{sizes}, wanted all at {args.ranks}")
+            return 1
+        for it in range(args.iters):
+            if not np.array_equal(outs[it][1], reference[it]):
+                print(f"FAIL: drill 3 rank {rank} iter {it} not "
+                      f"bitwise vs the clean 4-rank world")
+                return 1
+    outs = join_info["outs"]
+    if {outs[k][0] for k in outs} != {args.ranks} or not outs:
+        print(f"FAIL: drill 3 replacement ran at wrong world size")
+        return 1
+    for it, (_size, val) in outs.items():
+        if not np.array_equal(val, reference[it]):
+            print(f"FAIL: drill 3 replacement iter {it} not bitwise")
+            return 1
+    if join_info["stats"]["joined"] != 1:
+        print(f"FAIL: drill 3 join counters {join_info['stats']}")
+        return 1
+    if drill3_s > 40.0:
+        print(f"FAIL: drill 3 took {drill3_s:.1f}s — recovery leaned "
+              f"on a timeout path, not the abort clock")
+        return 1
+    hangs3 = [h for h in merged3["analysis"]["hangs"]]
+    if hangs3:
+        print(f"FAIL: drill 3 flight analysis reports hangs after "
+              f"recovery: {hangs3}")
+        return 1
+
     with open(args.stats, "w") as f:
         json.dump({"drill1": {"plan": plan, "per_rank": stats1,
                               "retransmits": recovered, "nacks": nacks},
                    "drill2": {"victim": victim, "kill_at_iter": kill_at,
                               "wall_s": round(drill2_s, 2),
-                              "per_rank": stats2}}, f, indent=1)
-    print(f"drill 2 OK: rank {victim} killed at iter {kill_at}; "
-          f"survivors aborted (RANK_FAILED), shrank to {survivors} "
-          f"ranks, finished bitwise in {drill2_s:.1f}s; "
-          f"dump={args.dump} stats={args.stats}")
+                              "per_rank": stats2},
+                   "drill3": {"plan": jplan.spec(), "victim": j_victim,
+                              "kill_at_iter": kill3_at,
+                              "replacement_session": join_info["rank"],
+                              "restart": join_info["restart"],
+                              "wall_s": round(drill3_s, 2),
+                              "join_stats": join_info["stats"]}},
+                  f, indent=1)
+    print(f"drill 3 OK: rank {j_victim} killed at iter {kill3_at}; "
+          f"supervisors shrank to {args.ranks - 1}, admitted "
+          f"replacement session {join_info['rank']}, grew back to "
+          f"{args.ranks} ranks, agreed restart "
+          f"{join_info['restart']}, finished bitwise in "
+          f"{drill3_s:.1f}s; supervisor log={args.supervisor_log} "
+          f"stats={args.stats}")
     return 0
 
 

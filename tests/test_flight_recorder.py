@@ -411,6 +411,62 @@ def test_to_openmetrics_format():
     assert f"accl_collective_latency_us_sum{{{lbl}}} 300.0" in text
 
 
+def test_openmetrics_membership_schema():
+    # r11 exporter-consumer contract: the accl_health gauge documents
+    # its new recovering=4 value, the membership-event counters and the
+    # recovery-latency histogram carry HELP text, and the value-
+    # histogram family renders cumulative buckets + sum/count
+    reg = obs_metrics.MetricsRegistry()
+    reg.set_gauge("accl_health", obs_health.HEALTH_RECOVERING)
+    reg.inc("membership/joins", 1)
+    reg.inc("membership/shrinks", 2)
+    reg.inc("membership/grows", 1)
+    reg.inc("membership/rank_deaths", 1)
+    reg.inc("recovery/rounds", 1)
+    reg.observe_value("recovery/latency_us", 5_000_000.0)
+    text = reg.to_openmetrics()
+    assert "# HELP accl_health " in text and "4=recovering" in text
+    assert "accl_health 4" in text
+    for fam in ("accl_membership_joins", "accl_membership_shrinks",
+                "accl_membership_grows", "accl_membership_rank_deaths",
+                "accl_recovery_rounds"):
+        assert f"# HELP {fam} " in text, fam
+        assert f"# TYPE {fam} counter" in text, fam
+    assert "accl_membership_joins_total 1" in text
+    assert "accl_membership_shrinks_total 2" in text
+    assert "# HELP accl_recovery_latency_us " in text
+    assert "# TYPE accl_recovery_latency_us histogram" in text
+    # 5 s lands in le=16777216 (power-of-4 µs buckets, cumulative)
+    assert 'accl_recovery_latency_us_bucket{le="4194304"} 0' in text
+    assert 'accl_recovery_latency_us_bucket{le="+Inf"} 1' in text
+    assert "accl_recovery_latency_us_sum 5000000.0" in text
+    assert "accl_recovery_latency_us_count 1" in text
+    # the gauge's code list stays in lockstep with HEALTH_NAMES
+    assert obs_health.HEALTH_NAMES == (
+        "ok", "degraded", "hung", "aborted", "recovering")
+
+
+def test_flight_record_recovering_state():
+    # supervisor phase records: live in the `recovering` state (in
+    # flight, but non-gang — invisible to the stuck-gang scan), retired
+    # by finish() like any record
+    rec_ring = obs_flight.FlightRecorder(0, capacity=8)
+    rec = rec_ring.new_record(-1, "recovery/shrink", 0, 0, "none", 0, 0,
+                              1, False, obs_flight.now_ns())
+    rec.mark_recovering(obs_flight.now_ns())
+    assert obs_flight.STATE_NAMES[rec.state] == "recovering"
+    assert rec.in_flight and not rec.gang
+    assert rec.lane == "supervisor"
+    assert rec.to_dict()["state"] == "recovering"
+    # a live recovering record never reads as a hang in the merge
+    doc = obs_flight.merge_flight_dumps([rec_ring.dump()])
+    assert doc["analysis"]["hangs"] == []
+    rec.finish(0, obs_flight.now_ns())
+    assert not rec.in_flight
+    assert obs_flight.STATE_NAMES[rec.state] == "complete"
+    assert "recovering" in obs_flight.STATE_NAMES
+
+
 def test_metrics_exporter_endpoints():
     reg = obs_metrics.MetricsRegistry()
     reg.set_gauge("accl_health", obs_health.HEALTH_OK)

@@ -452,6 +452,437 @@ def test_shrink_without_deaths_is_a_fresh_comm():
 
 
 # ---------------------------------------------------------------------------
+# recovery edge races (r11 satellite): abort/shrink interleavings
+# ---------------------------------------------------------------------------
+def test_double_abort_is_idempotent():
+    # two aborts of one comm (e.g. two survivors both classifying the
+    # same failure, or a watchdog racing an application abort) must be
+    # indistinguishable from one: epochs stay monotonic, waiters wake
+    # once, the second abort neither raises nor resurrects the comm
+    with EmuWorld(2) as world:
+        def fn(accl, rank):
+            if rank == 0:
+                time.sleep(0.3)
+                accl.abort(0)
+                accl.abort(0)  # idempotent re-revoke
+                with pytest.raises(ACCLError):
+                    accl.barrier()  # still fenced after the second
+            else:
+                dst = accl.create_buffer(COUNT, np.float32)
+                with pytest.raises(ACCLError) as e:
+                    accl.recv(dst, COUNT, 0, tag=9)
+                assert e.value.code & int(ErrorCode.COMM_ABORTED)
+                accl.abort(0)  # cross-rank double abort, same contract
+
+        world.run(fn)
+        # both ranks re-aborting bumped epochs monotonically — no
+        # wraparound/rollback (the handle_abort CAS adopts max only)
+        assert world.devices[0].comm_epoch(0) >= 1
+        assert world.devices[1].comm_epoch(0) >= 1
+
+
+def test_shrink_concurrent_with_watchdog_abort():
+    # a watchdog-triggered abort (action=abort) landing WHILE the
+    # survivors are already inside shrink_communicator must not corrupt
+    # the shrink: the probe runs on the control plane (epoch-agnostic)
+    # and the fresh comm id is minted identically everywhere
+    with EmuWorld(3) as world:
+        world.start_watchdog(timeout_s=1.0, action="abort", dump_path="")
+
+        def fn(accl, rank):
+            accl.set_timeout(1_500_000)
+            if rank == 0:
+                # withheld from the gang: the watchdog will abort comm 0
+                # while ranks 1-2 are mid-recovery
+                time.sleep(2.0)
+                accl.abort(0, error=int(ErrorCode.RANK_FAILED))
+                nc = accl.shrink_communicator(0, window_s=2.0)
+                return nc
+            s = accl.create_buffer_like(_data(COUNT, salt=rank))
+            r = accl.create_buffer(COUNT, np.float32)
+            with pytest.raises(ACCLError):
+                accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            accl.abort(0, error=int(ErrorCode.RANK_FAILED))
+            nc = accl.shrink_communicator(0, window_s=2.0)
+            return nc
+
+        outs = world.run(fn)
+        assert len(set(outs)) == 1, f"shrink ids diverged: {outs}"
+
+        def verify(accl, rank, comm_id):
+            s = accl.create_buffer_like(_data(8, salt=rank))
+            r = accl.create_buffer(8, np.float32)
+            accl.allreduce(s, r, 8, ReduceFunction.SUM, comm_id=comm_id)
+            return r.host.copy()
+
+        post = world.run(verify, outs[0])
+        expected = np.sum([_data(8, salt=q) for q in range(3)], axis=0)
+        for out in post:
+            np.testing.assert_allclose(out, expected, rtol=1e-6,
+                                       atol=1e-5)
+
+
+def test_all_alive_shrink_mints_identical_ids_every_rank():
+    # repeated all-alive shrinks are pure comm mints: every rank must
+    # observe the SAME fresh id at every step (the create-order
+    # discipline), and the last comm must still collectively work
+    with EmuWorld(3) as world:
+        def fn(accl, rank):
+            ids = [accl.shrink_communicator(0, window_s=1.0)
+                   for _ in range(3)]
+            s = accl.create_buffer_like(_data(8, salt=rank))
+            r = accl.create_buffer(8, np.float32)
+            accl.allreduce(s, r, 8, ReduceFunction.SUM, comm_id=ids[-1])
+            return ids, r.host.copy()
+
+        outs = world.run(fn)
+        ids = {tuple(o[0]) for o in outs}
+        assert len(ids) == 1, f"per-rank shrink id sequences: {ids}"
+        assert list(ids.pop()) == [1, 2, 3]
+        expected = np.sum([_data(8, salt=q) for q in range(3)], axis=0)
+        for _ids, out in outs:
+            np.testing.assert_allclose(out, expected, rtol=1e-6,
+                                       atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# probe validation + env knob clear errors (r11 satellites)
+# ---------------------------------------------------------------------------
+def test_probe_alive_rejects_bad_window_and_overlong_result():
+    from accl_tpu.resilience.membership import probe_alive
+
+    with EmuWorld(2) as world:
+        accl = world.accls[0]
+        with pytest.raises(ACCLError, match=r"window_s.*> 0"):
+            probe_alive(accl, 0, window_s=0.0)
+        with pytest.raises(ACCLError, match="comm 0"):
+            probe_alive(accl, 0, window_s=-1.0)
+
+        # a backend handing back liveness for a DIFFERENT world must be
+        # refused, not truncated (a shrink built from it could exclude
+        # the wrong ranks); a short answer still pads with dead
+        real = accl.device.probe_liveness
+        try:
+            accl.device.probe_liveness = \
+                lambda c, n, w: [True, True, True, False]
+            with pytest.raises(ACCLError, match="refusing to truncate"):
+                probe_alive(accl, 0, window_s=0.5)
+            accl.device.probe_liveness = lambda c, n, w: [True]
+            assert probe_alive(accl, 0, window_s=0.5) == [True, False]
+        finally:
+            accl.device.probe_liveness = real
+
+
+def test_env_knobs_raise_naming_errors(monkeypatch):
+    from accl_tpu.observability.flight import FlightRecorder
+    from accl_tpu.observability.health import watchdog_timeout_s
+
+    monkeypatch.setenv("ACCL_RETRY_MAX", "lots")
+    with pytest.raises(ACCLError, match="ACCL_RETRY_MAX"):
+        RetryPolicy.from_env()
+    monkeypatch.setenv("ACCL_RETRY_MAX", "-3")
+    with pytest.raises(ACCLError, match="ACCL_RETRY_MAX"):
+        RetryPolicy.from_env()
+    monkeypatch.delenv("ACCL_RETRY_MAX")
+    monkeypatch.setenv("ACCL_RETRY_BASE_US", "fast")
+    with pytest.raises(ACCLError, match="ACCL_RETRY_BASE_US"):
+        RetryPolicy.from_env()
+    monkeypatch.delenv("ACCL_RETRY_BASE_US")
+    monkeypatch.setenv("ACCL_WATCHDOG_TIMEOUT", "five minutes")
+    with pytest.raises(ACCLError, match="ACCL_WATCHDOG_TIMEOUT"):
+        watchdog_timeout_s()
+    monkeypatch.delenv("ACCL_WATCHDOG_TIMEOUT")
+    monkeypatch.setenv("ACCL_FLIGHT_CAP", "big")
+    with pytest.raises(ACCLError, match="ACCL_FLIGHT_CAP"):
+        FlightRecorder(0)
+    monkeypatch.delenv("ACCL_FLIGHT_CAP")
+    from accl_tpu.resilience.supervisor import RecoveryPolicy
+
+    monkeypatch.setenv("ACCL_RECOVERY", "pray")
+    with pytest.raises(ACCLError, match="ACCL_RECOVERY"):
+        RecoveryPolicy()
+    monkeypatch.setenv("ACCL_RECOVERY", "grow")
+    monkeypatch.setenv("ACCL_JOIN_WAIT_S", "soon")
+    with pytest.raises(ACCLError, match="ACCL_JOIN_WAIT_S"):
+        RecoveryPolicy()
+
+
+def test_chaos_plan_join_rank_grammar():
+    plan = ChaosPlan.parse("seed=5,kill_rank=2,join_rank=2")
+    assert plan.kills == [2] and plan.joins == [2]
+    assert ChaosPlan.parse(plan.spec()) == plan  # round-trips
+    with pytest.raises(ACCLError):
+        ChaosPlan.parse("join_rank=x")
+
+
+# ---------------------------------------------------------------------------
+# elastic membership (r11 tentpole): join + grow + supervisor
+# ---------------------------------------------------------------------------
+def test_spawn_replacement_grow_healthy_world():
+    # grow without any death: a 2-rank world admits a third live rank;
+    # the grown comm works collectively and the old comm is untouched
+    from accl_tpu.resilience.elastic import admit_pending
+
+    with EmuWorld(2) as world:
+        joiner = world.spawn_replacement()
+        out = {}
+
+        def joiner_thread():
+            cid = out["comm"] = joiner.join(timeout_s=20.0)
+            s = joiner.accl.create_buffer_like(np.full(8, 4.0,
+                                                       np.float32))
+            r = joiner.accl.create_buffer(8, np.float32)
+            joiner.accl.allreduce(s, r, 8, ReduceFunction.SUM,
+                                  comm_id=cid)
+            out["result"] = r.host.copy()
+
+        jt = threading.Thread(target=joiner_thread, daemon=True)
+        jt.start()
+
+        def fn(accl, rank):
+            new_comm, n = admit_pending(accl, 0, world.board,
+                                        wait_s=5.0, window_s=1.0)
+            assert n == 1
+            s = accl.create_buffer_like(
+                np.full(8, float(rank + 1), np.float32))
+            r = accl.create_buffer(8, np.float32)
+            accl.allreduce(s, r, 8, ReduceFunction.SUM,
+                           comm_id=new_comm)
+            # the ORIGINAL comm still works: growing drained nothing
+            accl.barrier(comm_id=0)
+            return new_comm, r.host.copy()
+
+        res = world.run(fn)
+        jt.join(timeout=30)
+        assert not jt.is_alive()
+        assert res[0][0] == res[1][0] == out["comm"] == 1
+        np.testing.assert_array_equal(res[0][1], np.full(8, 7.0,
+                                                         np.float32))
+        np.testing.assert_array_equal(out["result"],
+                                      np.full(8, 7.0, np.float32))
+        # engine-level join handshake really ran (Join/Welcome/
+        # StateSync): the sponsor answered, the joiner completed
+        stats = joiner.device.join_stats()
+        assert stats["joined"] == 1
+        assert sum(world.devices[r].join_stats()["sponsored"]
+                   for r in range(2)) == 1
+
+
+def test_placeholder_comms_fail_fast_on_joiner():
+    # a joiner's padded id space: calls on a placeholder slot raise a
+    # decodable error in the driver, and the engine fences strays
+    from accl_tpu.resilience.elastic import admit_pending
+
+    with EmuWorld(2) as world:
+        joiner = world.spawn_replacement()
+        out = {}
+
+        def joiner_thread():
+            # make the id space interesting: survivors mint one extra
+            # comm before the admission, so the joiner pads TWO slots
+            out["comm"] = joiner.join(timeout_s=20.0)
+
+        jt = threading.Thread(target=joiner_thread, daemon=True)
+        jt.start()
+
+        def fn(accl, rank):
+            accl.create_communicator([0, 1])  # id 1 (joiner never saw)
+            new_comm, n = admit_pending(accl, 0, world.board,
+                                        wait_s=5.0, window_s=1.0)
+            return new_comm
+
+        res = world.run(fn)
+        jt.join(timeout=30)
+        assert res[0] == out["comm"] == 2
+        # comm 1 is a placeholder on the joiner: decodable fast-fail
+        with pytest.raises(ACCLError, match="placeholder"):
+            joiner.accl.communicator(1)
+        s = joiner.accl.create_buffer_like(np.ones(4, np.float32))
+        r = joiner.accl.create_buffer(4, np.float32)
+        with pytest.raises(ACCLError, match="placeholder"):
+            joiner.accl.allreduce(s, r, 4, ReduceFunction.SUM,
+                                  comm_id=1)
+
+
+def test_supervised_kill_shrink_join_grow_resume():
+    # the tier-1 twin of the CI join drill (scripts/chaos_smoke.py
+    # drill 3), smaller: the per-rank supervisors drive kill -> abort
+    # -> probe -> shrink -> admit -> grow -> agree -> resume; the world
+    # returns to full size and the replacement participates
+    from accl_tpu.resilience.supervisor import RecoveryPolicy
+
+    nranks, iters, count = 3, 4, 16
+    victim = 1
+
+    def local_data(accl, comm_id, it):
+        comm = accl.communicator(comm_id)
+        rng = np.random.default_rng(70 * comm.local_rank + it)
+        return rng.standard_normal(count).astype(np.float32), comm.size
+
+    with EmuWorld(nranks) as world:
+        for a in world.accls:
+            a.set_timeout(1_500_000)
+        policy = dict(mode="grow", join_wait_s=8.0, probe_window_s=1.0,
+                      max_rounds=2)
+        join_out = {}
+
+        def replacement():
+            time.sleep(0.8)
+            j = world.spawn_replacement()
+            cid = j.join(timeout_s=30.0)
+            j.accl.set_timeout(30_000_000)
+            sup = j.accl.supervise(policy=RecoveryPolicy(**policy),
+                                   board=world.board)
+            sup.comm_id = cid
+            restart = sup.agree_restart(0, fresh=True)
+            outs = {}
+
+            def step(a, c, it):
+                data, size = local_data(a, c, it)
+                s = a.create_buffer_like(data)
+                r = a.create_buffer(count, np.float32)
+                a.allreduce(s, r, count, ReduceFunction.SUM, comm_id=c)
+                outs[it] = (size, r.host.copy())
+
+            sup.run_loop(step, iters, comm_id=cid,
+                         start_iteration=restart)
+            join_out.update(outs=outs, restart=restart)
+
+        jt = threading.Thread(target=replacement, daemon=True)
+        jt.start()
+
+        def supervised(accl, rank):
+            from accl_tpu.resilience.supervisor import RecoveryPolicy
+
+            sup = accl.supervise(policy=RecoveryPolicy(**policy),
+                                 board=world.board)
+            outs = {}
+
+            def step(a, comm_id, it):
+                if rank == victim and it == 1:
+                    world.kill_rank(victim)
+                data, size = local_data(a, comm_id, it)
+                s = a.create_buffer_like(data)
+                r = a.create_buffer(count, np.float32)
+                a.allreduce(s, r, count, ReduceFunction.SUM,
+                            comm_id=comm_id)
+                outs[it] = (size, r.host.copy())
+
+            try:
+                summary = sup.run_loop(
+                    step, iters, comm_id=0,
+                    on_restart=lambda i: [outs.pop(k) for k in
+                                          list(outs) if k >= i])
+            except ACCLError as e:
+                assert rank == victim, f"survivor {rank} died: {e}"
+                # the victim halts ISOLATED, never shrinks to itself
+                assert "isolated" in str(e)
+                return ("dead", sup.state_log)
+            return ("alive", outs, summary)
+
+        res = world.run(supervised)
+        jt.join(timeout=60)
+        assert not jt.is_alive() and "outs" in join_out
+        assert res[victim][0] == "dead"
+        survivors = [r for r in range(nranks) if r != victim]
+        for r in survivors:
+            state, outs, summary = res[r]
+            assert state == "alive"
+            assert sorted(outs) == list(range(iters))
+            # the supervisor drove the whole episode
+            states = [s for _t, s, _d in summary["state_log"]]
+            for needed in ("abort", "probe", "shrink", "grow",
+                           "agree", "resume"):
+                assert needed in states, (needed, states)
+            # world back at original size for every post-recovery iter
+            assert {outs[k][0] for k in outs} == {nranks}
+        # replacement fully participated at full size
+        assert {v[0] for v in join_out["outs"].values()} == {nranks}
+        # every member agrees on the result values per iteration
+        for it in range(iters):
+            vals = [res[r][1][it][1] for r in survivors]
+            if it in join_out["outs"]:
+                vals.append(join_out["outs"][it][1])
+            for v in vals[1:]:
+                np.testing.assert_array_equal(v, vals[0])
+        # observability: membership counters moved and the flight rings
+        # carry retired recovery/<phase> records
+        from accl_tpu.observability import metrics as obs_metrics
+
+        snap = obs_metrics.default_registry().snapshot()
+        assert snap["counters"].get("membership/joins", 0) >= 1
+        assert snap["counters"].get("membership/shrinks", 0) >= 1
+        assert snap["counters"].get("membership/grows", 0) >= 1
+        assert snap["counters"].get("recovery/rounds", 0) >= 1
+        assert snap["values"].get("recovery/latency_us",
+                                  {}).get("count", 0) >= 1
+        recs = [rec for a in world.accls
+                for rec in a.flight_recorder.records()
+                if rec.collective.startswith("recovery/")]
+        assert recs, "no recovery phase records in the flight rings"
+        assert all(not rec.in_flight for rec in recs)
+
+
+def test_supervisor_shrink_policy_finishes_smaller():
+    # default policy (shrink): a killed rank's world finishes at the
+    # smaller size with no join machinery involved
+    from accl_tpu.resilience.supervisor import RecoveryPolicy
+
+    nranks, iters, count = 3, 3, 16
+    with EmuWorld(nranks) as world:
+        for a in world.accls:
+            a.set_timeout(1_500_000)
+
+        def supervised(accl, rank):
+            sup = accl.supervise(
+                policy=RecoveryPolicy(mode="shrink",
+                                      probe_window_s=1.0),
+                board=world.board)
+            outs = {}
+
+            def step(a, comm_id, it):
+                if rank == 2 and it == 1:
+                    world.kill_rank(2)
+                comm = a.communicator(comm_id)
+                s = a.create_buffer_like(
+                    _data(count, salt=comm.local_rank + 7 * it))
+                r = a.create_buffer(count, np.float32)
+                a.allreduce(s, r, count, ReduceFunction.SUM,
+                            comm_id=comm_id)
+                outs[it] = (comm.size, r.host.copy())
+
+            try:
+                sup.run_loop(step, iters, comm_id=0,
+                             on_restart=lambda i: [outs.pop(k) for k in
+                                                   list(outs) if k >= i])
+            except ACCLError:
+                assert rank == 2
+                return "dead"
+            return outs
+
+        res = world.run(supervised)
+        assert res[2] == "dead"
+        for r in (0, 1):
+            outs = res[r]
+            assert sorted(outs) == list(range(iters))
+            # post-recovery iterations ran on the 2-rank survivor comm
+            assert outs[iters - 1][0] == 2
+
+
+def test_supervisor_health_gauge_recovering():
+    from accl_tpu.observability import health as oh
+    from accl_tpu.observability import metrics as om
+
+    reg = om.MetricsRegistry()
+    oh.note_recovering(reg, True)
+    assert reg.snapshot()["gauges"]["accl_health"] == \
+        oh.HEALTH_RECOVERING
+    assert oh.HEALTH_NAMES[oh.HEALTH_RECOVERING] == "recovering"
+    oh.note_recovering(reg, False)
+    assert reg.snapshot()["gauges"]["accl_health"] == oh.HEALTH_OK
+
+
+# ---------------------------------------------------------------------------
 # soak (slow-marked: excluded from tier-1, run by the nightly lane)
 # ---------------------------------------------------------------------------
 @pytest.mark.slow

@@ -995,3 +995,99 @@ def test_leader_dispatch_runs_outside_the_submission_lock():
     finally:
         TpuEngine._exec_gang = orig_exec
     assert not held, f"submission lock held during dispatch by ranks {held}"
+
+
+def test_elastic_state_sync_and_grow_rejoin():
+    # r11 elastic membership on the TPU rung: sponsor-side state sync
+    # (export_join_state), gang-table rebuild (partial gangs + cached
+    # plans of a dead comm drained), and a grown communicator a
+    # late-joining rank adopts after padding its comm-id space — the
+    # same id-alignment discipline the emulator rung's wire protocol
+    # enforces, collapsed to the in-process scheduler.
+    import threading
+
+    from accl_tpu import ACCLError
+    from accl_tpu.communicator import Communicator, Rank
+    from accl_tpu.constants import ErrorCode
+
+    barrier = threading.Barrier(NRANKS, timeout=60)
+    state = {}
+
+    with TpuWorld(NRANKS) as world:
+        def fn(accl, rank):
+            # ranks 0-2 mint a sub-comm the late rank never saw
+            if rank != 3:
+                assert accl.create_communicator([0, 1, 2]) == 1
+            barrier.wait()
+            if rank == 1:
+                # a PARTIAL gang on comm 1 (only this rank arrives)
+                s = accl.create_buffer_like(_data(COUNT, rank))
+                r = accl.create_buffer(COUNT, np.float32)
+                state["partial"] = accl.allreduce(
+                    s, r, COUNT, ReduceFunction.SUM, comm_id=1,
+                    run_async=True)
+            if rank == 2:
+                # a PENDING p2p recv on comm 1 (nothing ever sent):
+                # the rebuild must finalize its request too, not
+                # silently evict it (the blocked waiter would
+                # otherwise only wake at the driver budget)
+                d = accl.create_buffer(COUNT, np.float32)
+                state["precv"] = accl.recv(d, COUNT, 0, tag=77,
+                                           comm_id=1, run_async=True)
+            barrier.wait()
+            if rank == 0:
+                st = accl.device.export_join_state(1)
+                assert st["comm_count"] == 2
+                assert st["members"] == [0, 1, 2]
+                # the rebuild drains the stale partial gang AND the
+                # pending p2p recv
+                assert accl.device.rebuild_gang_tables(1) >= 2
+            barrier.wait()
+            if rank == 1:
+                req = state["partial"]
+                assert req.wait(30)
+                assert req.aborted
+                with pytest.raises(ACCLError):
+                    req.check()
+            if rank == 2:
+                req = state["precv"]
+                assert req.wait(30)
+                assert req.aborted
+            if rank == 0:
+                accl.abort(1, error=int(ErrorCode.RANK_FAILED))
+                assert accl.device.export_join_state(1)["aborted"]
+            barrier.wait()
+            # grow comm 1 back to full size; rank 3 is the "joiner".
+            # The joiner syncs + pads BEFORE any survivor's grow upload
+            # bumps the shared scheduler's comm count — the same
+            # sponsor-defers-until-synced ordering the emulator rung's
+            # wire protocol enforces (here a barrier plays the ack).
+            new_row = Rank(ip="127.0.0.1", port=0, session=3)
+            if rank == 3:
+                assert accl.device.join_sync(0) == 0
+                assert accl.device.comm_count() == 2
+                accl._pad_communicators(2)
+                with pytest.raises(ACCLError, match="placeholder"):
+                    accl.communicator(1)
+            barrier.wait()
+            if rank != 3:
+                gid = accl.grow_communicator([new_row], comm_id=1,
+                                             window_s=0.2)
+            else:
+                rows = [Rank(ip="127.0.0.1", port=0, session=i)
+                        for i in range(3)] + [new_row]
+                gid = accl._install_communicator(
+                    Communicator(rows, 3, comm_id=2))
+            assert gid == 2
+            barrier.wait()
+            s = accl.create_buffer_like(_data(COUNT, rank, salt=9))
+            r = accl.create_buffer(COUNT, np.float32)
+            accl.allreduce(s, r, COUNT, ReduceFunction.SUM, comm_id=gid)
+            return r.host.copy()
+
+        outs = world.run(fn)
+        expected = np.sum([_data(COUNT, q, salt=9)
+                           for q in range(NRANKS)], axis=0)
+        for out in outs:
+            np.testing.assert_allclose(out, expected, rtol=1e-5,
+                                       atol=1e-5)
