@@ -50,6 +50,8 @@ from .observability import trace as _trace
 from .request import Request, RequestQueue
 from .utils.logging import get_logger
 
+from . import plans as _plans
+
 GLOBAL_COMM = 0  # id of the world communicator, like the reference's comm 0
 
 #: scenarios that form cross-rank gangs in the engines (one instance ==
@@ -134,6 +136,27 @@ class ACCL:
         #: at initialize; None adds ZERO per-call code (loop-level, not
         #: call-level — the hot path never consults it)
         self.supervisor = None
+        #: persistent collective plans (accl_tpu/plans.py): weak refs to
+        #: live plans (abort/reset/shrink/grow invalidate them), the
+        #: capture recorder installed by capture_plan for the duration
+        #: of the captured function, and the per-driver capture group
+        #: counter the pooled cross-rank validation pairs on.  Off-path
+        #: cost in _execute is one falsy read per lane.
+        self._plans: list = []
+        self._plan_recorder = None
+        #: per-(domain, member-set) capture counters: the pooled
+        #: cross-rank validation pairs the K-th capture of the SAME
+        #: member group across ranks, so disjoint sub-comm captures
+        #: never skew each other's pairing
+        self._plan_group_seq: dict = {}
+        #: ACCL_PLAN_AUTO state (armed at initialize): streak-detect
+        #: identical resident sync gang calls and transparently route
+        #: them through a one-step plan ring once every gang member
+        #: agreed (None = auto lane off, zero per-call cost)
+        self._plan_auto = 0
+        self._auto_rings: Optional[dict] = None
+        self._auto_last = None
+        self._auto_streak = 0
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -223,6 +246,12 @@ class ACCL:
         #    nothing when it is off (the default)
         if os.environ.get("ACCL_SUPERVISE", "0") == "1":
             self.supervisor = self.supervise()
+
+        # 10. plan auto-capture (ACCL_PLAN_AUTO=N; honors ACCL_PLAN=0):
+        #     the env is read here, not at import, so tests and worlds
+        #     created after an env change see it
+        self._plan_auto = _plans.auto_threshold()
+        self._auto_rings = {} if self._plan_auto else None
 
     # ------------------------------------------------------------------
     # properties / config
@@ -370,6 +399,7 @@ class ACCL:
         self.communicator(comm_id)  # raises the naming error on bad ids
         err = int(error) | int(ErrorCode.COMM_ABORTED)
         self._aborted_comms.add(comm_id)
+        self._invalidate_plans(comm_id, "communicator aborted")
         handled = self._device.abort_comm(comm_id, err)
         if not handled:
             # backend has no engine-side abort: fail the driver-tracked
@@ -398,6 +428,13 @@ class ACCL:
         from .resilience.membership import shrink as _shrink
 
         new_id = _shrink(self, comm_id, window_s)
+        # plan fencing: a healed world must never replay a dead comm's
+        # plan — fence driver-side plans AND the engine-side ring/cache
+        # (the emu engine drains its plan slots here, not only on abort)
+        self._invalidate_plans(comm_id, "communicator shrunk")
+        inv = getattr(self._device, "invalidate_plans", None)
+        if inv is not None:
+            inv(comm_id)
         if _metrics.enabled():
             _metrics.default_registry().inc("membership/shrinks")
         return new_id
@@ -417,7 +454,14 @@ class ACCL:
         behind its bumped epoch, it is never drained."""
         from .resilience.elastic import grow as _grow
 
-        return _grow(self, new_ranks, comm_id, window_s)
+        new_id = _grow(self, new_ranks, comm_id, window_s)
+        # same plan-fencing contract as shrink: membership changed, the
+        # captured world no longer exists
+        self._invalidate_plans(comm_id, "communicator grown")
+        inv = getattr(self._device, "invalidate_plans", None)
+        if inv is not None:
+            inv(comm_id)
+        return new_id
 
     def supervise(self, policy=None, board=None, registry=None):
         """Arm (and return) a recovery supervisor for this rank — the
@@ -433,6 +477,90 @@ class ACCL:
                                              board=board,
                                              registry=registry)
         return self.supervisor
+
+    # ------------------------------------------------------------------
+    # persistent collective plans (accl_tpu/plans.py;
+    # docs/performance.md "Persistent plans")
+    # ------------------------------------------------------------------
+    def capture_plan(self, fn, *args, validate: bool = True,
+                     timeout_s: Optional[float] = None):
+        """Capture ``fn(self, *args)``'s collective calls into a
+        persistent plan: recorded once (the calls still execute — the
+        capture iteration's results are real), validated once (the
+        sanitizer checker suite; an error finding fails the capture
+        naming it), lowered once into the backend's pre-resolved
+        submission ring, then replayed with ``plan.replay()`` at ring
+        speed.  Under ``ACCL_PLAN=0`` returns an eager fallback whose
+        replay re-runs ``fn`` through the normal per-call path."""
+        if not _plans.enabled():
+            fn(self, *args)  # the capture iteration still executes
+            return _plans.EagerPlan(self, fn, args)
+        if self._plan_recorder is not None:
+            raise ACCLError("capture_plan: a capture is already in "
+                            "progress on this driver (no nesting)")
+        recorder = _plans.PlanRecorder(self)
+        self._plan_recorder = recorder
+        try:
+            fn(self, *args)
+        finally:
+            self._plan_recorder = None
+        return _plans.build_plan(self, recorder, validate=validate,
+                                 timeout_s=timeout_s)
+
+    def _invalidate_plans(self, comm_id: Optional[int],
+                          reason: str) -> None:
+        """Fence live plans touching ``comm_id`` (None = all): part of
+        the abort/reset/shrink/grow contract — a replay must raise (or
+        transparently re-capture, on the auto lane) after any epoch
+        fence, never silently run the dead world's program."""
+        live = []
+        for ref in self._plans:
+            p = ref()
+            if p is None:
+                continue
+            live.append(ref)
+            if comm_id is None or comm_id in p.comms:
+                p._invalidate(reason)
+        self._plans = live
+        if self._auto_rings:
+            self._auto_rings.clear()
+        self._auto_last = None
+        self._auto_streak = 0
+
+    def _replay_auto(self, entry, desc: str) -> Optional[Request]:
+        """Route one auto-captured call through its plan ring; returns
+        a completed Request, or None when the ring was invalidated by
+        an epoch fence (the caller falls through to the eager path,
+        which re-captures — or fast-fails if the comm is still dead)."""
+        call, ring = entry
+        rec = None
+        if self.flight_recorder is not None and _flight.enabled():
+            rec = self.flight_recorder.new_record(
+                next(_plans._replay_ids), "plan_replay", call.comm,
+                call.tag, "plan", call.count, 0, self.comm.size, True,
+                _trace.now_ns())
+            rec.mark_dispatched("plan", _trace.now_ns())
+        try:
+            self._device.plan_replay(ring, run_async=False,
+                                     timeout_s=self.call_timeout_s)
+        except ACCLError as e:
+            code = int(getattr(e, "code", 0))
+            if rec is not None:
+                rec.finish(code or int(ErrorCode.DMA_INTERNAL_ERROR),
+                           _trace.now_ns())
+            self._auto_rings.pop(id(call), None)
+            if code & int(ErrorCode.COMM_ABORTED) \
+                    or "invalidated" in str(e):
+                return None  # fenced: transparent re-capture via eager
+            raise
+        if rec is not None:
+            rec.finish(0, _trace.now_ns())
+        if _metrics.enabled():
+            _metrics.default_registry().inc("plans/replays")
+        req = Request(desc, sync=True)
+        req.complete(0, 0.0)
+        self._last_request = req
+        return req
 
     def _install_communicator(self, comm: Communicator) -> int:
         """Append + upload an explicitly-built communicator (the elastic
@@ -466,6 +594,11 @@ class ACCL:
         next collective on the same world must succeed (the
         fixture-reuse contract in tests/test_fault_injection.py)."""
         self._aborted_comms.clear()
+        # plan fencing: reset_errors is a world-state discontinuity —
+        # every plan (driver + engine side) is invalidated; re-capture
+        # on the recovered world (the emu engine drains its own plan
+        # slots inside reset_errors, the TPU engine in reset below)
+        self._invalidate_plans(None, "reset_errors")
         self._device.reset_errors()
 
     def resilience_stats(self) -> dict:
@@ -1114,6 +1247,27 @@ class ACCL:
         # placeholder fast-fail (elastic join): same falsy-set cost
         if self._placeholder_comms and call.comm in self._placeholder_comms:
             self.communicator(call.comm)  # raises the naming ACCLError
+        # plan auto-replay (ACCL_PLAN_AUTO, accl_tpu/plans.py): a call
+        # whose gang agreed to arm a one-step ring replays through it —
+        # no descriptor work, no gang assembly, no per-call request
+        # plumbing.  One falsy read when the auto lane is off; the
+        # identity check (`is`) is sound because _build memoizes: the
+        # steady-state loop returns the SAME CCLOCall object each step.
+        # Placed after the abort fast-fail so a fenced comm raises
+        # before any replay could run on a dead epoch.
+        if self._auto_rings is not None and not run_async:
+            entry = self._auto_rings.get(id(call))
+            if entry is not None and entry[0] is call \
+                    and self._plan_recorder is None \
+                    and not _san.active():
+                # the recorder/sanitizer guards keep an armed ring from
+                # short-circuiting an explicit capture_plan or an
+                # ACCL_SANITIZE lane that must observe every call
+                replayed = self._replay_auto(entry, desc)
+                if replayed is not None:
+                    return replayed
+                # ring fenced: fall through to the eager path, which
+                # re-captures (or fast-fails if the comm is still dead)
         # observability gate first: one module-bool read each when all
         # are off, and t_submit marks user-call entry (operand staging
         # below is inside the submit→queue window by design).  The
@@ -1149,6 +1303,29 @@ class ACCL:
         # shadow CaptureSession records the descriptor the same way.
         if _san.active():
             _san.on_call(self, call, desc, req, run_async)
+        # plan capture (capture_plan in progress): shadow-record the
+        # descriptor + staging spec; the call still executes below, so
+        # the capture iteration's results are real.  One falsy read
+        # when no capture is installed.
+        if self._plan_recorder is not None:
+            self._plan_recorder.on_call(call, sync_in, sync_out,
+                                        run_async, desc, req)
+        # plan auto-capture intent (ACCL_PLAN_AUTO): after N identical
+        # resident sync gang calls, mark intent on the request — the
+        # engine arms a ring only when EVERY member of the same gang
+        # instance carries intent, so no rank ever replays against an
+        # eager peer (the agreement rides the gang itself)
+        if self._auto_rings is not None and not run_async \
+                and not sync_in and not sync_out \
+                and self._plan_recorder is None and not _san.active() \
+                and call.scenario in _GANG_OPS:
+            if call is self._auto_last:
+                self._auto_streak += 1
+                if self._auto_streak >= self._plan_auto:
+                    req.plan_intent = True
+            else:
+                self._auto_last = call
+                self._auto_streak = 1
 
         if sync_out:  # device-resident results need no completion sync
             def finish(r: Request) -> None:
@@ -1179,6 +1356,11 @@ class ACCL:
             raise ACCLError(f"{desc} timed out waiting for engine "
                             f"completion{req.flight_info()}")
         req.check()
+        # plan auto-capture adoption: the engine published a ring on
+        # this request (every member of the gang carried intent) —
+        # subsequent identical calls route through _replay_auto
+        if self._auto_rings is not None and req.plan_ring is not None:
+            self._auto_rings[id(call)] = (call, req.plan_ring)
         return req
 
     def _observe_call(self, call: CCLOCall, desc: str, req: Request,

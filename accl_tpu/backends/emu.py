@@ -26,7 +26,7 @@ from ..accl import ACCL, default_timeout
 from ..arithconfig import ArithConfig
 from ..buffer import BaseBuffer, EmuBuffer, EmuBufferP2P
 from ..communicator import Communicator, Rank
-from ..constants import ACCLError, CCLOCall
+from ..constants import ACCLError, CCLOCall, ErrorCode
 from ..observability import health as _health
 from ..observability import trace as _trace
 from ..request import Request
@@ -165,6 +165,24 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_comm_epoch.argtypes = [p, i32, i32]
     lib.accl_join_stats.argtypes = [p, i32, ctypes.POINTER(u64),
                                     ctypes.POINTER(u64)]
+    # persistent collective plans (r12): pre-marshaled descriptor ring
+    i64 = ctypes.c_longlong
+    lib.accl_plan_create.restype = i32
+    lib.accl_plan_create.argtypes = [p, i32, ctypes.POINTER(u32), i32]
+    lib.accl_plan_replay.restype = i64
+    lib.accl_plan_replay.argtypes = [p, i32, i32]
+    lib.accl_plan_poll.restype = i32
+    lib.accl_plan_poll.argtypes = [p, i32, i64, ctypes.POINTER(u32),
+                                   ctypes.POINTER(ctypes.c_double)]
+    lib.accl_plan_wait.restype = i32
+    lib.accl_plan_wait.argtypes = [p, i32, i64, i32, ctypes.POINTER(u32),
+                                   ctypes.POINTER(ctypes.c_double)]
+    lib.accl_plan_invalidate.restype = i32
+    lib.accl_plan_invalidate.argtypes = [p, i32, i32]
+    lib.accl_plan_count.restype = i32
+    lib.accl_plan_count.argtypes = [p, i32]
+    lib.accl_plan_release.restype = i32
+    lib.accl_plan_release.argtypes = [p, i32, i32]
     _lib = lib
     return lib
 
@@ -434,6 +452,87 @@ class EmuDevice(CCLODevice):
         keys = ("retrans_sent", "nacks_tx", "nacks_rx", "fenced_drops")
         return dict(zip(keys, (int(v.value) for v in vals)))
 
+    # -- persistent collective plans (r12) ----------------------------
+    def arm_plan(self, calls, expected=None, timeout_s: float = 30.0):
+        """Pre-marshal a captured descriptor stream into the engine's
+        plan storage: every 15-word descriptor is parsed ONCE here; a
+        replay is a single FFI entry for the whole batch (no per-call
+        Python marshaling, no per-call FFI).  Per-rank — the engine's
+        own wire protocol pairs the gangs across ranks, exactly as it
+        does for eager calls."""
+        words: list = []
+        for call in calls:
+            words.extend(call.to_words())
+        pid = int(self._lib.accl_plan_create(
+            self._w, self._rank, _words(words), len(calls)))
+        if pid < 0:
+            raise ACCLError(
+                "arm_plan: engine rejected the descriptor batch (a "
+                "referenced communicator is aborted, or the batch is "
+                "empty) — recover the world, then capture")
+        return pid
+
+    def plan_replay(self, plan_id: int, run_async: bool = False,
+                    timeout_s: float = 60.0):
+        """One replay of the armed batch.  Sync (default): blocks until
+        every call completed and raises on a non-zero combined retcode.
+        Async: returns the completion token for plan_wait."""
+        token = int(self._lib.accl_plan_replay(self._w, self._rank,
+                                               plan_id))
+        if token == -2:
+            raise ACCLError(
+                "plan replay: plan invalidated by an abort/epoch "
+                "fence/reset — re-capture on the recovered "
+                "communicator",
+                int(ErrorCode.COMM_ABORTED))
+        if token < 0:
+            raise ACCLError(f"plan replay: unknown plan id {plan_id}")
+        if run_async:
+            return token
+        if not self.plan_wait(plan_id, token, timeout_s):
+            raise ACCLError(
+                f"plan replay timed out after {timeout_s:.0f}s")
+        return None
+
+    def plan_wait(self, plan_id: int, token: int,
+                  timeout_s: float) -> bool:
+        """Block until a replay token completes (False on timeout);
+        raises the decoded engine error on a non-zero retcode."""
+        from ..constants import error_code_to_str
+
+        ret = ctypes.c_uint32(0)
+        dur = ctypes.c_double(0.0)
+        rc = int(self._lib.accl_plan_wait(
+            self._w, self._rank, token, int(timeout_s * 1000),
+            ctypes.byref(ret), ctypes.byref(dur)))
+        if rc == 0:
+            return False
+        if rc < 0:
+            raise ACCLError(f"plan replay: unknown token {token}")
+        if ret.value != 0:
+            raise ACCLError(
+                f"plan replay failed: {error_code_to_str(ret.value)}",
+                int(ret.value))
+        return True
+
+    def invalidate_plans(self, comm_id: int = -1) -> None:
+        """Fence engine-side plans touching a comm (-1 = all) — the
+        shrink/grow half of the eviction contract (abort and
+        reset_errors fence inside the engine on their own)."""
+        self._lib.accl_plan_invalidate(self._w, self._rank, comm_id)
+
+    def plan_count(self) -> int:
+        """Live (valid) engine-side plans — eviction introspection."""
+        return int(self._lib.accl_plan_count(self._w, self._rank))
+
+    def plan_release(self, plan_id: int) -> None:
+        """Release a dead plan's engine-side storage.  Called from a
+        GC finalizer, which may outlive the world — the null-handle
+        guard keeps a post-teardown release a no-op instead of a
+        use-after-free (EmuWorld.close nulls its devices' handles)."""
+        if self._w:
+            self._lib.accl_plan_release(self._w, self._rank, plan_id)
+
     # -- elastic membership (r11): join control plane -----------------
     def join_sync(self, sponsor_session: int,
                   timeout_s: float = 10.0) -> int:
@@ -512,6 +611,7 @@ class EmuRankTcp:
 
     def close(self) -> None:
         if self._handle:
+            self.device._w = None  # plan finalizers must no-op now
             self._lib.accl_world_destroy(self._handle)
             self._handle = None
 
@@ -739,6 +839,13 @@ class EmuWorld:
         self.watchdog.stop()
         self._pool.shutdown(wait=False)
         if self._handle:
+            # null the device handles FIRST: a plan finalizer (GC) may
+            # fire after this close, and its engine call must become a
+            # no-op rather than touch the freed world
+            for d in self.devices:
+                d._w = None
+            for j in self.joiners:
+                j.device._w = None
             self._lib.accl_world_destroy(self._handle)
             self._handle = None
 

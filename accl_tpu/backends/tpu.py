@@ -21,6 +21,7 @@ backend unchanged (SURVEY §4: one suite, every rung).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from functools import lru_cache, partial
@@ -183,6 +184,48 @@ def _mark_flight(gang: dict, state: int, lane: Optional[str] = None,
                 rec.t_gang_ready = t
 
 
+class PlanRing:
+    """Fixed-slot submission/completion ring for one armed persistent
+    plan (accl_tpu/plans.py; io_uring-style).
+
+    Every descriptor of the captured program is pre-resolved at arm
+    time into a *slot* — a pinned gang execution plan (buffers bound,
+    SPMD program compiled), a pre-paired p2p move, or a local op — so
+    a replay is nothing but a sequence-counter bump: the rank's
+    ``gen``-th replay joins generation ``gen``; the LAST member to
+    arrive executes every slot inline (it holds the whole world's
+    pre-resolved state — the leader-dispatch economics applied to the
+    entire program, one rendezvous per replay instead of one per call)
+    while the others wait on the completion side of the ring.  No
+    descriptor build, no dict lookups, no per-call allocation.
+
+    ``invalid`` is the epoch fence: abort / membership change /
+    reset_errors poisons the ring and wakes every waiter — a replay
+    can raise on a fenced plan but never silently run it."""
+
+    __slots__ = ("slots", "members", "nmembers", "comm_gens", "cv",
+                 "rank_gen", "gen_count", "done_gen", "invalid",
+                 "replays", "refs")
+
+    def __init__(self, slots: list, members: frozenset,
+                 comm_gens: dict):
+        self.slots = slots
+        self.members = members
+        self.nmembers = len(members)
+        #: per-rank plan handles sharing this ring (release_ring drops
+        #: the pinned state only when the LAST holder dies)
+        self.refs = 0
+        #: comm id -> engine fence generation at arm time; any bump
+        #: (abort/rebuild) makes the ring unreplayable
+        self.comm_gens = comm_gens
+        self.cv = threading.Condition()
+        self.rank_gen: dict = {}    # rank -> replays this rank issued
+        self.gen_count: dict = {}   # generation -> arrivals so far
+        self.done_gen = 0           # completed replay generations
+        self.invalid: Optional[str] = None
+        self.replays = 0
+
+
 class TpuEngine:
     """World-level gang scheduler + jitted collective executor."""
 
@@ -264,7 +307,7 @@ class TpuEngine:
         #: serialized inline lane, the rest on the executor thread.
         self.metrics = _metrics.MetricsRegistry()
         for k in ("leader_dispatches", "executor_dispatches", "batches",
-                  "batched_gangs"):
+                  "batched_gangs", "plan_replays", "plan_auto_captures"):
             self.metrics.inc(k, 0)
         self._log = get_logger("accl_tpu.tpu")
         #: hang watchdog (observability/health.py), armed by
@@ -282,6 +325,15 @@ class TpuEngine:
 
         self._gang_plans: "OrderedDict" = OrderedDict()
         self._gang_plans_cap = 256
+        # persistent-plan submission rings (accl_tpu/plans.py): armed
+        # rings (pinned — NOT subject to the _gang_plans LRU), the arm
+        # rendezvous board pairing concurrent per-rank arms into one
+        # ring, and the per-comm fence generation rings snapshot at arm
+        # (abort/rebuild bump it, fencing every dependent ring)
+        self._plan_rings: list = []
+        self._plan_board: list = []
+        self._plan_cv = threading.Condition()
+        self._comm_gen: dict = {}
         # kernel streams: (rank, strm_id) -> deque of np arrays
         self._streams: dict[tuple[int, int], deque] = {}
         self._stream_cv = threading.Condition()
@@ -601,6 +653,14 @@ class TpuEngine:
             _mark_flight(ready, _flight.S_GANG_READY, t=t_ready)
             if _trace.enabled():
                 _mark_spans(ready, t_ready=t_ready)
+            # plan auto-capture (ACCL_PLAN_AUTO): arm a one-slot ring
+            # when EVERY member of this instance declared intent — the
+            # agreement rides the gang itself, so all ranks switch to
+            # replay on the same future instance.  One attr read per
+            # member on the ready path, only here.
+            if all(r_.plan_intent for _c, r_, _k in ready.values()):
+                self._arm_auto_ring(int(call.scenario), call.comm,
+                                    ready)
             self._dispatch_gang(int(call.scenario), call.comm, ready,
                                 request)
 
@@ -684,6 +744,9 @@ class TpuEngine:
         drained = []
         with self._lock:
             self._aborted_comms[comm_id] = err_bits
+            # epoch fence for persistent plans: any ring armed against
+            # the pre-abort world is now stale
+            self._comm_gen[comm_id] = self._comm_gen.get(comm_id, 0) + 1
             for key in list(self._gangs):
                 if key[0] == "coll" and key[2] == comm_id:
                     for gang in self._gangs.pop(key):
@@ -694,6 +757,7 @@ class TpuEngine:
                             drained.append(entry[2][2])
             for sig in [s for s in self._gang_plans if s[1] == comm_id]:
                 del self._gang_plans[sig]
+        self.invalidate_rings(comm_id, "communicator aborted")
         for req in drained:
             if not req.done:
                 req.complete(err_bits, 0.0)
@@ -736,6 +800,7 @@ class TpuEngine:
         with self._lock:
             err = self._aborted_comms.get(
                 comm_id, int(ErrorCode.COMM_ABORTED))
+            self._comm_gen[comm_id] = self._comm_gen.get(comm_id, 0) + 1
             evicted = 0
             for key in [k for k in self._gangs
                         if (k[0] == "coll" and k[2] == comm_id)
@@ -754,15 +819,455 @@ class TpuEngine:
             for sig in [s for s in self._gang_plans if s[1] == comm_id]:
                 del self._gang_plans[sig]
                 evicted += 1
+        self.invalidate_rings(comm_id, "gang tables rebuilt (grow)")
         for req in drained:
             if not req.done:
                 req.complete(err, 0.0)
         return evicted
 
     def reset_comm_errors(self) -> None:
-        """Clear abort fencing (driver reset_errors path)."""
+        """Clear abort fencing (driver reset_errors path).  Every armed
+        plan ring is invalidated too: reset_errors is a world-state
+        discontinuity, and a healed world must re-capture rather than
+        replay pre-reset state."""
         with self._lock:
             self._aborted_comms.clear()
+        self.invalidate_rings(None, "reset_errors")
+
+    # ------------------------------------------------------------------
+    # persistent-plan submission rings (accl_tpu/plans.py)
+    # ------------------------------------------------------------------
+    def arm_plan(self, rank: int, calls: Sequence[CCLOCall],
+                 expected: frozenset, timeout_s: float) -> PlanRing:
+        """Arm one rank's captured descriptor stream.  Ranks arming
+        concurrently (every member of ``expected``) rendezvous on the
+        arm board; the LAST arrival lowers the whole group into one
+        :class:`PlanRing` — gang pairing, buffer resolution, dtype
+        widening, sharding construction and AOT compilation all paid
+        here, once, instead of per call."""
+        with self._plan_cv:
+            group = None
+            for g in self._plan_board:
+                # join only a group with the IDENTICAL member union:
+                # every rank of one logical capture derives the same
+                # union (any shared gang guarantees it), and mere
+                # overlap would fuse two distinct concurrent captures
+                # that happen to share ranks into one broken ring.
+                # Plans whose per-rank unions differ (pure-p2p chains
+                # with asymmetric routes) arm-time out decodably —
+                # include a barrier/gang to give every rank the union.
+                if rank not in g["arrived"] and not g["building"] \
+                        and g["expected"] == set(expected):
+                    group = g
+                    break
+            if group is None:
+                group = {"arrived": {}, "expected": set(expected),
+                         "ring": None, "error": None, "building": False}
+                self._plan_board.append(group)
+            group["arrived"][rank] = list(calls)
+            complete = set(group["arrived"]) >= group["expected"]
+            if complete:
+                group["building"] = True
+        if complete:
+            ring = err = None
+            try:
+                ring = self._build_ring(group["arrived"],
+                                        group["expected"])
+            except Exception as e:  # noqa: BLE001 — every armer must
+                err = e             # see the same failure, not a hang
+            with self._plan_cv:
+                group["ring"], group["error"] = ring, err
+                if group in self._plan_board:
+                    self._plan_board.remove(group)
+                if ring is not None:
+                    self._plan_rings.append(ring)
+                self._plan_cv.notify_all()
+            if err is not None:
+                raise err if isinstance(err, ACCLError) else ACCLError(
+                    f"plan arm failed: {err}")
+            with ring.cv:
+                ring.refs += 1  # this rank's plan handle
+            return ring
+        deadline = time.monotonic() + timeout_s
+        with self._plan_cv:
+            while group["ring"] is None and group["error"] is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._plan_cv.wait(remaining):
+                    if group["ring"] is not None \
+                            or group["error"] is not None:
+                        break
+                    if group["building"]:
+                        # the last rank arrived and the build (AOT
+                        # compile) is in flight: it ALWAYS publishes a
+                        # ring or an error — poisoning now would race
+                        # the builder's overwrite and strand a ring
+                        # whose member count includes this rank.  Wait
+                        # for the build result instead.
+                        deadline = time.monotonic() + timeout_s
+                        continue
+                    missing = sorted(set(group["expected"])
+                                     - set(group["arrived"]))
+                    err = ACCLError(
+                        f"plan arm timed out after {timeout_s:.0f}s "
+                        f"waiting for rank(s) {missing} to capture the "
+                        f"same plan — capture_plan is collective over "
+                        f"every gang/p2p peer of the captured program")
+                    # poison + retire the group so a late arm can never
+                    # complete it against this rank's abandoned calls
+                    # (fellow waiters fail consistently; retries open a
+                    # FRESH group)
+                    group["error"] = err
+                    if group in self._plan_board:
+                        self._plan_board.remove(group)
+                    self._plan_cv.notify_all()
+                    raise err
+            if group["error"] is not None:
+                e = group["error"]
+                raise e if isinstance(e, ACCLError) else ACCLError(
+                    f"plan arm failed: {e}")
+            ring = group["ring"]
+        # refs outside the board lock: release_ring takes ring.cv then
+        # _plan_cv, so taking ring.cv under _plan_cv would invert
+        with ring.cv:
+            ring.refs += 1  # this rank's plan handle
+        return ring
+
+    def _build_ring(self, lists: dict, expected: set) -> PlanRing:
+        """Lower a complete arm group into ring slots: merge the
+        per-rank call streams into one serializable schedule (the gang
+        pairing the runtime scheduler would have done per call, done
+        once), resolving every operand and pre-compiling every SPMD
+        program."""
+        from ..constants import TAG_ANY
+
+        ranks = sorted(lists)
+        comm_gens: dict = {}
+
+        def note_comm(comm_id: int) -> list:
+            members = self._comms.get(comm_id)
+            if members is None:
+                raise ACCLError(f"plan arm: unknown communicator "
+                                f"{comm_id}")
+            if comm_id in self._aborted_comms:
+                raise ACCLError(
+                    f"plan arm: communicator {comm_id} is aborted — "
+                    f"recover first, then capture",
+                    int(ErrorCode.COMM_ABORTED))
+            comm_gens.setdefault(comm_id, self._comm_gen.get(comm_id, 0))
+            return members
+
+        heads = {r: 0 for r in ranks}
+        total = sum(len(v) for v in lists.values())
+        made = 0
+        slots: list = []
+        pending: dict = {}  # (comm, src, dst) -> deque of sends
+        while made < total:
+            progressed = False
+            for r in ranks:
+                i = heads[r]
+                if i >= len(lists[r]):
+                    continue
+                call = lists[r][i]
+                op = Operation(call.scenario)
+                if call.stream_flags:
+                    raise ACCLError(
+                        "plan arm: stream-operand calls are not "
+                        "replayable — keep stream traffic eager")
+                if op in (Operation.config, Operation.nop):
+                    heads[r] += 1
+                    made += 1
+                    progressed = True
+                elif op in (Operation.copy, Operation.combine):
+                    slots.append({"kind": "local", "rank": r,
+                                  "call": call})
+                    heads[r] += 1
+                    made += 1
+                    progressed = True
+                elif op == Operation.send:
+                    members = note_comm(call.comm)
+                    dst = members[call.root_src_dst]
+                    pending.setdefault((call.comm, r, dst),
+                                       deque()).append((r, call))
+                    heads[r] += 1
+                    made += 1
+                    progressed = True
+                elif op == Operation.recv:
+                    members = note_comm(call.comm)
+                    src = members[call.root_src_dst]
+                    q = pending.get((call.comm, src, r))
+                    if not q:
+                        continue  # sender not reached yet
+                    s_rank, s_call = q.popleft()
+                    if call.tag != TAG_ANY and call.tag != s_call.tag:
+                        raise ACCLError(
+                            f"plan arm: recv tag {call.tag} does not "
+                            f"match the oldest pending send tag "
+                            f"{s_call.tag} on route {s_rank}->{r} "
+                            f"(the PACK_SEQ sequence discipline)")
+                    sbuf, soff = self.resolve(s_rank, s_call.addr_0)
+                    dbuf, doff = self.resolve(r, call.addr_2)
+                    if sbuf is None or dbuf is None:
+                        raise ACCLError(
+                            "plan arm: p2p operand does not resolve "
+                            "to a registered device buffer")
+                    eth = ((int(s_call.compression_flags)
+                            | int(call.compression_flags))
+                           & int(CompressionFlags.ETH_COMPRESSED))
+                    slots.append({
+                        "kind": "p2p", "src_rank": s_rank,
+                        "dst_rank": r, "src": sbuf, "soff": soff,
+                        "dst": dbuf, "doff": doff, "n": call.count,
+                        "wire": (self.wire_dtype_for(s_call.arithcfg)
+                                 if eth else "")})
+                    heads[r] += 1
+                    made += 1
+                    progressed = True
+                else:  # gang collective
+                    members = note_comm(call.comm)
+                    ready = True
+                    for m in members:
+                        if m not in lists:
+                            raise ACCLError(
+                                f"plan arm: comm {call.comm} member "
+                                f"{m} never captured this plan — "
+                                f"every member must capture_plan the "
+                                f"same program")
+                        j = heads[m]
+                        if j >= len(lists[m]) or \
+                                (lists[m][j].scenario, lists[m][j].comm,
+                                 lists[m][j].tag) != (call.scenario,
+                                                      call.comm,
+                                                      call.tag):
+                            ready = False
+                            break
+                    if not ready:
+                        continue
+                    gang = {m: (lists[m][heads[m]], None, None)
+                            for m in members}
+                    plan = (None if op == Operation.barrier
+                            else self._gang_plan(op, call.comm, gang))
+                    slots.append({"kind": "gang", "op": op,
+                                  "comm": call.comm, "gang": gang,
+                                  "plan": plan})
+                    for m in members:
+                        heads[m] += 1
+                    made += len(members)
+                    progressed = True
+            if not progressed:
+                raise ACCLError(
+                    "plan arm: captured steps do not form a "
+                    "serializable schedule (cross-rank call order "
+                    "diverges, or a recv waits on a send outside the "
+                    "plan) — run scripts/accl_lint.py on the program")
+        leftover = sum(len(q) for q in pending.values())
+        if leftover:
+            raise ACCLError(
+                f"plan arm: {leftover} send(s) have no matching recv "
+                f"inside the plan — p2p must pair within the captured "
+                f"program")
+        return PlanRing(slots, frozenset(expected), comm_gens)
+
+    def ring_replay(self, rank: int, ring: PlanRing,
+                    run_async: bool = False,
+                    timeout_s: float = 60.0) -> int:
+        """The replay hot path: bump this rank's sequence counter; the
+        generation's LAST arrival executes every pre-resolved slot
+        inline, everyone else rides the completion side.  Returns the
+        generation (the async ticket's token)."""
+        with ring.cv:
+            if ring.invalid is not None:
+                raise ACCLError(
+                    f"plan replay: plan invalidated ({ring.invalid})",
+                    int(ErrorCode.COMM_ABORTED))
+            g = ring.rank_gen.get(rank, 0) + 1
+            ring.rank_gen[rank] = g
+            n = ring.gen_count.get(g, 0) + 1
+            last = n == ring.nmembers
+            if last:
+                ring.gen_count.pop(g, None)
+            else:
+                ring.gen_count[g] = n
+        if last:
+            self._ring_execute(ring, g, timeout_s)
+            return g
+        if run_async:
+            return g
+        if not self.ring_wait(ring, g, timeout_s):
+            raise ACCLError(
+                f"plan replay: generation {g} never completed within "
+                f"{timeout_s:.0f}s (a member rank stopped replaying?)")
+        return g
+
+    def ring_wait(self, ring: PlanRing, gen: int,
+                  timeout_s: float = 60.0) -> bool:
+        """Completion side of the ring: block until generation ``gen``
+        finished.  False on timeout; raises when the ring was fenced."""
+        deadline = time.monotonic() + timeout_s
+        with ring.cv:
+            while ring.done_gen < gen:
+                if ring.invalid is not None:
+                    raise ACCLError(
+                        f"plan replay: plan invalidated "
+                        f"({ring.invalid})",
+                        int(ErrorCode.COMM_ABORTED))
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                ring.cv.wait(remaining)
+        return True
+
+    def _ring_execute(self, ring: PlanRing, gen: int,
+                      timeout_s: float) -> None:
+        # generation ordering: an async pump can trigger gen g while
+        # g-1 is mid-execution on another thread — executions must
+        # land in order (slots rebind buffers)
+        deadline = time.monotonic() + timeout_s
+        with ring.cv:
+            while ring.done_gen < gen - 1 and ring.invalid is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ACCLError(
+                        f"plan replay: generation {gen - 1} never "
+                        f"completed within {timeout_s:.0f}s")
+                ring.cv.wait(remaining)
+            if ring.invalid is not None:
+                raise ACCLError(
+                    f"plan replay: plan invalidated ({ring.invalid})",
+                    int(ErrorCode.COMM_ABORTED))
+        # epoch fence: the comm generations must still match the armed
+        # snapshot — a replay must never run on a fenced epoch
+        for comm_id, gen0 in ring.comm_gens.items():
+            if self._comm_gen.get(comm_id, 0) != gen0 \
+                    or comm_id in self._aborted_comms:
+                self._invalidate_ring(
+                    ring, f"communicator {comm_id} fenced since arm")
+                raise ACCLError(
+                    f"plan replay: communicator {comm_id} was fenced "
+                    f"(abort/epoch bump) since the plan was armed — "
+                    f"re-capture on the recovered communicator",
+                    int(ErrorCode.COMM_ABORTED))
+        # claim the engine's one-gang-program-at-a-time slot (the same
+        # serialization invariant the leader/executor lanes uphold)
+        with self._ready_cv:
+            while (self._ready or self._exec_busy
+                   or self._inline_busy) and not self._shutdown:
+                self._ready_cv.wait(0.05)
+            if self._shutdown:
+                raise ACCLError(
+                    "plan replay: engine shut down while waiting for "
+                    "the dispatch slot")
+            self._inline_busy = True
+        try:
+            self.metrics.inc("plan_replays")
+            for slot in ring.slots:
+                self._exec_slot(slot)
+        except Exception as e:
+            self._invalidate_ring(ring, f"replay execution failed: {e}")
+            if isinstance(e, ACCLError):
+                raise
+            raise ACCLError(f"plan replay failed: {e}") from e
+        finally:
+            with self._ready_cv:
+                self._inline_busy = False
+                if self._ready or self._shutdown:
+                    self._ready_cv.notify()
+        with ring.cv:
+            ring.done_gen = gen
+            ring.replays += 1
+            ring.cv.notify_all()
+
+    def _exec_slot(self, slot: dict) -> None:
+        kind = slot["kind"]
+        if kind == "gang":
+            plan = slot["plan"]
+            if plan is None:  # barrier: the replay rendezvous IS it
+                return
+            x = self._assemble_global(plan, slot["gang"])
+            y = plan["compiled"](x)
+            self._scatter_back(plan, y)
+        elif kind == "local":
+            call = slot["call"]
+            if call.scenario == Operation.copy:
+                self._exec_copy(slot["rank"], call)
+            else:
+                self._exec_combine(slot["rank"], call)
+        else:  # p2p: pre-paired direct device-to-device move
+            import jax
+
+            data = slot["src"].dev[slot["soff"]:slot["soff"]
+                                   + slot["n"]]
+            if slot["wire"]:
+                data = _wire_roundtrip(data, slot["wire"])
+            moved = jax.device_put(data, self.devices[slot["dst_rank"]])
+            dst = slot["dst"]
+            if moved.dtype != dst.dev.dtype:
+                moved = moved.astype(dst.dev.dtype)
+            dst.set_dev_range(slot["doff"], moved)
+
+    def _invalidate_ring(self, ring: PlanRing, reason: str) -> None:
+        with ring.cv:
+            if ring.invalid is None:
+                ring.invalid = reason
+            ring.cv.notify_all()
+
+    def invalidate_rings(self, comm_id: Optional[int],
+                         reason: str) -> None:
+        """Fence every armed ring touching ``comm_id`` (None = all) and
+        wake their waiters — called from abort/rebuild/reset, and by
+        the driver's shrink/grow plan-fencing contract."""
+        with self._plan_cv:
+            keep = []
+            for ring in self._plan_rings:
+                if comm_id is None or comm_id in ring.comm_gens:
+                    self._invalidate_ring(ring, reason)
+                else:
+                    keep.append(ring)
+            self._plan_rings = keep
+
+    def release_ring(self, ring: PlanRing) -> None:
+        """Drop one rank's handle on a ring (its plan object died or
+        was closed); when the LAST holder releases, the ring is fenced
+        and its pinned compiled programs/buffer bindings are dropped —
+        the engine must not pin dead plans' state forever (rings are
+        otherwise evicted only by a comm fence)."""
+        with ring.cv:
+            ring.refs -= 1
+            if ring.refs > 0:
+                return
+        self._invalidate_ring(ring, "plan released")
+        with self._plan_cv:
+            if ring in self._plan_rings:
+                self._plan_rings.remove(ring)
+        ring.slots = []  # drop the pinned gang plans/buffers now
+
+    def _arm_auto_ring(self, scenario: int, comm_id: int,
+                       gang: dict) -> None:
+        """ACCL_PLAN_AUTO: every member of this gang instance carried
+        plan intent — arm a one-slot ring from the gang's descriptors
+        and publish it on each member's request (the driver adopts it
+        after completion, so every rank switches on the SAME instance
+        and no rank ever replays against an eager peer)."""
+        try:
+            op = Operation(scenario)
+            members = self._comms[comm_id]
+            gang2 = {g: (c, None, None)
+                     for g, (c, _r, _k) in gang.items()}
+            plan = (None if op == Operation.barrier
+                    else self._gang_plan(op, comm_id, gang2))
+            ring = PlanRing(
+                [{"kind": "gang", "op": op, "comm": comm_id,
+                  "gang": gang2, "plan": plan}],
+                frozenset(members),
+                {comm_id: self._comm_gen.get(comm_id, 0)})
+            with self._plan_cv:
+                self._plan_rings.append(ring)
+            for _c, req, _k in gang.values():
+                req.plan_ring = ring
+            self.metrics.inc("plan_auto_captures")
+        except Exception as e:  # noqa: BLE001 — auto arming is
+            # best-effort: a failure keeps the eager path, never
+            # breaks the call that triggered it
+            self._log.warning("plan auto-capture failed: %s", e)
 
     def shutdown(self) -> None:
         if self._watchdog is not None:
@@ -862,6 +1367,9 @@ class TpuEngine:
             finally:
                 with self._ready_cv:
                     self._exec_busy = False
+                    # wake a plan-replay leader parked on the idle
+                    # claim (the ring's one-program-at-a-time slot)
+                    self._ready_cv.notify_all()
 
     #: max gangs fused into one dispatch (the reference's effective
     #: FPGAQueue depth; also bounds compiled-variant count per fn key)
@@ -1555,6 +2063,31 @@ class TpuDeviceView(CCLODevice):
     def pop_stream(self, strm: int, nbytes: int, timeout_s: float = 10.0):
         arr = self._engine.pop_stream(self._rank, strm, timeout_s)
         return None if arr is None else arr.tobytes()[:nbytes]
+
+    # -- persistent plans (accl_tpu/plans.py): every rank shares the
+    # in-process engine, so the ring IS the shared submission/
+    # completion structure — arm rendezvouses the world's captures,
+    # replay is a sequence-counter bump on the shared ring
+    def arm_plan(self, calls, expected, timeout_s: float):
+        return self._engine.arm_plan(self._rank, calls, expected,
+                                     timeout_s)
+
+    def plan_replay(self, ring, run_async: bool = False,
+                    timeout_s: float = 60.0):
+        return self._engine.ring_replay(self._rank, ring, run_async,
+                                        timeout_s)
+
+    def plan_wait(self, ring, token, timeout_s: float) -> bool:
+        return self._engine.ring_wait(ring, token, timeout_s)
+
+    def invalidate_plans(self, comm_id: int = -1) -> None:
+        self._engine.invalidate_rings(
+            None if comm_id < 0 else comm_id,
+            "invalidated by the driver (shrink/grow/reset)")
+
+    def plan_release(self, ring) -> None:
+        """Release a dead plan's ring (driver finalizer path)."""
+        self._engine.release_ring(ring)
 
     # -- resilience: every rank shares one in-process engine, so a
     # single abort covers the whole world (no wire propagation needed)

@@ -25,6 +25,13 @@ Lanes (all interleaved, see below):
   drained at the end — the driver-side twin of the raw loop, which
   also only blocks once at the end (served by the executor + batched
   dispatch);
+- plan_sync / plan_async: the same resident call captured ONCE into a
+  persistent plan (accl_tpu/plans.py) and replayed through the
+  submission ring — no descriptor build, no gang assembly, no per-call
+  request plumbing; a replay is a sequence-counter bump and (for the
+  generation's last arrival) one pre-compiled dispatch.  Under
+  ACCL_PLAN=0 capture degrades to the eager fallback, so the same two
+  lanes record the kill-switch A/B (callrate_r12_plan_off);
 - raw: the shard_map ceiling.
 
 METHODOLOGY: the lanes are measured INTERLEAVED in rounds, keeping
@@ -130,6 +137,45 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
             jax.block_until_ready(r.dev)  # same-work guarantee as raw
             return time.perf_counter() - t0
 
+        # persistent-plan lanes: capture the resident call once per
+        # rank (collective across the world — every rank captures the
+        # same one-call program), then replay at ring speed
+        plan_handles: dict = {}
+
+        def plan_capture(accl, rank):
+            s, r = bufs[rank]
+            plan_handles[rank] = accl.capture_plan(
+                lambda a: a.allreduce(s, r, count, ReduceFunction.SUM,
+                                      from_fpga=True, to_fpga=True))
+
+        w.run(plan_capture)
+
+        def plan_sync(accl, rank):
+            p = plan_handles[rank]
+            _s, r = bufs[rank]
+            t0 = time.perf_counter()
+            for _ in range(si):
+                p.replay()
+            jax.block_until_ready(r.dev)  # same-work guarantee as raw
+            return time.perf_counter() - t0
+
+        def plan_async(accl, rank):
+            p = plan_handles[rank]
+            _s, r = bufs[rank]
+            window: list = []
+            t0 = time.perf_counter()
+            for _ in range(si):
+                window.append(p.replay(run_async=True))
+                if len(window) >= 8:
+                    head = window.pop(0)
+                    head.wait()
+                    head.check()
+            for t in window:
+                t.wait()
+                t.check()
+            jax.block_until_ready(r.dev)
+            return time.perf_counter() - t0
+
         # raw shard_map ceiling on the same device set / payload
         devs = jax.devices()[:nranks]
         mesh = Mesh(np.array(devs), ("rank",))
@@ -153,7 +199,8 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
         # discipline as bench/timing.py; a global per-lane best would
         # pair one lane's lucky window against another's average one)
         times: dict = {lane: [] for lane in (
-            "staged", "resident", "resident_exec", "async", "raw")}
+            "staged", "resident", "resident_exec", "async",
+            "plan_sync", "plan_async", "raw")}
 
         # dispatch-lane attribution per bench lane: the stats delta
         # across one lane slice shows which engine lane (leader inline /
@@ -184,6 +231,12 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
             s0 = snap()
             times["async"].append(max(w.run(resident_async)))
             lane_stats["async"] = delta(s0, snap())
+            s0 = snap()
+            times["plan_sync"].append(max(w.run(plan_sync)))
+            lane_stats["plan_sync"] = delta(s0, snap())
+            s0 = snap()
+            times["plan_async"].append(max(w.run(plan_async)))
+            lane_stats["plan_async"] = delta(s0, snap())
 
         best = {lane: min(ts) for lane, ts in times.items()}
 
@@ -208,6 +261,8 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
                         ("resident", "driver_sync_resident"),
                         ("resident_exec", "driver_sync_executor_path"),
                         ("async", "driver_async"),
+                        ("plan_sync", "driver_plan_sync"),
+                        ("plan_async", "driver_plan_async"),
                         ("raw", "raw_shardmap")):
         out["lanes"][label] = {
             "calls_per_s": round(si / best[lane], 1),
@@ -236,6 +291,16 @@ def run(nranks: int = 4, count: int = 1024, iters: int = 300,
     # forced through the executor, same interleaved windows
     out["leader_vs_executor_x"] = round(
         round_ratio("resident", "resident_exec"), 2)
+    # the r12 tentpole ratios: plan-replay lanes vs raw, and plan-sync
+    # vs the eager resident lane it amortizes (all window-to-window)
+    out["plan_sync_overhead_x"] = round(round_ratio("plan_sync", "raw"), 2)
+    out["plan_async_overhead_x"] = round(
+        round_ratio("plan_async", "raw"), 2)
+    out["plan_vs_resident_x"] = round(
+        round_ratio("plan_sync", "resident"), 2)
+    from accl_tpu import plans as _plans
+
+    out["plan_enabled"] = bool(_plans.enabled())
 
     # publish into the process metrics registry (observability layer):
     # the bench lanes become queryable gauges next to the driver's own

@@ -55,6 +55,14 @@ METRIC_HELP = {
                                  "detect -> resume"),
     "accl_join_wait_us": ("time a grow-policy supervisor spent waiting "
                           "for a replacement to announce itself"),
+    "accl_plans_captures": ("persistent collective plans captured + "
+                            "armed (ACCL.capture_plan)"),
+    "accl_plans_replays": ("plan replays issued through the submission "
+                           "ring (sync + async + auto lanes)"),
+    "accl_plans_invalidations": ("plans fenced by an abort/epoch bump/"
+                                 "membership change/reset — each one "
+                                 "is a replay that was REFUSED instead "
+                                 "of running on a dead world"),
 }
 
 

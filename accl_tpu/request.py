@@ -47,6 +47,15 @@ class Request:
 
     _ids = itertools.count()
 
+    #: persistent-plan auto-capture lane (accl_tpu/plans.py,
+    #: ACCL_PLAN_AUTO): class-level defaults so the per-call hot path
+    #: pays ZERO extra attribute writes — the driver sets an instance
+    #: `plan_intent` only on streak calls, and the engine publishes the
+    #: armed ring as an instance `plan_ring` only on the one gang
+    #: instance where every member agreed.
+    plan_intent = False
+    plan_ring = None
+
     def __init__(self, description: str = "", sync: bool = False):
         self.id = next(Request._ids)
         self.description = description

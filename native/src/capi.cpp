@@ -372,6 +372,74 @@ uint64_t accl_start_call(void* wp, int rank, const uint32_t* w15) {
   return e ? e->start_call(w15) : 0;
 }
 
+// ---- persistent collective plans (r12): pre-marshaled descriptor
+// batches replayed with ONE host->engine entry per replay instead of
+// one FFI round trip per call (see Engine::plan_create). ----
+
+// Create a plan from ncalls x 15 descriptor words; returns the plan id
+// (>= 0) or -1 (malformed input / a referenced comm is aborted).
+int accl_plan_create(void* wp, int rank, const uint32_t* words, int ncalls) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->plan_create(words, ncalls) : -1;
+}
+
+// Queue one replay of the whole batch; returns a completion token
+// (> 0), -1 for an unknown plan, -2 when the plan was invalidated by
+// an abort/epoch fence/reset (the caller must re-capture).
+long long accl_plan_replay(void* wp, int rank, int plan_id) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->plan_replay(plan_id) : -1;
+}
+
+// Poll a replay token: 1 = done (retcode = OR of every call's bits,
+// duration = sum), 0 = in flight, -1 = unknown token.
+int accl_plan_poll(void* wp, int rank, long long token, uint32_t* ret,
+                   double* dur) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->plan_poll(token, ret, dur) : -1;
+}
+
+// Blocking twin of accl_plan_poll (the sync replay lane): 1 = done,
+// 0 = timeout, -1 = unknown token.
+int accl_plan_wait(void* wp, int rank, long long token, int timeout_ms,
+                   uint32_t* ret, double* dur) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int rc = e->plan_poll(token, ret, dur);
+    if (rc != 0) return rc;
+    if (std::chrono::steady_clock::now() >= deadline) return 0;
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+}
+
+// Fence plans touching comm_id (-1 = all): the driver-side half of the
+// shrink/grow eviction contract (abort and reset_errors fence
+// engine-side on their own).
+int accl_plan_invalidate(void* wp, int rank, int comm_id) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->invalidate_plans(comm_id);
+  return 0;
+}
+
+// Live (valid) plan count — eviction introspection for tests.
+int accl_plan_count(void* wp, int rank) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  return e ? e->plan_count() : -1;
+}
+
+// Release one plan's engine-side storage (driver plan object died or
+// was closed) — the id's slot stays but pins nothing.
+int accl_plan_release(void* wp, int rank, int plan_id) {
+  Engine* e = static_cast<World*>(wp)->get(rank);
+  if (!e) return -1;
+  e->plan_release(plan_id);
+  return 0;
+}
+
 int accl_poll_call(void* wp, int rank, uint64_t id, uint32_t* ret,
                    double* dur) {
   Engine* e = static_cast<World*>(wp)->get(rank);

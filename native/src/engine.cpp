@@ -5,6 +5,7 @@
 #include <algorithm>
 #include <cassert>
 #include <cstdio>
+#include <set>
 
 namespace accl {
 
@@ -239,6 +240,149 @@ bool Engine::poll_call(uint64_t id, uint32_t* retcode, double* duration_ns) {
   if (duration_ns) *duration_ns = it->second.duration_ns;
   results_.erase(it);
   return true;
+}
+
+// ---------------------------------------------------------------------------
+// persistent collective plans (r12): parse once, replay whole batches
+// ---------------------------------------------------------------------------
+int Engine::plan_create(const uint32_t* words, int ncalls) {
+  if (!words || ncalls <= 0) return -1;
+  EnginePlan plan;
+  std::set<uint32_t> comms;
+  for (int i = 0; i < ncalls; ++i) {
+    std::array<uint32_t, 15> w{};
+    std::copy(words + i * 15, words + (i + 1) * 15, w.begin());
+    Op op = static_cast<Op>(w[0]);
+    if (op != Op::Config && op != Op::Nop && op != Op::Copy &&
+        op != Op::Combine)
+      comms.insert(w[2]);
+    plan.descs.push_back(w);
+  }
+  for (uint32_t c : comms) {
+    if (abort_err(c)) return -1;  // arming against a fenced comm
+    plan.comm_epochs.emplace_back(c, epoch_of(c));
+  }
+  std::lock_guard<std::mutex> g(plans_mu_);
+  plans_.push_back(std::move(plan));
+  return int(plans_.size()) - 1;
+}
+
+long long Engine::plan_replay(int plan_id) {
+  std::vector<std::array<uint32_t, 15>> descs;
+  {
+    std::lock_guard<std::mutex> g(plans_mu_);
+    if (plan_id < 0 || plan_id >= int(plans_.size())) return -1;
+    EnginePlan& p = plans_[size_t(plan_id)];
+    if (!p.valid) return -2;
+    // epoch fence: any abort/epoch bump since arm invalidates the
+    // plan — a replay must never run on a fenced world
+    for (auto& [comm, ep] : p.comm_epochs) {
+      if (epoch_of(comm) != ep || abort_err(comm)) {
+        p.valid = false;
+        return -2;
+      }
+    }
+    descs = p.descs;  // cheap: 15 words per call
+  }
+  std::vector<uint64_t> ids;
+  ids.reserve(descs.size());
+  for (auto& w : descs) ids.push_back(start_call(w.data()));
+  std::lock_guard<std::mutex> g(plans_mu_);
+  long long token = next_plan_token_++;
+  plan_tokens_[token] = std::move(ids);
+  // opportunistic reaper: tokens abandoned without a successful poll
+  // (dropped async tickets, timed-out waits) would otherwise pin their
+  // id vectors AND the calls' CallResults forever.  Reclaim fully-done
+  // stale tokens oldest-first once the map grows past its watermark —
+  // bounds the leak at ~256 in-flight/abandoned replays.
+  if (plan_tokens_.size() > 256) {
+    std::lock_guard<std::mutex> r(results_mu_);
+    for (auto it = plan_tokens_.begin();
+         it != plan_tokens_.end() && plan_tokens_.size() > 256;) {
+      if (it->first == token) break;  // never reap the fresh token
+      bool all_done = true;
+      for (uint64_t id : it->second) {
+        auto rit = results_.find(id);
+        if (rit != results_.end() && !rit->second.done) {
+          all_done = false;
+          break;
+        }
+      }
+      if (all_done) {
+        for (uint64_t id : it->second) results_.erase(id);
+        it = plan_tokens_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  return token;
+}
+
+int Engine::plan_poll(long long token, uint32_t* retcode,
+                      double* duration_ns) {
+  std::vector<uint64_t> ids;
+  {
+    std::lock_guard<std::mutex> g(plans_mu_);
+    auto it = plan_tokens_.find(token);
+    if (it == plan_tokens_.end()) return -1;
+    ids = it->second;
+  }
+  uint32_t ret = 0;
+  double dur = 0.0;
+  {
+    std::lock_guard<std::mutex> g(results_mu_);
+    for (uint64_t id : ids) {
+      auto it = results_.find(id);
+      if (it == results_.end() || !it->second.done) return 0;
+    }
+    for (uint64_t id : ids) {
+      auto it = results_.find(id);
+      ret |= it->second.retcode;
+      dur += it->second.duration_ns;
+      results_.erase(it);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(plans_mu_);
+    plan_tokens_.erase(token);
+  }
+  if (retcode) *retcode = ret;
+  if (duration_ns) *duration_ns = dur;
+  return 1;
+}
+
+void Engine::invalidate_plans(int comm_id) {
+  std::lock_guard<std::mutex> g(plans_mu_);
+  for (EnginePlan& p : plans_) {
+    bool hit = comm_id < 0;
+    for (auto& [comm, ep] : p.comm_epochs)
+      if (comm_id >= 0 && comm == uint32_t(comm_id)) hit = true;
+    if (hit) {
+      p.valid = false;
+      // an invalid plan can never replay again: free its descriptor
+      // storage now (slots are vector indices, so the slot stays)
+      p.descs.clear();
+      p.descs.shrink_to_fit();
+    }
+  }
+}
+
+void Engine::plan_release(int plan_id) {
+  std::lock_guard<std::mutex> g(plans_mu_);
+  if (plan_id < 0 || plan_id >= int(plans_.size())) return;
+  EnginePlan& p = plans_[size_t(plan_id)];
+  p.valid = false;
+  p.descs.clear();
+  p.descs.shrink_to_fit();
+}
+
+int Engine::plan_count() const {
+  std::lock_guard<std::mutex> g(plans_mu_);
+  int n = 0;
+  for (const EnginePlan& p : plans_)
+    if (p.valid) ++n;
+  return n;
 }
 
 void Engine::push_krnl(const uint8_t* data, uint64_t n) {
@@ -656,8 +800,10 @@ int Engine::abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate) {
   if (comm_id >= comms_.size() || comm_id >= kMaxComms) return -1;
   uint32_t new_epoch = comm_epoch_[comm_id].fetch_add(1) + 1;
   comm_abort_[comm_id].fetch_or(err_bits | COMM_ABORTED);
-  // reclaim pool buffers pinned by the dead epoch's traffic
+  // reclaim pool buffers pinned by the dead epoch's traffic; fence
+  // every persistent plan armed against the pre-abort epoch
   rx_.evict_comm(comm_id);
+  invalidate_plans(int(comm_id));
   if (propagate && !killed_.load()) {
     const CommTable& t = comms_[comm_id];
     for (uint32_t i = 0; i < t.rows.size(); ++i) {
@@ -686,6 +832,7 @@ void Engine::handle_abort(const WireHeader& hdr) {
   }
   comm_abort_[comm].fetch_or(hdr.count | COMM_ABORTED);
   rx_.evict_comm(comm);
+  invalidate_plans(int(comm));
   // pending calls on this comm finalize on the engine loop's next
   // sweep; blocked eager seeks notice within one recovery slice
 }
@@ -716,6 +863,9 @@ void Engine::reset_errors() {
   }
   fault_.store(0);
   for (uint32_t c = 0; c < kMaxComms; ++c) comm_abort_[c].store(0);
+  // plan-cache eviction fires here too (not only on abort): a healed
+  // world must re-capture, never replay pre-reset descriptor state
+  invalidate_plans(-1);
 }
 
 // ---------------------------------------------------------------------------

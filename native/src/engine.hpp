@@ -90,6 +90,34 @@ class Engine {
   uint64_t start_call(const uint32_t* w15);
   bool poll_call(uint64_t id, uint32_t* retcode, double* duration_ns);
 
+  // ---- persistent collective plans (r12): pre-marshaled descriptor
+  // ring.  A plan is an ordered batch of 15-word descriptors parsed
+  // ONCE at creation; a replay re-queues the whole batch through the
+  // normal engine loop with fresh call ids — one host->engine entry
+  // per replay instead of one per call (the ACCL+ pre-armed command
+  // sequence, arxiv 2312.11742).  Each plan snapshots the epoch of
+  // every communicator it touches: a replay after any abort/epoch
+  // bump (or reset_errors, which invalidates every plan) fails fast
+  // with -2 instead of silently running on a fenced world. ----
+  // Returns the plan id (>= 0), or -1 on malformed input.
+  int plan_create(const uint32_t* words, int ncalls);
+  // Queue one replay; returns a completion token (> 0), -1 for an
+  // unknown plan id, or -2 when the plan was invalidated/fenced.
+  long long plan_replay(int plan_id);
+  // Poll a replay token: 1 = all calls done (retcode = OR of every
+  // call's bits, duration = sum), 0 = still in flight, -1 = unknown.
+  int plan_poll(long long token, uint32_t* retcode, double* duration_ns);
+  // Fence plans touching comm_id (-1 = every plan); called from
+  // abort_comm/handle_abort/reset_errors and by the driver's
+  // shrink/grow plan-fencing contract.  Fencing also frees the plan's
+  // descriptor storage — an invalid plan can never replay again.
+  void invalidate_plans(int comm_id);
+  // Release one plan's storage (the driver plan object died/closed);
+  // the slot stays (ids are vector indices) but holds nothing.
+  void plan_release(int plan_id);
+  // Live (still-valid) plan count — eviction introspection for tests.
+  int plan_count() const;
+
   // ---- compute-kernel streams (PL-kernel equivalent) ----
   void push_krnl(const uint8_t* data, uint64_t n);
   bool pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap, uint64_t* got,
@@ -587,6 +615,17 @@ class Engine {
   uint32_t gather_flat_max_fanin_ = 64;
   uint64_t gather_flat_max_count_ = 32 * 1024;  // bytes (accl.cpp:1216-1217)
   uint64_t reduce_flat_max_count_ = 32 * 1024;  // bytes (accl.cpp:1222-1224)
+
+  // ---- persistent-plan storage (see plan_create/plan_replay) ----
+  struct EnginePlan {
+    std::vector<std::array<uint32_t, 15>> descs;  // pre-parsed, pinned
+    std::vector<std::pair<uint32_t, uint32_t>> comm_epochs;  // at arm
+    bool valid = true;
+  };
+  std::vector<EnginePlan> plans_;
+  std::map<long long, std::vector<uint64_t>> plan_tokens_;  // -> call ids
+  long long next_plan_token_ = 1;
+  mutable std::mutex plans_mu_;
 
   Fifo<CallDesc> cmd_q_;
   std::deque<CallDesc> retry_q_;  // firmware retry FIFO (fw :2460-2479)

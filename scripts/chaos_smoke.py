@@ -18,6 +18,16 @@ docs/fault_tolerance.md):
    agrees on the surviving set (``shrink_communicator``), and finishes
    the loop on the 3-rank communicator with bitwise-correct results.
 
+4. **Plan invalidation under chaos** (r12) — the loop runs through a
+   PERSISTENT PLAN (``ACCL.capture_plan`` / ``plan.replay()``,
+   accl_tpu/plans.py).  Mid-replay, one rank is killed: every
+   survivor's replay fails classified, the abort FENCES the plan —
+   the drill asserts a post-abort ``replay()`` RAISES (a stale plan
+   must never silently run on the fenced epoch) and
+   ``plan.invalidated`` is set — then survivors shrink, RE-CAPTURE on
+   the healed communicator, agree on the restart iteration, and
+   finish with results bitwise identical to the clean references.
+
 3. **Elastic join drill** (r11) — mid-loop, rank 2 is killed; the
    per-rank RECOVERY SUPERVISORS (not this harness) drive every
    transition: abort -> probe -> shrink to 3 -> admit the replacement
@@ -393,12 +403,123 @@ def main() -> int:
               f"recovery: {hangs3}")
         return 1
 
+    # ---- drill 4: persistent plans under chaos — mid-replay kill ->
+    # abort fences the plan (stale replay RAISES, never runs) ->
+    # shrink -> re-capture on the healed comm -> bitwise finish -------
+    kill4_at = args.iters // 2
+    victim4 = args.ranks - 1
+    with EmuWorld(args.ranks) as world:
+        for a in world.accls:
+            a.set_timeout(3_000_000)  # 3 s classification clock
+
+        def loop4(accl, rank):
+            comm_id = 0
+            outs = {}
+            restart = None
+            s = accl.create_buffer(args.count, np.float32)
+            r = accl.create_buffer(args.count, np.float32)
+
+            def body(a, cid):
+                a.allreduce(s, r, args.count, ReduceFunction.SUM,
+                            comm_id=cid)
+
+            s.host[:] = make_data(rank, 0)
+            plan4 = accl.capture_plan(body, comm_id)
+            outs[0] = r.host.copy()
+            it = 1
+            while it < args.iters:
+                if rank == victim4 and it == kill4_at:
+                    world.kill_rank(victim4)  # engine goes silent
+                s.host[:] = make_data(rank, it)
+                try:
+                    plan4.replay()
+                    outs[it] = r.host.copy()
+                    it += 1
+                except ACCLError as e:
+                    if rank == victim4:
+                        return ("dead", it, int(e.code))
+                    assert restart is None, "second failure after shrink"
+                    accl.abort(comm_id,
+                               error=int(ErrorCode.RANK_FAILED))
+                    # THE GATE: the fenced plan must refuse to replay
+                    try:
+                        plan4.replay()
+                        return ("stale-replay-ran", rank, it)
+                    except ACCLError:
+                        pass
+                    if not plan4.invalidated:
+                        return ("not-invalidated", rank, it)
+                    comm_id = accl.shrink_communicator(comm_id,
+                                                       window_s=2.0)
+                    sb = accl.create_buffer_like(
+                        np.array([-it], np.float32))
+                    rb = accl.create_buffer(1, np.float32)
+                    accl.allreduce(sb, rb, 1, ReduceFunction.MAX,
+                                   comm_id=comm_id)
+                    restart = int(-rb.host[0])
+                    for k in range(restart, it):
+                        outs.pop(k, None)
+                    it = restart
+                    # re-capture on the healed communicator
+                    s.host[:] = make_data(rank, it)
+                    plan4 = accl.capture_plan(body, comm_id)
+                    outs[it] = r.host.copy()
+                    it += 1
+            return ("alive", outs, restart, plan4.stats["replays"])
+
+        t0 = time.time()
+        results4 = world.run(loop4)
+        drill4_s = time.time() - t0
+
+    dead4 = results4[victim4]
+    if dead4[0] != "dead" or not (dead4[2] & int(ErrorCode.COMM_ABORTED)):
+        print(f"FAIL: drill 4 victim did not die aborted: {dead4}")
+        return 1
+    restarts4 = {results4[r][2] for r in range(args.ranks - 1)}
+    if len(restarts4) != 1 or None in restarts4:
+        print(f"FAIL: drill 4 survivors disagreed on restart: "
+              f"{restarts4}")
+        return 1
+    restart4 = restarts4.pop()
+    for rank in range(args.ranks - 1):
+        state = results4[rank][0]
+        if state != "alive":
+            print(f"FAIL: drill 4 rank {rank} ended {results4[rank]} "
+                  f"(stale-replay-ran = a fenced plan executed!)")
+            return 1
+        outs = results4[rank][1]
+        if sorted(outs) != list(range(args.iters)):
+            print(f"FAIL: drill 4 rank {rank} iters {sorted(outs)}")
+            return 1
+        for it in range(args.iters):
+            expected = (reference[it] if it < restart4 else ref3[it])
+            if not np.array_equal(outs[it], expected):
+                print(f"FAIL: drill 4 rank {rank} iter {it} not "
+                      f"bitwise vs the "
+                      f"{'4' if it < restart4 else '3'}-rank reference")
+                return 1
+        if results4[rank][3] < 1:
+            print(f"FAIL: drill 4 rank {rank} never replayed the "
+                  f"re-captured plan")
+            return 1
+    if drill4_s > 25.0:
+        print(f"FAIL: drill 4 took {drill4_s:.1f}s — recovery leaned "
+              f"on a timeout path, not the abort clock")
+        return 1
+    print(f"drill 4 OK: rank {victim4} killed at iter {kill4_at} "
+          f"mid-replay; fenced plan refused to run, survivors shrank, "
+          f"re-captured, finished bitwise in {drill4_s:.1f}s")
+
     with open(args.stats, "w") as f:
         json.dump({"drill1": {"plan": plan, "per_rank": stats1,
                               "retransmits": recovered, "nacks": nacks},
                    "drill2": {"victim": victim, "kill_at_iter": kill_at,
                               "wall_s": round(drill2_s, 2),
                               "per_rank": stats2},
+                   "drill4": {"victim": victim4,
+                              "kill_at_iter": kill4_at,
+                              "restart": restart4,
+                              "wall_s": round(drill4_s, 2)},
                    "drill3": {"plan": jplan.spec(), "victim": j_victim,
                               "kill_at_iter": kill3_at,
                               "replacement_session": join_info["rank"],
