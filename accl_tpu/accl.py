@@ -399,6 +399,10 @@ class ACCL:
         self.communicator(comm_id)  # raises the naming error on bad ids
         err = int(error) | int(ErrorCode.COMM_ABORTED)
         self._aborted_comms.add(comm_id)
+        # lifecycle anchor (r13): the fence event goes into the flight
+        # ring so post-mortem dumps can order replays against it
+        # (analysis.checks.check_fence_staleness)
+        _flight.mark_event(self.flight_recorder, "abort", comm_id, err)
         self._invalidate_plans(comm_id, "communicator aborted")
         handled = self._device.abort_comm(comm_id, err)
         if not handled:
@@ -428,6 +432,7 @@ class ACCL:
         from .resilience.membership import shrink as _shrink
 
         new_id = _shrink(self, comm_id, window_s)
+        _flight.mark_event(self.flight_recorder, "shrink", comm_id)
         # plan fencing: a healed world must never replay a dead comm's
         # plan — fence driver-side plans AND the engine-side ring/cache
         # (the emu engine drains its plan slots here, not only on abort)
@@ -455,6 +460,7 @@ class ACCL:
         from .resilience.elastic import grow as _grow
 
         new_id = _grow(self, new_ranks, comm_id, window_s)
+        _flight.mark_event(self.flight_recorder, "grow", comm_id)
         # same plan-fencing contract as shrink: membership changed, the
         # captured world no longer exists
         self._invalidate_plans(comm_id, "communicator grown")
@@ -594,6 +600,7 @@ class ACCL:
         next collective on the same world must succeed (the
         fixture-reuse contract in tests/test_fault_injection.py)."""
         self._aborted_comms.clear()
+        _flight.mark_event(self.flight_recorder, "reset_errors", -1)
         # plan fencing: reset_errors is a world-state discontinuity —
         # every plan (driver + engine side) is invalidated; re-capture
         # on the recovered world (the emu engine drains its own plan

@@ -31,6 +31,10 @@ _EXPORTS = {
     "LintWorld": "record",
     "record_program": "record",
     "check_programs": "checks",
+    "check_flight_lifecycle": "checks",
+    "check_fence_staleness": "checks",
+    "check_teardown_completions": "checks",
+    "check_lock_order": "checks",
     "CaptureSession": "sanitizer",
 }
 

@@ -29,7 +29,8 @@ from __future__ import annotations
 from typing import Optional
 
 from ..constants import Operation
-from ..observability.flight import first_divergence
+from ..observability.flight import (FENCE_EVENTS, PLAN_CAPTURE_EVENT,
+                                    TEARDOWN_EVENT, first_divergence)
 from .findings import ERROR, WARNING, Finding, sort_findings
 from .program import CollectiveProgram, RecordedCall, tags_match
 
@@ -563,4 +564,175 @@ def check_programs(programs: dict,
     findings += check_buffer_hazards(programs)
     findings += check_leaked_requests(programs)
     findings += check_deadlocks(programs, eager_threshold)
+    return sort_findings(findings)
+
+
+# ---------------------------------------------------------------------------
+# happens-before lifecycle checkers over merged flight dumps (r13)
+#
+# The checkers above reason over captured CollectivePrograms BEFORE
+# dispatch; these reason over flight-recorder dumps AFTER the fact —
+# production post-mortems.  The driver publishes zero-duration
+# lifecycle anchors into the ring (observability.flight.mark_event):
+# fences (abort/shrink/grow/reset_errors), plan_capture, and
+# engine_teardown — and the checkers verify the happens-before
+# invariants whose in-process violations are exactly the races the
+# TSan lane catches live:
+#
+# - ``fence-stale-replay`` — a plan replay COMPLETED successfully on a
+#   communicator after its last fence with no re-capture in between:
+#   the replay ran against a dead generation (the invalidation
+#   contract chaos drill 4 gates in-process, checked from dumps).
+# - ``completion-after-teardown`` — a call published a SUCCESSFUL
+#   completion after its rank's engine teardown record: some thread
+#   was still completing work into a world being destroyed (the r13
+#   suite-exit segfault class, as a dump invariant).
+# - ``lock-order-inversion`` — two ranks acquired the same pair of
+#   communicators (gang collectives held concurrently = locks) in
+#   opposite orders: the cross-rank ABBA pattern that deadlocks
+#   hierarchical/multi-comm schedules.
+# ---------------------------------------------------------------------------
+def _flight_per_rank(merged) -> dict:
+    """rank -> seq-ordered record dicts.  Accepts a merged dump doc
+    (``merge_flight_dumps`` output), a single-rank dump, or a path to
+    the JSON of either."""
+    import json
+
+    if isinstance(merged, str):
+        with open(merged) as f:
+            merged = json.load(f)
+    ranks = merged["ranks"] if "ranks" in merged else [merged]
+    return {rd["rank"]: sorted(rd["records"], key=lambda x: x["seq"])
+            for rd in ranks}
+
+
+def check_fence_staleness(merged) -> list:
+    """A successful ``plan_replay`` on a comm whose last fence has no
+    intervening ``plan_capture``: the replay ran on a generation older
+    than the comm's last fence."""
+    findings: list = []
+    for rank, recs in _flight_per_rank(merged).items():
+        fence_seq: dict = {}   # comm -> seq of its last fence
+        recaptured: dict = {}  # comm -> a capture happened since
+        seen: set = set()
+        for rec in recs:
+            comm = rec.get("comm", -1)
+            name = rec.get("collective", "")
+            if comm >= 0:
+                seen.add(comm)
+            if name in FENCE_EVENTS:
+                # comm -1 (reset_errors/teardown) fences every comm
+                # that existed at that point; later-minted comms are
+                # born clean
+                for c in ([comm] if comm >= 0 else sorted(seen)):
+                    fence_seq[c] = rec["seq"]
+                    recaptured[c] = False
+            elif name == PLAN_CAPTURE_EVENT:
+                recaptured[comm] = True
+            elif (name == "plan_replay" and rec.get("state") == "complete"
+                  and rec.get("retcode", 0) == 0
+                  and comm in fence_seq and not recaptured.get(comm, True)):
+                findings.append(Finding(
+                    ERROR, "fence-stale-replay",
+                    f"rank {rank}: plan replay (seq {rec['seq']}) "
+                    f"completed successfully on comm {comm} after its "
+                    f"fence at seq {fence_seq[comm]} with no re-capture "
+                    f"in between — the replay ran on a dead generation",
+                    hint="every abort/shrink/grow/reset must invalidate "
+                         "armed plans; re-capture before replaying "
+                         "(CollectivePlan fencing contract)",
+                    comm=comm, ranks=[rank], index=rec["seq"]))
+    return findings
+
+
+def check_teardown_completions(merged) -> list:
+    """A SUCCESSFUL completion published after the rank's
+    ``engine_teardown`` record: a thread was still finishing calls
+    into a world being destroyed.  Teardown-finalized calls carry
+    COMM_ABORTED (state ``aborted``) and are the sanctioned path."""
+    findings: list = []
+    for rank, recs in _flight_per_rank(merged).items():
+        teardown_t = None
+        teardown_seq = None
+        for rec in recs:
+            if rec.get("collective") == TEARDOWN_EVENT:
+                if teardown_t is None or rec["t_complete"] < teardown_t:
+                    teardown_t = rec["t_complete"]
+                    teardown_seq = rec["seq"]
+        if teardown_t is None:
+            continue
+        for rec in recs:
+            if rec.get("collective") == TEARDOWN_EVENT:
+                continue
+            if (rec.get("state") == "complete"
+                    and rec.get("retcode", 0) == 0
+                    and rec.get("t_complete", 0) > teardown_t):
+                findings.append(Finding(
+                    ERROR, "completion-after-teardown",
+                    f"rank {rank}: {rec.get('collective')} (seq "
+                    f"{rec['seq']}) published a successful completion "
+                    f"AFTER the engine teardown record (seq "
+                    f"{teardown_seq}) — a completion publisher outlived "
+                    f"its engine",
+                    hint="teardown must shutdown the engine, join the "
+                         "completion publishers, then free (the r13 "
+                         "close() ordering); a success after teardown "
+                         "means that ordering was violated",
+                    comm=rec.get("comm", -1), ranks=[rank],
+                    index=rec["seq"]))
+    return findings
+
+
+def check_lock_order(merged) -> list:
+    """Cross-rank communicator acquisition order: a gang collective in
+    flight is a held lock; a second gang submitted on another comm
+    while the first is unfinished is a nested acquisition.  Two ranks
+    nesting the same comm pair in OPPOSITE orders is the ABBA pattern
+    that deadlocks multi-communicator schedules."""
+    findings: list = []
+    edges: dict = {}  # (held_comm, wanted_comm) -> {rank: example seq}
+    for rank, recs in _flight_per_rank(merged).items():
+        gangs = [r for r in recs if r.get("gang")]
+        for i, a in enumerate(gangs):
+            a_end = a.get("t_complete") or float("inf")
+            for b in gangs[i + 1:]:
+                if b.get("comm") == a.get("comm"):
+                    continue
+                if b.get("t_submit", 0) < a_end:  # nested under a
+                    edges.setdefault(
+                        (a["comm"], b["comm"]), {}).setdefault(
+                        rank, (a["seq"], b["seq"]))
+    for (x, y), holders in sorted(edges.items()):
+        if x >= y or (y, x) not in edges:
+            continue
+        inverse = edges[(y, x)]
+        fwd_only = set(holders) - set(inverse)
+        inv_only = set(inverse) - set(holders)
+        if fwd_only and inv_only:
+            ra = sorted(fwd_only)[0]
+            rb = sorted(inv_only)[0]
+            findings.append(Finding(
+                WARNING, "lock-order-inversion",
+                f"rank {ra} holds comm {x} while acquiring comm {y} "
+                f"(seqs {holders[ra]}), but rank {rb} nests them in "
+                f"the OPPOSITE order (seqs {inverse[rb]}) — the "
+                f"cross-rank ABBA pattern that deadlocks when the "
+                f"windows overlap",
+                hint="acquire communicators in one global order on "
+                     "every rank (sort multi-comm gang issue order, "
+                     "e.g. by comm id) or barrier between the phases",
+                comm=x, ranks=sorted(set(list(fwd_only) + list(inv_only)))))
+    return findings
+
+
+def check_flight_lifecycle(merged) -> list:
+    """The post-mortem lifecycle suite over merged flight dumps:
+    fence-stale replays, completions after teardown, and cross-rank
+    lock-order inversions.  Accepts what :func:`~accl_tpu.
+    observability.flight.merge_flight_dumps` produces (dict or path)
+    or a single-rank dump."""
+    findings: list = []
+    findings += check_fence_staleness(merged)
+    findings += check_teardown_completions(merged)
+    findings += check_lock_order(merged)
     return sort_findings(findings)

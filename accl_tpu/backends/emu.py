@@ -27,16 +27,25 @@ from ..arithconfig import ArithConfig
 from ..buffer import BaseBuffer, EmuBuffer, EmuBufferP2P
 from ..communicator import Communicator, Rank
 from ..constants import ACCLError, CCLOCall, ErrorCode
+from ..observability import flight as _flight
 from ..observability import health as _health
 from ..observability import trace as _trace
 from ..request import Request
 from ..utils.logging import get_logger
 from .base import CCLODevice
 
-_LIB_PATH = os.path.join(
+# Sanitizer lane selection (docs/static_analysis.md "Native sanitizer
+# lanes"): ACCL_SANITIZER=asan|ubsan|tsan loads the instrumented twin
+# built by `ACCL_SANITIZER=<lane> make -C native`; ACCL_NATIVE_LIB
+# overrides the path outright (a prebuilt artifact in CI).  NB the
+# asan/tsan lanes need their runtime preloaded into the (uninstrumented)
+# python — see the docs for the LD_PRELOAD line.
+_SANITIZER = os.environ.get("ACCL_SANITIZER", "").strip()
+_LIB_NAME = f"libacclemu_{_SANITIZER}.so" if _SANITIZER else "libacclemu.so"
+_LIB_PATH = os.environ.get("ACCL_NATIVE_LIB") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
-    "libacclemu.so",
+    _LIB_NAME,
 )
 
 _lib = None
@@ -49,8 +58,11 @@ def _build_lib_if_stale() -> None:
     import glob
     import subprocess
 
+    if os.environ.get("ACCL_NATIVE_LIB"):
+        return  # explicit artifact: never rebuild over it
     native_dir = os.path.dirname(_LIB_PATH)
-    sources = glob.glob(os.path.join(native_dir, "src", "*")) + [
+    sources = glob.glob(os.path.join(native_dir, "src", "*.cpp")) + glob.glob(
+        os.path.join(native_dir, "src", "*.hpp")) + [
         os.path.join(native_dir, "Makefile")
     ]
     if os.path.exists(_LIB_PATH):
@@ -67,9 +79,15 @@ def _build_lib_if_stale() -> None:
             fcntl.flock(lock, fcntl.LOCK_EX)
         except ImportError:  # pragma: no cover (non-POSIX)
             pass
+        # the build must not inherit a sanitizer runtime: under the ASan
+        # lane LD_PRELOAD leaks into make/g++ and LeakSanitizer fails
+        # the COMPILER with its own (irrelevant) leaks
+        env = dict(os.environ)
+        env.pop("LD_PRELOAD", None)
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
         try:
             proc = subprocess.run(["make", "-C", native_dir],
-                                  capture_output=True, text=True)
+                                  capture_output=True, text=True, env=env)
         except FileNotFoundError as e:
             raise ACCLError(
                 f"native engine not built and `make` unavailable: {e} "
@@ -102,6 +120,7 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_world_create_rdma.argtypes = [i32, u64]
     lib.accl_dump_qps.argtypes = [p, i32, ctypes.c_char_p, i32]
     lib.accl_world_destroy.argtypes = [p]
+    lib.accl_world_shutdown.argtypes = [p]
     lib.accl_cfg_rx.argtypes = [p, i32, i32, u64]
     lib.accl_set_comm.argtypes = [p, i32, ctypes.POINTER(u32), i32]
     lib.accl_set_arithcfg.argtypes = [p, i32, ctypes.POINTER(u32), i32]
@@ -183,6 +202,20 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_plan_count.argtypes = [p, i32]
     lib.accl_plan_release.restype = i32
     lib.accl_plan_release.argtypes = [p, i32, i32]
+    # wire-protocol correctness surface (r13): raw-frame ingest, frame
+    # counters, egress frame tap (fuzz seed-corpus capture)
+    lib.accl_engine_ingest_bytes.restype = i32
+    lib.accl_engine_ingest_bytes.argtypes = [p, i32, ctypes.c_char_p, u64]
+    lib.accl_frame_stats.argtypes = [p, i32, ctypes.POINTER(u64),
+                                     ctypes.POINTER(u64)]
+    lib.accl_frame_tap.restype = i32
+    lib.accl_frame_tap.argtypes = [p, i32, i32]
+    lib.accl_frame_tap_count.restype = i32
+    lib.accl_frame_tap_count.argtypes = [p, i32]
+    lib.accl_frame_tap_read.restype = i32
+    lib.accl_frame_tap_read.argtypes = [p, i32, i32, ctypes.c_void_p, i32]
+    lib.accl_frame_tap_drain.restype = i32
+    lib.accl_frame_tap_drain.argtypes = [p, i32, ctypes.c_void_p, i32]
     _lib = lib
     return lib
 
@@ -190,6 +223,50 @@ def _load_lib() -> ctypes.CDLL:
 def _words(vals: Sequence[int]):
     arr = (ctypes.c_uint32 * len(vals))(*[v & 0xFFFFFFFF for v in vals])
     return arr
+
+
+def _join_waiters(devices, timeout_s: float = 10.0) -> int:
+    """Join every tracked waiter thread of `devices` (bounded); returns
+    how many were STILL alive afterwards.  Called between world
+    shutdown (which makes their FFI waits return promptly) and world
+    destroy (which frees the memory they were polling)."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    stuck = 0
+    for d in devices:
+        for t in list(getattr(d, "_waiters", ())):
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                stuck += 1
+    return stuck
+
+
+# ---------------------------------------------------------------------------
+# interpreter-exit safety net: close every still-open world BEFORE the
+# interpreter (and then the C runtime) starts tearing the process down.
+# A world leaked by test code keeps native engine threads running into
+# __cxa_finalize — where the library's static destructors run out from
+# under them (the r13 suite-exit segfault class).  atexit handlers run
+# LIFO, so registering at import time (before any ThreadPoolExecutor
+# exists) means this fires AFTER user code but BEFORE
+# concurrent.futures' own exit hook joins its workers.
+# ---------------------------------------------------------------------------
+import atexit  # noqa: E402 — grouped with its registry on purpose
+import weakref  # noqa: E402
+
+_live_worlds: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _close_live_worlds() -> None:  # pragma: no cover — exit path
+    for w in list(_live_worlds):
+        try:
+            w.close()
+        except Exception:  # noqa: BLE001 — never let cleanup raise at exit
+            pass
+
+
+atexit.register(_close_live_worlds)
 
 
 class EmuDevice(CCLODevice):
@@ -212,6 +289,22 @@ class EmuDevice(CCLODevice):
         #: processes (or sibling worlds) the in-process sanitizer
         #: exchange can never pair with
         self.shares_process_world = True
+        #: last frame-counter values already published to the metrics
+        #: registry (frame_stats publishes monotonic deltas)
+        self._frames_published: dict = {}
+        #: live waiter threads (one per in-flight engine call).  World
+        #: close() joins these AFTER accl_world_shutdown made their FFI
+        #: waits return and BEFORE accl_world_destroy frees the
+        #: engines — the ordering that fixes the suite-exit segfault
+        #: (a waiter scheduled late dereferencing a nulled/freed world).
+        self._waiters: set = set()
+        #: serializes call submission against close(): start() snapshots
+        #: the world handle, submits, and registers its waiter under
+        #: this lock; close() nulls the handle under the same lock, so
+        #: a submission either completes registration (and is joined
+        #: before destroy) or observes the nulled handle — there is no
+        #: window where a stale handle outlives the world
+        self._lifecycle = threading.Lock()
 
     def sanitizer_domain(self):
         """The native world handle identifies the in-process gang for
@@ -235,29 +328,48 @@ class EmuDevice(CCLODevice):
         if span is not None:
             span.lane = "emu"
             span.t_dispatch = span.t_device_begin = _trace.now_ns()
-        call_id = self._lib.accl_start_call(self._w, self._rank,
-                                            _words(call.to_words()))
+        # snapshot + submit + waiter registration happen atomically
+        # against close() (the _lifecycle contract above): after this
+        # block either the call is tracked (close joins its waiter
+        # before destroying) or the handle was already None and the
+        # request fails fast.  The C side additionally null-guards, so
+        # even an untracked straggler gets a clean error, never a
+        # dereference.
+        with self._lifecycle:
+            world = self._w
+            if world is None:
+                request.complete(int(ErrorCode.COMM_ABORTED
+                                     | ErrorCode.RANK_FAILED), 0.0)
+                return
+            call_id = self._lib.accl_start_call(world, self._rank,
+                                                _words(call.to_words()))
+            t = threading.Thread(target=lambda: waiter(), daemon=True)
+            self._waiters.add(t)
 
         def waiter():
-            ret = ctypes.c_uint32(0)
-            dur = ctypes.c_double(0.0)
-            ok = self._lib.accl_wait_call(self._w, self._rank, call_id,
-                                          self._timeout_ms, ctypes.byref(ret),
-                                          ctypes.byref(dur))
-            if span is not None:
-                span.t_device_end = _trace.now_ns()
-            if ok:
-                request.complete(ret.value, dur.value)
-            else:
-                from ..constants import ErrorCode
+            try:
+                ret = ctypes.c_uint32(0)
+                dur = ctypes.c_double(0.0)
+                ok = self._lib.accl_wait_call(world, self._rank, call_id,
+                                              self._timeout_ms,
+                                              ctypes.byref(ret),
+                                              ctypes.byref(dur))
+                if span is not None:
+                    span.t_device_end = _trace.now_ns()
+                if ok:
+                    request.complete(ret.value, dur.value)
+                else:
+                    from ..constants import ErrorCode
 
-                get_logger("accl_tpu.emu", rank=self._rank).warning(
-                    "engine wait timed out after %d ms: %s%s",
-                    self._timeout_ms, request.description,
-                    request.flight_info())
-                request.complete(int(ErrorCode.DMA_TIMEOUT_ERROR), 0.0)
+                    get_logger("accl_tpu.emu", rank=self._rank).warning(
+                        "engine wait timed out after %d ms: %s%s",
+                        self._timeout_ms, request.description,
+                        request.flight_info())
+                    request.complete(int(ErrorCode.DMA_TIMEOUT_ERROR), 0.0)
+            finally:
+                self._waiters.discard(threading.current_thread())
 
-        threading.Thread(target=waiter, daemon=True).start()
+        t.start()
 
     # -- device memory ------------------------------------------------
     def alloc_mem(self, nbytes: int, alignment: int = 64) -> int:
@@ -533,6 +645,69 @@ class EmuDevice(CCLODevice):
         if self._w:
             self._lib.accl_plan_release(self._w, self._rank, plan_id)
 
+    # -- wire-protocol correctness surface (r13) ----------------------
+    def ingest_bytes(self, frame: bytes) -> int:
+        """Feed one raw wire frame (64-byte header + payload) through
+        this engine's REAL ingress classification path, as if a peer's
+        transport delivered it.  Returns 0 when the engine consumed it
+        (or legally dropped it at the kill/epoch gate), 1 when it was
+        rejected as malformed (counted in :meth:`frame_stats`).  The
+        wire fuzzer's (scripts/fuzz_wire.py) one entry point."""
+        rc = int(self._lib.accl_engine_ingest_bytes(
+            self._w, self._rank, frame, len(frame)))
+        if rc < 0:
+            raise ACCLError(f"ingest_bytes failed for rank {self._rank}")
+        return rc
+
+    def frame_stats(self, publish: bool = True) -> dict:
+        """Frames that passed structural validation vs frames rejected
+        as malformed.  Each read publishes the deltas into the r8
+        metrics registry (``wire/accepted_frames`` /
+        ``wire/rejected_frames`` counters) so a scrape of /metrics sees
+        the rejection rate without touching the FFI."""
+        acc = ctypes.c_uint64(0)
+        rej = ctypes.c_uint64(0)
+        self._lib.accl_frame_stats(self._w, self._rank, ctypes.byref(acc),
+                                   ctypes.byref(rej))
+        stats = {"accepted_frames": int(acc.value),
+                 "rejected_frames": int(rej.value)}
+        if publish:
+            from ..observability import metrics as _metrics
+
+            reg = _metrics.default_registry()
+            for key, val in stats.items():
+                delta = val - self._frames_published.get(key, 0)
+                if delta > 0:
+                    reg.inc(f"wire/{key}", delta)
+                    self._frames_published[key] = val
+        return stats
+
+    def frame_tap(self, on: bool = True) -> None:
+        """Toggle the egress frame tap (bounded ring of the last 256
+        staged frames, serialized wire framing)."""
+        self._lib.accl_frame_tap(self._w, self._rank, 1 if on else 0)
+
+    def tap_frames(self) -> list:
+        """Drain the captured egress frames, oldest first, as raw
+        bytes.  Atomic per batch (one native lock hold serializes a
+        whole [len][bytes] run), so frames can never tear against live
+        traffic rotating the ring; the tap is left EMPTY."""
+        out: list = []
+        buf = ctypes.create_string_buffer(1 << 20)
+        while True:
+            n = int(self._lib.accl_frame_tap_drain(self._w, self._rank,
+                                                   buf, len(buf)))
+            if n <= 0:
+                break
+            raw = buf.raw[:n]
+            off = 0
+            while off + 4 <= n:
+                ln = int.from_bytes(raw[off:off + 4], "little")
+                off += 4
+                out.append(raw[off:off + ln])
+                off += ln
+        return out
+
     # -- elastic membership (r11): join control plane -----------------
     def join_sync(self, sponsor_session: int,
                   timeout_s: float = 10.0) -> int:
@@ -608,11 +783,24 @@ class EmuRankTcp:
             kwargs["max_eager_size"] = max_eager_size
         self.accl.initialize(ranks, rank, n_egr_rx_bufs=n_egr_rx_bufs,
                              egr_rx_buf_size=egr_rx_buf_size, **kwargs)
+        _live_worlds.add(self)  # interpreter-exit safety net
 
     def close(self) -> None:
         if self._handle:
-            self.device._w = None  # plan finalizers must no-op now
-            self._lib.accl_world_destroy(self._handle)
+            _flight.mark_event(self.accl.flight_recorder,
+                               _flight.TEARDOWN_EVENT, -1, lane="lifecycle")
+            # same shutdown -> null-under-lock -> join-waiters ->
+            # destroy ordering as EmuWorld.close (the segfault fix)
+            self._lib.accl_world_shutdown(self._handle)
+            with self.device._lifecycle:
+                self.device._w = None  # plan finalizers must no-op now
+            stuck = _join_waiters([self.device])
+            if stuck:
+                get_logger("accl_tpu.emu").warning(
+                    "tcp rank close: %d waiter thread(s) still alive "
+                    "after shutdown — leaking the native world", stuck)
+            else:
+                self._lib.accl_world_destroy(self._handle)
             self._handle = None
 
     def __enter__(self):
@@ -724,6 +912,7 @@ class EmuWorld:
 
         self.board = MembershipBoard()
         self.joiners: list = []
+        _live_worlds.add(self)  # interpreter-exit safety net
 
     def start_watchdog(self, **kwargs) -> "_health.Watchdog":
         """Re-arm the watchdog with explicit settings (tests shrink
@@ -839,14 +1028,40 @@ class EmuWorld:
         self.watchdog.stop()
         self._pool.shutdown(wait=False)
         if self._handle:
-            # null the device handles FIRST: a plan finalizer (GC) may
-            # fire after this close, and its engine call must become a
-            # no-op rather than touch the freed world
-            for d in self.devices:
-                d._w = None
-            for j in self.joiners:
-                j.device._w = None
-            self._lib.accl_world_destroy(self._handle)
+            # lifecycle anchor (r13): after this record, NO successful
+            # completion may publish on these ranks — the dump-side
+            # invariant analysis.checks.check_teardown_completions
+            # verifies (the post-mortem twin of the suite-exit fix)
+            for a in self.accls + [j.accl for j in self.joiners]:
+                _flight.mark_event(a.flight_recorder, _flight.TEARDOWN_EVENT,
+                                   -1, lane="lifecycle")
+            # Teardown ordering (the r13 suite-exit segfault fix —
+            # docs/debugging.md "The suite-exit segfault"):
+            # 1. shutdown: engine threads stop, every pending call
+            #    finalizes, so waiter threads parked in accl_wait_call
+            #    return within one poll interval;
+            # 2. null the device handles UNDER each device's lifecycle
+            #    lock: a submission in flight either finished
+            #    registering its waiter (joined below) or now observes
+            #    None and fails fast — no stale handle survives;
+            # 3. join the waiter threads — after this, NO thread can
+            #    be inside (or about to enter) the native world;
+            # 4. destroy.  If a waiter refuses to die (pathological),
+            #    LEAK the native world instead of freeing memory a
+            #    live thread may still touch.
+            self._lib.accl_world_shutdown(self._handle)
+            devices = self.devices + [j.device for j in self.joiners]
+            for d in devices:
+                with d._lifecycle:
+                    d._w = None
+            stuck = _join_waiters(devices)
+            if stuck:
+                get_logger("accl_tpu.emu").warning(
+                    "world close: %d waiter thread(s) still alive after "
+                    "shutdown — leaking the native world rather than "
+                    "freeing memory under a live thread", stuck)
+            else:
+                self._lib.accl_world_destroy(self._handle)
             self._handle = None
 
     def __enter__(self) -> "EmuWorld":

@@ -248,6 +248,31 @@ class FlightRecorder:
         }
 
 
+#: lifecycle event names (r13) published as zero-duration records so
+#: post-mortem dumps carry the happens-before anchors the
+#: analysis.checks lifecycle checkers reason over: fences (abort/
+#: shrink/grow/reset) order-stamp when a communicator's old world died;
+#: plan_capture marks a re-arm; engine_teardown marks the instant after
+#: which NO successful completion may ever publish on that rank.
+FENCE_EVENTS = frozenset(("abort", "shrink", "grow", "reset_errors"))
+PLAN_CAPTURE_EVENT = "plan_capture"
+TEARDOWN_EVENT = "engine_teardown"
+
+
+def mark_event(recorder: Optional["FlightRecorder"], name: str,
+               comm: int = -1, retcode: int = 0,
+               lane: str = "fence") -> None:
+    """Publish one zero-duration lifecycle event record (cold paths
+    only — abort/shrink/grow/reset/plan-arm/teardown).  ``comm=-1``
+    means every communicator (reset_errors, teardown)."""
+    if recorder is None or not _enabled:
+        return
+    t = now_ns()
+    rec = recorder.new_record(-1, name, comm, 0, "-", 0, 0, 0, False, t)
+    rec.lane = lane
+    rec.finish(retcode, t)
+
+
 # ---------------------------------------------------------------------------
 # module state: enable switch + live-recorder registry + SIGUSR1
 # ---------------------------------------------------------------------------

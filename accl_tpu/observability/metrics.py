@@ -63,6 +63,13 @@ METRIC_HELP = {
                                  "membership change/reset — each one "
                                  "is a replay that was REFUSED instead "
                                  "of running on a dead world"),
+    "accl_wire_accepted_frames": ("ingress wire frames that passed "
+                                  "structural validation"),
+    "accl_wire_rejected_frames": ("ingress wire frames rejected as "
+                                  "malformed (truncated/unknown type/"
+                                  "count mismatch/out-of-range comm) — "
+                                  "nonzero means a corrupting transport "
+                                  "or hostile peer"),
 }
 
 

@@ -486,6 +486,12 @@ def build_plan(accl, recorder: PlanRecorder, validate: bool = True,
                  frozenset(members), budget)
     plan = CollectivePlan(accl, [s for s, _r in recorder.entries],
                           frozenset(members), frozenset(comms), handle)
+    # lifecycle anchor (r13): a capture event per touched comm lets the
+    # dump checkers prove a post-fence replay was legitimately re-armed
+    # (analysis.checks.check_fence_staleness)
+    for c in sorted(comms):
+        _flight.mark_event(accl.flight_recorder, _flight.PLAN_CAPTURE_EVENT,
+                           int(c), lane="plan")
     if _metrics.enabled():
         _metrics.default_registry().inc("plans/captures")
     import weakref

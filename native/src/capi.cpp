@@ -32,6 +32,17 @@ struct World {
   }
 };
 
+// Every FFI entry resolves its engine through this null-tolerant
+// helper: ctypes passes Python None as NULL, and a late waiter thread
+// entering the FFI after the driver nulled its world handle must get a
+// clean "no engine" error, never a null dereference — the deterministic
+// half of the r13 suite-exit segfault (rc=139 after the pytest
+// summary: a daemon waiter scheduled after EmuWorld.close()).
+Engine* world_get(void* wp, int rank) {
+  World* w = static_cast<World*>(wp);
+  return w ? w->get(rank) : nullptr;
+}
+
 }  // namespace
 
 extern "C" {
@@ -112,7 +123,7 @@ void* accl_world_create_rdma(int nranks, uint64_t devmem_bytes) {
 // Queue-pair observability (dump_communicator analog for the RDMA rung).
 int accl_dump_qps(void* wp, int rank, char* out, int cap) {
   auto* w = static_cast<World*>(wp);
-  if (cap <= 0) return -1;
+  if (!w || cap <= 0) return -1;
   if (rank < 0 || rank >= int(w->rdma_transports.size())) return -1;
   std::string s = w->rdma_transports[rank]->dump_qps();
   int n = int(std::min<size_t>(s.size(), size_t(cap) - 1));
@@ -125,39 +136,52 @@ int accl_dump_qps(void* wp, int rank, char* out, int cap) {
 // 2=duplicate next fragment); -1 if this world has no datagram rung.
 int accl_dgram_fault(void* wp, uint32_t kind) {
   auto* w = static_cast<World*>(wp);
-  if (!w->dgram_hub) return -1;
+  if (!w || !w->dgram_hub) return -1;
   w->dgram_hub->inject_fault(kind);
   return 0;
 }
 
 void accl_world_destroy(void* wp) { delete static_cast<World*>(wp); }
 
+// Two-phase teardown, phase 1 (see Engine::shutdown): stop every
+// engine's threads and finalize every pending call so host-side
+// waiters return promptly; storage stays valid until
+// accl_world_destroy.  The driver calls this, then joins its waiter
+// threads, then destroys — the ordering that makes "a waiter was still
+// inside the engine when the world died" impossible.
+void accl_world_shutdown(void* wp) {
+  auto* w = static_cast<World*>(wp);
+  if (!w) return;
+  for (auto& e : w->engines)
+    if (e) e->shutdown();
+}
+
 int accl_cfg_rx(void* wp, int rank, int nbufs, uint64_t bufsize) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->cfg_rx_buffers(uint32_t(nbufs), bufsize);
   return 0;
 }
 
 int accl_set_comm(void* wp, int rank, const uint32_t* words, int n) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->set_comm(words, n) : -1;
 }
 
 int accl_set_arithcfg(void* wp, int rank, const uint32_t* words, int n) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->set_arithcfg(words, n) : -1;
 }
 
 int accl_set_tuning(void* wp, int rank, uint32_t key, uint32_t value) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->set_tuning(key, value);
   return 0;
 }
 
 int accl_inject_fault(void* wp, int rank, uint32_t kind) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->inject_fault(kind);
   return 0;
@@ -170,7 +194,7 @@ int accl_inject_fault(void* wp, int rank, uint32_t kind) {
 // backoff from retry_base_us (0 rounds = the lane is off).
 int accl_set_resilience(void* wp, int rank, uint32_t retry_max,
                         uint32_t retry_base_us) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->set_resilience(retry_max, retry_base_us);
   return 0;
@@ -179,14 +203,14 @@ int accl_set_resilience(void* wp, int rank, uint32_t retry_max,
 // Epoch-tagged communicator abort (ULFM-style revoke): every pending
 // call on all live ranks finalizes fast with err_bits | COMM_ABORTED.
 int accl_abort(void* wp, int rank, int comm_id, uint32_t err_bits) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->abort_comm(uint32_t(comm_id), err_bits, true) : -1;
 }
 
 // Seqn resync + transient-state drain after a classified fault; a
 // collective recovery op — every rank of a quiesced world calls it.
 int accl_reset_errors(void* wp, int rank) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->reset_errors();
   return 0;
@@ -197,7 +221,7 @@ int accl_reset_errors(void* wp, int rank) {
 int accl_set_chaos(void* wp, int rank, uint64_t seed, uint32_t drop_ppm,
                    uint32_t dup_ppm, uint32_t delay_ppm, uint32_t delay_us,
                    uint32_t corrupt_ppm, uint32_t slow_us) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->set_chaos(seed, drop_ppm, dup_ppm, delay_ppm, delay_us, corrupt_ppm,
                slow_us);
@@ -207,7 +231,7 @@ int accl_set_chaos(void* wp, int rank, uint64_t seed, uint32_t drop_ppm,
 // Kill-rank chaos: the engine goes silent and aborts its own comms
 // with RANK_FAILED so local pending calls finalize fast.
 int accl_chaos_kill(void* wp, int rank) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->kill();
   return 0;
@@ -218,7 +242,7 @@ int accl_chaos_kill(void* wp, int rank) {
 // rank i responded (the local rank is always alive).
 int accl_probe_liveness(void* wp, int rank, int comm_id, uint32_t window_us,
                         uint64_t* alive_bitmap) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   uint64_t bm = e->probe_liveness(uint32_t(comm_id), window_us);
   if (alive_bitmap) *alive_bitmap = bm;
@@ -235,7 +259,7 @@ int accl_probe_liveness(void* wp, int rank, int comm_id, uint32_t window_us,
 // exhausted — see the engines.reserve in accl_world_create).
 int accl_world_add_rank(void* wp) {
   auto* w = static_cast<World*>(wp);
-  if (!w->hub) return -1;
+  if (!w || !w->hub) return -1;
   if (w->engines.size() >= w->engines.capacity()) return -1;
   int r = w->hub->add_rank();
   w->engines.push_back(std::make_unique<Engine>(
@@ -252,7 +276,7 @@ int accl_world_add_rank(void* wp) {
 // sponsor session.  0 on success, -1 on timeout (sponsor deaf/dead).
 int accl_join_sync(void* wp, int rank, uint32_t sponsor_session,
                    int timeout_ms) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->join_sync(sponsor_session, timeout_ms) : -1;
 }
 
@@ -260,19 +284,19 @@ int accl_join_sync(void* wp, int rank, uint32_t sponsor_session,
 // knows, and a comm's current epoch — lets the driver and tests assert
 // that a joiner's id space and fences really aligned.
 int accl_comm_count(void* wp, int rank) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? int(e->comm_count()) : -1;
 }
 
 uint32_t accl_comm_epoch(void* wp, int rank, int comm_id) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->comm_epoch(uint32_t(comm_id)) : 0;
 }
 
 // Membership counters: joins answered as sponsor / completed as joiner.
 void accl_join_stats(void* wp, int rank, uint64_t* sponsored,
                      uint64_t* joined) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (e) e->join_stats(sponsored, joined);
 }
 
@@ -281,12 +305,12 @@ void accl_join_stats(void* wp, int rank, uint64_t* sponsored,
 void accl_resilience_stats(void* wp, int rank, uint64_t* retrans_sent,
                            uint64_t* nacks_tx, uint64_t* nacks_rx,
                            uint64_t* fenced_drops) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (e) e->resilience_stats(retrans_sent, nacks_tx, nacks_rx, fenced_drops);
 }
 
 uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->alloc(nbytes, align) : 0;
 }
 
@@ -294,7 +318,7 @@ uint64_t accl_alloc(void* wp, int rank, uint64_t nbytes, uint64_t align) {
 // external_dma path); returned addresses carry the engine's host tag.
 uint64_t accl_alloc_host(void* wp, int rank, uint64_t nbytes,
                          uint64_t align) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->alloc_host(nbytes, align) : 0;
 }
 
@@ -303,7 +327,7 @@ uint64_t accl_alloc_host(void* wp, int rank, uint64_t nbytes,
 // peer's rendezvous write lands by direct memcpy, bypassing the wire.
 uint64_t accl_alloc_p2p(void* wp, int rank, uint64_t nbytes,
                         uint64_t align) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return 0;
   uint64_t addr = e->alloc(nbytes, align);
   if (addr) e->register_p2p(addr, nbytes);
@@ -311,7 +335,7 @@ uint64_t accl_alloc_p2p(void* wp, int rank, uint64_t nbytes,
 }
 
 void accl_free_p2p(void* wp, int rank, uint64_t addr) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return;
   e->unregister_p2p(addr);
   e->free_addr(addr);
@@ -321,7 +345,7 @@ void accl_free_p2p(void* wp, int rank, uint64_t addr) {
 // bo.map<dtype*>() on a p2p BO).  Valid for the world's lifetime;
 // nullptr when out of range.
 void* accl_mem_ptr(void* wp, int rank, uint64_t addr, uint64_t nbytes) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->raw_mem(addr, nbytes) : nullptr;
 }
 
@@ -329,7 +353,7 @@ void* accl_mem_ptr(void* wp, int rank, uint64_t addr, uint64_t nbytes) {
 // the p2p path moved no payload over the transport.
 void accl_tx_stats(void* wp, int rank, uint64_t* msgs,
                    uint64_t* payload_bytes) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (e) e->tx_stats(msgs, payload_bytes);
 }
 
@@ -337,38 +361,38 @@ void accl_tx_stats(void* wp, int rank, uint64_t* msgs,
 // over the tcp_session_handler; see Engine).  open/close return 0 on
 // success or (1 + peer_local_rank) / -1 on failure.
 int accl_open_port(void* wp, int rank) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->open_port() : -1;
 }
 
 int accl_open_con(void* wp, int rank, int comm_id) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->open_con(uint32_t(comm_id)) : -1;
 }
 
 int accl_close_con(void* wp, int rank, int comm_id) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->close_con(uint32_t(comm_id)) : -1;
 }
 
 void accl_free(void* wp, int rank, uint64_t addr) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (e) e->free_addr(addr);
 }
 
 int accl_read_mem(void* wp, int rank, uint64_t addr, void* dst, uint64_t n) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e && e->read_mem(addr, dst, n) ? 0 : -1;
 }
 
 int accl_write_mem(void* wp, int rank, uint64_t addr, const void* src,
                    uint64_t n) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e && e->write_mem(addr, src, n) ? 0 : -1;
 }
 
 uint64_t accl_start_call(void* wp, int rank, const uint32_t* w15) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->start_call(w15) : 0;
 }
 
@@ -379,7 +403,7 @@ uint64_t accl_start_call(void* wp, int rank, const uint32_t* w15) {
 // Create a plan from ncalls x 15 descriptor words; returns the plan id
 // (>= 0) or -1 (malformed input / a referenced comm is aborted).
 int accl_plan_create(void* wp, int rank, const uint32_t* words, int ncalls) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->plan_create(words, ncalls) : -1;
 }
 
@@ -387,7 +411,7 @@ int accl_plan_create(void* wp, int rank, const uint32_t* words, int ncalls) {
 // (> 0), -1 for an unknown plan, -2 when the plan was invalidated by
 // an abort/epoch fence/reset (the caller must re-capture).
 long long accl_plan_replay(void* wp, int rank, int plan_id) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->plan_replay(plan_id) : -1;
 }
 
@@ -395,7 +419,7 @@ long long accl_plan_replay(void* wp, int rank, int plan_id) {
 // duration = sum), 0 = in flight, -1 = unknown token.
 int accl_plan_poll(void* wp, int rank, long long token, uint32_t* ret,
                    double* dur) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->plan_poll(token, ret, dur) : -1;
 }
 
@@ -403,7 +427,7 @@ int accl_plan_poll(void* wp, int rank, long long token, uint32_t* ret,
 // 0 = timeout, -1 = unknown token.
 int accl_plan_wait(void* wp, int rank, long long token, int timeout_ms,
                    uint32_t* ret, double* dur) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -419,7 +443,7 @@ int accl_plan_wait(void* wp, int rank, long long token, int timeout_ms,
 // shrink/grow eviction contract (abort and reset_errors fence
 // engine-side on their own).
 int accl_plan_invalidate(void* wp, int rank, int comm_id) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->invalidate_plans(comm_id);
   return 0;
@@ -427,14 +451,14 @@ int accl_plan_invalidate(void* wp, int rank, int comm_id) {
 
 // Live (valid) plan count — eviction introspection for tests.
 int accl_plan_count(void* wp, int rank) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e ? e->plan_count() : -1;
 }
 
 // Release one plan's engine-side storage (driver plan object died or
 // was closed) — the id's slot stays but pins nothing.
 int accl_plan_release(void* wp, int rank, int plan_id) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return -1;
   e->plan_release(plan_id);
   return 0;
@@ -442,13 +466,13 @@ int accl_plan_release(void* wp, int rank, int plan_id) {
 
 int accl_poll_call(void* wp, int rank, uint64_t id, uint32_t* ret,
                    double* dur) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e && e->poll_call(id, ret, dur) ? 1 : 0;
 }
 
 int accl_wait_call(void* wp, int rank, uint64_t id, int timeout_ms,
                    uint32_t* ret, double* dur) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e) return 0;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -460,21 +484,78 @@ int accl_wait_call(void* wp, int rank, uint64_t id, int timeout_ms,
 }
 
 void accl_push_krnl(void* wp, int rank, const void* data, uint64_t n) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (e) e->push_krnl(static_cast<const uint8_t*>(data), n);
 }
 
 int accl_pop_stream(void* wp, int rank, uint32_t strm, void* dst, uint64_t cap,
                     uint64_t* got, int timeout_ms) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   return e && e->pop_stream(strm, static_cast<uint8_t*>(dst), cap, got,
                             timeout_ms)
              ? 1
              : 0;
 }
 
+// ---- wire-protocol correctness surface (r13): raw-frame ingest for
+// the deterministic fuzzer + malformed-frame counters + egress frame
+// tap (seed-corpus capture).  See Engine::ingest_bytes. ----
+
+// Feed one raw frame (64-byte header + payload) to an engine's real
+// ingress classification path.  Returns 0 = consumed (or legally
+// dropped by the kill/epoch gates), 1 = rejected as malformed, -1 =
+// bad rank.
+int accl_engine_ingest_bytes(void* wp, int rank, const void* data,
+                             uint64_t nbytes) {
+  Engine* e = world_get(wp, rank);
+  if (!e) return -1;
+  return e->ingest_bytes(static_cast<const uint8_t*>(data), nbytes);
+}
+
+// Frame counters: frames that passed structural validation vs frames
+// rejected as malformed (the latter is the fuzz/abuse observable,
+// exported as engine/wire/rejected_frames through the metrics
+// registry on the Python side).
+void accl_frame_stats(void* wp, int rank, uint64_t* accepted,
+                      uint64_t* rejected) {
+  Engine* e = world_get(wp, rank);
+  if (e) e->frame_stats(accepted, rejected);
+}
+
+// Egress frame tap on/off (bounded ring of the last 256 staged frames).
+int accl_frame_tap(void* wp, int rank, int on) {
+  Engine* e = world_get(wp, rank);
+  if (!e) return -1;
+  e->set_frame_tap(on != 0);
+  return 0;
+}
+
+int accl_frame_tap_count(void* wp, int rank) {
+  Engine* e = world_get(wp, rank);
+  return e ? e->tap_count() : -1;
+}
+
+// Read captured frame `idx` (oldest first); returns the frame's full
+// byte size (retry with a bigger buffer if > cap), or -1 when idx is
+// out of range / the rank is unknown.  Index->frame identity is only
+// stable while nothing rotates the ring — concurrent readers of a
+// live tap must use accl_frame_tap_drain.
+int accl_frame_tap_read(void* wp, int rank, int idx, void* out, int cap) {
+  Engine* e = world_get(wp, rank);
+  return e ? e->tap_read(idx, static_cast<uint8_t*>(out), cap) : -1;
+}
+
+// Atomically drain captured frames into out as consecutive
+// [u32 len][bytes] records (one lock hold — frames can never tear
+// against live traffic rotating the ring); returns bytes written,
+// 0 when the tap is empty, -1 for an unknown rank.
+int accl_frame_tap_drain(void* wp, int rank, void* out, int cap) {
+  Engine* e = world_get(wp, rank);
+  return e ? e->tap_drain(static_cast<uint8_t*>(out), cap) : -1;
+}
+
 int accl_dump_rx(void* wp, int rank, char* out, int cap) {
-  Engine* e = static_cast<World*>(wp)->get(rank);
+  Engine* e = world_get(wp, rank);
   if (!e || cap <= 0) return -1;
   std::string s = e->dump_rx();
   int n = int(std::min<size_t>(s.size(), size_t(cap) - 1));

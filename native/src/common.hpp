@@ -216,6 +216,56 @@ struct NotReadyEx {
 struct SizeCapEx {};
 
 // ---------------------------------------------------------------------------
+// TSan-safe timed condition waits (r13).  libstdc++ (gcc 10) lowers
+// every steady-clock timed CV wait to pthread_cond_clockwait, which
+// this toolchain's ThreadSanitizer runtime does NOT intercept: the
+// checker then never observes the mutex being released inside the wait
+// and reports impossible "double lock of a mutex"/"race with mutex
+// held" findings on perfectly locked queues.  Under
+// __SANITIZE_THREAD__ these helpers replace the timed wait with a
+// bounded unlock/sleep/relock poll (1 ms granularity — every caller
+// re-checks its predicate, so the observable semantics are identical);
+// all other builds use the real futex-backed wait.  Policy + rationale:
+// docs/static_analysis.md "Native sanitizer lanes".
+// ---------------------------------------------------------------------------
+template <typename Pred>
+inline bool cv_wait_for_pred(std::condition_variable& cv,
+                             std::unique_lock<std::mutex>& g,
+                             std::chrono::nanoseconds timeout, Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  (void)cv;
+  auto deadline = std::chrono::steady_clock::now() + timeout;
+  for (;;) {
+    if (pred()) return true;
+    if (std::chrono::steady_clock::now() >= deadline) return pred();
+    g.unlock();
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    g.lock();
+  }
+#else
+  return cv.wait_for(g, timeout, pred);
+#endif
+}
+
+inline std::cv_status cv_wait_until_point(
+    std::condition_variable& cv, std::unique_lock<std::mutex>& g,
+    std::chrono::steady_clock::time_point deadline) {
+#if defined(__SANITIZE_THREAD__)
+  (void)cv;
+  if (std::chrono::steady_clock::now() >= deadline)
+    return std::cv_status::timeout;
+  g.unlock();
+  std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  g.lock();
+  return std::chrono::steady_clock::now() >= deadline
+             ? std::cv_status::timeout
+             : std::cv_status::no_timeout;
+#else
+  return cv.wait_until(g, deadline);
+#endif
+}
+
+// ---------------------------------------------------------------------------
 // Bounded-ish MPMC fifo used for command/status/notification streams
 // (role of the hlslib FIFOs wiring the reference emulator threads).
 // ---------------------------------------------------------------------------
@@ -232,7 +282,8 @@ class Fifo {
 
   std::optional<T> pop_wait(std::chrono::nanoseconds timeout) {
     std::unique_lock<std::mutex> g(m_);
-    if (!cv_.wait_for(g, timeout, [&] { return !q_.empty() || closed_; }))
+    if (!cv_wait_for_pred(cv_, g, timeout,
+                          [&] { return !q_.empty() || closed_; }))
       return std::nullopt;
     if (q_.empty()) return std::nullopt;
     T v = std::move(q_.front());
@@ -263,7 +314,7 @@ class Fifo {
         }
       }
       if (closed_) return std::nullopt;
-      if (cv_.wait_until(g, deadline) == std::cv_status::timeout) {
+      if (cv_wait_until_point(cv_, g, deadline) == std::cv_status::timeout) {
         // one last scan after timeout
         for (auto it = q_.begin(); it != q_.end(); ++it) {
           if (pred(*it)) {

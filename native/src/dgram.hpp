@@ -122,8 +122,8 @@ class DgramHub {
       DgSink sink;
       {
         std::unique_lock<std::mutex> g(st.mu);
-        st.cv.wait_for(g, std::chrono::milliseconds(50),
-                       [&] { return !st.q.empty() || !running_; });
+        cv_wait_for_pred(st.cv, g, std::chrono::milliseconds(50),
+                         [&] { return !st.q.empty() || !running_; });
         if (!running_ && st.q.empty()) return;
         for (uint32_t i = 0; i < window_ && !st.q.empty(); ++i) {
           batch.push_back(std::move(st.q.front()));
@@ -225,8 +225,11 @@ class DatagramTransport : public Transport {
       Slot& s = it->second;
       if (d.frag_idx < s.nfrags && !s.seen[d.frag_idx] &&
           d.frag_off + d.chunk.size() <= s.buf.size()) {
-        std::memcpy(s.buf.data() + d.frag_off, d.chunk.data(),
-                    d.chunk.size());
+        // empty chunk (zero-payload message): data() may be null and
+        // memcpy declares its args nonnull (UBSan)
+        if (!d.chunk.empty())
+          std::memcpy(s.buf.data() + d.frag_off, d.chunk.data(),
+                      d.chunk.size());
         s.seen[d.frag_idx] = true;
         s.got++;
       }

@@ -39,11 +39,15 @@ Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
   delay_thread_ = std::thread([this] { delay_loop(); });
 }
 
-Engine::~Engine() {
+Engine::~Engine() { shutdown(); }
+
+void Engine::shutdown() {
+  if (stopped_.exchange(true)) return;  // idempotent
   running_ = false;
   cmd_q_.close();
   completions_.close();
   pending_addrs_.close();
+  krnl_in_.close();  // unblock drain_krnl_to/send-from-stream waits
   if (loop_thread_.joinable()) loop_thread_.join();
   {
     // chaos-delayed messages still pending at teardown are dropped (the
@@ -58,13 +62,32 @@ Engine::~Engine() {
     // drain staged segments so tail messages of completed calls are not
     // lost, then stop the writer
     std::unique_lock<std::mutex> g(egress_mu_);
-    egress_cv_.wait_for(g, std::chrono::seconds(2),
-                        [&] { return egress_q_.empty(); });
+    cv_wait_for_pred(egress_cv_, g, std::chrono::seconds(2),
+                     [&] { return egress_q_.empty(); });
     egress_running_ = false;
   }
   egress_cv_.notify_all();
   if (egress_thread_.joinable()) egress_thread_.join();
   transport_->stop();
+  // unblock host-side stream readers parked in pop_stream
+  {
+    std::lock_guard<std::mutex> g(streams_mu_);
+    for (auto& [strm, fifo] : streams_)
+      if (fifo) fifo->close();
+  }
+  // finalize every call the stopped loop left pending, so a host
+  // waiter polling its id returns NOW instead of burning its full wait
+  // budget against a dead engine (and then touching freed memory — the
+  // suite-exit segfault)
+  {
+    std::lock_guard<std::mutex> g(results_mu_);
+    for (auto& [id, r] : results_) {
+      if (!r.done) {
+        r.retcode = COMM_ABORTED | RANK_FAILED;
+        r.done = true;
+      }
+    }
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -229,6 +252,17 @@ uint64_t Engine::start_call(const uint32_t* w15) {
     results_[c.id] = CallResult{};
   }
   cmd_q_.push(c);
+  // a submission racing shutdown(): the finalize sweep may already
+  // have run, leaving this call pending forever (its waiter would burn
+  // the full wait budget against a dead engine) — finalize inline
+  if (stopped_.load()) {
+    std::lock_guard<std::mutex> g(results_mu_);
+    auto& r = results_[c.id];
+    if (!r.done) {
+      r.retcode = COMM_ABORTED | RANK_FAILED;
+      r.done = true;
+    }
+  }
   return c.id;
 }
 
@@ -401,7 +435,7 @@ bool Engine::pop_stream(uint32_t strm, uint8_t* dst, uint64_t cap,
   auto v = stream_for(strm)->pop_wait(milliseconds(timeout_ms));
   if (!v) return false;
   uint64_t n = std::min<uint64_t>(cap, v->size());
-  std::memcpy(dst, v->data(), n);
+  if (n) std::memcpy(dst, v->data(), n);
   if (got) *got = n;
   return true;
 }
@@ -478,7 +512,7 @@ void Engine::delay_loop() {
         [](const Delayed& a, const Delayed& b) { return a.release < b.release; });
     auto now = steady_clock::now();
     if (it->release > now) {
-      delay_cv_.wait_until(lk, it->release);
+      cv_wait_until_point(delay_cv_, lk, it->release);
       continue;
     }
     Delayed d = std::move(*it);
@@ -542,6 +576,20 @@ void Engine::kill() {
 // `pipeline_depth_` segments are already outstanding (the end_move()
 // backpressure point of the reference's pipelined send).
 void Engine::stage_egress(uint32_t session, Message&& msg) {
+  if (tap_on_.load()) {
+    // fuzz seed-corpus capture: serialize exactly the wire framing
+    // (64-byte header + payload) into a bounded ring.  Taps here, not
+    // in send_out, because the control plane (NACK/pong/abort/join)
+    // stages directly and must be capturable too.
+    std::vector<uint8_t> raw(sizeof(WireHeader) + msg.payload.size());
+    std::memcpy(raw.data(), &msg.hdr, sizeof(WireHeader));
+    if (!msg.payload.empty())
+      std::memcpy(raw.data() + sizeof(WireHeader), msg.payload.data(),
+                  msg.payload.size());
+    std::lock_guard<std::mutex> g(tap_mu_);
+    if (tap_frames_.size() >= kTapCap) tap_frames_.pop_front();
+    tap_frames_.push_back(std::move(raw));
+  }
   {
     std::unique_lock<std::mutex> g(egress_mu_);
     egress_cv_.wait(g, [&] {
@@ -589,10 +637,136 @@ void Engine::egress_loop() {
 // engine's pending/completion queues (reference: udp_depacketizer.cpp
 // strm routing :136-147, rdma_depacketizer notification routing)
 // ---------------------------------------------------------------------------
+// Structural frame validation — the contract every conforming sender in
+// this file upholds, enforced at the receiver so a corrupted/hostile
+// frame is COUNTED and DROPPED before any routing interprets it:
+//  - msg_type must be a known MsgType;
+//  - payload-bearing types (EgrMsg/RndzvsMsg/StateSync) must carry
+//    count == payload size (their senders always stamp it so);
+//  - comm-addressed types must carry comm_id < kMaxComms (the fence
+//    arrays index by it; conforming comm ids are < 64 by construction);
+//  - a pool-routed eager segment larger than one rx buffer cannot come
+//    from a conforming sender (segmentation quantum) and would be
+//    silently TRUNCATED at install — rejected instead.
+// Join/Welcome are session-addressed (pre-communicator) and carry no
+// payload contract; RndzvsInit's count is an element count, not bytes.
+bool Engine::frame_ok(const WireHeader& hdr, uint64_t payload_bytes) {
+  switch (static_cast<MsgType>(hdr.msg_type)) {
+    case MsgType::EgrMsg:
+      if (hdr.count != payload_bytes) return false;
+      if (hdr.comm_id >= kMaxComms) return false;
+      if (hdr.strm < FIRST_KRNL_STREAM && rx_.buf_size() &&
+          payload_bytes > rx_.buf_size())
+        return false;
+      if (hdr.strm >= FIRST_KRNL_STREAM) {
+        // stream-route state is minted per (comm, src, strm) from
+        // attacker-controlled header fields: reject BEFORE any state
+        // exists once the route count or the total parked holdback
+        // would exceed its bound (a conforming sender uses a handful
+        // of stream ids and an out-of-order window no deeper than the
+        // egress pipeline).  Checked here — not in classify() — so a
+        // dropped frame is a single counted rejection and
+        // ingest_bytes' return code matches the counter.
+        std::lock_guard<std::mutex> g(strm_seq_mu_);
+        StrmKey key{hdr.comm_id, hdr.src, hdr.strm};
+        auto it = strm_in_seq_.find(key);
+        if (it == strm_in_seq_.end() &&
+            strm_in_seq_.size() >= kMaxStrmRoutes)
+          return false;
+        uint32_t expect = it == strm_in_seq_.end() ? 0 : it->second;
+        if (hdr.seqn > expect) {  // would park in holdback
+          if (strm_holdback_.size() >= kMaxStrmHoldbackTotal)
+            return false;
+          if (!lossy_transport_.load()) {
+            size_t held = 0;
+            for (const auto& kv : strm_holdback_)
+              if (kv.first.first == key) ++held;
+            if (held >= kStrmHoldbackLimit) return false;
+          }
+        }
+      }
+      return true;
+    case MsgType::RndzvsMsg:
+      return hdr.comm_id < kMaxComms && hdr.count == payload_bytes;
+    case MsgType::RndzvsInit:
+    case MsgType::RndzvsWrDone:
+    case MsgType::Nack:
+    case MsgType::Heartbeat:
+    case MsgType::Abort:
+      return hdr.comm_id < kMaxComms;
+    case MsgType::Join:
+    case MsgType::Welcome:
+      return true;
+    case MsgType::StateSync:
+      return hdr.count == payload_bytes;
+  }
+  return false;  // unknown message type
+}
+
 void Engine::ingress(Message&& msg) {
   // kill-rank chaos: a dead engine hears nothing — no pongs, no
   // completions, no deposits (the peer-visible half of kill())
   if (killed_.load()) return;
+  if (!frame_ok(msg.hdr, msg.payload.size())) {
+    frames_rejected_.fetch_add(1);
+    return;
+  }
+  frames_accepted_.fetch_add(1);
+  classify(std::move(msg));
+}
+
+// Test/fuzz hook: the raw-bytes twin of a transport delivery.  Same
+// gates, same validation, same routing; returns the accept/reject
+// verdict the transport path only counts.
+int Engine::ingest_bytes(const uint8_t* data, uint64_t nbytes) {
+  if (!data || nbytes < sizeof(WireHeader)) {
+    frames_rejected_.fetch_add(1);
+    return 1;
+  }
+  Message msg;
+  std::memcpy(&msg.hdr, data, sizeof(WireHeader));
+  msg.payload.assign(data + sizeof(WireHeader), data + nbytes);
+  if (!frame_ok(msg.hdr, msg.payload.size())) {
+    frames_rejected_.fetch_add(1);
+    return 1;
+  }
+  frames_accepted_.fetch_add(1);
+  if (!killed_.load()) classify(std::move(msg));
+  return 0;
+}
+
+int Engine::tap_read(int idx, uint8_t* out, int cap) const {
+  std::lock_guard<std::mutex> g(tap_mu_);
+  if (idx < 0 || idx >= int(tap_frames_.size())) return -1;
+  const std::vector<uint8_t>& f = tap_frames_[size_t(idx)];
+  if (out && cap > 0) {
+    size_t n = std::min<size_t>(f.size(), size_t(cap));
+    std::memcpy(out, f.data(), n);
+  }
+  return int(f.size());
+}
+
+int Engine::tap_drain(uint8_t* out, int cap) {
+  std::lock_guard<std::mutex> g(tap_mu_);
+  int off = 0;
+  while (!tap_frames_.empty()) {
+    const std::vector<uint8_t>& f = tap_frames_.front();
+    int need = int(sizeof(uint32_t) + f.size());
+    if (off + need > cap) {
+      // oversized lone frame can never fit any buffer of this cap
+      if (off == 0 && need > cap) tap_frames_.pop_front();
+      break;
+    }
+    uint32_t len = uint32_t(f.size());
+    std::memcpy(out + off, &len, sizeof len);
+    if (len) std::memcpy(out + off + sizeof len, f.data(), len);
+    off += need;
+    tap_frames_.pop_front();
+  }
+  return off;
+}
+
+void Engine::classify(Message&& msg) {
   switch (static_cast<MsgType>(msg.hdr.msg_type)) {
     case MsgType::Nack:
       nacks_rx_.fetch_add(1);
@@ -675,6 +849,9 @@ void Engine::ingress(Message&& msg) {
             ++expect;
           }
         } else if (msg.hdr.seqn > expect) {
+          // holdback growth is pre-bounded by frame_ok (route count,
+          // per-route window on reliable rungs, total across routes) —
+          // a frame reaching this insertion was already admitted
           strm_holdback_[{key, msg.hdr.seqn}] = std::move(msg.payload);
           // loss recovery: a hole that parks too many successors means
           // the expected message was lost on a lossy rung — resync to
@@ -1066,7 +1243,7 @@ void Engine::land_one_sided(const WireHeader& hdr, const uint8_t* payload,
           run_compress_lane(post->comp_kind, payload,
                             region.data() + vaddr, elems);
       }
-    } else if (vaddr + payload_bytes <= region.size()) {
+    } else if (payload_bytes && vaddr + payload_bytes <= region.size()) {
       std::memcpy(region.data() + vaddr, payload, payload_bytes);
     }
   }
@@ -1511,13 +1688,18 @@ void Engine::do_config(CallDesc& c) {
 // ---------------------------------------------------------------------------
 // helpers
 // ---------------------------------------------------------------------------
+// The fallback tables are IMMORTAL by design (leaked, never destroyed):
+// a world the host leaked at interpreter exit still has engine threads
+// running when __cxa_finalize destroys this library's function-local
+// statics — a destroyed fallback under a live loop thread is a
+// use-after-free at process exit (the r13 suite-exit segfault class).
 const CommTable& Engine::comm_for(const CallDesc& c) const {
-  static CommTable empty;
+  static const CommTable& empty = *new CommTable();
   return c.comm() < comms_.size() ? comms_[c.comm()] : empty;
 }
 
 const ArithCfgN& Engine::arith_for(const CallDesc& c) const {
-  static ArithCfgN dflt;
+  static const ArithCfgN& dflt = *new ArithCfgN();
   return c.arithcfg() < arithcfgs_.size() ? arithcfgs_[c.arithcfg()] : dflt;
 }
 
@@ -1543,6 +1725,10 @@ Engine::Dom Engine::dom(const CallDesc& c) const {
 
 uint32_t Engine::convert_elems(const Dom& d, const uint8_t* in, bool in_c,
                                uint8_t* out, bool out_c, uint64_t elems) {
+  // zero-element moves are legal (barrier's empty messages) but the
+  // pointers may then be null (an empty vector's data()) — and
+  // memmove/the lanes declare their pointers nonnull (UBSan)
+  if (elems == 0) return OK;
   if (in_c == out_c) {
     std::memmove(out, in, elems * d.eb(in_c));
     return OK;
@@ -1637,7 +1823,7 @@ bool Engine::drain_krnl_to(uint64_t addr, uint64_t bytes) {
     uint64_t n = std::min<uint64_t>(v->size(), bytes - off);
     if (v->size() > bytes - off) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
     std::lock_guard<std::mutex> g(mem_mu_);
-    std::memcpy(mem(addr + off, n), v->data(), n);
+    if (n) std::memcpy(mem(addr + off, n), v->data(), n);
     off += n;
   }
   return true;
@@ -1781,6 +1967,14 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
   uint32_t attempts = 0;  // fast-phase NACK rounds consumed
   uint32_t chunks = 0;    // steady-state 50 ms slices elapsed
   for (;;) {
+    // engine shutdown mid-seek: give the call back to the loop (which
+    // is exiting) so shutdown's finalize sweep retires it — a blocked
+    // receive must never hold the loop-thread join hostage for the
+    // rest of its receive budget
+    if (!running_.load()) {
+      sticky_err_ |= COMM_ABORTED | RANK_FAILED;
+      return std::nullopt;
+    }
     uint32_t ab = abort_err(c.comm());
     if (ab) {
       sticky_err_ |= ab;

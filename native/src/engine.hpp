@@ -66,6 +66,16 @@ class Engine {
          std::unique_ptr<Transport> transport);
   ~Engine();
 
+  // Two-phase teardown (r13, the suite-exit segfault fix): stop every
+  // engine thread, close the queues, and finalize every still-pending
+  // call with COMM_ABORTED|RANK_FAILED — WITHOUT freeing any storage.
+  // After shutdown() a host-side waiter parked in a poll/wait loop
+  // returns within one poll interval, so the world can be destroyed
+  // with a hard guarantee that no thread is still inside the engine
+  // (the crash class: accl_world_destroy racing a waiter thread's
+  // poll_call).  Idempotent; the destructor runs it first.
+  void shutdown();
+
   // ---- host-facing config (driver bring-up path) ----
   void cfg_rx_buffers(uint32_t nbufs, uint64_t bufsize);
   int set_comm(const uint32_t* words, int nwords);
@@ -125,6 +135,43 @@ class Engine {
 
   std::string dump_rx() const { return rx_.dump(); }
   uint32_t rank() const { return global_rank_; }
+
+  // ---- wire-protocol correctness surface (r13) ----
+  // Feed one raw frame (64-byte WireHeader + payload) through the real
+  // ingress classification path, exactly as if the transport delivered
+  // it.  Returns 0 when the frame was consumed (including a legal drop
+  // by the kill/epoch gates) and 1 when it was REJECTED as malformed —
+  // truncated header, unknown MsgType, count/payload mismatch,
+  // out-of-range comm id, oversized eager segment.  The same
+  // validation runs on every transport-delivered frame; rejections
+  // increment the counter either way.
+  int ingest_bytes(const uint8_t* data, uint64_t nbytes);
+  void frame_stats(uint64_t* accepted, uint64_t* rejected) const {
+    if (accepted) *accepted = frames_accepted_.load();
+    if (rejected) *rejected = frames_rejected_.load();
+  }
+
+  // Egress frame tap: bounded ring of the last kTapCap frames this
+  // engine staged (serialized header + payload) — the wire fuzzer's
+  // seed-corpus capture (scripts/fuzz_wire.py records one real frame
+  // of every MsgType through this before mutating).
+  void set_frame_tap(bool on) { tap_on_.store(on); }
+  int tap_count() const {
+    std::lock_guard<std::mutex> g(tap_mu_);
+    return int(tap_frames_.size());
+  }
+  // Copy frame `idx` (oldest first) into out; returns the frame's full
+  // size in bytes (even if > cap — caller retries with a bigger
+  // buffer), or -1 for an out-of-range index.  NB index->frame identity
+  // is only stable while nothing rotates the ring: concurrent readers
+  // of a LIVE tap must use tap_drain, which is atomic per batch.
+  int tap_read(int idx, uint8_t* out, int cap) const;
+  // Atomically drain captured frames (oldest first) into out as
+  // consecutive [u32 len][frame bytes] records under one lock hold;
+  // returns bytes written.  Frames that don't fit stay for the next
+  // drain; a single frame larger than the whole buffer is dropped
+  // (it could never fit).
+  int tap_drain(uint8_t* out, int cap);
 
   // ---- fault injection (test harness; SURVEY §5 failure detection) ----
   // Forces the chaos funnel's NEXT egress draw: 1=drop, 2=duplicate,
@@ -265,8 +312,27 @@ class Engine {
   struct Progress;
   void dispatch(CallDesc& c, Progress& p);
 
-  // transport ingress demux (the depacketizer role, eth_intf routing)
+  // transport ingress demux (the depacketizer role, eth_intf routing):
+  // frame validation + rejection counting in ingress(), the per-type
+  // routing in classify() — ingest_bytes shares both.
   void ingress(Message&& msg);
+  void classify(Message&& msg);
+  // Structural validation of one frame BEFORE any routing touches it:
+  // a malformed frame must be counted and dropped, never interpreted.
+  // Non-const: the stream-route pressure checks read the resequencer
+  // maps under their mutex so rejection happens BEFORE any per-route
+  // state is minted from attacker-controlled header fields.
+  bool frame_ok(const WireHeader& hdr, uint64_t payload_bytes);
+  //: bounds on state minted from inbound stream headers (comm, src and
+  //: strm are attacker-controlled): max distinct inbound stream routes,
+  //: and max total parked out-of-order payloads across ALL routes
+  static constexpr size_t kMaxStrmRoutes = 256;
+  static constexpr size_t kMaxStrmHoldbackTotal = 1024;
+  std::atomic<uint64_t> frames_accepted_{0}, frames_rejected_{0};
+  std::atomic<bool> tap_on_{false};
+  static constexpr size_t kTapCap = 256;
+  mutable std::mutex tap_mu_;
+  std::deque<std::vector<uint8_t>> tap_frames_;
 
   // ---- primitives (firmware primitive layer, fw :533-791) ----
   struct Progress {
@@ -641,6 +707,7 @@ class Engine {
 
   std::thread loop_thread_;
   std::atomic<bool> running_{true};
+  std::atomic<bool> stopped_{false};  // shutdown() ran to completion
 
   // scratch for fused recv-reduce chains (plays the role of the spare
   // rendezvous buffers SPARE1-3, accl.cpp:1190-1212)

@@ -92,8 +92,8 @@ class RdmaHub {
       Transport::Sink sink;
       {
         std::unique_lock<std::mutex> g(st.mu);
-        st.cv.wait_for(g, std::chrono::milliseconds(50),
-                       [&] { return !st.q.empty() || !running_; });
+        cv_wait_for_pred(st.cv, g, std::chrono::milliseconds(50),
+                         [&] { return !st.q.empty() || !running_; });
         if (st.q.empty()) {
           if (!running_) return;
           continue;

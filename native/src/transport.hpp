@@ -54,42 +54,74 @@ class Transport {
 };
 
 // Shared in-process hub: global rank -> sink.
+//
+// Teardown discipline (r13, TSan-found): deliver() invokes the sink
+// OUTSIDE the hub lock (holding it would deadlock against engine
+// backpressure), so detach() must wait out any in-flight delivery —
+// otherwise a peer thread can still be executing inside the detached
+// engine's ingress while its destructor tears the members down (the
+// same delivering/cv drain the datagram and RDMA hubs already use).
 class InprocHub {
  public:
-  explicit InprocHub(int nranks) : sinks_(nranks) {}
+  explicit InprocHub(int nranks) {
+    for (int i = 0; i < nranks; ++i)
+      slots_.push_back(std::make_unique<Slot>());
+  }
   // Elastic membership: mint a delivery slot for a joining rank.  The
   // slot exists (deliver() can route to it) before the engine attaches,
   // so a survivor's early message to the joiner is dropped — exactly a
   // not-yet-listening process — rather than out-of-bounds.
   int add_rank() {
     std::lock_guard<std::mutex> g(m_);
-    sinks_.emplace_back();
-    return int(sinks_.size()) - 1;
+    slots_.push_back(std::make_unique<Slot>());
+    return int(slots_.size()) - 1;
   }
   int size() const {
     std::lock_guard<std::mutex> g(m_);
-    return int(sinks_.size());
+    return int(slots_.size());
   }
   void attach(int rank, Transport::Sink sink) {
     std::lock_guard<std::mutex> g(m_);
-    sinks_[rank] = std::move(sink);
+    slots_[size_t(rank)]->sink = std::move(sink);
   }
   void detach(int rank) {
-    std::lock_guard<std::mutex> g(m_);
-    sinks_[rank] = nullptr;
+    std::unique_lock<std::mutex> g(m_);
+    Slot& s = *slots_[size_t(rank)];
+    s.sink = nullptr;
+    // wait out in-flight deliveries: a sender thread that copied the
+    // sink may be mid-call into the engine being detached
+    s.cv.wait(g, [&] { return s.inflight == 0; });
   }
   void deliver(uint32_t dst, Message&& msg) {
+    Slot* s = nullptr;
     Transport::Sink sink;
     {
       std::lock_guard<std::mutex> g(m_);
-      if (dst < sinks_.size()) sink = sinks_[dst];
+      if (dst < slots_.size() && slots_[dst]->sink) {
+        s = slots_[dst].get();
+        sink = s->sink;
+        ++s->inflight;
+      }
     }
-    if (sink) sink(std::move(msg));
+    if (!sink) return;
+    sink(std::move(msg));
+    {
+      std::lock_guard<std::mutex> g(m_);
+      --s->inflight;
+    }
+    s->cv.notify_all();
   }
 
  private:
+  // unique_ptr slots: add_rank must not move live Slot objects (their
+  // cv/mutex state is waited on) when the vector grows
+  struct Slot {
+    Transport::Sink sink;
+    int inflight = 0;  // guarded by m_
+    std::condition_variable cv;
+  };
   mutable std::mutex m_;
-  std::vector<Transport::Sink> sinks_;
+  std::vector<std::unique_ptr<Slot>> slots_;
 };
 
 class InprocTransport : public Transport {

@@ -547,6 +547,15 @@ static void test_p2p_buffer(ACCL& a, int rank) {
 }
 
 static void test_rendezvous_latency(ACCL& a, int rank) {
+#if defined(__SANITIZE_THREAD__) || defined(__SANITIZE_ADDRESS__)
+  // a latency-RATIO guard is meaningless under a 10-30x sanitizer
+  // slowdown (TSan serializes every atomics-heavy path differently
+  // than the eager/rendezvous split assumes); the functional corpus
+  // still runs — only the pacing assertion is skipped
+  (void)a;
+  (void)rank;
+  return;
+#endif
   // Contended-rendezvous pacing guard: every rendezvous call takes at
   // least one NotReady retry (the receiver's address must cross the
   // wire), so a fixed retry sleep puts a hard floor under ping-pong
@@ -623,6 +632,35 @@ static void test_rendezvous_latency(ACCL& a, int rank) {
 // stale segments that cascade into seqn/BTT errors in later cases (the
 // reference boots one fixture per gtest process; this is the same
 // isolation in-proc).
+struct World;
+
+// ---------------------------------------------------------------------------
+// concurrency drills (r13): the TSan-focused section.  These hammer
+// the surfaces the r10-r13 arc made concurrent — raw-frame ingest vs
+// live traffic, abort/epoch fencing vs in-flight collectives, the plan
+// ring's create/replay/poll/invalidate races, shutdown vs host-side
+// pollers (the suite-exit teardown ordering), and the egress frame tap
+// — with every thread fully instrumented, which the Python test suite
+// cannot be (an uninstrumented CPython hides the GIL from TSan and
+// fabricates impossible races; docs/static_analysis.md "Native
+// sanitizer lanes").  The drills also run in the plain corpus build:
+// same assertions, just without the race checker underneath.
+// ---------------------------------------------------------------------------
+using DrillFn = std::function<void(World&)>;
+
+static std::vector<uint8_t> make_frame(uint8_t msg_type, uint32_t src,
+                                       uint32_t comm, uint32_t count,
+                                       uint32_t payload_bytes) {
+  WireHeader h;
+  h.msg_type = msg_type;
+  h.src = src;
+  h.comm_id = comm;
+  h.count = count;
+  std::vector<uint8_t> out(sizeof(WireHeader) + payload_bytes, 0x5A);
+  std::memcpy(out.data(), &h, sizeof(WireHeader));
+  return out;
+}
+
 struct World {
   std::shared_ptr<InprocHub> hub;
   std::vector<std::unique_ptr<Engine>> engines;
@@ -651,6 +689,236 @@ struct World {
     }
   }
 };
+
+// All-rank verified allreduce used by the drills as the liveness probe.
+static void drill_allreduce_round(World& w, int rounds) {
+  std::atomic<int> failures{0};
+  std::string first_err;
+  std::mutex err_mu;
+  std::vector<std::thread> threads;
+  for (int r = 0; r < NRANKS; ++r)
+    threads.emplace_back([&, r] {
+      try {
+        auto src = w.accls[r]->create_buffer<float>(64);
+        auto dst = w.accls[r]->create_buffer<float>(64);
+        for (uint32_t i = 0; i < 64; ++i) src->data()[i] = float(r + 1);
+        for (int it = 0; it < rounds; ++it) {
+          w.accls[r]->allreduce(*src, *dst, 64, Reduce::SUM);
+          float want = float(NRANKS * (NRANKS + 1)) / 2.0f;
+          for (uint32_t i = 0; i < 64; ++i)
+            if (dst->data()[i] != want)
+              throw std::runtime_error("allreduce corrupted under drill");
+        }
+      } catch (const std::exception& ex) {
+        failures.fetch_add(1);
+        std::lock_guard<std::mutex> g(err_mu);
+        if (first_err.empty()) first_err = ex.what();
+      }
+    });
+  for (auto& t : threads) t.join();
+  if (failures) throw std::runtime_error(first_err);
+}
+
+static void drill_ingest_vs_traffic(World& w) {
+  std::atomic<bool> stop{false};
+  // two attacker threads spray every engine with malformed + valid-
+  // shaped frames through the REAL ingress path
+  std::vector<std::thread> attackers;
+  for (int a = 0; a < 2; ++a)
+    attackers.emplace_back([&, a] {
+      uint64_t rng = 0x9E3779B97F4A7C15ull * (a + 1);
+      while (!stop.load()) {
+        rng ^= rng >> 12; rng ^= rng << 25; rng ^= rng >> 27;
+        Engine* e = w.engines[rng % NRANKS].get();
+        switch (rng % 5) {
+          case 0: {  // truncated header
+            uint8_t junk[16] = {0};
+            e->ingest_bytes(junk, sizeof junk);
+            break;
+          }
+          case 1: {  // unknown message type
+            auto f = make_frame(uint8_t(40 + rng % 200), 1, 0, 0, 0);
+            e->ingest_bytes(f.data(), f.size());
+            break;
+          }
+          case 2: {  // eager count/payload mismatch
+            auto f = make_frame(0, 1, 0, 999, 8);
+            e->ingest_bytes(f.data(), f.size());
+            break;
+          }
+          case 3: {  // well-formed heartbeat pong
+            auto f = make_frame(5, 1, 0, 0, 0);
+            e->ingest_bytes(f.data(), f.size());
+            break;
+          }
+          default: {  // out-of-range comm id
+            auto f = make_frame(4, 1, 1u << 20, 0, 0);
+            e->ingest_bytes(f.data(), f.size());
+            break;
+          }
+        }
+      }
+    });
+  drill_allreduce_round(w, 25);
+  stop.store(true);
+  for (auto& t : attackers) t.join();
+  uint64_t rejected = 0;
+  w.engines[0]->frame_stats(nullptr, &rejected);
+  if (rejected == 0)
+    throw std::runtime_error("ingest drill: nothing was ever rejected");
+}
+
+static void drill_abort_vs_traffic(World& w) {
+  std::atomic<int> aborted_seen{0};
+  std::atomic<long> iters{0};
+  std::vector<std::thread> threads;
+  for (int r = 0; r < NRANKS; ++r)
+    threads.emplace_back([&, r] {
+      auto src = w.accls[r]->create_buffer<float>(256);
+      auto dst = w.accls[r]->create_buffer<float>(256);
+      // effectively unbounded: the loop ends when the abort fences it
+      // (the fetch below gates the abort on real progress, so a fixed
+      // iteration count racing a fixed sleep can't end the loop first)
+      for (int it = 0; it < 1'000'000; ++it) {
+        try {
+          w.accls[r]->allreduce(*src, *dst, 256, Reduce::SUM);
+          iters.fetch_add(1);
+        } catch (const std::exception&) {
+          aborted_seen.fetch_add(1);
+          break;  // fenced: stop issuing on the dead epoch
+        }
+      }
+    });
+  // mid-flight abort, gated on PROGRESS (not wall clock): wait until
+  // the world demonstrably ran collectives, then fence it
+  while (iters.load() < 2 * NRANKS)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  w.engines[0]->abort_comm(0, 0, true);
+  for (auto& t : threads) t.join();
+  if (aborted_seen.load() == 0)
+    throw std::runtime_error("abort drill: no rank ever saw the fence");
+  // collective recovery on the SAME world: reset + verified allreduce
+  for (auto& e : w.engines) e->reset_errors();
+  drill_allreduce_round(w, 3);
+}
+
+static void drill_plan_races(World& w) {
+  Engine* e = w.engines[0].get();
+  // a small plan of Nop descriptors (pure engine-loop traffic — the
+  // drill targets the ring/token bookkeeping, not the collectives)
+  std::vector<uint32_t> words(15 * 8, 0);
+  for (int i = 0; i < 8; ++i) words[size_t(i) * 15] = 255;  // Op::Nop
+  int plan = e->plan_create(words.data(), 8);
+  if (plan < 0) throw std::runtime_error("plan drill: create failed");
+  std::atomic<int> fenced{0}, completed{0}, errors{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 3; ++t)
+    threads.emplace_back([&] {
+      // never throw out of a drill thread (std::terminate): record and
+      // bail, the joiner raises
+      for (int it = 0; it < 50; ++it) {
+        long long tok = e->plan_replay(plan);
+        if (tok == -2) {  // invalidated mid-loop: the fence worked
+          fenced.fetch_add(1);
+          return;
+        }
+        if (tok < 0) {
+          errors.fetch_add(1);
+          return;
+        }
+        uint32_t ret = 0;
+        double dur = 0;
+        for (;;) {
+          int rc = e->plan_poll(tok, &ret, &dur);
+          if (rc == 1) break;
+          if (rc < 0) {  // token vanished under a live poller
+            errors.fetch_add(1);
+            return;
+          }
+          std::this_thread::yield();
+        }
+        completed.fetch_add(1);
+      }
+    });
+  std::this_thread::sleep_for(std::chrono::milliseconds(3));
+  e->invalidate_plans(-1);
+  for (auto& t : threads) t.join();
+  if (errors.load())
+    throw std::runtime_error("plan drill: bad token / token vanished");
+  if (completed.load() == 0 && fenced.load() == 0)
+    throw std::runtime_error("plan drill: no thread made progress");
+  if (e->plan_count() != 0)
+    throw std::runtime_error("plan drill: invalidation left live plans");
+}
+
+static void drill_shutdown_vs_pollers(World& w) {
+  Engine* e = w.engines[0].get();
+  // one never-completing receive PER poller (src rank 1 sends nothing;
+  // poll_call is consume-once, so each poller owns its call — exactly
+  // the Python waiter-thread shape)
+  constexpr int kPollers = 3;
+  uint64_t ids[kPollers];
+  for (int t = 0; t < kPollers; ++t) {
+    uint64_t addr = e->alloc(256, 64);
+    std::array<uint32_t, 15> wds{};
+    wds[0] = 4;  // Op::Recv
+    wds[1] = 64;
+    wds[2] = 0;               // comm
+    wds[3] = 1;               // src
+    wds[5] = uint32_t(t);     // distinct tags
+    wds[13] = uint32_t(addr & 0xFFFFFFFFu);
+    wds[14] = uint32_t(addr >> 32);
+    ids[t] = e->start_call(wds.data());
+  }
+  std::atomic<uint32_t> final_ret{0};
+  std::atomic<int> released{0};
+  std::vector<std::thread> pollers;
+  for (int t = 0; t < kPollers; ++t)
+    pollers.emplace_back([&, t] {
+      uint32_t ret = 0;
+      double dur = 0;
+      // poll until the call finalizes; shutdown() must make this
+      // return promptly — the native twin of the Python waiter thread
+      for (int spins = 0; spins < 1'000'000; ++spins) {
+        if (e->poll_call(ids[t], &ret, &dur)) {
+          final_ret.fetch_or(ret);
+          released.fetch_add(1);
+          return;
+        }
+        std::this_thread::sleep_for(std::chrono::microseconds(50));
+      }
+    });
+  std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  e->shutdown();
+  for (auto& t : pollers) t.join();
+  if (released.load() != kPollers)
+    throw std::runtime_error("shutdown drill: a poller never released");
+  if ((final_ret.load() & (COMM_ABORTED | RANK_FAILED)) == 0)
+    throw std::runtime_error(
+        "shutdown drill: pending calls not finalized with "
+        "COMM_ABORTED|RANK_FAILED");
+}
+
+static void drill_tap_vs_traffic(World& w) {
+  std::atomic<bool> stop{false};
+  for (auto& e : w.engines) e->set_frame_tap(true);
+  std::thread reader([&] {
+    uint8_t buf[4096];
+    while (!stop.load()) {
+      for (auto& e : w.engines) {
+        int n = e->tap_count();
+        for (int i = 0; i < n; ++i) e->tap_read(i, buf, sizeof buf);
+      }
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+    }
+  });
+  drill_allreduce_round(w, 20);
+  stop.store(true);
+  reader.join();
+  for (auto& e : w.engines) e->set_frame_tap(false);
+  if (w.engines[0]->tap_count() == 0)
+    throw std::runtime_error("tap drill: no frames captured");
+}
 
 int main() {
   struct Case {
@@ -720,11 +988,35 @@ int main() {
     }
   }
 
+  // concurrency drills (r13): direct World access, fresh world each
+  struct Drill {
+    const char* name;
+    DrillFn fn;
+  };
+  std::vector<Drill> drills = {
+      {"drill_ingest_vs_traffic", drill_ingest_vs_traffic},
+      {"drill_abort_vs_traffic", drill_abort_vs_traffic},
+      {"drill_plan_races", drill_plan_races},
+      {"drill_shutdown_vs_pollers", drill_shutdown_vs_pollers},
+      {"drill_tap_vs_traffic", drill_tap_vs_traffic},
+  };
+  for (auto& d : drills) {
+    World w;
+    try {
+      d.fn(w);
+      std::printf("PASS %s\n", d.name);
+    } catch (const std::exception& ex) {
+      ++failed_cases;
+      std::printf("FAIL %-26s %s\n", d.name, ex.what());
+    }
+  }
+
+  size_t total = cases.size() + drills.size();
   if (failed_cases) {
     std::printf("native driver corpus: %d/%zu cases FAILED\n", failed_cases,
-                cases.size());
+                total);
     return 1;
   }
-  std::printf("native driver corpus: all %zu cases OK\n", cases.size());
+  std::printf("native driver corpus: all %zu cases OK\n", total);
   return 0;
 }

@@ -89,9 +89,18 @@ def report(doc: dict, out=sys.stdout) -> bool:
           f"flight ring wrapped (uneven eviction would fake desyncs; "
           f"raise ACCL_FLIGHT_CAP for full-history analysis)\n")
 
-    if an["ok"] and not an["stragglers"]:
-        w("\nno hangs, desyncs or stragglers — all ranks in sync\n")
-    return not an["ok"]
+    # r13: happens-before lifecycle suite (fence-stale replays,
+    # completions after teardown, cross-rank lock-order inversions)
+    from accl_tpu.analysis.checks import check_flight_lifecycle
+
+    lifecycle = check_flight_lifecycle(doc)
+    for f in lifecycle:
+        w(f"\nLIFECYCLE {f.render()}\n")
+
+    if an["ok"] and not an["stragglers"] and not lifecycle:
+        w("\nno hangs, desyncs, stragglers or lifecycle violations — "
+          "all ranks in sync\n")
+    return (not an["ok"]) or any(f.severity == "error" for f in lifecycle)
 
 
 def scrape_live(target: str, timeout_s: float = 10.0) -> dict:

@@ -20,9 +20,14 @@ Two capture modes (``--mode auto`` picks per script):
   real backend while a CaptureSession records the same facts (how CI
   lints ``examples/``, whose assertions need real data movement).
 
+Exit codes: 0 = clean at the selected gate; 1 = findings at or above
+``--fail-on`` (errors always fail; ``--fail-on warning`` — or its
+alias ``--strict`` — fails on warnings too; info never fails).
+
 Usage:
     python scripts/accl_lint.py program.py [--ranks N]
-        [--mode auto|record|shadow] [--json out.json] [--strict]
+        [--mode auto|record|shadow] [--json out.json]
+        [--fail-on error|warning] [--strict]
 """
 from __future__ import annotations
 
@@ -89,7 +94,15 @@ def main() -> int:
     ap = argparse.ArgumentParser(
         prog="accl_lint",
         description="static desync/deadlock linter for ACCL collective "
-                    "programs")
+                    "programs",
+        epilog="exit codes: 0 = no finding at or above the --fail-on "
+               "severity (info-level findings never fail); 1 = at "
+               "least one ERROR (always), or at least one WARNING "
+               "with --fail-on warning / --strict; 2 = usage error "
+               "(argparse).  A crash while importing or running the "
+               "target script propagates as a nonzero exit with the "
+               "traceback — that is a broken script, not a lint "
+               "verdict.")
     ap.add_argument("script", help="python file to lint")
     ap.add_argument("--ranks", type=int, default=2,
                     help="simulated world size for record mode "
@@ -101,11 +114,19 @@ def main() -> int:
                          "backend with capture)")
     ap.add_argument("--json", default="",
                     help="write findings + captured programs as JSON")
+    ap.add_argument("--fail-on", choices=("error", "warning"),
+                    default="error",
+                    help="lowest severity that fails the run: 'error' "
+                         "(default) exits 1 only on errors; 'warning' "
+                         "also fails on warnings (CI gate mode)")
     ap.add_argument("--strict", action="store_true",
-                    help="exit nonzero on warnings too")
+                    help="alias for --fail-on warning (kept for "
+                         "existing CI invocations)")
     ap.add_argument("--max-findings", type=int, default=50,
                     help="print at most N findings (default 50)")
     args = ap.parse_args()
+    if args.strict:
+        args.fail_on = "warning"
 
     mode = args.mode
     if mode == "auto":
@@ -141,7 +162,7 @@ def main() -> int:
         print("accl_lint: clean — no findings")
     else:
         print(f"accl_lint: {n_err} error(s), {n_warn} warning(s)")
-    if n_err or (args.strict and n_warn):
+    if n_err or (args.fail_on == "warning" and n_warn):
         return 1
     return 0
 

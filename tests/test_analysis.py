@@ -583,3 +583,171 @@ def test_shadow_capture_session(emu_world):
 
 def test_check_programs_empty_input():
     assert check_programs({}) == []
+
+
+# ---------------------------------------------------------------------------
+# r13: happens-before lifecycle checkers over merged flight dumps
+# ---------------------------------------------------------------------------
+def _rec(rank, seq, collective, comm=0, state="complete", retcode=0,
+         gang=False, t_submit=0, t_complete=0, lane="emu"):
+    """Minimal flight-record dict with the RECORD_SCHEMA_KEYS fields
+    the lifecycle checkers consume."""
+    return {"seq": seq, "req_id": seq, "rank": rank,
+            "collective": collective, "comm": comm, "tag": 0,
+            "dtype": "float32", "count": 16, "nbytes": 64, "nranks": 2,
+            "lane": lane, "state": state, "gang": gang,
+            "retcode": retcode, "age_us": 0.0, "t_submit": t_submit,
+            "t_queue": 0, "t_gang_ready": 0, "t_dispatch": 0,
+            "t_complete": t_complete}
+
+
+def _dump(rank, records):
+    return {"rank": rank, "capacity": 512, "last_completed_seq": -1,
+            "records": records}
+
+
+def test_fence_stale_replay_flagged():
+    from accl_tpu.analysis.checks import check_fence_staleness
+
+    recs = [
+        _rec(0, 0, "plan_replay", state="complete", gang=True),
+        _rec(0, 1, "abort", retcode=1 << 27, state="aborted",
+             lane="fence"),
+        # replay AFTER the fence with no re-capture: the violation
+        _rec(0, 2, "plan_replay", state="complete", gang=True),
+    ]
+    findings = check_fence_staleness(_dump(0, recs))
+    assert [f.code for f in findings] == ["fence-stale-replay"]
+    assert findings[0].index == 2
+
+
+def test_fence_then_recapture_then_replay_clean():
+    from accl_tpu.analysis.checks import check_fence_staleness
+
+    recs = [
+        _rec(0, 0, "abort", retcode=1 << 27, state="aborted",
+             lane="fence"),
+        _rec(0, 1, "plan_capture", lane="plan"),
+        _rec(0, 2, "plan_replay", state="complete", gang=True),
+    ]
+    assert check_fence_staleness(_dump(0, recs)) == []
+
+
+def test_reset_errors_fences_every_existing_comm():
+    from accl_tpu.analysis.checks import check_fence_staleness
+
+    recs = [
+        _rec(0, 0, "allreduce", comm=3, gang=True),
+        _rec(0, 1, "reset_errors", comm=-1, lane="fence"),
+        _rec(0, 2, "plan_replay", comm=3, state="complete", gang=True),
+    ]
+    findings = check_fence_staleness(_dump(0, recs))
+    assert [f.code for f in findings] == ["fence-stale-replay"]
+
+
+def test_failed_replay_after_fence_is_the_sanctioned_path():
+    from accl_tpu.analysis.checks import check_fence_staleness
+
+    recs = [
+        _rec(0, 0, "abort", retcode=1 << 27, state="aborted",
+             lane="fence"),
+        # the fencing contract WORKING: replay raised COMM_ABORTED
+        _rec(0, 1, "plan_replay", state="aborted", retcode=1 << 27,
+             gang=True),
+    ]
+    assert check_fence_staleness(_dump(0, recs)) == []
+
+
+def test_completion_after_teardown_flagged():
+    from accl_tpu.analysis.checks import check_teardown_completions
+
+    recs = [
+        _rec(0, 0, "allreduce", gang=True, t_submit=10, t_complete=20),
+        _rec(0, 1, "engine_teardown", comm=-1, t_submit=100,
+             t_complete=100, lane="lifecycle"),
+        # a success published after teardown: the segfault class
+        _rec(0, 2, "allreduce", gang=True, t_submit=90, t_complete=150),
+    ]
+    findings = check_teardown_completions(_dump(0, recs))
+    assert [f.code for f in findings] == ["completion-after-teardown"]
+    assert findings[0].index == 2
+
+
+def test_aborted_finalization_after_teardown_is_sanctioned():
+    from accl_tpu.analysis.checks import check_teardown_completions
+
+    recs = [
+        _rec(0, 0, "engine_teardown", comm=-1, t_submit=100,
+             t_complete=100, lane="lifecycle"),
+        # shutdown's finalize sweep: COMM_ABORTED, state aborted — OK
+        _rec(0, 1, "recv", state="aborted", retcode=(1 << 27) | (1 << 28),
+             t_submit=90, t_complete=150),
+    ]
+    assert check_teardown_completions(_dump(0, recs)) == []
+
+
+def test_lock_order_inversion_flagged_across_ranks():
+    from accl_tpu.analysis.checks import check_lock_order
+
+    # rank 0 nests comm 1 inside comm 0 (0 held while 1 submits);
+    # rank 1 nests comm 0 inside comm 1 — ABBA
+    r0 = [_rec(0, 0, "allreduce", comm=0, gang=True, t_submit=10,
+               t_complete=0, state="dispatched"),
+          _rec(0, 1, "allreduce", comm=1, gang=True, t_submit=20,
+               t_complete=0, state="dispatched")]
+    r1 = [_rec(1, 0, "allreduce", comm=1, gang=True, t_submit=10,
+               t_complete=0, state="dispatched"),
+          _rec(1, 1, "allreduce", comm=0, gang=True, t_submit=20,
+               t_complete=0, state="dispatched")]
+    merged = {"ranks": [_dump(0, r0), _dump(1, r1)]}
+    findings = check_lock_order(merged)
+    assert [f.code for f in findings] == ["lock-order-inversion"]
+    assert findings[0].ranks == [0, 1]
+
+
+def test_lock_order_sequential_acquisition_clean():
+    from accl_tpu.analysis.checks import check_lock_order
+
+    # both ranks run comm 0 to completion BEFORE touching comm 1 and
+    # vice versa — no held-while-acquiring window, no finding
+    r0 = [_rec(0, 0, "allreduce", comm=0, gang=True, t_submit=10,
+               t_complete=15),
+          _rec(0, 1, "allreduce", comm=1, gang=True, t_submit=20,
+               t_complete=25)]
+    r1 = [_rec(1, 0, "allreduce", comm=1, gang=True, t_submit=10,
+               t_complete=15),
+          _rec(1, 1, "allreduce", comm=0, gang=True, t_submit=20,
+               t_complete=25)]
+    merged = {"ranks": [_dump(0, r0), _dump(1, r1)]}
+    assert check_lock_order(merged) == []
+
+
+def test_lifecycle_suite_end_to_end_on_real_world(tmp_path):
+    """A real abort -> fenced replay -> re-capture -> replay flow must
+    come out CLEAN, and the dump must carry the lifecycle anchors."""
+    from accl_tpu.analysis.checks import check_flight_lifecycle
+    from accl_tpu.backends.emu import EmuWorld
+    from accl_tpu.observability.flight import merge_flight_dumps
+
+    with EmuWorld(2) as w:
+
+        def fn(accl, rank):
+            src = accl.create_buffer(16, np.float32)
+            src.host[:] = rank + 1.0
+            src.sync_to_device()
+            dst = accl.create_buffer(16, np.float32)
+            accl.allreduce(src, dst, 16)
+
+        w.run(fn)
+        w.accls[0].abort(0)
+        names = [r.collective for r in w.accls[0].flight_recorder.records()]
+        assert "abort" in names
+        doc = merge_flight_dumps(
+            [a.flight_recorder.dump() for a in w.accls])
+        findings = check_flight_lifecycle(doc)
+        assert [f for f in findings if f.severity == ERROR] == []
+        # round-trip through JSON like a production post-mortem would
+        p = tmp_path / "dump.json"
+        p.write_text(json.dumps(doc))
+        assert [f for f in check_flight_lifecycle(str(p))
+                if f.severity == ERROR] == []
