@@ -19,6 +19,7 @@ from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig  # noqa: F401
 from .buffer import BaseBuffer, DummyBuffer  # noqa: F401
 from .communicator import Communicator, Rank  # noqa: F401
 from .constants import (  # noqa: F401
+    TAG_ANY,
     ACCLError,
     CCLOCall,
     CfgFunc,
@@ -29,7 +30,6 @@ from .constants import (  # noqa: F401
     Operation,
     ReduceFunction,
     StreamFlags,
-    TAG_ANY,
 )
 from .device_api import ACCLCommand, ACCLData, DeviceCollectives  # noqa: F401
 from .request import Request  # noqa: F401

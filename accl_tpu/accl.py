@@ -19,29 +19,30 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from . import plans as _plans
 from .analysis import sanitizer as _san
-from .arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
+from .arithconfig import DEFAULT_ARITH_CONFIG
 from .backends.base import CCLODevice
 from .buffer import BaseBuffer, DummyBuffer
 from .communicator import Communicator, Rank
 from .constants import (
+    DATA_TYPE_SIZE,
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_EAGER_RX_BUFS,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    GANG_OPERATIONS,
+    TAG_ANY,
     ACCLError,
     CCLOCall,
     CfgFunc,
     CompressionFlags,
-    DATA_TYPE_SIZE,
     DataType,
-    DEFAULT_EAGER_RX_BUFS,
-    DEFAULT_EAGER_RX_BUF_SIZE,
-    DEFAULT_MAX_EAGER_SIZE,
-    DEFAULT_MAX_RENDEZVOUS_SIZE,
     ErrorCode,
-    GANG_OPERATIONS,
     HostFlags,
     Operation,
     ReduceFunction,
     StreamFlags,
-    TAG_ANY,
 )
 from .observability import flight as _flight
 from .observability import health as _health
@@ -49,8 +50,6 @@ from .observability import metrics as _metrics
 from .observability import trace as _trace
 from .request import Request, RequestQueue
 from .utils.logging import get_logger
-
-from . import plans as _plans
 
 GLOBAL_COMM = 0  # id of the world communicator, like the reference's comm 0
 

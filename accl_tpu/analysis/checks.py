@@ -29,8 +29,12 @@ from __future__ import annotations
 from typing import Optional
 
 from ..constants import Operation
-from ..observability.flight import (FENCE_EVENTS, PLAN_CAPTURE_EVENT,
-                                    TEARDOWN_EVENT, first_divergence)
+from ..observability.flight import (
+    FENCE_EVENTS,
+    PLAN_CAPTURE_EVENT,
+    TEARDOWN_EVENT,
+    first_divergence,
+)
 from .findings import ERROR, WARNING, Finding, sort_findings
 from .program import CollectiveProgram, RecordedCall, tags_match
 

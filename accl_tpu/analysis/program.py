@@ -26,7 +26,6 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..constants import (
-    DATA_TYPE_SIZE,
     GANG_OPERATIONS,
     TAG_ANY,
     CCLOCall,

@@ -8,7 +8,7 @@ driver/xrt/include/accl/cclo.hpp:35-160).
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

@@ -24,7 +24,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -1989,7 +1989,9 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
     # gangs ride ONE compiled program — K inputs, K outputs, no
     # concatenation — so the per-dispatch overhead is paid once per
     # batch instead of once per call
-    batched = lambda *vs: tuple(fn(v) for v in vs)
+    def batched(*vs):
+        return tuple(fn(v) for v in vs)
+
     return jax.jit(batched).lower(*([arg] * nbatch)).compile()
 
 

@@ -30,7 +30,9 @@ def make_inputs(jax, jnp, d=D):
     head dim (64 or 128) with H scaled to keep total flops fixed."""
     h = (H * D) // d
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
-    mk = lambda kk: jax.random.normal(kk, (B * h, T, d), jnp.float32)
+    def mk(kk):
+        return jax.random.normal(kk, (B * h, T, d), jnp.float32)
+
     return mk(k1), mk(k2), mk(k3)
 
 
@@ -39,7 +41,9 @@ def matmul_context(jax, jnp):
     ka, kb = jax.random.split(jax.random.PRNGKey(7))
     ma = jax.random.normal(ka, (MM_N, MM_N), jnp.bfloat16)
     mb = jax.random.normal(kb, (MM_N, MM_N), jnp.bfloat16)
-    mm = lambda x, y: (x @ y).astype(jnp.bfloat16)
+    def mm(x, y):
+        return (x @ y).astype(jnp.bfloat16)
+
     return mm, ma, mb
 
 
@@ -65,7 +69,8 @@ def run_sweep(jax, jnp, timed_chain, cands, rounds=3, log=None, d=D):
     best_mm is the matmul's best seconds in the same windows.
     """
     if log is None:
-        log = lambda msg: print(msg, file=sys.stderr, flush=True)
+        def log(msg):
+            print(msg, file=sys.stderr, flush=True)
     q, k, v = make_inputs(jax, jnp, d=d)
     mm, ma, mb = matmul_context(jax, jnp)
 

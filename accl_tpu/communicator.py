@@ -13,7 +13,7 @@ device coordinates instead of ip:port endpoints.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from .constants import DEFAULT_MAX_EAGER_SIZE

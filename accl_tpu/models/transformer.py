@@ -11,7 +11,6 @@ every collective over ICI.
 """
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Optional
 
@@ -19,12 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-
-from ..utils.compat import axis_size as _axis_size
-from ..utils.compat import shard_map as _shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel.ring_attention import _dense_attention, ring_attention
+from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import shard_map as _shard_map
 
 
 @dataclass(frozen=True)

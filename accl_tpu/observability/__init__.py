@@ -20,6 +20,15 @@ from .health import (  # noqa: F401
     start_exporter,
     stop_exporter,
 )
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS_US,
+    MetricsRegistry,
+    busbw_factor,
+    default_registry,
+    dump_metrics,
+    payload_factor,
+    size_bucket,
+)
 from .trace import (  # noqa: F401
     TraceCollector,
     TraceSpan,
@@ -30,13 +39,4 @@ from .trace import (  # noqa: F401
     merge_trace_files,
     new_span,
     traced_window,
-)
-from .metrics import (  # noqa: F401
-    LATENCY_BUCKETS_US,
-    MetricsRegistry,
-    busbw_factor,
-    default_registry,
-    dump_metrics,
-    payload_factor,
-    size_bucket,
 )

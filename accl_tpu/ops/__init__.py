@@ -18,14 +18,8 @@ Reference plugin mapping (SURVEY §2.5):
                      no reference analog, TPU-first addition)
 """
 
-from .flash import flash_attention  # noqa: F401
-from .reduce_ops import reduce_lane, pallas_add, pallas_max  # noqa: F401
 from .compression import compress_cast, decompress_cast  # noqa: F401
-from .ring import (  # noqa: F401
-    ring_all_gather_pallas,
-    ring_all_reduce_pallas,
-    ring_reduce_scatter_pallas,
-)
+from .flash import flash_attention  # noqa: F401
 from .fused import fused_matmul_allreduce  # noqa: F401
 from .quantized import (  # noqa: F401
     dequantize_blockwise,
@@ -33,4 +27,10 @@ from .quantized import (  # noqa: F401
     quantized_all_reduce,
     quantized_ring_all_gather,
     quantized_ring_reduce_scatter,
+)
+from .reduce_ops import pallas_add, pallas_max, reduce_lane  # noqa: F401
+from .ring import (  # noqa: F401
+    ring_all_gather_pallas,
+    ring_all_reduce_pallas,
+    ring_reduce_scatter_pallas,
 )

@@ -124,7 +124,7 @@ def _fold_consume(s, vb, acc, m_prev, l_prev, *, mask, mxu_dtype,
     return acc_new, m_new, l_new
 
 
-def _finalize(acc, m, l, o_ref, lse_ref, row_off=None):
+def _finalize(acc, m, lsum, o_ref, lse_ref, row_off=None):
     """Write the normalized output and the lse statistics (shared by
     both schedules so the denom/dead-row guards stay identical).  `m` is
     a log2-domain running max (see _softmax_fold); the emitted lse is in
@@ -136,11 +136,11 @@ def _finalize(acc, m, l, o_ref, lse_ref, row_off=None):
     its tile-padded minor dim, which Mosaic rejects."""
     from jax.experimental import pallas as pl
 
-    denom = jnp.where(l == 0.0, 1.0, l)
+    denom = jnp.where(lsum == 0.0, 1.0, lsum)
     out = (acc / denom).astype(o_ref.dtype)
     dead = m <= NEG_INF / 2
     lse = jnp.where(dead, NEG_INF,
-                    m * _LN2 + jnp.log(jnp.maximum(l, 1e-38)))
+                    m * _LN2 + jnp.log(jnp.maximum(lsum, 1e-38)))
     # lse block is [bq, 1] — the trailing unit dim keeps it tile-aligned
     # for Mosaic (second-minor bq % 8 == 0, minor == full)
     if row_off is None:
@@ -256,10 +256,10 @@ def _flash_kernel_grid(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s,
                               mxu_dtype=mxu_dtype,
                               static_max=static_max)
                 for t in range(q_tiles)]
-        for t, (a, m, l) in enumerate(carries):
+        for t, (a, m, lsum) in enumerate(carries):
             acc[pl.ds(t * tq, tq), :] = a
             m_s[pl.ds(t * tq, tq), :] = m
-            l_s[pl.ds(t * tq, tq), :] = l
+            l_s[pl.ds(t * tq, tq), :] = lsum
 
     if causal:
         @pl.when(diag)
@@ -388,15 +388,15 @@ def _flash_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
     carry = _run_block_loops(step, carry, causal, iq, block_q,
                              block_k, nk_total)
     for t in range(q_tiles):
-        acc, m, l = carry[t]
+        acc, m, lsum = carry[t]
         if fuse_denom:
-            acc, l = acc[:, :D], acc[:, D:]
+            acc, lsum = acc[:, :D], acc[:, D:]
         if static_max is not None:
             # the carry's m was never updated — reconstruct the value
             # _finalize's lse/dead-row algebra expects: the pin for
             # live rows, NEG_INF for fully-dead rows (l stayed 0)
-            m = jnp.where(l == 0.0, NEG_INF, static_max)
-        _finalize(acc, m, l, o_ref, lse_ref,
+            m = jnp.where(lsum == 0.0, NEG_INF, static_max)
+        _finalize(acc, m, lsum, o_ref, lse_ref,
                   row_off=None if q_tiles == 1 else t * tq)
 
 
@@ -436,14 +436,14 @@ def _flash_kernel_resident_skew(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                                    preferred_element_type=jnp.float32)
 
     def body(j, carry, masked):
-        acc, m, l, s_cur = carry
+        acc, m, lsum, s_cur = carry
         # lookahead FIRST in program order — independent of the consume
         s_nxt = score(j + 1)
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(mxu_dtype)
         mask = (iq * block_q, j * block_k, None) if masked else None
-        acc, m, l = _fold_consume(s_cur, vb, acc, m, l, mask=mask,
+        acc, m, lsum = _fold_consume(s_cur, vb, acc, m, lsum, mask=mask,
                                   mxu_dtype=mxu_dtype)
-        return acc, m, l, s_nxt
+        return acc, m, lsum, s_nxt
 
     D = q_ref.shape[-1]
     carry = (jnp.zeros((block_q, D), jnp.float32),
@@ -452,8 +452,8 @@ def _flash_kernel_resident_skew(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
              score(0))
     carry = _run_block_loops(body, carry, causal, iq, block_q,
                              block_k, nk_total)
-    acc, m, l, _ = carry
-    _finalize(acc, m, l, o_ref, lse_ref)
+    acc, m, lsum, _ = carry
+    _finalize(acc, m, lsum, o_ref, lse_ref)
 
 
 def _vma_of(*xs):
@@ -759,7 +759,9 @@ def _flash_forward_impl(qp, kp, vp, cfg):
                 return jnp.minimum(first + j, nk - 1)
         else:
             nk_eff = nk
-            _kv_block = lambda i, j: j
+
+            def _kv_block(i, j):
+                return j
         grid = (N, nq, nk_eff)
         kv_resident = kernel == "grid_resident"
         if kv_resident:
@@ -1064,8 +1066,12 @@ def _flash_backward(qp, kp, vp, out, lse, g_out, g_lse, cfg):
             return jnp.minimum((jk * bk) // bq + j2, nq - 1)
     else:
         nk_eff, nq_eff = nk, nq
-        _kblk = lambda i, j: j
-        _qblk = lambda jk, j2: j2
+
+        def _kblk(i, j):
+            return j
+
+        def _qblk(jk, j2):
+            return j2
 
     qb_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)

@@ -9,7 +9,6 @@ all-reduce is the canonical case.
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

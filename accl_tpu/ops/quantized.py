@@ -19,7 +19,6 @@ over its fused recv-reduce-send rings, two tiers sharper.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -7,21 +7,6 @@ meshes, sharding-annotated functional collectives, and the parallelism
 strategies (data/tensor/pipeline/expert/sequence) the reference's
 collectives exist to serve (SURVEY §2.8)."""
 
-from .mesh import make_mesh, MeshConfig  # noqa: F401
-from .ring_attention import (  # noqa: F401
-    ring_attention,
-    ulysses_attention,
-)
-from .strategies import (  # noqa: F401
-    column_parallel,
-    expert_combine,
-    expert_dispatch,
-    pipeline_apply,
-    row_parallel,
-    sync_gradients,
-    zero_shard_gradients,
-    zero_unshard_params,
-)
 from .collectives import (  # noqa: F401
     all_gather,
     all_reduce,
@@ -37,4 +22,19 @@ from .collectives import (  # noqa: F401
     ring_reduce_scatter,
     scatter,
     send_recv,
+)
+from .mesh import MeshConfig, make_mesh  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)
+from .strategies import (  # noqa: F401
+    column_parallel,
+    expert_combine,
+    expert_dispatch,
+    pipeline_apply,
+    row_parallel,
+    sync_gradients,
+    zero_shard_gradients,
+    zero_unshard_params,
 )

@@ -22,10 +22,7 @@ built-in lowering, and as the scheduling skeleton the Pallas kernels
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -125,8 +122,8 @@ def ring_reduce_scatter(x, axis: str = "rank"):
     fwd = [(i, (i + 1) % size) for i in range(size)]
 
     def step(s, carry):
-        # chunk arriving this step: (idx - 1 - s) mod size
-        send_c = (idx - 1 - s) % size
+        # the chunk sent this step is (idx - 1 - s) mod size; only the
+        # arriving chunk index below is needed to fold the reduction
         partial = carry
         moved = lax.ppermute(partial, axis, fwd)
         recv_c = (idx - 2 - s) % size

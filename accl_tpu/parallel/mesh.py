@@ -14,7 +14,7 @@ logical parallelism axes to the physical ICI topology via
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import numpy as np

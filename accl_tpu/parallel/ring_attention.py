@@ -209,7 +209,7 @@ def _dense_ring_loop(q, k, v, axis: str, bias_fn):
     qf = q.astype(jnp.float32)
 
     def step(s, carry):
-        o, m, l, kc, vc = carry
+        o, m, lsum, kc, vc = carry
         # current block originated at rank (idx - s) mod P
         src = (idx - s) % P
         bias = (bias_fn(idx, src) if bias_fn is not None
@@ -220,7 +220,7 @@ def _dense_ring_loop(q, k, v, axis: str, bias_fn):
         # guard the all-dead case (exp(NEG_INF - NEG_INF) = 1)
         alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
         beta = jnp.where(m_blk <= NEG_INF / 2, 0.0, jnp.exp(m_blk - m_new))
-        l_new = l * alpha + jnp.sum(p, axis=-1) * beta
+        l_new = lsum * alpha + jnp.sum(p, axis=-1) * beta
         o_new = (o * alpha.transpose(0, 2, 1)[..., None]
                  + pv * beta.transpose(0, 2, 1)[..., None])
         # rotate K/V one hop (the ring relay)
@@ -237,9 +237,9 @@ def _dense_ring_loop(q, k, v, axis: str, bias_fn):
     zt = jnp.transpose(jnp.sum(o0, axis=-1), (0, 2, 1))  # [B, H, Tl] zeros
     m0 = zt + NEG_INF
     l0 = zt
-    o, m, l, _, _ = lax.fori_loop(0, P, step, (o0, m0, l0, k, v))
-    l = jnp.maximum(l, 1e-30)
-    out = o / l.transpose(0, 2, 1)[..., None]
+    o, m, lsum, _, _ = lax.fori_loop(0, P, step, (o0, m0, l0, k, v))
+    lsum = jnp.maximum(lsum, 1e-30)
+    out = o / lsum.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
@@ -407,14 +407,14 @@ def _banded_cross_lse(q, kk, vv, offset: int, window: int, live):
     shift = jnp.where(m <= NEG_INF / 2, 0.0, m)
     p = jnp.exp(s - shift)
     p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-    l = jnp.sum(p, axis=-1, keepdims=True)
+    lsum = jnp.sum(p, axis=-1, keepdims=True)
     # epsilon must be a NORMAL f32: 1e-38 is subnormal and flushes to
     # zero under FTZ, turning the dead-row guard into 0/0 = NaN
-    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30),
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(lsum, 1e-30),
                      vv.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
-    lse = jnp.where(l[..., 0] == 0.0, NEG_INF,
-                    shift[..., 0] + jnp.log(jnp.maximum(l[..., 0],
+    lse = jnp.where(lsum[..., 0] == 0.0, NEG_INF,
+                    shift[..., 0] + jnp.log(jnp.maximum(lsum[..., 0],
                                                         1e-30)))
     return out, lse  # o fp32, lse [B, H, T]
 
@@ -604,7 +604,8 @@ def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = False,
             # flash kernel (same backend-resolved default as ring)
             from ..ops.flash import flash_attention
 
-            mxu_dt = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)                 else jnp.float32
+            mxu_dt = (q.dtype if q.dtype in (jnp.bfloat16, jnp.float16)
+                      else jnp.float32)
             attn_fn = functools.partial(flash_attention, causal=causal,
                                         mxu_dtype=mxu_dt)
         else:

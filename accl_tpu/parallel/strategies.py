@@ -14,7 +14,6 @@ meant to run inside shard_map/pjit over the mesh axes from
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax

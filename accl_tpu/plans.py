@@ -57,10 +57,10 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .constants import (
+    GANG_OPERATIONS,
     ACCLError,
     CCLOCall,
     ErrorCode,
-    GANG_OPERATIONS,
     Operation,
 )
 from .observability import flight as _flight

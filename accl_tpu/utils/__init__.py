@@ -1,2 +1,2 @@
-from .timing import Timer, timed  # noqa: F401
 from .logging import get_logger  # noqa: F401
+from .timing import Timer, timed  # noqa: F401

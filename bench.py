@@ -233,8 +233,9 @@ def _measure(platform: str) -> dict:
     if not on_tpu:
         # CPU fallback: headline only, no ledger (nothing hardware-fresh
         # to bank), interpret-mode kernels
-        run = lambda x, bb: pallas_add(x, bb, interpret=interpret,
-                                       block_rows=512, donate=True)
+        def run(x, bb):
+            return pallas_add(x, bb, interpret=interpret,
+                              block_rows=512, donate=True)
         dt = timed_chain(run, a, 3, trials=3, consts=(b,))
         gbps = 3 * n * 4 / dt / 1e9
         return {
@@ -259,20 +260,23 @@ def _measure(platform: str) -> dict:
         # pipeline-starved at huge ones; best of a short ladder
         best_dt, best_rows = None, 0
         for rows in (512, 2048):
-            fn = lambda x, bb, r=rows: pallas_add(x, bb, interpret=False,
-                                                  block_rows=r, donate=True)
+            def fn(x, bb, r=rows):
+                return pallas_add(x, bb, interpret=False,
+                                  block_rows=r, donate=True)
             dt_r = timed_chain(fn, a, 8, trials=2, consts=(b,))
             if best_dt is None or dt_r < best_dt:
                 best_dt, best_rows = dt_r, rows
         print(f"[bench worker] pallas_add autotune -> "
               f"block_rows={best_rows}", file=sys.stderr)
-        run = lambda x, bb: pallas_add(x, bb, interpret=False,
-                                      block_rows=best_rows, donate=True)
+        def run(x, bb):
+            return pallas_add(x, bb, interpret=False,
+                              block_rows=best_rows, donate=True)
         nbytes = 3 * n * 4  # read a, read b, write out
         # headline + roofline measured interleaved: the same 3-stream
         # add through plain XLA is the practical HBM ceiling on this
         # chip, so the headline number carries its own context
-        xla_add = lambda x, bb: x + bb
+        def xla_add(x, bb):
+            return x + bb
         dts = timed_chain_ab({"pallas": run, "xla": xla_add}, a, 30,
                              consts=(b,))
         _bank_stage(led, "headline", {
@@ -369,7 +373,8 @@ def _flash_operands(jax, jnp):
     v2 = jax.random.normal(k3, (B, T, H2, D2), jnp.float32)
     # head-packed operands (the zero-transpose entries; transposes
     # measured ~free on this chip, so numbers stay comparable)
-    pk = lambda x, h, d: x.transpose(0, 2, 1, 3).reshape(B * h, T, d)
+    def pk(x, h, d):
+        return x.transpose(0, 2, 1, 3).reshape(B * h, T, d)
     ops = {
         "B": B, "T": T, "H": H, "D": D, "H2": H2, "D2": D2,
         "q": q, "k": k, "v": v, "q2": q2, "k2": k2_, "v2": v2,
@@ -413,8 +418,7 @@ def _flash_stage(jax, jnp, timed_chain) -> dict:
         # exactly splash's single-device MHA layout (heads, seq, hd)
         # with a per-head causal mask.
         try:
-            from jax.experimental.pallas.ops.tpu import (
-                splash_attention as _sp)
+            from jax.experimental.pallas.ops.tpu import splash_attention as _sp
             _mask = _sp.splash_attention_mask.MultiHeadMask(
                 [_sp.splash_attention_mask.CausalMask((T, T))]
                 * (B * o["H2"]))
@@ -783,9 +787,11 @@ def _selfring_stage(jax, jnp, timed_chain) -> dict:
         import numpy as np
         from jax.sharding import Mesh, PartitionSpec as P
 
-        from accl_tpu.ops.ring import (ring_all_gather_pallas,
-                                       ring_all_reduce_pallas,
-                                       ring_reduce_scatter_pallas)
+        from accl_tpu.ops.ring import (
+            ring_all_gather_pallas,
+            ring_all_reduce_pallas,
+            ring_reduce_scatter_pallas,
+        )
 
         V = 8
         rows = 4096                      # 4096 x 128 f32 = 2 MB chunk

@@ -28,13 +28,11 @@ import jax
 if not os.environ.get("ACCL_EXAMPLE_ON_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
-import numpy as np
-
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding
 
-from accl_tpu.models.transformer import (ModelConfig, init_params,
-                                         make_train_step, shard_params)
+from accl_tpu.models.transformer import ModelConfig, init_params, make_train_step, shard_params
 from accl_tpu.parallel.mesh import make_mesh
 from accl_tpu.parallel.ring_attention import zigzag_indices
 

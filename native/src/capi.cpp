@@ -435,7 +435,7 @@ int accl_plan_wait(void* wp, int rank, long long token, int timeout_ms,
     int rc = e->plan_poll(token, ret, dur);
     if (rc != 0) return rc;
     if (std::chrono::steady_clock::now() >= deadline) return 0;
-    std::this_thread::sleep_for(std::chrono::microseconds(100));
+    accl::det_sleep_for(std::chrono::microseconds(100));
   }
 }
 
@@ -478,7 +478,7 @@ int accl_wait_call(void* wp, int rank, uint64_t id, int timeout_ms,
                   std::chrono::milliseconds(timeout_ms);
   while (std::chrono::steady_clock::now() < deadline) {
     if (e->poll_call(id, ret, dur)) return 1;
-    std::this_thread::sleep_for(std::chrono::microseconds(100));
+    accl::det_sleep_for(std::chrono::microseconds(100));
   }
   return 0;
 }

@@ -26,6 +26,52 @@
 #include <thread>
 #include <vector>
 
+// ---------------------------------------------------------------------------
+// Compile-time lock discipline: Clang Thread Safety Analysis attributes.
+//
+// Every mutex-guarded field and lock-requiring function in the native
+// engine is annotated with these macros; `make tsa` builds the tree
+// with -Werror=thread-safety (scripts/tsa_check.py drives a real
+// clang++ when one is installed, or the libclang frontend otherwise)
+// so an unlocked read of a guarded field, a missing REQUIRES on a
+// helper, or a lock-order inversion against the declared
+// ACQUIRED_BEFORE edges fails the build.  Policy mirrors the r13
+// sanitizer wall: ZERO waivers under accl:: — ACCL_NO_TSA exists for
+// third-party interop only and scripts/tsa_check.py greps it banned
+// from native/src.  Under gcc (plain/ASan/TSan lanes) every macro
+// expands to nothing, so non-clang builds are bit-identical.
+// ---------------------------------------------------------------------------
+#if defined(__clang__)
+#define ACCL_TSA(x) __attribute__((x))
+#else
+#define ACCL_TSA(x)
+#endif
+#define ACCL_CAPABILITY(x) ACCL_TSA(capability(x))
+#define ACCL_SCOPED_CAPABILITY ACCL_TSA(scoped_lockable)
+#define ACCL_GUARDED_BY(x) ACCL_TSA(guarded_by(x))
+#define ACCL_PT_GUARDED_BY(x) ACCL_TSA(pt_guarded_by(x))
+#define ACCL_REQUIRES(...) ACCL_TSA(requires_capability(__VA_ARGS__))
+#define ACCL_ACQUIRE(...) ACCL_TSA(acquire_capability(__VA_ARGS__))
+#define ACCL_RELEASE(...) ACCL_TSA(release_capability(__VA_ARGS__))
+#define ACCL_TRY_ACQUIRE(...) ACCL_TSA(try_acquire_capability(__VA_ARGS__))
+#define ACCL_EXCLUDES(...) ACCL_TSA(locks_excluded(__VA_ARGS__))
+#define ACCL_ACQUIRED_BEFORE(...) ACCL_TSA(acquired_before(__VA_ARGS__))
+#define ACCL_ACQUIRED_AFTER(...) ACCL_TSA(acquired_after(__VA_ARGS__))
+#define ACCL_RETURN_CAPABILITY(x) ACCL_TSA(lock_returned(x))
+// Third-party interop escape hatch.  NEVER legal under accl:: —
+// scripts/tsa_check.py fails the lane if it appears in native/src.
+#define ACCL_NO_TSA ACCL_TSA(no_thread_safety_analysis)
+
+// Deterministic schedule exploration (docs/static_analysis.md): the
+// ACCL_DETSCHED build routes every blocking primitive below through
+// the virtual scheduler in detsched.hpp, serializing all engine
+// threads onto one deterministic schedule so small-world drills can be
+// model-checked exhaustively.  Plain builds never include it.
+#if defined(ACCL_DETSCHED)
+#include "detsched.hpp"
+#include "detsched_pred.hpp"
+#endif
+
 namespace accl {
 
 // ---------------------------------------------------------------------------
@@ -216,6 +262,216 @@ struct NotReadyEx {
 struct SizeCapEx {};
 
 // ---------------------------------------------------------------------------
+// Synchronization wrappers: the compile-time lock discipline's
+// capability types AND the deterministic scheduler's hook points.
+//
+// accl::Mutex / MutexLock / UniqueLock / CondVar / Thread replace the
+// raw std primitives everywhere under accl:: so that
+//  (a) clang Thread Safety Analysis sees every acquire/release (std::
+//      mutex carries no capability attributes on libstdc++), and
+//  (b) the ACCL_DETSCHED build can serialize every blocking operation
+//      onto the virtual scheduler (detsched.hpp) — the hooks live in
+//      exactly one place, inside these wrappers.
+// Plain builds compile the wrappers down to the raw std calls.
+// ---------------------------------------------------------------------------
+class ACCL_CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+  void lock() ACCL_ACQUIRE() {
+#if defined(ACCL_DETSCHED)
+    if (det::on()) {
+      det::lock_hooked(&m_);
+      return;
+    }
+#endif
+    m_.lock();
+  }
+  void unlock() ACCL_RELEASE() {
+#if defined(ACCL_DETSCHED)
+    if (det::on()) {
+      det::unlock_hooked(&m_);
+      return;
+    }
+#endif
+    m_.unlock();
+  }
+  bool try_lock() ACCL_TRY_ACQUIRE(true) { return m_.try_lock(); }
+  std::mutex& native() { return m_; }
+
+ private:
+  std::mutex m_;
+};
+
+// std::lock_guard replacement (scoped capability so the analysis
+// tracks the critical section's extent).
+class ACCL_SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& m) ACCL_ACQUIRE(m) : m_(m) { m_.lock(); }
+  ~MutexLock() ACCL_RELEASE() { m_.unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& m_;
+};
+
+// std::unique_lock replacement.  Derives the std type so condition
+// waits (CondVar, cv_wait_for_pred) take it unchanged; lock/unlock are
+// shadowed with capability-annotated, scheduler-aware versions.
+class ACCL_SCOPED_CAPABILITY UniqueLock : public std::unique_lock<std::mutex> {
+ public:
+  explicit UniqueLock(Mutex& m) ACCL_ACQUIRE(m)
+      : std::unique_lock<std::mutex>(acquire_adopted(m)), mu_(&m) {}
+  ~UniqueLock() ACCL_RELEASE() {
+    if (owns_lock()) {
+      std::unique_lock<std::mutex>::release();
+      mu_->unlock();
+    }
+  }
+  void unlock() ACCL_RELEASE() {
+    std::unique_lock<std::mutex>::release();
+    mu_->unlock();
+  }
+  void lock() ACCL_ACQUIRE() {
+    mu_->lock();
+    static_cast<std::unique_lock<std::mutex>&>(*this) =
+        std::unique_lock<std::mutex>(mu_->native(), std::adopt_lock);
+  }
+  UniqueLock(const UniqueLock&) = delete;
+  UniqueLock& operator=(const UniqueLock&) = delete;
+
+ private:
+  static std::unique_lock<std::mutex> acquire_adopted(Mutex& m)
+      ACCL_ACQUIRE(m) {
+    m.lock();  // capability-aware + det-aware acquire
+    return std::unique_lock<std::mutex>(m.native(), std::adopt_lock);
+  }
+  Mutex* mu_;
+};
+
+// std::condition_variable replacement; notify and the untimed waits
+// are scheduler hook points.  Untimed pthread_cond_wait is intercepted
+// by every sanitizer runtime, so no TSan workaround is needed here
+// (only the TIMED waits below need one — see cv_wait_for_pred).
+class CondVar {
+ public:
+  CondVar() = default;
+  CondVar(const CondVar&) = delete;
+  CondVar& operator=(const CondVar&) = delete;
+  void notify_all() {
+#if defined(ACCL_DETSCHED)
+    if (det::on()) {
+      det::cv_notify(this, true);
+      return;
+    }
+#endif
+    cv_.notify_all();
+  }
+  void notify_one() {
+#if defined(ACCL_DETSCHED)
+    if (det::on()) {
+      det::cv_notify(this, false);
+      return;
+    }
+#endif
+    cv_.notify_one();
+  }
+  // Untimed predicate wait; `g` holds the Mutex associated with the
+  // guarded state the predicate reads.
+  template <typename Pred>
+  void wait(std::unique_lock<std::mutex>& g, Pred pred) {
+#if defined(ACCL_DETSCHED)
+    if (det::on()) {
+      while (!det::invoke_pred(pred)) det::cv_block(this, g, det::kInf);
+      return;
+    }
+#endif
+    cv_.wait(g, pred);
+  }
+  void wait(std::unique_lock<std::mutex>& g) {
+#if defined(ACCL_DETSCHED)
+    if (det::on()) {
+      det::cv_block(this, g, det::kInf);
+      return;
+    }
+#endif
+    cv_.wait(g);
+  }
+  std::condition_variable& native() { return cv_; }
+
+ private:
+  std::condition_variable cv_;
+};
+
+// std::thread replacement: under ACCL_DETSCHED a child spawned during
+// an active run registers with the scheduler before its body runs, so
+// the scheduler serializes it from its first instruction; join parks
+// on the virtual scheduler instead of blocking the token.
+class Thread {
+ public:
+  Thread() noexcept = default;
+  template <typename F>
+  explicit Thread(F fn) {
+#if defined(ACCL_DETSCHED)
+    if (det::run_active()) {
+      det_id_ = det::Sched::inst().pre_spawn();
+      int id = det_id_;
+      t_ = std::thread([id, fn2 = std::move(fn)]() mutable {
+        det::Sched::inst().child_enter(id);
+        fn2();
+        det::Sched::inst().child_exit();
+      });
+      // deterministic spawn: the child is registered (and parked for
+      // its first grant) before the parent's next instruction
+      det::Sched::inst().await_child_enter(det_id_);
+      return;
+    }
+#endif
+    t_ = std::thread(std::move(fn));
+  }
+  Thread(Thread&&) noexcept = default;
+  Thread& operator=(Thread&&) noexcept = default;
+  bool joinable() const { return t_.joinable(); }
+  void join() {
+#if defined(ACCL_DETSCHED)
+    if (det_id_ >= 0 && det::on()) det::Sched::inst().join_wait_slot(det_id_);
+#endif
+    t_.join();
+  }
+
+ private:
+  std::thread t_;
+#if defined(ACCL_DETSCHED)
+  int det_id_ = -1;
+#endif
+};
+
+// Scheduler-aware sleep/yield (the engine loop's retry pacing, chaos
+// stalls, liveness-probe polls): virtual time under ACCL_DETSCHED,
+// the real thing everywhere else.
+inline void det_sleep_for(std::chrono::nanoseconds d) {
+#if defined(ACCL_DETSCHED)
+  if (det::on()) {
+    det::sleep_hooked(uint64_t(d.count() > 0 ? d.count() : 1));
+    return;
+  }
+#endif
+  std::this_thread::sleep_for(d);
+}
+
+inline void det_yield() {
+#if defined(ACCL_DETSCHED)
+  if (det::on()) {
+    det::yield_hooked();
+    return;
+  }
+#endif
+  std::this_thread::yield();
+}
+
+// ---------------------------------------------------------------------------
 // TSan-safe timed condition waits (r13).  libstdc++ (gcc 10) lowers
 // every steady-clock timed CV wait to pthread_cond_clockwait, which
 // this toolchain's ThreadSanitizer runtime does NOT intercept: the
@@ -227,13 +483,26 @@ struct SizeCapEx {};
 // re-checks its predicate, so the observable semantics are identical);
 // all other builds use the real futex-backed wait.  Policy + rationale:
 // docs/static_analysis.md "Native sanitizer lanes".
+// Under ACCL_DETSCHED the deadline is VIRTUAL: the wait parks on the
+// scheduler and the clock jumps when nothing is runnable, so receive
+// budgets cost microseconds of wall time per explored schedule.
 // ---------------------------------------------------------------------------
 template <typename Pred>
-inline bool cv_wait_for_pred(std::condition_variable& cv,
-                             std::unique_lock<std::mutex>& g,
+inline bool cv_wait_for_pred(CondVar& cv, std::unique_lock<std::mutex>& g,
                              std::chrono::nanoseconds timeout, Pred pred) {
+#if defined(ACCL_DETSCHED)
+  if (det::on()) {
+    uint64_t deadline =
+        det::now_ns() + uint64_t(timeout.count() > 0 ? timeout.count() : 0);
+    for (;;) {
+      if (det::invoke_pred(pred)) return true;
+      uint64_t now = det::now_ns();
+      if (now >= deadline) return det::invoke_pred(pred);
+      det::cv_block(&cv, g, deadline - now);
+    }
+  }
+#endif
 #if defined(__SANITIZE_THREAD__)
-  (void)cv;
   auto deadline = std::chrono::steady_clock::now() + timeout;
   for (;;) {
     if (pred()) return true;
@@ -243,15 +512,25 @@ inline bool cv_wait_for_pred(std::condition_variable& cv,
     g.lock();
   }
 #else
-  return cv.wait_for(g, timeout, pred);
+  return cv.native().wait_for(g, timeout, pred);
 #endif
 }
 
 inline std::cv_status cv_wait_until_point(
-    std::condition_variable& cv, std::unique_lock<std::mutex>& g,
+    CondVar& cv, std::unique_lock<std::mutex>& g,
     std::chrono::steady_clock::time_point deadline) {
+#if defined(ACCL_DETSCHED)
+  if (det::on()) {
+    auto now = std::chrono::steady_clock::now();
+    if (now >= deadline) return std::cv_status::timeout;
+    uint64_t ns = uint64_t(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(deadline - now)
+            .count());
+    return det::cv_block(&cv, g, ns) ? std::cv_status::no_timeout
+                                     : std::cv_status::timeout;
+  }
+#endif
 #if defined(__SANITIZE_THREAD__)
-  (void)cv;
   if (std::chrono::steady_clock::now() >= deadline)
     return std::cv_status::timeout;
   g.unlock();
@@ -261,7 +540,7 @@ inline std::cv_status cv_wait_until_point(
              ? std::cv_status::timeout
              : std::cv_status::no_timeout;
 #else
-  return cv.wait_until(g, deadline);
+  return cv.native().wait_until(g, deadline);
 #endif
 }
 
@@ -274,16 +553,19 @@ class Fifo {
  public:
   void push(T v) {
     {
-      std::lock_guard<std::mutex> g(m_);
+      MutexLock g(m_);
       q_.push_back(std::move(v));
     }
     cv_.notify_all();
   }
 
   std::optional<T> pop_wait(std::chrono::nanoseconds timeout) {
-    std::unique_lock<std::mutex> g(m_);
+    UniqueLock g(m_);
+    // the predicate runs with m_ held (cv_wait_for_pred's contract);
+    // the REQUIRES annotation tells the analysis, which otherwise
+    // checks lambda bodies as lock-free contexts
     if (!cv_wait_for_pred(cv_, g, timeout,
-                          [&] { return !q_.empty() || closed_; }))
+                          [&]() ACCL_REQUIRES(m_) { return !q_.empty() || closed_; }))
       return std::nullopt;
     if (q_.empty()) return std::nullopt;
     T v = std::move(q_.front());
@@ -292,7 +574,7 @@ class Fifo {
   }
 
   std::optional<T> try_pop() {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     if (q_.empty()) return std::nullopt;
     T v = std::move(q_.front());
     q_.pop_front();
@@ -301,41 +583,34 @@ class Fifo {
 
   // Wait until pred matches an element; remove and return it.  Other
   // elements stay queued (out-of-order matching for rendezvous queues).
+  // Expressed as one predicate wait so the deterministic scheduler's
+  // virtual deadline applies (and the post-timeout last scan the r13
+  // version did by hand falls out of cv_wait_for_pred's contract).
   std::optional<T> pop_match(std::function<bool(const T&)> pred,
                              std::chrono::nanoseconds timeout) {
-    std::unique_lock<std::mutex> g(m_);
-    auto deadline = std::chrono::steady_clock::now() + timeout;
-    for (;;) {
-      for (auto it = q_.begin(); it != q_.end(); ++it) {
-        if (pred(*it)) {
-          T v = std::move(*it);
-          q_.erase(it);
-          return v;
-        }
-      }
-      if (closed_) return std::nullopt;
-      if (cv_wait_until_point(cv_, g, deadline) == std::cv_status::timeout) {
-        // one last scan after timeout
-        for (auto it = q_.begin(); it != q_.end(); ++it) {
-          if (pred(*it)) {
-            T v = std::move(*it);
-            q_.erase(it);
-            return v;
-          }
-        }
-        return std::nullopt;
-      }
-    }
+    UniqueLock g(m_);
+    auto find = [&]() ACCL_REQUIRES(m_) {
+      for (auto it = q_.begin(); it != q_.end(); ++it)
+        if (pred(*it)) return it;
+      return q_.end();
+    };
+    cv_wait_for_pred(cv_, g, timeout,
+                     [&]() ACCL_REQUIRES(m_) { return closed_ || find() != q_.end(); });
+    auto it = find();
+    if (it == q_.end()) return std::nullopt;
+    T v = std::move(*it);
+    q_.erase(it);
+    return v;
   }
 
   bool empty() const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     return q_.empty();
   }
 
   // Non-destructive scan: does any queued element satisfy pred?
   bool any(std::function<bool(const T&)> pred) const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     for (const auto& v : q_)
       if (pred(v)) return true;
     return false;
@@ -343,28 +618,28 @@ class Fifo {
 
   // Non-destructive visit of every queued element.
   void for_each(std::function<void(const T&)> fn) const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     for (const auto& v : q_) fn(v);
   }
 
   size_t size() const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     return q_.size();
   }
 
   void close() {
     {
-      std::lock_guard<std::mutex> g(m_);
+      MutexLock g(m_);
       closed_ = true;
     }
     cv_.notify_all();
   }
 
  private:
-  mutable std::mutex m_;
-  std::condition_variable cv_;
-  std::deque<T> q_;
-  bool closed_ = false;
+  mutable Mutex m_;
+  CondVar cv_;
+  std::deque<T> q_ ACCL_GUARDED_BY(m_);
+  bool closed_ ACCL_GUARDED_BY(m_) = false;
 };
 
 // fp16 <-> fp32 conversion (the emulator arithmetic/compression lanes'

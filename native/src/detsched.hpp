@@ -1,0 +1,732 @@
+// Deterministic schedule-exploration scheduler (ACCL_DETSCHED builds).
+//
+// The engine's synchronization wrappers in common.hpp (accl::Mutex,
+// accl::CondVar, accl::Thread, det_sleep_for/det_yield) route every
+// blocking operation through the hooks below when a controlled run is
+// active.  All registered threads are serialized onto ONE virtual
+// scheduler: exactly one thread runs at a time, every hook is a
+// scheduling point, and which thread runs next is decided by an
+// explicit schedule (a choice string) — so a drill's interleaving is a
+// pure function of (schedule, seed) and can be replayed bit-for-bit
+// from the failing-schedule artifact scripts/model_check.py dumps
+// (hex trace + seed, mirroring fuzz_wire.py's failing-frame artifact).
+//
+// Blocking never really blocks: timed waits park on a VIRTUAL clock
+// that jumps to the earliest deadline whenever no thread is runnable,
+// so a drill that would spend seconds in receive budgets finishes in
+// microseconds and a lost wakeup surfaces as a detected deadlock, not
+// a hung harness.
+//
+// The explorer at the bottom does stateless bounded exploration over
+// choice prefixes: DFS over decision points, preemption bounding
+// (alternatives that would exceed the bound are not expanded), and a
+// DPOR-flavored persistent-set prune — a decision point only branches
+// when at least two runnable threads' pending operations CONFLICT
+// (same mutex, or a notify against a wait on the same condvar);
+// interleavings of independent operations commute and are explored
+// once.  Duplicate complete traces are hash-deduplicated.
+//
+// This header is self-contained (std only) so common.hpp can include
+// it before defining the wrapper classes.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace accl {
+namespace det {
+
+constexpr int kMaxThreads = 64;
+constexpr uint64_t kInf = ~0ull;
+
+// Operation a thread is about to perform at its scheduling point —
+// the conflict relation below drives the partial-order prune.
+enum class OpKind : uint8_t {
+  None = 0,
+  Lock,      // about to acquire obj (mutex)
+  Unlock,    // just released obj (mutex)
+  CvWait,    // about to park on obj (condvar)
+  CvNotify,  // about to notify obj (condvar)
+  Sleep,
+  Yield,
+  Spawn,
+  Exit,
+  Join,
+};
+
+struct Decision {
+  uint8_t nen = 0;        // enabled-thread count at this point
+  uint8_t chosen = 0;     // index chosen into the sorted enabled list
+  bool branchable = false;  // alternatives worth exploring (conflict + bounds)
+};
+
+struct RunResult {
+  bool failed = false;
+  std::string what;          // first invariant violation / deadlock text
+  uint64_t fail_step = 0;
+  std::vector<uint8_t> choices;     // chosen index per decision (the trace)
+  std::vector<Decision> decisions;  // full decision metadata
+  uint64_t steps = 0;
+  bool free_ran = false;  // budget/deadlock escape hatch fired (see below)
+};
+
+class Sched {
+ public:
+  static Sched& inst() {
+    static Sched* s = new Sched();  // immortal: engine threads may outlive main
+    return *s;
+  }
+
+  // ---- hook-side queries (hot; called from every wrapper) ----
+  bool on() const { return active_.load(std::memory_order_relaxed) && slot() >= 0; }
+  bool run_active() const { return active_.load(std::memory_order_relaxed); }
+
+  // ---- virtual clock ----
+  uint64_t now_ns() {
+    std::lock_guard<std::mutex> g(mu_);
+    return vnow_;
+  }
+
+  // ---- mutex protocol (wrapper holds no real lock on entry) ----
+  // Deterministic acquire: yield at the decision point, then take
+  // logical ownership (the real lock is guaranteed free when the owner
+  // table says so — ownership mirrors the real lock exactly at every
+  // scheduling point).  m is the address of the underlying std::mutex.
+  void lock_hooked(std::mutex* m) {
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    for (;;) {
+      yield_locked(g, me, OpKind::Lock, m);
+      if (free_run_) break;  // escape hatch: fall through to real lock
+      auto it = owner_.find(m);
+      if (it == owner_.end()) {
+        owner_[m] = me;
+        break;
+      }
+      // owner holds it: park until the unlock hook wakes this slot
+      th_[me].st = St::BlockedMutex;
+      th_[me].obj = m;
+      schedule_locked(g, me);
+    }
+    g.unlock();
+    m->lock();  // uncontended by construction (or free-run: real race)
+  }
+
+  void unlock_hooked(std::mutex* m) {
+    m->unlock();
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    owner_.erase(m);
+    wake_mutex_waiters_locked(m);
+    // release is a scheduling point too: schedules where a waiter (or
+    // anyone else) runs between unlock and the owner's next action are
+    // reachable — the InprocHub::detach race needs exactly this window
+    yield_locked(g, me, OpKind::Unlock, m);
+  }
+
+  // ---- condvar protocol ----
+  // Caller holds `lk` (a std::unique_lock over the user mutex).
+  // Releases it, parks on the virtual condvar until a notify or the
+  // virtual deadline (timeout_ns == kInf: untimed), then deterministically
+  // reacquires.  Returns true if woken by a notify, false on timeout.
+  bool cv_block(const void* cv, std::unique_lock<std::mutex>& lk,
+                uint64_t timeout_ns) {
+    std::mutex* m = lk.mutex();
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    if (free_run_) {
+      g.unlock();
+      return free_run_cv_wait(lk, timeout_ns);
+    }
+    yield_locked(g, me, OpKind::CvWait, cv);
+    if (free_run_) {
+      g.unlock();
+      return free_run_cv_wait(lk, timeout_ns);
+    }
+    // release the user mutex while parked (what a real cv wait does)
+    lk.unlock();
+    owner_.erase(m);
+    wake_mutex_waiters_locked(m);
+    th_[me].st = St::BlockedCv;
+    th_[me].obj = cv;
+    th_[me].deadline = timeout_ns == kInf ? kInf : vnow_ + timeout_ns;
+    th_[me].notified = false;
+    th_[me].cv_seq = cv_seq_++;
+    schedule_locked(g, me);
+    bool notified = th_[me].notified;
+    th_[me].deadline = kInf;
+    // deterministic reacquire of the user mutex
+    for (;;) {
+      if (free_run_) break;
+      auto it = owner_.find(m);
+      if (it == owner_.end()) {
+        owner_[m] = me;
+        break;
+      }
+      th_[me].st = St::BlockedMutex;
+      th_[me].obj = m;
+      schedule_locked(g, me);
+    }
+    g.unlock();
+    lk.lock();
+    return notified;
+  }
+
+  void cv_notify(const void* cv, bool all) {
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    yield_locked(g, me, OpKind::CvNotify, cv);
+    if (free_run_) return;
+    // FIFO wake order (by park sequence): deterministic notify_one
+    int best = -1;
+    do {
+      best = -1;
+      uint64_t best_seq = kInf;
+      for (int i = 0; i < nth_; ++i) {
+        Th& t = th_[i];
+        if (t.used && t.st == St::BlockedCv && t.obj == cv &&
+            t.cv_seq < best_seq) {
+          best = i;
+          best_seq = t.cv_seq;
+        }
+      }
+      if (best >= 0) {
+        th_[best].notified = true;
+        th_[best].st = St::Ready;
+        th_[best].pending = OpKind::Lock;  // it reacquires its mutex next
+        th_[best].obj = nullptr;
+      }
+    } while (all && best >= 0);
+  }
+
+  // ---- sleep / yield ----
+  void sleep_hooked(uint64_t ns) {
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    yield_locked(g, me, OpKind::Sleep, nullptr);
+    if (free_run_) return;  // virtual sleep: no real time passes
+    th_[me].st = St::BlockedSleep;
+    th_[me].deadline = vnow_ + (ns ? ns : 1);
+    schedule_locked(g, me);
+    th_[me].deadline = kInf;
+  }
+
+  void yield_hooked() {
+    std::unique_lock<std::mutex> g(mu_);
+    yield_locked(g, slot(), OpKind::Yield, nullptr);
+  }
+
+  // ---- thread lifecycle ----
+  // Parent side, BEFORE std::thread construction: reserve the child's
+  // slot so quiescence can never be declared while a spawn is in
+  // flight.  Returns the slot id the child adopts, or -1 when the run
+  // table is full (the child then runs unmanaged — real primitives).
+  int pre_spawn() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!active_.load() || free_run_) return -1;
+    if (nth_ >= kMaxThreads) return -1;
+    int id = nth_++;
+    th_[id].used = true;
+    th_[id].exited = false;
+    th_[id].st = St::Spawning;  // not schedulable until child_enter
+    th_[id].pending = OpKind::Spawn;
+    th_[id].obj = nullptr;
+    th_[id].notified = false;
+    th_[id].deadline = kInf;
+    return id;
+  }
+
+  void child_enter(int id) {
+    if (id < 0) return;
+    std::unique_lock<std::mutex> g(mu_);
+    slot_ref() = id;
+    th_[id].tid = std::this_thread::get_id();
+    th_[id].st = St::Ready;
+    cv_.notify_all();  // release the parent's await_child_enter
+    // if the token is parked (everyone was waiting for this spawn to
+    // land), hand it on now; otherwise wait for the first grant
+    if (cur_ < 0) {
+      pick_next_locked();
+      cv_.notify_all();
+    }
+    schedule_locked(g, id, /*reschedule=*/false);
+  }
+
+  // Parent side, right after std::thread construction: block (real,
+  // microseconds) until the child has REGISTERED.  This makes spawn a
+  // deterministic synchronization point — whether the child is in the
+  // enabled set no longer depends on OS thread-start timing, which
+  // would otherwise misalign prefix replay run-to-run.
+  void await_child_enter(int id) {
+    if (id < 0) return;
+    std::unique_lock<std::mutex> g(mu_);
+    cv_.wait(g, [&] { return th_[id].st != St::Spawning || free_run_; });
+  }
+
+  void child_exit() {
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    if (me < 0) return;
+    th_[me].st = St::Done;
+    th_[me].exited = true;
+    // wake joiners parked on this slot
+    for (int i = 0; i < nth_; ++i)
+      if (th_[i].used && th_[i].st == St::BlockedJoin &&
+          th_[i].join_slot == me)
+        th_[i].st = St::Ready;
+    slot_ref() = -1;
+    pick_next_locked();  // hand the token on; this thread is done
+    cv_.notify_all();
+  }
+
+  // Joiner side: park until the target SLOT exits, then the caller
+  // does the real std::thread::join (the exiting thread is past its
+  // last managed instruction — the real join returns promptly).
+  // Keyed by slot id, not thread id: a child that has not yet
+  // registered must read as not-exited, never as already-gone.
+  void join_wait_slot(int id) {
+    if (id < 0) return;
+    std::unique_lock<std::mutex> g(mu_);
+    int me = slot();
+    for (;;) {
+      yield_locked(g, me, OpKind::Join, nullptr);
+      if (free_run_) return;
+      if (th_[id].exited) return;
+      th_[me].st = St::BlockedJoin;
+      th_[me].join_slot = id;
+      schedule_locked(g, me);
+    }
+  }
+
+  // ---- drill-side invariant check ----
+  void expect(bool cond, const char* what) {
+    if (cond) return;
+    std::lock_guard<std::mutex> g(mu_);
+    if (!result_.failed) {
+      result_.failed = true;
+      result_.what = what;
+      result_.fail_step = step_;
+    }
+  }
+
+  // ---- run control (explorer side; call from ONE driver thread) ----
+  RunResult run(const std::vector<uint8_t>& prefix, uint64_t seed,
+                uint64_t max_steps, const std::function<void()>& drill) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (int i = 0; i < kMaxThreads; ++i) th_[i] = Th{};
+      nth_ = 1;  // slot 0 = this driver thread
+      th_[0].used = true;
+      th_[0].st = St::Running;
+      th_[0].tid = std::this_thread::get_id();
+      slot_ref() = 0;
+      cur_ = 0;
+      vnow_ = 0;
+      step_ = 0;
+      cv_seq_ = 0;
+      preempts_ = 0;
+      owner_.clear();
+      prefix_ = prefix;
+      prefix_pos_ = 0;
+      seed_ = seed ? seed : 1;
+      max_steps_ = max_steps;
+      free_run_ = false;
+      result_ = RunResult{};
+      active_.store(true);
+    }
+    drill();
+    RunResult out;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      active_.store(false);
+      out = result_;
+      out.free_ran = free_run_;
+      out.steps = step_;
+      slot_ref() = -1;
+    }
+    cv_.notify_all();  // release anything the escape hatch left parked
+    return out;
+  }
+
+  // exploration knobs (see Explorer)
+  int preempt_bound = 3;
+  uint64_t branch_depth = 4096;  // decisions beyond this: default policy only
+
+ private:
+  enum class St : uint8_t {
+    Ready,
+    Running,
+    Spawning,
+    BlockedMutex,
+    BlockedCv,
+    BlockedSleep,
+    BlockedJoin,
+    Done,
+  };
+  struct Th {
+    bool used = false, exited = false, notified = false;
+    std::thread::id tid{};
+    St st = St::Ready;
+    const void* obj = nullptr;  // blocked-on / pending-op object
+    OpKind pending = OpKind::None;
+    uint64_t deadline = kInf;
+    uint64_t cv_seq = 0;
+    int join_slot = -1;
+  };
+
+  static int& slot_ref() {
+    thread_local int s = -1;
+    return s;
+  }
+  static int slot() { return slot_ref(); }
+
+  void wake_mutex_waiters_locked(std::mutex* m) {
+    for (int i = 0; i < nth_; ++i)
+      if (th_[i].used && th_[i].st == St::BlockedMutex && th_[i].obj == m) {
+        th_[i].st = St::Ready;
+        th_[i].pending = OpKind::Lock;
+        th_[i].obj = m;
+      }
+  }
+
+  // Two pending ops conflict when reordering them could change the
+  // outcome: same mutex, or a notify against a wait on the same cv.
+  static bool conflict(const Th& a, const Th& b) {
+    if (a.pending == OpKind::Spawn || b.pending == OpKind::Spawn) return true;
+    if (a.pending == OpKind::Lock && b.pending == OpKind::Lock)
+      return a.obj && a.obj == b.obj;
+    if ((a.pending == OpKind::Unlock && b.pending == OpKind::Lock) ||
+        (a.pending == OpKind::Lock && b.pending == OpKind::Unlock))
+      return a.obj && a.obj == b.obj;
+    auto cvpair = [](const Th& x, const Th& y) {
+      return x.pending == OpKind::CvNotify && y.pending == OpKind::CvWait &&
+             x.obj && x.obj == y.obj;
+    };
+    return cvpair(a, b) || cvpair(b, a);
+  }
+
+  // The core decision point.  Called with mu_ held by the thread that
+  // holds the token; records its pending op, picks who runs next, and
+  // parks the caller until it is scheduled again.
+  void yield_locked(std::unique_lock<std::mutex>& g, int me, OpKind kind,
+                    const void* obj) {
+    if (me < 0 || free_run_) return;
+    th_[me].pending = kind;
+    th_[me].obj = obj;
+    th_[me].st = St::Ready;
+    pick_next_locked();
+    cv_.notify_all();
+    schedule_locked(g, me, /*reschedule=*/false);
+  }
+
+  // Park until this slot is granted the token (st == Running), or the
+  // escape hatch fires.  When `reschedule`, the caller just blocked
+  // itself (st set by the caller) and the token must be handed on first.
+  void schedule_locked(std::unique_lock<std::mutex>& g, int me,
+                       bool reschedule = true) {
+    if (free_run_) return;
+    if (reschedule) {
+      pick_next_locked();
+      cv_.notify_all();
+    }
+    cv_.wait(g, [&] { return th_[me].st == St::Running || free_run_; });
+  }
+
+  // Pick the next token holder among Ready threads; advance the
+  // virtual clock past sleeps/timeouts when nothing is runnable.
+  void pick_next_locked() {
+    for (;;) {
+      int en[kMaxThreads];
+      int nen = 0;
+      for (int i = 0; i < nth_; ++i)
+        if (th_[i].used && th_[i].st == St::Ready) en[nen++] = i;
+      if (nen > 0) {
+        if (++step_ > max_steps_) {
+          if (!result_.failed) {
+            result_.failed = true;
+            result_.what = "step budget exceeded (possible livelock)";
+            result_.fail_step = step_;
+          }
+          enter_free_run_locked();
+          return;
+        }
+        int choice = 0;
+        bool from_prefix = prefix_pos_ < prefix_.size();
+        if (from_prefix) {
+          // consumed at EVERY decision (also forced nen==1 ones) so a
+          // prefix copied from a recorded trace stays index-aligned
+          choice = prefix_[prefix_pos_++] % nen;
+        } else if (nen == 1) {
+          choice = 0;
+        } else {
+          // default policy: keep the current thread running when it is
+          // still enabled (short traces), else a seeded pick — varied
+          // but fully reproducible from (prefix, seed)
+          choice = -1;
+          for (int k = 0; k < nen; ++k)
+            if (en[k] == cur_) choice = k;
+          if (choice < 0)
+            choice = int(mix(seed_ ^ (step_ * 0x9E3779B97F4A7C15ull)) % nen);
+        }
+        // preemption accounting: picking another thread while the
+        // current one is still runnable is a preemption
+        bool cur_enabled = false;
+        for (int k = 0; k < nen; ++k)
+          if (en[k] == cur_) cur_enabled = true;
+        if (cur_enabled && en[choice] != cur_) ++preempts_;
+        // branchable: >= 2 enabled, a real conflict among pending ops,
+        // inside the branch window, preemption budget left
+        bool conf = false;
+        for (int a = 0; a < nen && !conf; ++a)
+          for (int b = a + 1; b < nen && !conf; ++b)
+            if (conflict(th_[en[a]], th_[en[b]])) conf = true;
+        Decision d;
+        d.nen = uint8_t(nen);
+        d.chosen = uint8_t(choice);
+        d.branchable = nen > 1 && conf &&
+                       result_.decisions.size() < branch_depth &&
+                       preempts_ < uint64_t(preempt_bound);
+        result_.decisions.push_back(d);
+        result_.choices.push_back(uint8_t(choice));
+        cur_ = en[choice];
+        th_[cur_].st = St::Running;
+        if (debug_) {
+          std::fprintf(stderr, "[ds] step=%llu nen=%d chose=%d -> slot %d",
+                       (unsigned long long)step_, nen, choice, cur_);
+          for (int k = 0; k < nen; ++k)
+            std::fprintf(stderr, " e%d(p=%d)", en[k],
+                         int(th_[en[k]].pending));
+          std::fprintf(stderr, "\n");
+        }
+        return;
+      }
+      // nothing runnable: advance the virtual clock to the earliest
+      // deadline (sleeps + timed cv waits)
+      uint64_t dl = kInf;
+      for (int i = 0; i < nth_; ++i)
+        if (th_[i].used &&
+            (th_[i].st == St::BlockedSleep || th_[i].st == St::BlockedCv) &&
+            th_[i].deadline < dl)
+          dl = th_[i].deadline;
+      if (dl == kInf) {
+        // spawning threads still on their way in: let them arrive (the
+        // parent holds no token; real wait is bounded by thread start)
+        bool spawning = false;
+        for (int i = 0; i < nth_; ++i)
+          if (th_[i].used && th_[i].st == St::Spawning) spawning = true;
+        if (spawning) {
+          cur_ = -1;
+          return;  // child_enter will call schedule_locked -> picks next
+        }
+        if (!result_.failed) {
+          result_.failed = true;
+          result_.what = "deadlock: no runnable thread and no deadline";
+          result_.fail_step = step_;
+        }
+        enter_free_run_locked();
+        return;
+      }
+      vnow_ = dl;
+      for (int i = 0; i < nth_; ++i)
+        if (th_[i].used && th_[i].deadline <= vnow_ &&
+            (th_[i].st == St::BlockedSleep || th_[i].st == St::BlockedCv)) {
+          bool was_cv = th_[i].st == St::BlockedCv;
+          th_[i].notified = false;  // cv deadline: a timeout, not a wake
+          th_[i].st = St::Ready;
+          th_[i].pending = was_cv ? OpKind::Lock : OpKind::None;
+          th_[i].obj = nullptr;
+        }
+    }
+  }
+
+  // Escape hatch for deadlock/budget findings: stop scheduling, wake
+  // every parked thread, and let the drill finish on REAL primitives
+  // (engine receive budgets unstick anything genuinely wedged) so the
+  // harness can tear down and report instead of hanging.
+  void enter_free_run_locked() {
+    free_run_ = true;
+    for (int i = 0; i < nth_; ++i)
+      if (th_[i].used && th_[i].st != St::Done) th_[i].st = St::Running;
+    cv_.notify_all();
+  }
+
+  bool free_run_cv_wait(std::unique_lock<std::mutex>& lk, uint64_t ns) {
+    // free-run fallback: the caller's mutex MUST be released across
+    // the wait (the predicate it re-checks only changes under that
+    // lock — holding it here would wedge the very thread that has to
+    // flip it, hanging the harness instead of reporting the finding)
+    (void)ns;
+    lk.unlock();
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    lk.lock();
+    return true;  // caller re-checks its predicate
+  }
+
+  static uint64_t mix(uint64_t x) {
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+    x ^= x >> 33;
+    x *= 0xC4CEB9FE1A85EC53ull;
+    x ^= x >> 33;
+    return x;
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::atomic<bool> active_{false};
+  bool free_run_ = false;
+  Th th_[kMaxThreads];
+  int nth_ = 0;
+  int cur_ = -1;
+  uint64_t vnow_ = 0, step_ = 0, cv_seq_ = 0, preempts_ = 0;
+  std::map<const std::mutex*, int> owner_;
+  std::vector<uint8_t> prefix_;
+  size_t prefix_pos_ = 0;
+  uint64_t seed_ = 1, max_steps_ = 200000;
+  RunResult result_;
+  bool debug_ = std::getenv("ACCL_DS_DEBUG") != nullptr;
+};
+
+// ---- thin hook surface used by common.hpp wrappers ----
+inline bool on() { return Sched::inst().on(); }
+inline bool run_active() { return Sched::inst().run_active(); }
+inline uint64_t now_ns() { return Sched::inst().now_ns(); }
+inline void lock_hooked(std::mutex* m) { Sched::inst().lock_hooked(m); }
+inline void unlock_hooked(std::mutex* m) { Sched::inst().unlock_hooked(m); }
+inline bool cv_block(const void* cv, std::unique_lock<std::mutex>& lk,
+                     uint64_t timeout_ns) {
+  return Sched::inst().cv_block(cv, lk, timeout_ns);
+}
+inline void cv_notify(const void* cv, bool all) {
+  Sched::inst().cv_notify(cv, all);
+}
+inline void sleep_hooked(uint64_t ns) { Sched::inst().sleep_hooked(ns); }
+inline void yield_hooked() { Sched::inst().yield_hooked(); }
+inline void expect(bool cond, const char* what) {
+  Sched::inst().expect(cond, what);
+}
+
+// ---------------------------------------------------------------------------
+// Explorer: stateless bounded exploration over choice prefixes.
+// ---------------------------------------------------------------------------
+struct ExploreOpts {
+  uint64_t max_runs = 2000;
+  uint64_t max_steps = 200000;   // per run
+  uint64_t seed = 1;
+  int preempt_bound = 3;
+  uint64_t branch_depth = 4096;
+  bool stop_on_first = true;
+  double budget_s = 0;  // 0 = unbounded
+};
+
+struct ExploreStats {
+  uint64_t runs = 0;            // schedules executed
+  uint64_t unique_traces = 0;   // distinct complete traces (hash-deduped)
+  uint64_t findings = 0;
+  RunResult first_failure;      // valid when findings > 0
+  std::vector<uint8_t> first_failure_prefix;  // minimal failing prefix
+  uint64_t seed = 1;
+};
+
+inline uint64_t trace_hash(const std::vector<uint8_t>& v) {
+  uint64_t h = 1469598103934665603ull;
+  for (uint8_t b : v) {
+    h ^= b;
+    h *= 1099511628211ull;
+  }
+  h ^= v.size();
+  return h;
+}
+
+// Shortest failing prefix: re-run with successively shorter prefixes of
+// the failing choice string (default policy beyond) and keep the
+// shortest that still fails — the replay artifact stays minimal.
+inline std::vector<uint8_t> minimize_prefix(
+    const std::function<void()>& drill, const std::vector<uint8_t>& failing,
+    uint64_t seed, uint64_t max_steps) {
+  std::vector<uint8_t> best = failing;
+  size_t lo = 0, hi = failing.size();
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    std::vector<uint8_t> probe(failing.begin(), failing.begin() + long(mid));
+    RunResult r = Sched::inst().run(probe, seed, max_steps, drill);
+    if (r.failed) {
+      best = probe;
+      hi = mid;
+    } else {
+      lo = mid + 1;
+    }
+  }
+  return best;
+}
+
+inline ExploreStats explore(const std::function<void()>& drill,
+                            const ExploreOpts& opts) {
+  Sched& S = Sched::inst();
+  S.preempt_bound = opts.preempt_bound;
+  S.branch_depth = opts.branch_depth;
+  ExploreStats st;
+  st.seed = opts.seed;
+  std::set<uint64_t> seen;
+  // DFS frontier of prefixes; each entry remembers the decision index
+  // from which new alternatives may be expanded (alternatives before it
+  // are covered by the branch that generated the prefix)
+  struct Item {
+    std::vector<uint8_t> prefix;
+    size_t expand_from;
+  };
+  std::vector<Item> stack;
+  stack.push_back({{}, 0});
+  auto t0 = std::chrono::steady_clock::now();
+  while (!stack.empty() && st.runs < opts.max_runs) {
+    if (opts.budget_s > 0) {
+      double el = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+      if (el > opts.budget_s) break;
+    }
+    Item it = std::move(stack.back());
+    stack.pop_back();
+    RunResult r = S.run(it.prefix, opts.seed, opts.max_steps, drill);
+    ++st.runs;
+    if (seen.insert(trace_hash(r.choices)).second) ++st.unique_traces;
+    if (r.failed) {
+      ++st.findings;
+      if (st.findings == 1) {
+        st.first_failure = r;
+        st.first_failure_prefix =
+            minimize_prefix(drill, r.choices, opts.seed, opts.max_steps);
+      }
+      if (opts.stop_on_first) break;
+      continue;  // do not expand a failing schedule further
+    }
+    // expand alternatives at branchable decision points
+    for (size_t i = it.expand_from; i < r.decisions.size(); ++i) {
+      const Decision& d = r.decisions[i];
+      if (!d.branchable) continue;
+      for (uint8_t alt = 0; alt < d.nen; ++alt) {
+        if (alt == d.chosen) continue;
+        std::vector<uint8_t> p(r.choices.begin(),
+                               r.choices.begin() + long(i));
+        p.push_back(alt);
+        stack.push_back({std::move(p), i + 1});
+      }
+    }
+  }
+  return st;
+}
+
+}  // namespace det
+}  // namespace accl

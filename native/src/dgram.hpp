@@ -64,7 +64,7 @@ class DgramHub {
   uint32_t mtu() const { return mtu_; }
 
   void attach(int rank, DgSink sink) {
-    std::lock_guard<std::mutex> g(states_[rank].mu);
+    MutexLock g(states_[rank].mu);
     states_[rank].sink = std::move(sink);
   }
   void detach(int rank) {
@@ -72,9 +72,9 @@ class DgramHub {
     // already copied the sink may be mid-call into the engine, and the
     // caller is about to destruct it (teardown use-after-free guard)
     auto& st = states_[rank];
-    std::unique_lock<std::mutex> g(st.mu);
+    UniqueLock g(st.mu);
     st.sink = nullptr;
-    st.cv.wait(g, [&] { return !st.delivering; });
+    st.cv.wait(g, [&]() ACCL_REQUIRES(st.mu) { return !st.delivering; });
   }
 
   void post(uint32_t dst, Datagram&& d) {
@@ -99,17 +99,18 @@ class DgramHub {
 
  private:
   struct DstState {
-    std::mutex mu;
-    std::condition_variable cv;
-    std::deque<Datagram> q;
-    DgSink sink;
-    bool delivering = false;  // a worker holds a copy of sink right now
+    Mutex mu;
+    CondVar cv;
+    std::deque<Datagram> q ACCL_GUARDED_BY(mu);
+    DgSink sink ACCL_GUARDED_BY(mu);
+    // a worker holds a copy of sink right now
+    bool delivering ACCL_GUARDED_BY(mu) = false;
   };
 
   void enqueue(uint32_t dst, Datagram&& d) {
     auto& st = states_[dst];
     {
-      std::lock_guard<std::mutex> g(st.mu);
+      MutexLock g(st.mu);
       st.q.push_back(std::move(d));
     }
     st.cv.notify_one();
@@ -121,9 +122,11 @@ class DgramHub {
       std::vector<Datagram> batch;
       DgSink sink;
       {
-        std::unique_lock<std::mutex> g(st.mu);
+        UniqueLock g(st.mu);
         cv_wait_for_pred(st.cv, g, std::chrono::milliseconds(50),
-                         [&] { return !st.q.empty() || !running_; });
+                         [&]() ACCL_REQUIRES(st.mu) {
+                           return !st.q.empty() || !running_;
+                         });
         if (!running_ && st.q.empty()) return;
         for (uint32_t i = 0; i < window_ && !st.q.empty(); ++i) {
           batch.push_back(std::move(st.q.front()));
@@ -137,7 +140,7 @@ class DgramHub {
       for (auto it = batch.rbegin(); it != batch.rend(); ++it)
         sink(std::move(*it));
       {
-        std::lock_guard<std::mutex> g(st.mu);
+        MutexLock g(st.mu);
         st.delivering = false;
       }
       st.cv.notify_all();
@@ -146,7 +149,7 @@ class DgramHub {
 
   uint32_t mtu_, window_;
   std::vector<DstState> states_;
-  std::vector<std::thread> workers_;
+  std::vector<Thread> workers_;  // det-managed: dgram worlds are drillable
   std::atomic<bool> running_{true};
   std::atomic<uint32_t> fault_{0};
 };
@@ -204,7 +207,7 @@ class DatagramTransport : public Transport {
     Message out;
     bool complete = false;
     {
-      std::lock_guard<std::mutex> g(mu_);
+      MutexLock g(mu_);
       uint64_t key = (uint64_t(d.src_global) << 32) | d.msg_id;
       // duplicate of an already-delivered message (e.g. a duplicated
       // single-fragment datagram): must not re-deliver — rendezvous
@@ -249,7 +252,7 @@ class DatagramTransport : public Transport {
     if (complete && sink_) sink_(std::move(out));
   }
 
-  void evict_oldest_locked() {
+  void evict_oldest_locked() ACCL_REQUIRES(mu_) {
     auto oldest = slots_.end();
     for (auto it = slots_.begin(); it != slots_.end(); ++it)
       if (oldest == slots_.end() || it->second.stamp < oldest->second.stamp)
@@ -261,12 +264,13 @@ class DatagramTransport : public Transport {
   int rank_;
   uint32_t max_sessions_;
   std::atomic<uint32_t> next_msg_id_{1};
-  Sink sink_;
-  std::mutex mu_;
-  std::unordered_map<uint64_t, Slot> slots_;
+  Sink sink_;  // set once in start(), before hub delivery is attached
+  Mutex mu_;
+  std::unordered_map<uint64_t, Slot> slots_ ACCL_GUARDED_BY(mu_);
   // per-sender ids already delivered (duplicate suppression window)
-  std::unordered_map<uint32_t, std::set<uint32_t>> done_ids_;
-  uint64_t stamp_ = 0;
+  std::unordered_map<uint32_t, std::set<uint32_t>> done_ids_
+      ACCL_GUARDED_BY(mu_);
+  uint64_t stamp_ ACCL_GUARDED_BY(mu_) = 0;
 };
 
 }  // namespace accl

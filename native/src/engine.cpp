@@ -27,16 +27,16 @@ Engine::Engine(uint32_t global_rank, uint64_t devmem_bytes,
       transport_(std::move(transport)) {
   free_spans_[0x1000] = devmem_bytes - 0x1000;
   // hostmem_ is committed lazily on first alloc_host: most worlds never
-  // use host-only buffers and should not pay half a devmem of RSS
-  // avoid vector reallocation races between the engine loop and host-side
-  // configuration (the reference's exchange memory is likewise written
-  // live while the firmware polls it)
+  // use host-only buffers and should not pay half a devmem of RSS.
+  // The tables behind comms_/arithcfgs_ are heap-pinned (unique_ptr
+  // slots), so growth can never move a table the engine loop holds a
+  // pointer into; the reserve only avoids pointer-vector churn.
   comms_.reserve(64);
   arithcfgs_.reserve(64);
   transport_->start([this](Message&& m) { ingress(std::move(m)); });
-  loop_thread_ = std::thread([this] { loop(); });
-  egress_thread_ = std::thread([this] { egress_loop(); });
-  delay_thread_ = std::thread([this] { delay_loop(); });
+  loop_thread_ = Thread([this] { loop(); });
+  egress_thread_ = Thread([this] { egress_loop(); });
+  delay_thread_ = Thread([this] { delay_loop(); });
 }
 
 Engine::~Engine() { shutdown(); }
@@ -52,7 +52,7 @@ void Engine::shutdown() {
   {
     // chaos-delayed messages still pending at teardown are dropped (the
     // world is going away; the peer's receive machinery is too)
-    std::lock_guard<std::mutex> g(delay_mu_);
+    MutexLock g(delay_mu_);
     delay_running_ = false;
     delayed_.clear();
   }
@@ -61,9 +61,9 @@ void Engine::shutdown() {
   {
     // drain staged segments so tail messages of completed calls are not
     // lost, then stop the writer
-    std::unique_lock<std::mutex> g(egress_mu_);
+    UniqueLock g(egress_mu_);
     cv_wait_for_pred(egress_cv_, g, std::chrono::seconds(2),
-                     [&] { return egress_q_.empty(); });
+                     [&]() ACCL_REQUIRES(egress_mu_) { return egress_q_.empty(); });
     egress_running_ = false;
   }
   egress_cv_.notify_all();
@@ -71,7 +71,7 @@ void Engine::shutdown() {
   transport_->stop();
   // unblock host-side stream readers parked in pop_stream
   {
-    std::lock_guard<std::mutex> g(streams_mu_);
+    MutexLock g(streams_mu_);
     for (auto& [strm, fifo] : streams_)
       if (fifo) fifo->close();
   }
@@ -80,7 +80,7 @@ void Engine::shutdown() {
   // budget against a dead engine (and then touching freed memory — the
   // suite-exit segfault)
   {
-    std::lock_guard<std::mutex> g(results_mu_);
+    MutexLock g(results_mu_);
     for (auto& [id, r] : results_) {
       if (!r.done) {
         r.retcode = COMM_ABORTED | RANK_FAILED;
@@ -98,37 +98,39 @@ void Engine::cfg_rx_buffers(uint32_t nbufs, uint64_t bufsize) {
 }
 
 int Engine::set_comm(const uint32_t* words, int nwords) {
-  std::lock_guard<std::mutex> g(cfg_mu_);
-  CommTable t;
-  t.size = words[0];
-  t.local = words[1];
-  if (nwords < int(2 + 4 * t.size)) return -1;
-  for (uint32_t i = 0; i < t.size; ++i) {
+  // build the table FULLY before publication: rows of a published
+  // table are immutable and may be read lock-free (see CommTable)
+  auto t = std::make_unique<CommTable>();
+  t->size = words[0];
+  t->local = words[1];
+  if (nwords < int(2 + 4 * t->size)) return -1;
+  for (uint32_t i = 0; i < t->size; ++i) {
     CommTable::Row r;
     r.ip = words[2 + 4 * i];
     r.port = words[3 + 4 * i];
     r.session = words[4 + 4 * i];
     r.max_seg = words[5 + 4 * i];
-    t.rows.push_back(r);
+    t->rows.push_back(r);
   }
-  t.inbound_seq.assign(t.size, 0);
-  t.outbound_seq.assign(t.size, 0);
+  t->inbound_seq.assign(t->size, 0);
+  t->outbound_seq.assign(t->size, 0);
+  MutexLock g(cfg_mu_);
   comms_.push_back(std::move(t));
   return int(comms_.size()) - 1;
 }
 
 int Engine::set_arithcfg(const uint32_t* words, int nwords) {
-  std::lock_guard<std::mutex> g(cfg_mu_);
-  ArithCfgN a;
-  a.ubits = words[0];
-  a.cbits = words[1];
-  a.ratio_log = words[2];
-  a.compressor = words[3];
-  a.decompressor = words[4];
-  a.arith_compressed = words[5];
+  auto a = std::make_unique<ArithCfgN>();
+  a->ubits = words[0];
+  a->cbits = words[1];
+  a->ratio_log = words[2];
+  a->compressor = words[3];
+  a->decompressor = words[4];
+  a->arith_compressed = words[5];
   uint32_t nlanes = words[6];
   for (uint32_t i = 0; i < nlanes && int(7 + i) < nwords; ++i)
-    a.lanes.push_back(words[7 + i]);
+    a->lanes.push_back(words[7 + i]);
+  MutexLock g(cfg_mu_);
   arithcfgs_.push_back(std::move(a));
   return int(arithcfgs_.size()) - 1;
 }
@@ -161,14 +163,14 @@ static uint64_t alloc_first_fit(std::map<uint64_t, uint64_t>& spans,
 }
 
 uint64_t Engine::alloc(uint64_t nbytes, uint64_t align) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   return alloc_first_fit(free_spans_, alloc_sizes_, nbytes, align, 0);
 }
 
 // Host-region allocator: same first-fit discipline over the host span
 // map; returned addresses carry HOST_ADDR_BIT.
 uint64_t Engine::alloc_host(uint64_t nbytes, uint64_t align) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   if (hostmem_.empty()) {
     hostmem_.resize(host_region_bytes_);
     host_spans_[0x1000] = hostmem_.size() - 0x1000;
@@ -178,7 +180,7 @@ uint64_t Engine::alloc_host(uint64_t nbytes, uint64_t align) {
 }
 
 void Engine::free_addr(uint64_t addr) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   auto it = alloc_sizes_.find(addr);
   if (it == alloc_sizes_.end()) return;
   uint64_t size = it->second;
@@ -201,7 +203,12 @@ void Engine::free_addr(uint64_t addr) {
   spans[addr] = size;
 }
 
+// Host-side reads/writes take mem_mu_ like every other memory toucher
+// (before r14 they ran bare — an unlocked-read class the TSA lane now
+// rejects: a host read racing the hostmem_ lazy commit in alloc_host
+// observed a vector mid-resize).
 bool Engine::read_mem(uint64_t addr, void* dst, uint64_t n) {
+  MutexLock g(mem_mu_);
   auto& region = (addr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
   addr &= ~HOST_ADDR_BIT;
   if (addr + n > region.size()) return false;
@@ -210,6 +217,7 @@ bool Engine::read_mem(uint64_t addr, void* dst, uint64_t n) {
 }
 
 bool Engine::write_mem(uint64_t addr, const void* src, uint64_t n) {
+  MutexLock g(mem_mu_);
   auto& region = (addr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
   addr &= ~HOST_ADDR_BIT;
   if (addr + n > region.size()) return false;
@@ -248,7 +256,7 @@ uint64_t Engine::start_call(const uint32_t* w15) {
   std::copy(w15, w15 + 15, c.w.begin());
   c.id = next_call_id_++;
   {
-    std::lock_guard<std::mutex> g(results_mu_);
+    MutexLock g(results_mu_);
     results_[c.id] = CallResult{};
   }
   cmd_q_.push(c);
@@ -256,7 +264,7 @@ uint64_t Engine::start_call(const uint32_t* w15) {
   // have run, leaving this call pending forever (its waiter would burn
   // the full wait budget against a dead engine) — finalize inline
   if (stopped_.load()) {
-    std::lock_guard<std::mutex> g(results_mu_);
+    MutexLock g(results_mu_);
     auto& r = results_[c.id];
     if (!r.done) {
       r.retcode = COMM_ABORTED | RANK_FAILED;
@@ -267,7 +275,7 @@ uint64_t Engine::start_call(const uint32_t* w15) {
 }
 
 bool Engine::poll_call(uint64_t id, uint32_t* retcode, double* duration_ns) {
-  std::lock_guard<std::mutex> g(results_mu_);
+  MutexLock g(results_mu_);
   auto it = results_.find(id);
   if (it == results_.end() || !it->second.done) return false;
   if (retcode) *retcode = it->second.retcode;
@@ -296,7 +304,7 @@ int Engine::plan_create(const uint32_t* words, int ncalls) {
     if (abort_err(c)) return -1;  // arming against a fenced comm
     plan.comm_epochs.emplace_back(c, epoch_of(c));
   }
-  std::lock_guard<std::mutex> g(plans_mu_);
+  MutexLock g(plans_mu_);
   plans_.push_back(std::move(plan));
   return int(plans_.size()) - 1;
 }
@@ -304,7 +312,7 @@ int Engine::plan_create(const uint32_t* words, int ncalls) {
 long long Engine::plan_replay(int plan_id) {
   std::vector<std::array<uint32_t, 15>> descs;
   {
-    std::lock_guard<std::mutex> g(plans_mu_);
+    MutexLock g(plans_mu_);
     if (plan_id < 0 || plan_id >= int(plans_.size())) return -1;
     EnginePlan& p = plans_[size_t(plan_id)];
     if (!p.valid) return -2;
@@ -321,7 +329,7 @@ long long Engine::plan_replay(int plan_id) {
   std::vector<uint64_t> ids;
   ids.reserve(descs.size());
   for (auto& w : descs) ids.push_back(start_call(w.data()));
-  std::lock_guard<std::mutex> g(plans_mu_);
+  MutexLock g(plans_mu_);
   long long token = next_plan_token_++;
   plan_tokens_[token] = std::move(ids);
   // opportunistic reaper: tokens abandoned without a successful poll
@@ -330,7 +338,7 @@ long long Engine::plan_replay(int plan_id) {
   // stale tokens oldest-first once the map grows past its watermark —
   // bounds the leak at ~256 in-flight/abandoned replays.
   if (plan_tokens_.size() > 256) {
-    std::lock_guard<std::mutex> r(results_mu_);
+    MutexLock r(results_mu_);
     for (auto it = plan_tokens_.begin();
          it != plan_tokens_.end() && plan_tokens_.size() > 256;) {
       if (it->first == token) break;  // never reap the fresh token
@@ -357,7 +365,7 @@ int Engine::plan_poll(long long token, uint32_t* retcode,
                       double* duration_ns) {
   std::vector<uint64_t> ids;
   {
-    std::lock_guard<std::mutex> g(plans_mu_);
+    MutexLock g(plans_mu_);
     auto it = plan_tokens_.find(token);
     if (it == plan_tokens_.end()) return -1;
     ids = it->second;
@@ -365,7 +373,7 @@ int Engine::plan_poll(long long token, uint32_t* retcode,
   uint32_t ret = 0;
   double dur = 0.0;
   {
-    std::lock_guard<std::mutex> g(results_mu_);
+    MutexLock g(results_mu_);
     for (uint64_t id : ids) {
       auto it = results_.find(id);
       if (it == results_.end() || !it->second.done) return 0;
@@ -378,7 +386,7 @@ int Engine::plan_poll(long long token, uint32_t* retcode,
     }
   }
   {
-    std::lock_guard<std::mutex> g(plans_mu_);
+    MutexLock g(plans_mu_);
     plan_tokens_.erase(token);
   }
   if (retcode) *retcode = ret;
@@ -387,7 +395,7 @@ int Engine::plan_poll(long long token, uint32_t* retcode,
 }
 
 void Engine::invalidate_plans(int comm_id) {
-  std::lock_guard<std::mutex> g(plans_mu_);
+  MutexLock g(plans_mu_);
   for (EnginePlan& p : plans_) {
     bool hit = comm_id < 0;
     for (auto& [comm, ep] : p.comm_epochs)
@@ -403,7 +411,7 @@ void Engine::invalidate_plans(int comm_id) {
 }
 
 void Engine::plan_release(int plan_id) {
-  std::lock_guard<std::mutex> g(plans_mu_);
+  MutexLock g(plans_mu_);
   if (plan_id < 0 || plan_id >= int(plans_.size())) return;
   EnginePlan& p = plans_[size_t(plan_id)];
   p.valid = false;
@@ -412,7 +420,7 @@ void Engine::plan_release(int plan_id) {
 }
 
 int Engine::plan_count() const {
-  std::lock_guard<std::mutex> g(plans_mu_);
+  MutexLock g(plans_mu_);
   int n = 0;
   for (const EnginePlan& p : plans_)
     if (p.valid) ++n;
@@ -424,7 +432,7 @@ void Engine::push_krnl(const uint8_t* data, uint64_t n) {
 }
 
 std::shared_ptr<Fifo<std::vector<uint8_t>>> Engine::stream_for(uint32_t strm) {
-  std::lock_guard<std::mutex> g(streams_mu_);
+  MutexLock g(streams_mu_);
   auto& slot = streams_[strm];
   if (!slot) slot = std::make_shared<Fifo<std::vector<uint8_t>>>();
   return slot;
@@ -477,10 +485,10 @@ void Engine::send_out(uint32_t session, Message&& msg) {
     case 4: {  // delay: hold the message past its siblings (reordering)
       uint32_t us;
       {
-        std::lock_guard<std::mutex> g(chaos_mu_);
+        MutexLock g(chaos_mu_);
         us = chaos_.delay_us ? chaos_.delay_us : 2000;
       }
-      std::lock_guard<std::mutex> g(delay_mu_);
+      MutexLock g(delay_mu_);
       if (delay_running_) {
         delayed_.push_back(Delayed{
             steady_clock::now() + microseconds(us), session,
@@ -501,7 +509,7 @@ void Engine::send_out(uint32_t session, Message&& msg) {
 // wire (a FIFO stall would delay everything behind it and never open a
 // sequence gap for the NACK path to close).
 void Engine::delay_loop() {
-  std::unique_lock<std::mutex> lk(delay_mu_);
+  UniqueLock lk(delay_mu_);
   while (delay_running_) {
     if (delayed_.empty()) {
       delay_cv_.wait(lk);
@@ -524,7 +532,7 @@ void Engine::delay_loop() {
 }
 
 uint32_t Engine::chaos_draw() {
-  std::lock_guard<std::mutex> g(chaos_mu_);
+  MutexLock g(chaos_mu_);
   if (!chaos_.armed) return 0;
   // xorshift64*: deterministic per (seed, draw index) — a seeded plan
   // replays the same fault schedule run after run
@@ -547,7 +555,7 @@ uint32_t Engine::chaos_draw() {
 void Engine::set_chaos(uint64_t seed, uint32_t drop_ppm, uint32_t dup_ppm,
                        uint32_t delay_ppm, uint32_t delay_us,
                        uint32_t corrupt_ppm, uint32_t slow_us) {
-  std::lock_guard<std::mutex> g(chaos_mu_);
+  MutexLock g(chaos_mu_);
   chaos_.drop_ppm = drop_ppm;
   chaos_.dup_ppm = dup_ppm;
   chaos_.delay_ppm = delay_ppm;
@@ -566,7 +574,8 @@ void Engine::kill() {
   // local abort of every comm (no propagation — a dead rank cannot
   // send): this rank's own pending calls finalize fast with RANK_FAILED
   // instead of burning their receive budget against silence
-  for (uint32_t c = 0; c < comms_.size() && c < kMaxComms; ++c) {
+  uint32_t n = comm_count();
+  for (uint32_t c = 0; c < n && c < kMaxComms; ++c) {
     comm_epoch_[c].fetch_add(1);
     comm_abort_[c].fetch_or(COMM_ABORTED | RANK_FAILED);
   }
@@ -586,13 +595,13 @@ void Engine::stage_egress(uint32_t session, Message&& msg) {
     if (!msg.payload.empty())
       std::memcpy(raw.data() + sizeof(WireHeader), msg.payload.data(),
                   msg.payload.size());
-    std::lock_guard<std::mutex> g(tap_mu_);
+    MutexLock g(tap_mu_);
     if (tap_frames_.size() >= kTapCap) tap_frames_.pop_front();
     tap_frames_.push_back(std::move(raw));
   }
   {
-    std::unique_lock<std::mutex> g(egress_mu_);
-    egress_cv_.wait(g, [&] {
+    UniqueLock g(egress_mu_);
+    egress_cv_.wait(g, [&]() ACCL_REQUIRES(egress_mu_) {
       return egress_q_.size() < pipeline_depth_.load() || !egress_running_;
     });
     if (!egress_running_) return;
@@ -605,8 +614,10 @@ void Engine::egress_loop() {
   for (;;) {
     std::pair<uint32_t, Message> item;
     {
-      std::unique_lock<std::mutex> g(egress_mu_);
-      egress_cv_.wait(g, [&] { return !egress_q_.empty() || !egress_running_; });
+      UniqueLock g(egress_mu_);
+      egress_cv_.wait(g, [&]() ACCL_REQUIRES(egress_mu_) {
+        return !egress_q_.empty() || !egress_running_;
+      });
       if (egress_q_.empty()) {
         if (!egress_running_) return;
         continue;
@@ -618,7 +629,7 @@ void Engine::egress_loop() {
     // slow-rank chaos: stall the egress writer per message so this rank
     // lags the gang without dropping anything
     uint32_t stall = slow_us_.load();
-    if (stall) std::this_thread::sleep_for(microseconds(stall));
+    if (stall) det_sleep_for(microseconds(stall));
     try {
       transport_->send(item.first, std::move(item.second));
     } catch (const std::exception& e) {
@@ -667,7 +678,7 @@ bool Engine::frame_ok(const WireHeader& hdr, uint64_t payload_bytes) {
         // egress pipeline).  Checked here — not in classify() — so a
         // dropped frame is a single counted rejection and
         // ingest_bytes' return code matches the counter.
-        std::lock_guard<std::mutex> g(strm_seq_mu_);
+        MutexLock g(strm_seq_mu_);
         StrmKey key{hdr.comm_id, hdr.src, hdr.strm};
         auto it = strm_in_seq_.find(key);
         if (it == strm_in_seq_.end() &&
@@ -703,7 +714,19 @@ bool Engine::frame_ok(const WireHeader& hdr, uint64_t payload_bytes) {
   return false;  // unknown message type
 }
 
+// RAII depth marker for ingress_depth() (see engine.hpp): lets the
+// detsched shutdown drill assert no delivery is mid-flight inside a
+// detached engine.
+namespace {
+struct DepthGuard {
+  explicit DepthGuard(std::atomic<int>& d) : d_(d) { d_.fetch_add(1); }
+  ~DepthGuard() { d_.fetch_sub(1); }
+  std::atomic<int>& d_;
+};
+}  // namespace
+
 void Engine::ingress(Message&& msg) {
+  DepthGuard depth(ingress_depth_);
   // kill-rank chaos: a dead engine hears nothing — no pongs, no
   // completions, no deposits (the peer-visible half of kill())
   if (killed_.load()) return;
@@ -736,7 +759,7 @@ int Engine::ingest_bytes(const uint8_t* data, uint64_t nbytes) {
 }
 
 int Engine::tap_read(int idx, uint8_t* out, int cap) const {
-  std::lock_guard<std::mutex> g(tap_mu_);
+  MutexLock g(tap_mu_);
   if (idx < 0 || idx >= int(tap_frames_.size())) return -1;
   const std::vector<uint8_t>& f = tap_frames_[size_t(idx)];
   if (out && cap > 0) {
@@ -747,7 +770,7 @@ int Engine::tap_read(int idx, uint8_t* out, int cap) const {
 }
 
 int Engine::tap_drain(uint8_t* out, int cap) {
-  std::lock_guard<std::mutex> g(tap_mu_);
+  MutexLock g(tap_mu_);
   int off = 0;
   while (!tap_frames_.empty()) {
     const std::vector<uint8_t>& f = tap_frames_.front();
@@ -773,26 +796,24 @@ void Engine::classify(Message&& msg) {
       note_alive(msg.hdr.comm_id, msg.hdr.src);
       handle_nack(msg.hdr);
       return;
-    case MsgType::Heartbeat:
+    case MsgType::Heartbeat: {
       // liveness control plane: epoch-agnostic (survivors probe the
       // ABORTED comm while agreeing on the shrink set)
       note_alive(msg.hdr.comm_id, msg.hdr.src);
       if (msg.hdr.count == 1) {  // ping: pong back (count = 0)
-        std::lock_guard<std::mutex> g(cfg_mu_);
-        if (msg.hdr.comm_id < comms_.size()) {
-          const CommTable& t = comms_[msg.hdr.comm_id];
-          if (msg.hdr.src < t.rows.size()) {
-            Message pong;
-            pong.hdr.msg_type = uint8_t(MsgType::Heartbeat);
-            pong.hdr.comm_id = msg.hdr.comm_id;
-            pong.hdr.src = t.local;
-            pong.hdr.count = 0;
-            pong.hdr.dst_session = uint16_t(t.rows[msg.hdr.src].session);
-            stage_egress(t.rows[msg.hdr.src].session, std::move(pong));
-          }
+        const CommTable* t = comm_ptr(msg.hdr.comm_id);
+        if (t && msg.hdr.src < t->rows.size()) {
+          Message pong;
+          pong.hdr.msg_type = uint8_t(MsgType::Heartbeat);
+          pong.hdr.comm_id = msg.hdr.comm_id;
+          pong.hdr.src = t->local;
+          pong.hdr.count = 0;
+          pong.hdr.dst_session = uint16_t(t->rows[msg.hdr.src].session);
+          stage_egress(t->rows[msg.hdr.src].session, std::move(pong));
         }
       }
       return;
+    }
     case MsgType::Abort:
       note_alive(msg.hdr.comm_id, msg.hdr.src);
       handle_abort(msg.hdr);
@@ -835,7 +856,7 @@ void Engine::classify(Message&& msg) {
         // resequence per (comm, src, stream): non-FIFO transports (the
         // datagram rung) may deliver stream messages out of order, and
         // the stream FIFO has no other ordering discipline
-        std::lock_guard<std::mutex> g(strm_seq_mu_);
+        MutexLock g(strm_seq_mu_);
         StrmKey key{msg.hdr.comm_id, msg.hdr.src, msg.hdr.strm};
         uint32_t& expect = strm_in_seq_[key];
         if (msg.hdr.seqn == expect) {
@@ -907,7 +928,7 @@ void Engine::classify(Message&& msg) {
 // resilience: retransmission lane (NACK-driven eager resend)
 // ---------------------------------------------------------------------------
 void Engine::store_retrans(uint32_t comm, uint32_t dst, const Message& msg) {
-  std::lock_guard<std::mutex> g(retrans_mu_);
+  MutexLock g(retrans_mu_);
   if (retrans_ring_.empty()) retrans_ring_.resize(kRetransCap);
   RetransSlot& s = retrans_ring_[retrans_pos_];
   retrans_pos_ = (retrans_pos_ + 1) % kRetransCap;
@@ -922,20 +943,19 @@ void Engine::store_retrans(uint32_t comm, uint32_t dst, const Message& msg) {
 
 void Engine::send_nack(uint32_t comm, uint32_t src, uint32_t tag,
                        uint32_t seqn) {
-  if (comm >= comms_.size()) return;
-  CommTable& t = comms_[comm];
-  if (src >= t.rows.size()) return;
+  const CommTable* t = comm_ptr(comm);
+  if (!t || src >= t->rows.size()) return;
   Message m;
   m.hdr.msg_type = uint8_t(MsgType::Nack);
   m.hdr.comm_id = comm;
   m.hdr.tag = tag;
   m.hdr.seqn = seqn;
-  m.hdr.src = t.local;
+  m.hdr.src = t->local;
   m.hdr.epoch = epoch_of(comm);
-  m.hdr.dst_session = uint16_t(t.rows[src].session);
+  m.hdr.dst_session = uint16_t(t->rows[src].session);
   nacks_tx_.fetch_add(1);
   // control plane: staged directly (not a chaos target, see send_out)
-  stage_egress(t.rows[src].session, std::move(m));
+  stage_egress(t->rows[src].session, std::move(m));
 }
 
 void Engine::handle_nack(const WireHeader& hdr) {
@@ -946,7 +966,7 @@ void Engine::handle_nack(const WireHeader& hdr) {
   // index-free so the hot path pays nothing for our convenience here.
   std::vector<Message> out;
   {
-    std::lock_guard<std::mutex> g(retrans_mu_);
+    MutexLock g(retrans_mu_);
     for (const RetransSlot& s : retrans_ring_) {
       // a wildcard-tag NACK (a TAG_ANY recv's seek pairs with any
       // tag, so its solicitation must too) matches the whole route —
@@ -974,7 +994,8 @@ void Engine::handle_nack(const WireHeader& hdr) {
 // resilience: abort + epoch fencing
 // ---------------------------------------------------------------------------
 int Engine::abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate) {
-  if (comm_id >= comms_.size() || comm_id >= kMaxComms) return -1;
+  const CommTable* t = comm_ptr(comm_id);
+  if (!t || comm_id >= kMaxComms) return -1;
   uint32_t new_epoch = comm_epoch_[comm_id].fetch_add(1) + 1;
   comm_abort_[comm_id].fetch_or(err_bits | COMM_ABORTED);
   // reclaim pool buffers pinned by the dead epoch's traffic; fence
@@ -982,17 +1003,16 @@ int Engine::abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate) {
   rx_.evict_comm(comm_id);
   invalidate_plans(int(comm_id));
   if (propagate && !killed_.load()) {
-    const CommTable& t = comms_[comm_id];
-    for (uint32_t i = 0; i < t.rows.size(); ++i) {
-      if (i == t.local) continue;
+    for (uint32_t i = 0; i < t->rows.size(); ++i) {
+      if (i == t->local) continue;
       Message m;
       m.hdr.msg_type = uint8_t(MsgType::Abort);
       m.hdr.comm_id = comm_id;
-      m.hdr.src = t.local;
+      m.hdr.src = t->local;
       m.hdr.count = err_bits | COMM_ABORTED;
       m.hdr.epoch = new_epoch;
-      m.hdr.dst_session = uint16_t(t.rows[i].session);
-      stage_egress(t.rows[i].session, std::move(m));
+      m.hdr.dst_session = uint16_t(t->rows[i].session);
+      stage_egress(t->rows[i].session, std::move(m));
     }
   }
   return 0;
@@ -1000,7 +1020,7 @@ int Engine::abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate) {
 
 void Engine::handle_abort(const WireHeader& hdr) {
   uint32_t comm = hdr.comm_id;
-  if (comm >= kMaxComms || comm >= comms_.size()) return;
+  if (comm >= kMaxComms || !comm_ptr(comm)) return;
   // adopt the highest epoch seen (monotonic: a replayed abort cannot
   // roll the fence back)
   uint32_t cur = comm_epoch_[comm].load();
@@ -1021,20 +1041,20 @@ void Engine::reset_errors() {
   // abort flags.  Epochs stay bumped: old-epoch stragglers remain
   // fenced forever.
   {
-    std::lock_guard<std::mutex> g(cfg_mu_);
+    MutexLock g(cfg_mu_);
     for (auto& t : comms_) {
-      std::fill(t.inbound_seq.begin(), t.inbound_seq.end(), 0);
-      std::fill(t.outbound_seq.begin(), t.outbound_seq.end(), 0);
+      std::fill(t->inbound_seq.begin(), t->inbound_seq.end(), 0);
+      std::fill(t->outbound_seq.begin(), t->outbound_seq.end(), 0);
     }
   }
   rx_.clear_pending();
   {
-    std::lock_guard<std::mutex> g(retrans_mu_);
+    MutexLock g(retrans_mu_);
     for (RetransSlot& s : retrans_ring_) s.used = false;
     retrans_pos_ = 0;
   }
   {
-    std::lock_guard<std::mutex> g(strm_seq_mu_);
+    MutexLock g(strm_seq_mu_);
     strm_in_seq_.clear();
     strm_holdback_.clear();
   }
@@ -1062,10 +1082,10 @@ void Engine::handle_join(const WireHeader& hdr) {
   uint32_t joiner = hdr.src;  // raw session id, pre-communicator
   std::vector<uint32_t> words;
   {
-    std::lock_guard<std::mutex> g(cfg_mu_);
+    MutexLock g(cfg_mu_);
     words.push_back(uint32_t(comms_.size()));
     for (uint32_t ci = 0; ci < comms_.size(); ++ci) {
-      const CommTable& t = comms_[ci];
+      const CommTable& t = *comms_[ci];
       words.push_back(t.size);
       words.push_back(epoch_of(ci));
       words.push_back(abort_err(ci));
@@ -1110,7 +1130,7 @@ void Engine::apply_state_sync(const std::vector<uint32_t>& w) {
   if (w.empty()) return;
   uint32_t ncomms = w[0];
   size_t i = 1;
-  std::lock_guard<std::mutex> g(cfg_mu_);
+  MutexLock g(cfg_mu_);
   for (uint32_t ci = 0; ci < ncomms && ci < kMaxComms; ++ci) {
     if (i >= w.size()) break;
     uint32_t size = w[i++];
@@ -1120,7 +1140,7 @@ void Engine::apply_state_sync(const std::vector<uint32_t>& w) {
     // pad with placeholder slots so the NEXT set_comm on this engine
     // lands at the same index as the survivors' next create; a call on
     // a placeholder finalizes fast in loop() instead of scheduling
-    while (comms_.size() <= ci) comms_.push_back(CommTable{});
+    while (comms_.size() <= ci) comms_.push_back(std::make_unique<CommTable>());
     // adopt the fence monotonically (a replayed sync cannot roll back)
     uint32_t cur = comm_epoch_[ci].load();
     while (int32_t(epoch - cur) > 0 &&
@@ -1131,7 +1151,7 @@ void Engine::apply_state_sync(const std::vector<uint32_t>& w) {
 }
 
 uint32_t Engine::comm_count() const {
-  std::lock_guard<std::mutex> g(cfg_mu_);
+  MutexLock g(cfg_mu_);
   return uint32_t(comms_.size());
 }
 
@@ -1142,21 +1162,17 @@ void Engine::note_alive(uint32_t comm, uint32_t src) {
   uint64_t now = uint64_t(
       duration_cast<nanoseconds>(steady_clock::now().time_since_epoch())
           .count());
-  std::lock_guard<std::mutex> g(live_mu_);
+  MutexLock g(live_mu_);
   last_heard_ns_[{comm, src}] = now;
 }
 
 uint64_t Engine::probe_liveness(uint32_t comm_id, uint32_t window_us) {
-  if (comm_id >= comms_.size()) return 0;
-  uint32_t local, nranks;
+  const CommTable* t = comm_ptr(comm_id);
+  if (!t) return 0;
+  // rows are immutable after publication: lock-free reads (CommTable)
+  uint32_t local = t->local, nranks = t->size;
   std::vector<uint32_t> sessions;
-  {
-    std::lock_guard<std::mutex> g(cfg_mu_);
-    const CommTable& t = comms_[comm_id];
-    local = t.local;
-    nranks = t.size;
-    for (const auto& r : t.rows) sessions.push_back(r.session);
-  }
+  for (const auto& r : t->rows) sessions.push_back(r.session);
   uint64_t start_ns = uint64_t(
       duration_cast<nanoseconds>(steady_clock::now().time_since_epoch())
           .count());
@@ -1176,7 +1192,7 @@ uint64_t Engine::probe_liveness(uint32_t comm_id, uint32_t window_us) {
   uint64_t want = nranks < 64 ? (1ull << nranks) - 1 : ~0ull;
   for (;;) {
     {
-      std::lock_guard<std::mutex> g(live_mu_);
+      MutexLock g(live_mu_);
       for (uint32_t i = 0; i < nranks && i < 64; ++i) {
         if (i == local) continue;
         auto it = last_heard_ns_.find({comm_id, i});
@@ -1185,7 +1201,7 @@ uint64_t Engine::probe_liveness(uint32_t comm_id, uint32_t window_us) {
       }
     }
     if (alive == want || steady_clock::now() >= deadline) break;
-    std::this_thread::sleep_for(microseconds(500));
+    det_sleep_for(microseconds(500));
   }
   return alive;
 }
@@ -1205,7 +1221,7 @@ uint64_t Engine::probe_liveness(uint32_t comm_id, uint32_t window_us) {
 // teardown decided the call is dead.
 void Engine::land_one_sided(const WireHeader& hdr, const uint8_t* payload,
                             uint64_t payload_bytes) {
-  std::lock_guard<std::mutex> pg(posted_mu_);
+  MutexLock pg(posted_mu_);
   std::optional<PostedRndzv> post;
   {
     auto it =
@@ -1223,10 +1239,12 @@ void Engine::land_one_sided(const WireHeader& hdr, const uint8_t* payload,
   if (!post) return;
   {
     // the landing address may be tagged host-resident (host-only
-    // rendezvous buffers); resolve the region like mem() does
+    // rendezvous buffers); resolve the region like mem() does — the
+    // region reference is bound UNDER mem_mu_ (binding it outside was
+    // itself an unlocked read of the lazily-committed hostmem_)
+    MutexLock g(mem_mu_);
     auto& region = (hdr.vaddr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
     uint64_t vaddr = hdr.vaddr & ~HOST_ADDR_BIT;
-    std::lock_guard<std::mutex> g(mem_mu_);
     if (post->wire_c != post->lnd_c) {
       // clamp to what actually arrived: a short payload (divergent
       // arithcfg, stale posted entry) must not read past the wire
@@ -1254,23 +1272,25 @@ void Engine::land_one_sided(const WireHeader& hdr, const uint8_t* payload,
 // explicit session lifecycle (reference tcp_session_handler; see engine.hpp)
 // ---------------------------------------------------------------------------
 int Engine::open_con(uint32_t comm_id) {
-  if (comm_id >= comms_.size() || comms_[comm_id].rows.empty()) return -1;
-  const CommTable& t = comms_[comm_id];
-  for (uint32_t i = 0; i < t.rows.size(); ++i) {
-    if (i == t.local) continue;
-    if (transport_->open_session(t.rows[i].session) != 0) return int(i) + 1;
+  // row reads are lock-free (immutable after publication) — holding
+  // cfg_mu_ across the blocking connect attempts would stall ingress
+  const CommTable* t = comm_ptr(comm_id);
+  if (!t || t->rows.empty()) return -1;
+  for (uint32_t i = 0; i < t->rows.size(); ++i) {
+    if (i == t->local) continue;
+    if (transport_->open_session(t->rows[i].session) != 0) return int(i) + 1;
   }
   return 0;
 }
 
 int Engine::close_con(uint32_t comm_id) {
-  if (comm_id >= comms_.size() || comms_[comm_id].rows.empty()) return -1;
-  const CommTable& t = comms_[comm_id];
-  for (uint32_t i = 0; i < t.rows.size(); ++i) {
-    if (i == t.local) continue;
+  const CommTable* t = comm_ptr(comm_id);
+  if (!t || t->rows.empty()) return -1;
+  for (uint32_t i = 0; i < t->rows.size(); ++i) {
+    if (i == t->local) continue;
     // closing a never-opened session is not a failure of the teardown
     // sweep (the lazy path may simply never have connected yet)
-    transport_->close_session(t.rows[i].session);
+    transport_->close_session(t->rows[i].session);
   }
   return 0;
 }
@@ -1279,17 +1299,17 @@ int Engine::close_con(uint32_t comm_id) {
 // p2p buffer windows (FPGABufferP2P analog — see engine.hpp)
 // ---------------------------------------------------------------------------
 void Engine::register_p2p(uint64_t addr, uint64_t bytes) {
-  std::lock_guard<std::mutex> g(p2p_mu_);
+  MutexLock g(p2p_mu_);
   p2p_spans_[addr] = bytes;
 }
 
 void Engine::unregister_p2p(uint64_t addr) {
-  std::lock_guard<std::mutex> g(p2p_mu_);
+  MutexLock g(p2p_mu_);
   p2p_spans_.erase(addr);
 }
 
 bool Engine::p2p_covers(uint64_t addr, uint64_t bytes) const {
-  std::lock_guard<std::mutex> g(p2p_mu_);
+  MutexLock g(p2p_mu_);
   auto it = p2p_spans_.upper_bound(addr);
   if (it == p2p_spans_.begin()) return false;
   --it;
@@ -1297,7 +1317,7 @@ bool Engine::p2p_covers(uint64_t addr, uint64_t bytes) const {
 }
 
 uint8_t* Engine::raw_mem(uint64_t addr, uint64_t bytes) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   if (addr & HOST_ADDR_BIT) return nullptr;  // p2p windows are devicemem
   if (addr == 0 || addr + bytes > devicemem_.size()) return nullptr;
   return devicemem_.data() + addr;
@@ -1359,7 +1379,7 @@ void Engine::loop() {
         ab = COMM_ABORTED | RANK_FAILED;
       if (ab) {
         teardown_call(c);
-        std::lock_guard<std::mutex> g(results_mu_);
+        MutexLock g(results_mu_);
         auto& r = results_[c.id];
         r.retcode = ab;
         r.duration_ns = 0.0;
@@ -1379,7 +1399,7 @@ void Engine::loop() {
       uint32_t ret = execute(c);
       retry_idle_sweeps_ = 0;  // a call completed: the world moved
       auto dt = duration_cast<nanoseconds>(steady_clock::now() - t0).count();
-      std::lock_guard<std::mutex> g(results_mu_);
+      MutexLock g(results_mu_);
       auto& r = results_[c.id];
       r.retcode = ret;
       r.duration_ns = double(dt);
@@ -1403,7 +1423,7 @@ void Engine::loop() {
                     int64_t(c.first_try_ns);
       if (waited > timeout_budget().count()) {
         teardown_call(c);
-        std::lock_guard<std::mutex> g(results_mu_);
+        MutexLock g(results_mu_);
         auto& r = results_[c.id];
         r.retcode = sticky_err_ | RECEIVE_TIMEOUT_ERROR;
         r.duration_ns = double(waited);
@@ -1420,9 +1440,9 @@ void Engine::loop() {
         if (c.current_step != step_before) {
           retry_idle_sweeps_ = 0;  // step progress: stay hot
         } else if (++retry_idle_sweeps_ <= 64) {
-          std::this_thread::yield();
+          det_yield();
         } else {
-          std::this_thread::sleep_for(microseconds(
+          det_sleep_for(microseconds(
               std::min<uint32_t>(200, retry_idle_sweeps_ - 64)));
         }
       }
@@ -1442,7 +1462,7 @@ void Engine::loop() {
 // healthy call's completion on the same (comm, src, tag) survives.
 void Engine::teardown_call(CallDesc& c) {
   {
-    std::lock_guard<std::mutex> g(posted_mu_);
+    MutexLock g(posted_mu_);
     for (const auto& k : c.rndzv_posts) {
       posted_.erase(PostedKey{uint32_t(k[0]), uint32_t(k[1]),
                               uint32_t(k[2]), k[3]});
@@ -1555,7 +1575,7 @@ void Engine::dispatch(CallDesc& c, Progress& p) {
     case Op::Combine: {
       Dom d = dom(c);
       uint64_t elems = c.count();
-      std::lock_guard<std::mutex> g(mem_mu_);
+      MutexLock g(mem_mu_);
       uint8_t* a0 = mem(c.addr0(), elems * d.eb(d.op0));
       uint8_t* a1 = mem(c.addr1(), elems * d.eb(d.op1));
       uint8_t* r = mem(c.addr2(), elems * d.eb(d.res));
@@ -1634,7 +1654,7 @@ void Engine::tree_reduce(CallDesc& c, Progress& p, uint32_t root,
                  false);
       step_local(p, [&] {
         Dom d = dom(c);
-        std::lock_guard<std::mutex> g(mem_mu_);
+        MutexLock g(mem_mu_);
         uint8_t* acc = mem(acc_addr, elems * d.eb(acc_c && d.pair));
         uint8_t* tmp = mem(tmp_addr, elems * d.ub);
         reduce_mixed(c, acc, acc_c, tmp, false, acc, acc_c, elems);
@@ -1651,18 +1671,23 @@ void Engine::do_config(CallDesc& c) {
       while (pending_addrs_.try_pop()) {}
       while (completions_.try_pop()) {}
       {
-        std::lock_guard<std::mutex> g(posted_mu_);
+        MutexLock g(posted_mu_);
         posted_.clear();
       }
       {
-        std::lock_guard<std::mutex> g(strm_seq_mu_);
+        MutexLock g(strm_seq_mu_);
         strm_in_seq_.clear();
         strm_holdback_.clear();
       }
       strm_out_seq_.clear();
-      for (auto& t : comms_) {
-        std::fill(t.inbound_seq.begin(), t.inbound_seq.end(), 0);
-        std::fill(t.outbound_seq.begin(), t.outbound_seq.end(), 0);
+      {
+        // the loop thread owns the seq columns, but the pointer vector
+        // itself is cfg_mu_-guarded (a concurrent set_comm may grow it)
+        MutexLock g(cfg_mu_);
+        for (auto& t : comms_) {
+          std::fill(t->inbound_seq.begin(), t->inbound_seq.end(), 0);
+          std::fill(t->outbound_seq.begin(), t->outbound_seq.end(), 0);
+        }
       }
       pkt_enabled_ = false;
       break;
@@ -1688,6 +1713,20 @@ void Engine::do_config(CallDesc& c) {
 // ---------------------------------------------------------------------------
 // helpers
 // ---------------------------------------------------------------------------
+// Stable-pointer fetch: cfg_mu_ guards the pointer vector (growth);
+// the pointee tables are heap-pinned and follow CommTable's per-field
+// ownership discipline, so the returned pointer is usable lock-free
+// for the rest of the call.
+CommTable* Engine::comm_ptr(uint32_t id) const {
+  MutexLock g(cfg_mu_);
+  return id < comms_.size() ? comms_[id].get() : nullptr;
+}
+
+ArithCfgN* Engine::arith_ptr(uint32_t id) const {
+  MutexLock g(cfg_mu_);
+  return id < arithcfgs_.size() ? arithcfgs_[id].get() : nullptr;
+}
+
 // The fallback tables are IMMORTAL by design (leaked, never destroyed):
 // a world the host leaked at interpreter exit still has engine threads
 // running when __cxa_finalize destroys this library's function-local
@@ -1695,12 +1734,14 @@ void Engine::do_config(CallDesc& c) {
 // use-after-free at process exit (the r13 suite-exit segfault class).
 const CommTable& Engine::comm_for(const CallDesc& c) const {
   static const CommTable& empty = *new CommTable();
-  return c.comm() < comms_.size() ? comms_[c.comm()] : empty;
+  const CommTable* t = comm_ptr(c.comm());
+  return t ? *t : empty;
 }
 
 const ArithCfgN& Engine::arith_for(const CallDesc& c) const {
   static const ArithCfgN& dflt = *new ArithCfgN();
-  return c.arithcfg() < arithcfgs_.size() ? arithcfgs_[c.arithcfg()] : dflt;
+  const ArithCfgN* a = arith_ptr(c.arithcfg());
+  return a ? *a : dflt;
 }
 
 uint64_t Engine::elem_bytes(const CallDesc& c) const {
@@ -1822,7 +1863,7 @@ bool Engine::drain_krnl_to(uint64_t addr, uint64_t bytes) {
     }
     uint64_t n = std::min<uint64_t>(v->size(), bytes - off);
     if (v->size() > bytes - off) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
-    std::lock_guard<std::mutex> g(mem_mu_);
+    MutexLock g(mem_mu_);
     if (n) std::memcpy(mem(addr + off, n), v->data(), n);
     off += n;
   }
@@ -1832,7 +1873,7 @@ bool Engine::drain_krnl_to(uint64_t addr, uint64_t bytes) {
 void Engine::push_local_stream(uint32_t strm, uint64_t addr, uint64_t bytes) {
   std::vector<uint8_t> out;
   {
-    std::lock_guard<std::mutex> g(mem_mu_);
+    MutexLock g(mem_mu_);
     uint8_t* p = mem(addr, bytes);
     out.assign(p, p + bytes);
   }
@@ -1840,7 +1881,7 @@ void Engine::push_local_stream(uint32_t strm, uint64_t addr, uint64_t bytes) {
 }
 
 uint32_t Engine::local_copy(uint64_t src, uint64_t dst, uint64_t bytes) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   uint8_t* s = mem(src, bytes);
   uint8_t* d = mem(dst, bytes);
   std::memmove(d, s, bytes);
@@ -1855,7 +1896,7 @@ uint32_t Engine::local_move(const CallDesc& c, uint64_t src, uint64_t dst,
   Dom d = dom(c);
   src_c = src_c && d.pair;
   dst_c = dst_c && d.pair;
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   uint8_t* s = mem(src, elems * d.eb(src_c));
   uint8_t* t = mem(dst, elems * d.eb(dst_c));
   convert_elems(d, s, src_c, t, dst_c, elems);
@@ -1864,7 +1905,7 @@ uint32_t Engine::local_move(const CallDesc& c, uint64_t src, uint64_t dst,
 
 uint32_t Engine::local_reduce(uint32_t lane, uint64_t a, uint64_t b,
                               uint64_t dst, uint64_t bytes) {
-  std::lock_guard<std::mutex> g(mem_mu_);
+  MutexLock g(mem_mu_);
   uint8_t* pa = mem(a, bytes);
   uint8_t* pb = mem(b, bytes);
   uint8_t* pd = mem(dst, bytes);
@@ -1878,7 +1919,9 @@ uint32_t Engine::local_reduce(uint32_t lane, uint64_t a, uint64_t b,
 void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
                         uint64_t elems, bool from_stream, uint32_t to_strm,
                         uint32_t comp) {
-  CommTable& t = comms_[c.comm()];
+  // loop() already finalized calls on unknown/placeholder comms, so the
+  // fetch cannot miss here (same contract the old direct index relied on)
+  CommTable& t = *comm_ptr(c.comm());
   Dom d = dom(c);
   bool src_c = d.pair && (comp & OP0_COMPRESSED) && !from_stream;
   bool wire_c = d.pair && (comp & ETH_COMPRESSED);
@@ -1914,7 +1957,7 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
         msg.payload = std::move(packed);
       }
     } else {
-      std::lock_guard<std::mutex> g(mem_mu_);
+      MutexLock g(mem_mu_);
       uint8_t* p = mem(addr + off * d.eb(src_c), chunk * d.eb(src_c));
       msg.payload.resize(chunk * d.eb(wire_c));
       if (convert_elems(d, p, src_c, msg.payload.data(), wire_c, chunk))
@@ -1960,7 +2003,7 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
 std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
                                                    uint32_t tag,
                                                    int* evicted_out) {
-  CommTable& t = comms_[c.comm()];
+  CommTable& t = *comm_ptr(c.comm());
   auto budget = timeout_budget();
   auto deadline = steady_clock::now() + budget;
   uint32_t retry_max = retrans_enabled() ? retry_max_.load() : 0;
@@ -2027,7 +2070,7 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
 void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
                         uint64_t elems, RecvMode mode, uint32_t strm,
                         uint32_t comp) {
-  CommTable& t = comms_[c.comm()];
+  CommTable& t = *comm_ptr(c.comm());
   Dom d = dom(c);
   bool dst_c = d.pair && (comp & RES_COMPRESSED) && mode != RecvMode::STREAM;
   bool wire_c = d.pair && (comp & ETH_COMPRESSED);
@@ -2118,7 +2161,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     uint64_t n = std::min(got_elems, chunk);
     switch (mode) {
       case RecvMode::COPY: {
-        std::lock_guard<std::mutex> g(mem_mu_);
+        MutexLock g(mem_mu_);
         uint8_t* dst = mem(addr + off * d.eb(dst_c), n * d.eb(dst_c));
         convert_elems(d, data, got_c, dst, dst_c, n);
         break;
@@ -2127,7 +2170,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
         // fused recv-reduce: the wire payload is OP1, the accumulator at
         // addr is OP0 and RES (mixed-precision accumulate per arithcfg;
         // ETH>>2 -> OP1_COMPRESSED shifting, fw :1953-1955)
-        std::lock_guard<std::mutex> g(mem_mu_);
+        MutexLock g(mem_mu_);
         uint8_t* acc = mem(addr + off * d.eb(dst_c), n * d.eb(dst_c));
         reduce_mixed(c, acc, dst_c, data, got_c, acc, dst_c, n);
         break;
@@ -2156,14 +2199,14 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
 void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
                              uint32_t tag, uint64_t addr, uint64_t elems,
                              bool dst_c) {
-  CommTable& t = comms_[c.comm()];
+  CommTable& t = *comm_ptr(c.comm());
   Dom d = dom(c);
   if (p.pending()) {
     // record the wire->landing conversion the depacketizer must apply
     // when the peer's one-sided write arrives; both peers derive the
     // wire representation from their own arithcfg + ETH flag
     {
-      std::lock_guard<std::mutex> g(posted_mu_);
+      MutexLock g(posted_mu_);
       posted_[PostedKey{c.comm(), src, tag, addr}] =
           PostedRndzv{elems, d.eth, dst_c && d.pair, d.comp_kind,
                       uint32_t(d.ub), uint32_t(d.cb)};
@@ -2213,7 +2256,7 @@ void Engine::rndzv_recv(CallDesc& c, Progress& p, uint32_t src, uint32_t tag,
 
 void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
                         uint64_t addr, uint64_t elems, bool src_c) {
-  CommTable& t = comms_[c.comm()];
+  CommTable& t = *comm_ptr(c.comm());
   Dom d = dom(c);
   src_c = src_c && d.pair;
   if (p.pending()) {
@@ -2247,7 +2290,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
       if (peer && peer != this && peer->p2p_covers(a->vaddr, nbytes)) {
         uint8_t* pdata;
         {
-          std::lock_guard<std::mutex> g(mem_mu_);
+          MutexLock g(mem_mu_);
           pdata = mem(addr, nbytes);
         }
         if (sticky_err_ == 0) {
@@ -2278,7 +2321,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
       // ETH flag, same rule as eager); the receiver's depacketizer
       // applies its own wire->landing conversion on arrival — this is
       // the ETH-compressed rendezvous the reference leaves as a TODO
-      std::lock_guard<std::mutex> g(mem_mu_);
+      MutexLock g(mem_mu_);
       uint8_t* pdata = mem(addr, elems * d.eb(src_c));
       msg.payload.resize(elems * d.eb(d.eth));
       // on conversion failure (unknown compressor lane) fall through to
@@ -2420,8 +2463,8 @@ void Engine::coll_gather(CallDesc& c, Progress& p) {
       // the fan-in window caps concurrent inbound writes
       // root-only decision, so cross-rank divergence is impossible, but
       // wire width keeps the threshold meaning consistent with reduce
-      uint32_t fanin = (elems * d.eb(d.eth) > gather_flat_max_count_)
-                           ? gather_flat_max_fanin_
+      uint32_t fanin = (elems * d.eb(d.eth) > gather_flat_max_count_.load())
+                           ? gather_flat_max_fanin_.load()
                            : P - 1;
       fanin = std::max(1u, fanin);
       uint32_t i = 1;
@@ -2565,7 +2608,7 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
         for (uint32_t i = 1; i < P; ++i) {
           rndzv_recv(c, p, (root + i) % P, c.tag(), c.scratch0, elems, false);
           step_local(p, [&] {
-            std::lock_guard<std::mutex> g(mem_mu_);
+            MutexLock g(mem_mu_);
             uint8_t* acc = mem(c.addr2(), elems * d.eb(d.res));
             uint8_t* tmp = mem(c.scratch0, bytes);
             reduce_mixed(c, acc, d.res, tmp, false, acc, d.res, elems);

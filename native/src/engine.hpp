@@ -20,6 +20,13 @@
 namespace accl {
 
 struct CommTable {
+  // Ownership discipline (the TSA annotations on Engine::comms_ lean
+  // on this): size/local/rows are IMMUTABLE after publication —
+  // set_comm builds the whole table before the cfg_mu_-guarded push,
+  // so a published row may be read lock-free from any thread.  The
+  // seq columns are owned by the ENGINE LOOP thread after publication;
+  // the only cross-thread writers (reset_errors, ResetPeriph) run on a
+  // quiesced world by the r10 recovery contract.
   uint32_t size = 0;
   uint32_t local = 0;
   struct Row {
@@ -136,6 +143,13 @@ class Engine {
   std::string dump_rx() const { return rx_.dump(); }
   uint32_t rank() const { return global_rank_; }
 
+  // Deterministic-schedule introspection (ACCL_DETSCHED drills): how
+  // many transport deliveries are executing inside this engine right
+  // now.  The shutdown-vs-traffic drill asserts it is zero after the
+  // transport detached — the invariant the r13 InprocHub::detach drain
+  // establishes (and the ACCL_FAULT_DETACH_RACE build breaks).
+  int ingress_depth() const { return ingress_depth_.load(); }
+
   // ---- wire-protocol correctness surface (r13) ----
   // Feed one raw frame (64-byte WireHeader + payload) through the real
   // ingress classification path, exactly as if the transport delivered
@@ -157,7 +171,7 @@ class Engine {
   // of every MsgType through this before mutating).
   void set_frame_tap(bool on) { tap_on_.store(on); }
   int tap_count() const {
-    std::lock_guard<std::mutex> g(tap_mu_);
+    MutexLock g(tap_mu_);
     return int(tap_frames_.size());
   }
   // Copy frame `idx` (oldest first) into out; returns the frame's full
@@ -329,10 +343,11 @@ class Engine {
   static constexpr size_t kMaxStrmRoutes = 256;
   static constexpr size_t kMaxStrmHoldbackTotal = 1024;
   std::atomic<uint64_t> frames_accepted_{0}, frames_rejected_{0};
+  std::atomic<int> ingress_depth_{0};
   std::atomic<bool> tap_on_{false};
   static constexpr size_t kTapCap = 256;
-  mutable std::mutex tap_mu_;
-  std::deque<std::vector<uint8_t>> tap_frames_;
+  mutable Mutex tap_mu_;
+  std::deque<std::vector<uint8_t>> tap_frames_ ACCL_GUARDED_BY(tap_mu_);
 
   // ---- primitives (firmware primitive layer, fw :533-791) ----
   struct Progress {
@@ -462,20 +477,26 @@ class Engine {
                       const std::vector<uint64_t>& off,
                       const std::vector<uint64_t>& len);
 
-  uint8_t* mem(uint64_t addr, uint64_t n);
+  // Resolve an engine address to backing storage.  REQUIRES(mem_mu_):
+  // every caller stages its copy/convert/reduce under the lock, so the
+  // TSA lane proves no primitive ever touches devicemem/hostmem bytes
+  // without it.
+  uint8_t* mem(uint64_t addr, uint64_t n) ACCL_REQUIRES(mem_mu_);
 
   // ---- state ----
   uint32_t global_rank_;
-  std::vector<uint8_t> devicemem_;
-  std::vector<uint8_t> hostmem_;        // host-only region, lazily committed
-  uint64_t host_region_bytes_ = 0;      // capacity reserved for hostmem_
-  std::map<uint64_t, uint64_t> free_spans_;   // addr -> size
-  std::map<uint64_t, uint64_t> host_spans_;   // untagged addr -> size
-  std::map<uint64_t, uint64_t> alloc_sizes_;  // addr -> size (both spaces)
+  std::vector<uint8_t> devicemem_ ACCL_GUARDED_BY(mem_mu_);
+  std::vector<uint8_t> hostmem_ ACCL_GUARDED_BY(mem_mu_);  // lazily committed
+  uint64_t host_region_bytes_ = 0;  // immutable after the constructor
+  // addr -> size maps for both address spaces
+  std::map<uint64_t, uint64_t> free_spans_ ACCL_GUARDED_BY(mem_mu_);
+  std::map<uint64_t, uint64_t> host_spans_ ACCL_GUARDED_BY(mem_mu_);
+  std::map<uint64_t, uint64_t> alloc_sizes_ ACCL_GUARDED_BY(mem_mu_);
   // LOCK ORDER: mem_mu_ may be taken while holding posted_mu_ (the
   // rendezvous landing path holds posted_mu_ across its payload copy,
   // engine.cpp RndzvsMsg) — NEVER take posted_mu_ while holding mem_mu_.
-  std::mutex mem_mu_;
+  // The ACQUIRED_AFTER edge makes the TSA lane enforce this statically.
+  Mutex mem_mu_ ACCL_ACQUIRED_AFTER(posted_mu_);
 
   // Landing-pad registry for one-sided writes: rndzv_post_addr records
   // the conversion the depacketizer must apply when the peer's write
@@ -492,7 +513,7 @@ class Engine {
     uint32_t ub, cb;  // bytes/element in each representation
   };
   using PostedKey = std::tuple<uint32_t, uint32_t, uint32_t, uint64_t>;
-  std::map<PostedKey, PostedRndzv> posted_;
+  std::map<PostedKey, PostedRndzv> posted_ ACCL_GUARDED_BY(posted_mu_);
   // Shared landing logic for one-sided writes: wire ingress (RndzvsMsg)
   // and the direct p2p path both run exactly this (consume posted
   // record under posted_mu_, convert/copy under mem_mu_, surface the
@@ -501,13 +522,14 @@ class Engine {
                       uint64_t payload_bytes);
 
   // p2p window registry + peer resolution (see public section)
-  mutable std::mutex p2p_mu_;
-  std::map<uint64_t, uint64_t> p2p_spans_;  // addr -> bytes
+  mutable Mutex p2p_mu_;
+  std::map<uint64_t, uint64_t> p2p_spans_ ACCL_GUARDED_BY(p2p_mu_);
+  // set once at world wiring, before traffic (no guard needed)
   std::function<Engine*(uint32_t session)> peer_hook_;
   std::atomic<uint64_t> tx_msgs_{0}, tx_payload_bytes_{0};
   // LOCK ORDER: posted_mu_ comes BEFORE mem_mu_ (see mem_mu_ above);
   // acquiring posted_mu_ under mem_mu_ would invert the order = deadlock.
-  std::mutex posted_mu_;
+  Mutex posted_mu_;
 
   std::unique_ptr<Transport> transport_;
   //: pending one-shot egress fault (0 = none); see inject_fault()
@@ -534,9 +556,9 @@ class Engine {
     Message msg;
   };
   static constexpr size_t kRetransCap = 1024;
-  std::vector<RetransSlot> retrans_ring_;
-  size_t retrans_pos_ = 0;
-  std::mutex retrans_mu_;
+  std::vector<RetransSlot> retrans_ring_ ACCL_GUARDED_BY(retrans_mu_);
+  size_t retrans_pos_ ACCL_GUARDED_BY(retrans_mu_) = 0;
+  Mutex retrans_mu_;
   std::atomic<uint32_t> retry_max_{4};
   std::atomic<uint32_t> retry_base_us_{200};
   std::atomic<uint64_t> retrans_sent_{0}, nacks_tx_{0}, nacks_rx_{0};
@@ -571,8 +593,9 @@ class Engine {
   void handle_abort(const WireHeader& hdr);
 
   // ---- liveness (resilience layer 3) ----
-  mutable std::mutex live_mu_;
-  std::map<std::pair<uint32_t, uint32_t>, uint64_t> last_heard_ns_;
+  mutable Mutex live_mu_;
+  std::map<std::pair<uint32_t, uint32_t>, uint64_t> last_heard_ns_
+      ACCL_GUARDED_BY(live_mu_);
   void note_alive(uint32_t comm, uint32_t src);
 
   // ---- elastic membership (r11): join control plane ----
@@ -588,8 +611,8 @@ class Engine {
     uint32_t corrupt_ppm = 0;
     uint64_t rng = 0x9E3779B97F4A7C15ull;
   };
-  Chaos chaos_;
-  std::mutex chaos_mu_;
+  Chaos chaos_ ACCL_GUARDED_BY(chaos_mu_);
+  Mutex chaos_mu_;
   std::atomic<uint32_t> slow_us_{0};
   std::atomic<bool> killed_{false};
   uint32_t chaos_draw();  // fault kind for this message (0 = none)
@@ -599,11 +622,11 @@ class Engine {
     uint32_t session;
     Message msg;
   };
-  std::deque<Delayed> delayed_;
-  std::mutex delay_mu_;
-  std::condition_variable delay_cv_;
-  bool delay_running_ = true;  // guarded by delay_mu_
-  std::thread delay_thread_;
+  std::deque<Delayed> delayed_ ACCL_GUARDED_BY(delay_mu_);
+  Mutex delay_mu_;
+  CondVar delay_cv_;
+  bool delay_running_ ACCL_GUARDED_BY(delay_mu_) = true;
+  Thread delay_thread_;
   void delay_loop();
 
   // ---- egress pipeline: bounded outstanding-segment window ----
@@ -617,17 +640,18 @@ class Engine {
   // ccl_offload_control.c:628-649, :1981-1986).
   void egress_loop();
   void stage_egress(uint32_t session, Message&& msg);
-  std::deque<std::pair<uint32_t, Message>> egress_q_;
-  std::mutex egress_mu_;
-  std::condition_variable egress_cv_;
+  std::deque<std::pair<uint32_t, Message>> egress_q_ ACCL_GUARDED_BY(egress_mu_);
+  Mutex egress_mu_;
+  CondVar egress_cv_;
   std::atomic<uint32_t> pipeline_depth_{3};
-  bool egress_running_ = true;  // guarded by egress_mu_
-  std::thread egress_thread_;
+  bool egress_running_ ACCL_GUARDED_BY(egress_mu_) = true;
+  Thread egress_thread_;
   RxPool rx_;
   Fifo<RndzvAddr> pending_addrs_;
   Fifo<RndzvDone> completions_;
-  std::map<uint32_t, std::shared_ptr<Fifo<std::vector<uint8_t>>>> streams_;
-  std::mutex streams_mu_;
+  std::map<uint32_t, std::shared_ptr<Fifo<std::vector<uint8_t>>>> streams_
+      ACCL_GUARDED_BY(streams_mu_);
+  Mutex streams_mu_;
 
   // Stream-destined messages bypass the rx pool, so they carry their own
   // per-(comm, peer, stream) sequence space and ingress resequences them
@@ -639,14 +663,27 @@ class Engine {
   //: rung declares the gap a loss hole and resyncs (bounds holdback)
   static constexpr size_t kStrmHoldbackLimit = 64;
   std::map<StrmKey, uint32_t> strm_out_seq_;  // engine loop thread only
-  std::map<StrmKey, uint32_t> strm_in_seq_;
-  std::map<std::pair<StrmKey, uint32_t>, std::vector<uint8_t>> strm_holdback_;
-  std::mutex strm_seq_mu_;
+  std::map<StrmKey, uint32_t> strm_in_seq_ ACCL_GUARDED_BY(strm_seq_mu_);
+  std::map<std::pair<StrmKey, uint32_t>, std::vector<uint8_t>> strm_holdback_
+      ACCL_GUARDED_BY(strm_seq_mu_);
+  Mutex strm_seq_mu_;
   Fifo<std::vector<uint8_t>> krnl_in_;
 
-  std::vector<CommTable> comms_;
-  std::vector<ArithCfgN> arithcfgs_;
-  mutable std::mutex cfg_mu_;
+  // Communicator/arithcfg tables as stable heap pointers: cfg_mu_
+  // guards the pointer VECTORS (growth by set_comm / join padding);
+  // the pointees are never moved, so the engine loop fetches a row
+  // pointer once under the lock and then uses it lock-free for the
+  // whole call under CommTable's per-field ownership discipline.
+  // (Before r14 these were value vectors whose safety hung on a
+  // reserve(64) never-reallocate convention the analysis could not
+  // see; the pointer indirection makes the guarded structure explicit
+  // AND lifts the hard 64-comm growth ceiling.)
+  std::vector<std::unique_ptr<CommTable>> comms_ ACCL_GUARDED_BY(cfg_mu_);
+  std::vector<std::unique_ptr<ArithCfgN>> arithcfgs_ ACCL_GUARDED_BY(cfg_mu_);
+  mutable Mutex cfg_mu_;
+  // stable-pointer fetch (nullptr when out of range); see comms_ above
+  CommTable* comm_ptr(uint32_t id) const;
+  ArithCfgN* arith_ptr(uint32_t id) const;
 
   std::atomic<bool> lossy_transport_{false};
   uint64_t timeout_ = 1'000'000;  // in emulated cycles; 1 cycle = 1us here
@@ -676,11 +713,15 @@ class Engine {
   void set_tuning(uint32_t key, uint32_t value);
 
  private:
-  uint32_t bcast_flat_max_ranks_ = 4;
-  uint32_t reduce_flat_max_ranks_ = 4;
-  uint32_t gather_flat_max_fanin_ = 64;
-  uint64_t gather_flat_max_count_ = 32 * 1024;  // bytes (accl.cpp:1216-1217)
-  uint64_t reduce_flat_max_count_ = 32 * 1024;  // bytes (accl.cpp:1222-1224)
+  // tuning registers: written by the host thread (set_tuning) while
+  // the engine loop reads them mid-schedule — atomics, like
+  // pipeline_depth_, so the live-write is well-defined on every lane
+  std::atomic<uint32_t> bcast_flat_max_ranks_{4};
+  std::atomic<uint32_t> reduce_flat_max_ranks_{4};
+  std::atomic<uint32_t> gather_flat_max_fanin_{64};
+  // byte thresholds (accl.cpp:1216-1224)
+  std::atomic<uint64_t> gather_flat_max_count_{32 * 1024};
+  std::atomic<uint64_t> reduce_flat_max_count_{32 * 1024};
 
   // ---- persistent-plan storage (see plan_create/plan_replay) ----
   struct EnginePlan {
@@ -688,10 +729,14 @@ class Engine {
     std::vector<std::pair<uint32_t, uint32_t>> comm_epochs;  // at arm
     bool valid = true;
   };
-  std::vector<EnginePlan> plans_;
-  std::map<long long, std::vector<uint64_t>> plan_tokens_;  // -> call ids
-  long long next_plan_token_ = 1;
-  mutable std::mutex plans_mu_;
+  std::vector<EnginePlan> plans_ ACCL_GUARDED_BY(plans_mu_);
+  // token -> call ids
+  std::map<long long, std::vector<uint64_t>> plan_tokens_
+      ACCL_GUARDED_BY(plans_mu_);
+  long long next_plan_token_ ACCL_GUARDED_BY(plans_mu_) = 1;
+  // LOCK ORDER: plans_mu_ before results_mu_ (the replay token reaper
+  // scans results under both); never the inverse.
+  mutable Mutex plans_mu_ ACCL_ACQUIRED_BEFORE(results_mu_);
 
   Fifo<CallDesc> cmd_q_;
   std::deque<CallDesc> retry_q_;  // firmware retry FIFO (fw :2460-2479)
@@ -699,19 +744,14 @@ class Engine {
   //: loop(): yield first, escalate to a bounded sleep (engine thread
   //: only — no locking needed)
   uint32_t retry_idle_sweeps_ = 0;
-  std::map<uint64_t, CallResult> results_;
-  std::mutex results_mu_;
-  std::condition_variable results_cv_;
+  std::map<uint64_t, CallResult> results_ ACCL_GUARDED_BY(results_mu_);
+  Mutex results_mu_;
   std::atomic<uint64_t> next_call_id_{1};
-  uint32_t sticky_err_ = 0;  // per-call error accumulator
+  uint32_t sticky_err_ = 0;  // per-call error accumulator (loop thread only)
 
-  std::thread loop_thread_;
+  Thread loop_thread_;
   std::atomic<bool> running_{true};
   std::atomic<bool> stopped_{false};  // shutdown() ran to completion
-
-  // scratch for fused recv-reduce chains (plays the role of the spare
-  // rendezvous buffers SPARE1-3, accl.cpp:1190-1212)
-  std::vector<uint8_t> scratch_a_, scratch_b_;
 };
 
 }  // namespace accl

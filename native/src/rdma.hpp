@@ -51,13 +51,13 @@ class RdmaHub {
   void detach(int rank) {
     msg_plane_.detach(rank);
     auto& st = mem_states_[rank];
-    std::unique_lock<std::mutex> g(st.mu);
+    UniqueLock g(st.mu);
     st.sink = nullptr;
-    st.cv.wait(g, [&] { return !st.delivering; });
+    st.cv.wait(g, [&]() ACCL_REQUIRES(st.mu) { return !st.delivering; });
   }
   void attach_mem(int rank, Transport::Sink sink) {
     auto& st = mem_states_[rank];
-    std::lock_guard<std::mutex> g(st.mu);
+    MutexLock g(st.mu);
     st.sink = std::move(sink);
   }
 
@@ -70,7 +70,7 @@ class RdmaHub {
     if (dst >= mem_states_.size()) return;
     auto& st = mem_states_[dst];
     {
-      std::lock_guard<std::mutex> g(st.mu);
+      MutexLock g(st.mu);
       st.q.push_back(std::move(msg));
     }
     st.cv.notify_one();
@@ -78,11 +78,11 @@ class RdmaHub {
 
  private:
   struct MemState {
-    std::mutex mu;
-    std::condition_variable cv;
-    std::deque<Message> q;
-    Transport::Sink sink;
-    bool delivering = false;
+    Mutex mu;
+    CondVar cv;
+    std::deque<Message> q ACCL_GUARDED_BY(mu);
+    Transport::Sink sink ACCL_GUARDED_BY(mu);
+    bool delivering ACCL_GUARDED_BY(mu) = false;
   };
 
   void mem_worker(int rank) {
@@ -91,9 +91,11 @@ class RdmaHub {
       Message msg;
       Transport::Sink sink;
       {
-        std::unique_lock<std::mutex> g(st.mu);
+        UniqueLock g(st.mu);
         cv_wait_for_pred(st.cv, g, std::chrono::milliseconds(50),
-                         [&] { return !st.q.empty() || !running_; });
+                         [&]() ACCL_REQUIRES(st.mu) {
+                           return !st.q.empty() || !running_;
+                         });
         if (st.q.empty()) {
           if (!running_) return;
           continue;
@@ -106,7 +108,7 @@ class RdmaHub {
       if (!sink) continue;
       sink(std::move(msg));
       {
-        std::lock_guard<std::mutex> g(st.mu);
+        MutexLock g(st.mu);
         st.delivering = false;
       }
       st.cv.notify_all();
@@ -115,7 +117,7 @@ class RdmaHub {
 
   InprocHub msg_plane_;
   std::vector<MemState> mem_states_;
-  std::vector<std::thread> mem_workers_;
+  std::vector<Thread> mem_workers_;  // det-managed, like the dgram workers
   std::atomic<bool> running_{true};
 };
 
@@ -131,17 +133,22 @@ class RdmaTransport : public Transport {
   }
 
   void send(uint32_t dst, Message&& msg) override {
-    if (dst >= qps_.size()) return;  // bad session id: drop, like the hubs
-    if (msg.hdr.msg_type == uint8_t(MsgType::RndzvsMsg)) {
-      // one-sided WRITE on the memory plane: SQ/CQ accounting, then
-      // out-of-band delivery that may overtake ordered traffic
-      {
-        std::lock_guard<std::mutex> g(qp_mu_);
+    {
+      // the bounds read rides the same lock as the accounting (the
+      // table never resizes after the constructor, but the analysis —
+      // rightly — wants one discipline, not a prose argument)
+      MutexLock g(qp_mu_);
+      if (dst >= qps_.size()) return;  // bad session id: drop, like the hubs
+      if (msg.hdr.msg_type == uint8_t(MsgType::RndzvsMsg)) {
+        // one-sided WRITE on the memory plane: SQ/CQ accounting, then
+        // out-of-band delivery that may overtake ordered traffic
         auto& qp = qps_[dst];
         qp.sq_posted++;
         qp.bytes_written += msg.payload.size();
         qp.cq_completed++;  // local completion: buffer ownership returns
       }
+    }
+    if (msg.hdr.msg_type == uint8_t(MsgType::RndzvsMsg)) {
       hub_->post_write(dst, std::move(msg));
       return;
     }
@@ -158,7 +165,7 @@ class RdmaTransport : public Transport {
   void stop() override { hub_->detach(rank_); }
 
   std::string dump_qps() const {
-    std::lock_guard<std::mutex> g(qp_mu_);
+    MutexLock g(qp_mu_);
     std::string out = "queue pairs (rank " + std::to_string(rank_) + "):\n";
     for (const auto& qp : qps_) {
       out += "  -> " + std::to_string(qp.peer) +
@@ -172,8 +179,8 @@ class RdmaTransport : public Transport {
  private:
   std::shared_ptr<RdmaHub> hub_;
   int rank_;
-  mutable std::mutex qp_mu_;
-  std::vector<QueuePair> qps_;
+  mutable Mutex qp_mu_;
+  std::vector<QueuePair> qps_ ACCL_GUARDED_BY(qp_mu_);
 };
 
 }  // namespace accl

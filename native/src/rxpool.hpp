@@ -32,10 +32,10 @@ class RxPool {
   enum class Status : uint8_t { IDLE = 0, RESERVED = 1 };
 
   void configure(uint32_t nbufs, uint64_t bufsize) {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     bufs_.assign(nbufs, std::vector<uint8_t>(bufsize));
     status_.assign(nbufs, Status::IDLE);
-    bufsize_ = bufsize;
+    bufsize_.store(bufsize);
     // The transport (and ingress) is live from engine construction, so a
     // peer racing ahead through bring-up can deliver BEFORE this pool is
     // configured; those deposits staged against zero buffers and — with
@@ -51,12 +51,12 @@ class RxPool {
     }
   }
 
-  uint64_t buf_size() const { return bufsize_; }
+  uint64_t buf_size() const { return bufsize_.load(); }
 
   // Ingress path (called from the transport sink).
   void deposit(Message&& msg) {
     {
-      std::lock_guard<std::mutex> g(m_);
+      MutexLock g(m_);
       int idx = find_idle_locked();
       if (idx >= 0) {
         install_locked(uint32_t(idx), msg);
@@ -179,14 +179,14 @@ class RxPool {
       if (!n) break;
       release(n->index);
     }
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     staging_.clear();
     std::fill(status_.begin(), status_.end(), Status::IDLE);
   }
 
   // Is at least one buffer IDLE right now?  (pressure probe)
   bool has_idle() const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     for (auto s : status_)
       if (s == Status::IDLE) return true;
     return false;
@@ -214,12 +214,21 @@ class RxPool {
     }
   }
 
-  const uint8_t* data(uint32_t index) const { return bufs_[index].data(); }
+  // Pointer into a RESERVED buffer: contents are stable until the
+  // caller release()s the index, and the buffer table itself only
+  // changes in configure() (bring-up, before traffic) — the lock here
+  // covers the table lookup, the returned pointer rides the RESERVED
+  // guarantee (pre-r14 this read the table bare, which a configure()
+  // racing live traffic could have invalidated mid-copy).
+  const uint8_t* data(uint32_t index) const {
+    MutexLock g(m_);
+    return bufs_[index].data();
+  }
 
   // Release a buffer back to IDLE and pull one staged message in
   // (rxbuf_seek release path + re-enqueue).
   void release(uint32_t index) {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     status_[index] = Status::IDLE;
     if (!staging_.empty()) {
       Message msg = std::move(staging_.front());
@@ -229,9 +238,9 @@ class RxPool {
   }
 
   std::string dump() const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     std::string out = "rx pool: " + std::to_string(bufs_.size()) + " x " +
-                      std::to_string(bufsize_) + "B, " +
+                      std::to_string(bufsize_.load()) + "B, " +
                       std::to_string(staging_.size()) + " staged, " +
                       std::to_string(notif_.size()) + " pending\n";
     for (size_t i = 0; i < bufs_.size(); ++i) {
@@ -242,13 +251,13 @@ class RxPool {
   }
 
  private:
-  int find_idle_locked() {
+  int find_idle_locked() ACCL_REQUIRES(m_) {
     for (size_t i = 0; i < status_.size(); ++i)
       if (status_[i] == Status::IDLE) return int(i);
     return -1;
   }
 
-  void install_locked(uint32_t idx, Message& msg) {
+  void install_locked(uint32_t idx, Message& msg) ACCL_REQUIRES(m_) {
     status_[idx] = Status::RESERVED;
     size_t n = std::min<size_t>(msg.payload.size(), bufs_[idx].size());
     if (n) std::memcpy(bufs_[idx].data(), msg.payload.data(), n);
@@ -263,12 +272,12 @@ class RxPool {
     notif_.push(note);
   }
 
-  mutable std::mutex m_;
-  std::vector<std::vector<uint8_t>> bufs_;
-  std::vector<Status> status_;
-  std::deque<Message> staging_;
-  Fifo<RxNotification> notif_;
-  uint64_t bufsize_ = 0;
+  mutable Mutex m_;
+  std::vector<std::vector<uint8_t>> bufs_ ACCL_GUARDED_BY(m_);
+  std::vector<Status> status_ ACCL_GUARDED_BY(m_);
+  std::deque<Message> staging_ ACCL_GUARDED_BY(m_);
+  Fifo<RxNotification> notif_;  // internally locked
+  std::atomic<uint64_t> bufsize_{0};  // hot-path read (frame_ok, eager segmentation)
 };
 
 }  // namespace accl

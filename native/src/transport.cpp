@@ -69,6 +69,7 @@ void TcpTransport::start(Sink sink) {
     throw std::runtime_error("TcpTransport: bind failed on port " +
                              std::to_string(base_port_ + rank_));
   ::listen(listen_fd_, nranks_ + 4);
+  MutexLock g(conn_mu_);
   threads_.emplace_back([this] { accept_loop(); });
 }
 
@@ -97,7 +98,7 @@ void TcpTransport::stop() {
     // the closing window can no longer emplace into the vector we are
     // iterating (accept_loop re-checks running_ under the same lock
     // and closes the fd instead).
-    std::lock_guard<std::mutex> g(conn_mu_);
+    MutexLock g(conn_mu_);
     for (int fd : accepted_fds_) ::shutdown(fd, SHUT_RDWR);
     to_join.swap(threads_);
   }
@@ -114,7 +115,7 @@ void TcpTransport::accept_loop() {
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::lock_guard<std::mutex> g(conn_mu_);
+    MutexLock g(conn_mu_);
     if (!running_) {  // raced with stop(): the join sweep already ran
       ::close(fd);
       break;
@@ -139,7 +140,7 @@ void TcpTransport::reader_loop(int fd) {
   }
   {
     // deregister before close so stop() never shuts down a recycled fd
-    std::lock_guard<std::mutex> g(conn_mu_);
+    MutexLock g(conn_mu_);
     for (auto it = accepted_fds_.begin(); it != accepted_fds_.end(); ++it)
       if (*it == fd) {
         accepted_fds_.erase(it);
@@ -177,7 +178,7 @@ int TcpTransport::connect_to(uint32_t dst, int max_attempts) {
 
 int TcpTransport::open_session(uint32_t dst) {
   if (dst >= peer_fds_.size()) return -1;
-  std::lock_guard<std::mutex> g(peer_mu_[dst]);
+  MutexLock g(peer_mu_[dst]);
   if (peer_fds_[dst] >= 0) return 0;  // already open: success no-op
   peer_fds_[dst] = connect_to(dst, /*max_attempts=*/80);  // ~2 s window
   return peer_fds_[dst] >= 0 ? 0 : -1;
@@ -185,7 +186,7 @@ int TcpTransport::open_session(uint32_t dst) {
 
 int TcpTransport::close_session(uint32_t dst) {
   if (dst >= peer_fds_.size()) return -1;
-  std::lock_guard<std::mutex> g(peer_mu_[dst]);
+  MutexLock g(peer_mu_[dst]);
   if (peer_fds_[dst] < 0) return -1;  // nothing open on this session
   ::shutdown(peer_fds_[dst], SHUT_RDWR);
   ::close(peer_fds_[dst]);
@@ -194,7 +195,7 @@ int TcpTransport::close_session(uint32_t dst) {
 }
 
 void TcpTransport::send(uint32_t dst, Message&& msg) {
-  std::lock_guard<std::mutex> g(peer_mu_[dst]);
+  MutexLock g(peer_mu_[dst]);
   if (peer_fds_[dst] < 0) {
     peer_fds_[dst] = connect_to(dst);
     if (peer_fds_[dst] < 0)

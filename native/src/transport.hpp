@@ -72,31 +72,38 @@ class InprocHub {
   // so a survivor's early message to the joiner is dropped — exactly a
   // not-yet-listening process — rather than out-of-bounds.
   int add_rank() {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     slots_.push_back(std::make_unique<Slot>());
     return int(slots_.size()) - 1;
   }
   int size() const {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     return int(slots_.size());
   }
   void attach(int rank, Transport::Sink sink) {
-    std::lock_guard<std::mutex> g(m_);
+    MutexLock g(m_);
     slots_[size_t(rank)]->sink = std::move(sink);
   }
   void detach(int rank) {
-    std::unique_lock<std::mutex> g(m_);
+    UniqueLock g(m_);
     Slot& s = *slots_[size_t(rank)];
     s.sink = nullptr;
+#if !defined(ACCL_FAULT_DETACH_RACE)
     // wait out in-flight deliveries: a sender thread that copied the
     // sink may be mid-call into the engine being detached
-    s.cv.wait(g, [&] { return s.inflight == 0; });
+    s.cv.wait(g, [&]() ACCL_REQUIRES(m_) { return s.inflight == 0; });
+#endif
+    // ACCL_FAULT_DETACH_RACE reverts the r13 TSan fix: detach returns
+    // while a peer thread may still be mid-delivery into the detached
+    // engine.  Compile-time fault seed for the model checker's
+    // sensitivity drill (scripts/model_check.py --drill detach_race
+    // must REDISCOVER this interleaving; docs/static_analysis.md).
   }
   void deliver(uint32_t dst, Message&& msg) {
     Slot* s = nullptr;
     Transport::Sink sink;
     {
-      std::lock_guard<std::mutex> g(m_);
+      MutexLock g(m_);
       if (dst < slots_.size() && slots_[dst]->sink) {
         s = slots_[dst].get();
         sink = s->sink;
@@ -106,7 +113,7 @@ class InprocHub {
     if (!sink) return;
     sink(std::move(msg));
     {
-      std::lock_guard<std::mutex> g(m_);
+      MutexLock g(m_);
       --s->inflight;
     }
     s->cv.notify_all();
@@ -114,14 +121,18 @@ class InprocHub {
 
  private:
   // unique_ptr slots: add_rank must not move live Slot objects (their
-  // cv/mutex state is waited on) when the vector grows
+  // cv state is waited on) when the vector grows.  sink/inflight are
+  // guarded by the hub's m_ (a nested type cannot name the enclosing
+  // instance's capability in a GUARDED_BY, so the discipline is
+  // documented here and enforced by deliver()/attach()/detach() all
+  // locking m_).
   struct Slot {
     Transport::Sink sink;
     int inflight = 0;  // guarded by m_
-    std::condition_variable cv;
+    CondVar cv;
   };
-  mutable std::mutex m_;
-  std::vector<std::unique_ptr<Slot>> slots_;
+  mutable Mutex m_;
+  std::vector<std::unique_ptr<Slot>> slots_ ACCL_GUARDED_BY(m_);
 };
 
 class InprocTransport : public Transport {
@@ -166,13 +177,19 @@ class TcpTransport : public Transport {
   int rank_, nranks_, base_port_;
   std::vector<std::string> peer_ips_;
   int listen_fd_ = -1;
+  // peer_fds_[d] is guarded by peer_mu_[d] (per-element locking the
+  // analysis cannot express on a dynamic array; the pairing is local
+  // to open_session/close_session/send)
   std::vector<int> peer_fds_;       // lazily-opened outbound sockets
-  std::vector<std::mutex> peer_mu_; // serialize writes per peer
-  Sink sink_;
+  std::vector<Mutex> peer_mu_;      // serialize writes per peer
+  Sink sink_;  // set once in start(), before any reader thread exists
   std::atomic<bool> running_{false};
-  std::vector<std::thread> threads_;
-  std::mutex conn_mu_;
-  std::vector<int> accepted_fds_;  // live inbound sockets (conn_mu_)
+  // Deliberately std::thread, not accl::Thread: these block in
+  // accept(2)/read(2), which the deterministic scheduler cannot
+  // virtualize — TCP worlds are out of detsched drills' scope.
+  std::vector<std::thread> threads_ ACCL_GUARDED_BY(conn_mu_);
+  Mutex conn_mu_;
+  std::vector<int> accepted_fds_ ACCL_GUARDED_BY(conn_mu_);
 };
 
 }  // namespace accl

@@ -1,0 +1,142 @@
+// Deterministic schedule-exploration driver (ACCL_DETSCHED builds).
+//
+// CLI over the drills in detsched_drills.hpp and the explorer in
+// src/detsched.hpp; scripts/model_check.py is the orchestration layer
+// (build, sweep, artifacts, CI budgets).  One JSON result line per
+// invocation on stdout — everything else goes to stderr.
+//
+//   --drill NAME            which drill (see --list)
+//   --explore N             bounded exploration, at most N schedules
+//   --schedule HEX          run exactly one schedule (artifact replay)
+//   --seed S                default-policy seed (part of the artifact)
+//   --pbound K              preemption bound (default 3)
+//   --max-steps N           per-run scheduling-step budget
+//   --budget-s S            wall-clock budget for the exploration
+//   --expect-finding        exit 0 iff a finding WAS discovered
+//                           (sensitivity runs under the fault build)
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "detsched_drills.hpp"
+
+using accl::det::ExploreOpts;
+using accl::det::ExploreStats;
+using accl::det::RunResult;
+using accl::det::Sched;
+
+static std::string hex_encode(const std::vector<uint8_t>& v) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  out.reserve(v.size() * 2);
+  for (uint8_t b : v) {
+    out.push_back(d[b >> 4]);
+    out.push_back(d[b & 15]);
+  }
+  return out;
+}
+
+static std::vector<uint8_t> hex_decode(const std::string& s) {
+  std::vector<uint8_t> out;
+  for (size_t i = 0; i + 1 < s.size(); i += 2)
+    out.push_back(uint8_t(std::stoul(s.substr(i, 2), nullptr, 16)));
+  return out;
+}
+
+static std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out.push_back('\\');
+    if (c == '\n') {
+      out += "\\n";
+      continue;
+    }
+    out.push_back(c);
+  }
+  return out;
+}
+
+int main(int argc, char** argv) {
+  std::string drill, schedule_hex;
+  ExploreOpts opts;
+  bool expect_finding = false, do_explore = false, do_replay = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "missing value for %s\n", a.c_str());
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--drill") {
+      drill = next();
+    } else if (a == "--explore") {
+      do_explore = true;
+      opts.max_runs = std::strtoull(next(), nullptr, 10);
+    } else if (a == "--schedule") {
+      do_replay = true;
+      schedule_hex = next();
+    } else if (a == "--seed") {
+      opts.seed = std::strtoull(next(), nullptr, 10);
+    } else if (a == "--pbound") {
+      opts.preempt_bound = std::atoi(next());
+    } else if (a == "--max-steps") {
+      opts.max_steps = std::strtoull(next(), nullptr, 10);
+    } else if (a == "--budget-s") {
+      opts.budget_s = std::atof(next());
+    } else if (a == "--expect-finding") {
+      expect_finding = true;
+    } else if (a == "--list") {
+      for (const auto& [name, fn] : accl::drills::registry()) {
+        (void)fn;
+        std::printf("%s\n", name.c_str());
+      }
+      return 0;
+    } else {
+      std::fprintf(stderr, "unknown arg %s\n", a.c_str());
+      return 2;
+    }
+  }
+  const auto& reg = accl::drills::registry();
+  auto it = reg.find(drill);
+  if (it == reg.end()) {
+    std::fprintf(stderr, "unknown drill '%s' (see --list)\n", drill.c_str());
+    return 2;
+  }
+  const auto& fn = it->second;
+
+  if (do_replay) {
+    RunResult r =
+        Sched::inst().run(hex_decode(schedule_hex), opts.seed, opts.max_steps, fn);
+    std::printf(
+        "{\"drill\":\"%s\",\"mode\":\"replay\",\"failed\":%s,"
+        "\"what\":\"%s\",\"steps\":%llu,\"seed\":%llu}\n",
+        drill.c_str(), r.failed ? "true" : "false",
+        json_escape(r.what).c_str(), (unsigned long long)r.steps,
+        (unsigned long long)opts.seed);
+    bool as_expected = expect_finding ? r.failed : !r.failed;
+    return as_expected ? 0 : 1;
+  }
+
+  if (!do_explore) opts.max_runs = 1;
+  opts.stop_on_first = true;
+  ExploreStats st = accl::det::explore(fn, opts);
+  std::printf(
+      "{\"drill\":\"%s\",\"mode\":\"explore\",\"runs\":%llu,"
+      "\"unique_traces\":%llu,\"findings\":%llu,\"what\":\"%s\","
+      "\"fail_step\":%llu,\"prefix_hex\":\"%s\",\"trace_hex\":\"%s\","
+      "\"seed\":%llu,\"pbound\":%d,\"max_steps\":%llu}\n",
+      drill.c_str(), (unsigned long long)st.runs,
+      (unsigned long long)st.unique_traces, (unsigned long long)st.findings,
+      json_escape(st.first_failure.what).c_str(),
+      (unsigned long long)st.first_failure.fail_step,
+      hex_encode(st.first_failure_prefix).c_str(),
+      hex_encode(st.first_failure.choices).c_str(),
+      (unsigned long long)st.seed, opts.preempt_bound,
+      (unsigned long long)opts.max_steps);
+  bool as_expected = expect_finding ? st.findings > 0 : st.findings == 0;
+  return as_expected ? 0 : 1;
+}

@@ -18,6 +18,9 @@
 #include <vector>
 
 #include "../include/accl_host.hpp"
+#if defined(ACCL_DETSCHED)
+#include "detsched_drills.hpp"
+#endif
 
 using namespace accl;
 using namespace accl::host;
@@ -1011,7 +1014,32 @@ int main() {
     }
   }
 
-  size_t total = cases.size() + drills.size();
+  size_t det_cases = 0;
+#if defined(ACCL_DETSCHED)
+  // model-checked drill under the deterministic scheduler (the rest of
+  // this corpus runs with the hooks dormant — no controlled run is
+  // active — proving the instrumented build behaves like the plain
+  // one).  A bounded exploration of the abort-vs-traffic drill must
+  // come back clean; see scripts/model_check.py for the full sweep.
+  ++det_cases;
+  {
+    accl::det::ExploreOpts opts;
+    opts.max_runs = 200;
+    opts.seed = 3;
+    auto st = accl::det::explore(
+        accl::drills::registry().at("abort_vs_traffic"), opts);
+    if (st.findings == 0 && st.runs >= 1) {
+      std::printf("PASS det_drill_smoke (%llu schedules)\n",
+                  (unsigned long long)st.runs);
+    } else {
+      ++failed_cases;
+      std::printf("FAIL det_drill_smoke            %s\n",
+                  st.first_failure.what.c_str());
+    }
+  }
+#endif
+
+  size_t total = cases.size() + drills.size() + det_cases;
   if (failed_cases) {
     std::printf("native driver corpus: %d/%zu cases FAILED\n", failed_cases,
                 total);

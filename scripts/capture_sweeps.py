@@ -181,8 +181,7 @@ def _vsraw_stage(args, tag, TpuWorld) -> None:
     _compat_install(_jax)  # old-jax: alias jax.shard_map to the shim
     import jax.numpy as _jnp
     import numpy as _np
-    from jax.sharding import (Mesh as _Mesh, NamedSharding as _NS,
-                              PartitionSpec as _P)
+    from jax.sharding import Mesh as _Mesh, NamedSharding as _NS, PartitionSpec as _P
 
     path = os.path.join(args.outdir, f"driver_vs_raw_{tag}.csv")
     with TpuWorld(8) as w, open(path, "w", newline="") as f:

@@ -370,7 +370,6 @@ def main() -> int:
                 print(f"FAIL: drill 3 rank {rank} supervisor never "
                       f"entered {needed!r} (log: {states})")
                 return 1
-        final_comm = summary["comm_id"]
         # world restored to original size, replacement participating
         sizes = {outs[k][0] for k in outs}
         if sizes != {args.ranks}:
@@ -384,7 +383,7 @@ def main() -> int:
                 return 1
     outs = join_info["outs"]
     if {outs[k][0] for k in outs} != {args.ranks} or not outs:
-        print(f"FAIL: drill 3 replacement ran at wrong world size")
+        print("FAIL: drill 3 replacement ran at wrong world size")
         return 1
     for it, (_size, val) in outs.items():
         if not np.array_equal(val, reference[it]):

@@ -87,8 +87,7 @@ def _build(make_variant, specs):
 
 
 def flash_stage(jax, jnp, timed_chain):
-    from accl_tpu.bench.flash_sweep import (make_variant, report,
-                                            run_sweep)
+    from accl_tpu.bench.flash_sweep import make_variant, report, run_sweep
 
     # resumable at sweep granularity: the d128 result persists before
     # the d64 sweep starts, so a window closing mid-stage never
@@ -259,8 +258,11 @@ def lane_stage(jax, jnp, timed_chain_ab):
         # keep ~8-30 ms of device work per dispatch across sizes
         iters = max(20, min(20000, (160 << 20) // nbytes))
         br = min(2048, rows)
-        run = lambda x, bb: pallas_add(x, bb, block_rows=br, donate=True)
-        xla = lambda x, bb: x + bb
+        def run(x, bb):
+            return pallas_add(x, bb, block_rows=br, donate=True)
+
+        def xla(x, bb):
+            return x + bb
         try:
             # operand allocation INSIDE the try: a deterministic OOM at
             # the big sizes must count toward retirement too

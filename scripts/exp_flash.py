@@ -111,7 +111,8 @@ def main() -> None:
         try:
             from jax.experimental.pallas.ops.tpu.splash_attention import (
                 splash_attention_kernel as sk,
-                splash_attention_mask as sm)
+                splash_attention_mask as sm,
+            )
             mask = sm.MultiHeadMask(
                 [sm.CausalMask((T, T)) for _ in range(H)])
             kernel = sk.make_splash_mha(

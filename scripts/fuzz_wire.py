@@ -45,7 +45,11 @@ import numpy as np  # noqa: E402
 
 from accl_tpu.backends.emu import EmuWorld  # noqa: E402
 from accl_tpu.utils.wire import (  # noqa: E402
-    HEADER_SIZE, MSG_TYPE_NAMES, MSG_TYPES, WireFrame)
+    HEADER_SIZE,
+    MSG_TYPE_NAMES,
+    MSG_TYPES,
+    WireFrame,
+)
 
 #: header (offset, size) pairs for the field-smash mutator — kept in
 #: sync with accl_tpu/utils/wire.py HEADER_FMT

@@ -1,0 +1,278 @@
+#!/usr/bin/env python3
+"""Deterministic schedule-exploration model checker for the native engine.
+
+Orchestrates the ``ACCL_DETSCHED`` harness (``native/test/test_detsched``,
+scheduler in ``native/src/detsched.hpp``): builds the instrumented
+binaries, explores drill interleavings (DPOR-pruned, bounded-preemption
+DFS over schedule prefixes), and — on a finding — writes a replayable
+failing-schedule artifact (drill + minimal hex schedule prefix + seed,
+mirroring fuzz_wire.py's failing-frame artifact).  Reproduce with::
+
+    python scripts/model_check.py --replay model_check_failure.json
+
+Modes
+-----
+``--drill NAME [--runs N]``
+    explore one drill (see ``--list``) on the fixed build.
+``--ci``
+    the CI gate: >= ``--runs`` (default 3000) schedules on EACH of the
+    four engine drills with zero findings, PLUS the sensitivity proof —
+    the ``ACCL_FAULT_DETACH_RACE`` build (which reverts the r13
+    InprocHub::detach drain) must REDISCOVER the detach race.  A
+    checker that cannot re-find a known race proves nothing; this run
+    proves sensitivity on every CI invocation.
+``--replay ARTIFACT``
+    re-run one recorded schedule; exits 0 iff the artifact's verdict
+    (failing schedule) reproduces.
+
+Exit codes: 0 clean/as-expected, 1 findings (or sensitivity loss),
+2 usage/build errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BIN = os.path.join(NATIVE, "test", "test_detsched")
+BIN_FAULT = os.path.join(NATIVE, "test", "test_detsched_fault")
+
+ENGINE_DRILLS = (
+    "replay_vs_invalidate",
+    "abort_vs_traffic",
+    "join_vs_traffic",
+    "shutdown_vs_waiters",
+)
+SENSITIVITY_DRILL = "detach_race"
+
+
+def build(verbose: bool) -> None:
+    cmd = ["make", "-C", NATIVE, "detsched"]
+    proc = subprocess.run(cmd, capture_output=not verbose, text=True)
+    if proc.returncode != 0:
+        if proc.stdout:
+            sys.stderr.write(proc.stdout)
+        if proc.stderr:
+            sys.stderr.write(proc.stderr)
+        raise SystemExit(2)
+
+
+def run_harness(binary: str, args: list[str], timeout_s: float) -> dict:
+    try:
+        proc = subprocess.run(
+            [binary, *args], capture_output=True, text=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired as exc:
+        # a wedged harness is itself a finding, not an orchestrator
+        # crash: report it like a failed run so artifacts still land
+        return {
+            "findings": 1,
+            "runs": 0,
+            "what": f"harness timeout after {timeout_s:.0f}s "
+                    f"(possible scheduler hang): {exc}",
+            "exit_code": -1,
+        }
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "{}"
+    try:
+        out = json.loads(line)
+    except json.JSONDecodeError:
+        out = {"parse_error": line}
+    out["exit_code"] = proc.returncode
+    if proc.stderr.strip():
+        out["stderr_tail"] = proc.stderr.strip().splitlines()[-5:]
+    return out
+
+
+def write_artifact(path: str, drill: str, result: dict, fault_build: bool) -> None:
+    art = {
+        "drill": drill,
+        "schedule_hex": result.get("prefix_hex", ""),
+        "full_trace_hex": result.get("trace_hex", ""),
+        "seed": result.get("seed", 1),
+        "what": result.get("what", ""),
+        "fail_step": result.get("fail_step", 0),
+        "pbound": result.get("pbound", 3),
+        "max_steps": result.get("max_steps", 200000),
+        "fault_build": fault_build,
+        "replay": (
+            f"python scripts/model_check.py --replay {os.path.basename(path)}"
+        ),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(art, f, indent=2)
+    print(f"[model_check] failing-schedule artifact -> {path}")
+
+
+def explore_drill(
+    drill: str,
+    runs: int,
+    seed: int,
+    pbound: int,
+    max_steps: int,
+    budget_s: float,
+    artifact: str,
+    fault_build: bool = False,
+    expect_finding: bool = False,
+) -> tuple[bool, dict]:
+    """Returns (ok, result)."""
+    binary = BIN_FAULT if fault_build else BIN
+    args = [
+        "--drill", drill,
+        "--explore", str(runs),
+        "--seed", str(seed),
+        "--pbound", str(pbound),
+        "--max-steps", str(max_steps),
+        "--budget-s", str(budget_s),
+    ]
+    if expect_finding:
+        args.append("--expect-finding")
+    res = run_harness(binary, args, timeout_s=budget_s + 120)
+    findings = int(res.get("findings", 0))
+    label = "fault" if fault_build else "fixed"
+    print(
+        f"[model_check] {drill} ({label}): {res.get('runs', '?')} schedules, "
+        f"{res.get('unique_traces', '?')} unique, {findings} finding(s)"
+    )
+    if findings and not expect_finding:
+        print(f"[model_check]   FINDING: {res.get('what', '')!r} "
+              f"(step {res.get('fail_step')})")
+        write_artifact(artifact, drill, res, fault_build)
+        return False, res
+    if expect_finding and not findings:
+        print(
+            f"[model_check]   SENSITIVITY LOSS: the {label} build's seeded "
+            f"race was NOT rediscovered"
+        )
+        return False, res
+    if expect_finding and findings:
+        print(f"[model_check]   rediscovered: {res.get('what', '')!r} "
+              f"(minimal prefix {res.get('prefix_hex', '')!r})")
+    return True, res
+
+
+def replay(path: str) -> int:
+    with open(path, encoding="utf-8") as f:
+        art = json.load(f)
+    binary = BIN_FAULT if art.get("fault_build") else BIN
+    args = [
+        "--drill", art["drill"],
+        "--schedule", art["schedule_hex"],
+        "--seed", str(art.get("seed", 1)),
+        "--max-steps", str(art.get("max_steps", 200000)),
+        "--expect-finding",
+    ]
+    res = run_harness(binary, args, timeout_s=120)
+    ok = res.get("exit_code") == 0 and res.get("failed") is True
+    print(
+        f"[model_check] replay {art['drill']} schedule "
+        f"{art['schedule_hex']!r}: "
+        + (f"reproduced ({res.get('what', '')!r})" if ok else "did NOT reproduce")
+    )
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--drill", help="explore one drill on the fixed build")
+    ap.add_argument("--list", action="store_true", help="list drills")
+    ap.add_argument("--ci", action="store_true",
+                    help="CI gate: all four drills + sensitivity proof")
+    ap.add_argument("--runs", type=int, default=3000,
+                    help="schedules per drill (default 3000)")
+    ap.add_argument("--min-interleavings", type=int, default=10000,
+                    help="--ci fails below this explored total (the "
+                         "acceptance floor; no silent coverage caps)")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--pbound", type=int, default=3,
+                    help="preemption bound per schedule")
+    ap.add_argument("--max-steps", type=int, default=200000,
+                    help="scheduling-step budget per run (livelock guard)")
+    ap.add_argument("--budget-s", type=float, default=240.0,
+                    help="wall-clock budget per drill sweep")
+    ap.add_argument("--artifact", default="model_check_failure.json",
+                    help="failing-schedule artifact path")
+    ap.add_argument("--replay", default="",
+                    help="replay a failure artifact instead of exploring")
+    ap.add_argument("--fault-build", action="store_true",
+                    help="run --drill against the ACCL_FAULT_DETACH_RACE build")
+    ap.add_argument("--expect-finding", action="store_true",
+                    help="with --drill: exit 0 iff a finding IS discovered")
+    ap.add_argument("--no-build", action="store_true",
+                    help="assume the harness binaries are current")
+    ap.add_argument("--verbose", action="store_true")
+    opts = ap.parse_args()
+
+    if not opts.no_build:
+        build(opts.verbose)
+
+    if opts.list:
+        subprocess.run([BIN, "--list"])
+        return 0
+
+    if opts.replay:
+        return replay(opts.replay)
+
+    if opts.drill:
+        ok, _ = explore_drill(
+            opts.drill, opts.runs, opts.seed, opts.pbound, opts.max_steps,
+            opts.budget_s, opts.artifact, fault_build=opts.fault_build,
+            expect_finding=opts.expect_finding,
+        )
+        return 0 if ok else 1
+
+    if opts.ci:
+        total = 0
+        all_ok = True
+        for drill in ENGINE_DRILLS:
+            ok, res = explore_drill(
+                drill, opts.runs, opts.seed, opts.pbound, opts.max_steps,
+                opts.budget_s, opts.artifact,
+            )
+            total += int(res.get("runs", 0))
+            all_ok = all_ok and ok
+            if not ok:
+                break
+        if all_ok:
+            # sensitivity: the seeded detach race must be rediscovered
+            ok, _ = explore_drill(
+                SENSITIVITY_DRILL, max(opts.runs, 500), opts.seed,
+                opts.pbound, opts.max_steps, opts.budget_s, opts.artifact,
+                fault_build=True, expect_finding=True,
+            )
+            all_ok = all_ok and ok
+            # and the FIXED hub must hold the same invariant clean
+            ok, res = explore_drill(
+                SENSITIVITY_DRILL, max(opts.runs, 500), opts.seed,
+                opts.pbound, opts.max_steps, opts.budget_s, opts.artifact,
+            )
+            total += int(res.get("runs", 0))
+            all_ok = all_ok and ok
+        if all_ok and total < opts.min_interleavings:
+            # the acceptance floor is a guarantee, not a report: a
+            # budget-truncated sweep must fail loudly, never pass green
+            print(
+                f"[model_check] CI sweep EXPLORED TOO LITTLE: {total} < "
+                f"{opts.min_interleavings} interleavings (budget/runs too "
+                f"low for this box)"
+            )
+            all_ok = False
+        print(
+            f"[model_check] CI sweep: {total} interleavings across the "
+            f"engine drills, "
+            + ("sensitivity proven, zero findings" if all_ok else "FAILED")
+        )
+        return 0 if all_ok else 1
+
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

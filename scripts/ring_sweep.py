@@ -44,7 +44,6 @@ def main() -> None:
         # rung's sweep tiny; the TPU sweep covers the BASELINE.md range
         args.sizes = ("4096,65536,1048576,16777216" if args.tpu
                       else "1024,4096,16384")
-    import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -42,7 +42,6 @@ def main() -> None:
 
     import numpy as np
 
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from accl_tpu.parallel.collectives import hierarchical_all_reduce

@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Clang Thread Safety Analysis lane for the native engine (`make tsa`).
+
+Builds every native translation unit under clang with
+``-Wthread-safety -Wthread-safety-beta`` and **fails on any
+thread-safety diagnostic** — the ``-Werror=thread-safety`` wall the
+annotation macros in ``native/src/common.hpp`` feed.  Two configs run
+per TU: the plain build and the ``-DACCL_DETSCHED`` build (the model
+checker's scheduler hooks change which code paths exist, so both must
+hold the discipline).
+
+Frontend selection, in order:
+
+1. a real ``clang++`` (``$CLANGXX`` or PATH): compiled with
+   ``-fsyntax-only -Werror=thread-safety``, the canonical CI path;
+2. the ``libclang`` Python bindings (pip wheel): the same clang Sema —
+   including the full thread-safety analysis — driven in-process, for
+   boxes that carry the wheel but no clang driver.  GCC's builtin
+   include directory substitutes for clang's resource dir.
+
+Zero-waiver policy (the r13 sanitizer-suppression rule applied to
+static analysis): ``ACCL_NO_TSA`` must not appear anywhere under
+``native/src`` except its definition in common.hpp — this script greps
+it banned before running the frontend, so the wall cannot be
+quietly waived from inside the code it checks.
+
+Exit codes: 0 clean, 1 thread-safety findings (or compile errors),
+2 no usable clang frontend.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "native", "src")
+
+# (translation unit, extra flags) — both lock-discipline configs
+CONFIGS: list[tuple[str, tuple[str, ...]]] = [
+    ("engine.cpp", ()),
+    ("transport.cpp", ()),
+    ("capi.cpp", ()),
+    ("engine.cpp", ("-DACCL_DETSCHED",)),
+    ("transport.cpp", ("-DACCL_DETSCHED",)),
+    ("capi.cpp", ("-DACCL_DETSCHED",)),
+]
+
+BASE_FLAGS = [
+    "-std=c++17",
+    "-x",
+    "c++",
+    "-Wthread-safety",
+    "-Wthread-safety-beta",
+]
+
+
+def check_no_waivers(src_dir: str) -> list[str]:
+    """ACCL_NO_TSA is banned under accl:: — only its #define may exist."""
+    offenders = []
+    for name in sorted(os.listdir(src_dir)):
+        if not name.endswith((".hpp", ".cpp")):
+            continue
+        path = os.path.join(src_dir, name)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                if "ACCL_NO_TSA" not in line:
+                    continue
+                stripped = line.strip()
+                if stripped.startswith("//"):
+                    continue  # prose mentioning the macro is not a waiver
+                # the definition site lives in common.hpp
+                if name == "common.hpp" and stripped.startswith(
+                    "#define ACCL_NO_TSA"
+                ):
+                    continue
+                offenders.append(f"{name}:{lineno}: {stripped}")
+    return offenders
+
+
+def gcc_builtin_include() -> str | None:
+    gcc = shutil.which("gcc") or shutil.which("cc")
+    if not gcc:
+        return None
+    try:
+        out = subprocess.run(
+            [gcc, "-print-file-name=include"],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return out if os.path.isdir(out) else None
+
+
+def find_clangxx() -> str | None:
+    env = os.environ.get("CLANGXX")
+    if env and shutil.which(env):
+        return env
+    for cand in ("clang++", "clang++-18", "clang++-17", "clang++-16",
+                 "clang++-15", "clang++-14"):
+        if shutil.which(cand):
+            return cand
+    return None
+
+
+def run_real_clang(clangxx: str, verbose: bool) -> int:
+    findings = 0
+    for tu, extra in CONFIGS:
+        cmd = [
+            clangxx,
+            *BASE_FLAGS,
+            "-Werror=thread-safety",
+            "-fsyntax-only",
+            *extra,
+            os.path.join(SRC, tu),
+        ]
+        if verbose:
+            print("+", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        label = f"{tu} {' '.join(extra) or '(plain)'}"
+        if proc.returncode != 0:
+            findings += 1
+            print(f"[tsa] FAIL {label}")
+            sys.stdout.write(proc.stderr)
+        else:
+            print(f"[tsa] ok   {label}")
+    return findings
+
+
+def run_libclang(verbose: bool) -> int:
+    try:
+        import clang.cindex as cindex
+    except ImportError:
+        return -1
+    try:
+        index = cindex.Index.create()
+    except Exception as exc:  # pragma: no cover - env-specific
+        print(f"[tsa] libclang unusable: {exc}", file=sys.stderr)
+        return -1
+    flags = list(BASE_FLAGS)
+    builtin = gcc_builtin_include()
+    if builtin:
+        flags += ["-isystem", builtin]
+    findings = 0
+    for tu, extra in CONFIGS:
+        args = flags + list(extra)
+        label = f"{tu} {' '.join(extra) or '(plain)'}"
+        if verbose:
+            print("+ libclang", " ".join(args), tu)
+        unit = index.parse(os.path.join(SRC, tu), args=args)
+        bad = []
+        for diag in unit.diagnostics:
+            # severity 3+ = hard error; any -Wthread-safety* warning is
+            # promoted to error (the -Werror=thread-safety contract)
+            opt = diag.option or ""
+            if diag.severity >= 3 or opt.startswith("-Wthread-safety"):
+                bad.append(diag)
+        if bad:
+            findings += 1
+            print(f"[tsa] FAIL {label}")
+            for d in bad:
+                loc = d.location
+                where = (
+                    f"{loc.file}:{loc.line}:{loc.column}" if loc.file else "?"
+                )
+                print(f"  {where}: {d.spelling} [{d.option or 'error'}]")
+        else:
+            print(f"[tsa] ok   {label}")
+    return findings
+
+
+def emit_compile_commands(path: str) -> None:
+    """Mirror of the Makefile's compile_commands target, importable by
+    clangd/clang-tidy and any external TSA driver."""
+    entries = []
+    for tu, extra in CONFIGS:
+        if extra:
+            continue  # one canonical entry per file
+        entries.append(
+            {
+                "directory": os.path.join(REPO, "native"),
+                "file": os.path.join(SRC, tu),
+                "arguments": [
+                    "clang++",
+                    *BASE_FLAGS,
+                    "-c",
+                    os.path.join(SRC, tu),
+                ],
+            }
+        )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=2)
+    print(f"[tsa] wrote {path}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--verbose", action="store_true")
+    ap.add_argument(
+        "--emit-compile-commands",
+        metavar="PATH",
+        help="also write a compile_commands.json for the native TUs",
+    )
+    ap.add_argument(
+        "--emit-only",
+        metavar="PATH",
+        help="write compile_commands.json and exit (no analysis)",
+    )
+    opts = ap.parse_args()
+
+    if opts.emit_only:
+        emit_compile_commands(opts.emit_only)
+        return 0
+
+    offenders = check_no_waivers(SRC)
+    if offenders:
+        print("[tsa] ACCL_NO_TSA waivers are banned under native/src:")
+        for o in offenders:
+            print("  " + o)
+        return 1
+
+    if opts.emit_compile_commands:
+        emit_compile_commands(opts.emit_compile_commands)
+
+    clangxx = find_clangxx()
+    if clangxx:
+        print(f"[tsa] frontend: {clangxx}")
+        findings = run_real_clang(clangxx, opts.verbose)
+    else:
+        print("[tsa] frontend: libclang python bindings")
+        findings = run_libclang(opts.verbose)
+        if findings < 0:
+            print(
+                "[tsa] no clang++ on PATH and no usable libclang wheel — "
+                "install either to run the thread-safety wall",
+                file=sys.stderr,
+            )
+            return 2
+
+    if findings:
+        print(f"[tsa] {findings} translation-unit config(s) FAILED")
+        return 1
+    print("[tsa] clean: zero thread-safety findings, zero waivers")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

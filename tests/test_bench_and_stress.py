@@ -8,7 +8,6 @@
 import io
 
 import numpy as np
-import pytest
 
 from accl_tpu.backends.emu import EmuWorld
 from accl_tpu.bench import SweepConfig, run_sweep

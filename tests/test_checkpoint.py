@@ -3,17 +3,14 @@ checkpointing — this is the model-layer snapshot/resume the framework
 adds, including distributed sharded checkpoints via orbax)."""
 import os
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
-import jax
-import jax.numpy as jnp
-
-from accl_tpu.models.transformer import (ModelConfig, init_params,
-                                         make_train_step, shard_params)
+from accl_tpu.models.transformer import ModelConfig, init_params, make_train_step, shard_params
 from accl_tpu.parallel.mesh import make_mesh
-from accl_tpu.utils.checkpoint import (load_pytree, load_sharded,
-                                       save_pytree, save_sharded)
+from accl_tpu.utils.checkpoint import load_pytree, load_sharded, save_pytree, save_sharded
 
 CFG = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4, d_head=8,
                   d_ff=64)

@@ -3,20 +3,18 @@ communicator, request layer (reference test analog: driver-level pieces of
 test/host/xrt/src/test.cpp plus constants sanity)."""
 import threading
 
-import numpy as np
 import pytest
 
 from accl_tpu import (
     ACCLError,
     CCLOCall,
-    CompressionFlags,
     Communicator,
+    CompressionFlags,
     DataType,
     Operation,
     Rank,
     ReduceFunction,
     Request,
-    TAG_ANY,
 )
 from accl_tpu.arithconfig import DEFAULT_ARITH_CONFIG
 from accl_tpu.communicator import _ip_decode, _ip_encode

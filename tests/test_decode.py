@@ -195,10 +195,13 @@ def test_moe_decode_expert_parallel_matches_dense():
     from jax.sharding import PartitionSpec as P
 
     from accl_tpu.models import moe_decode
-    from accl_tpu.models.moe import (MoEConfig, forward as moe_forward,
-                                     init_params as moe_init,
-                                     param_specs as moe_specs,
-                                     shard_params as moe_shard)
+    from accl_tpu.models.moe import (
+        MoEConfig,
+        forward as moe_forward,
+        init_params as moe_init,
+        param_specs as moe_specs,
+        shard_params as moe_shard,
+    )
     from accl_tpu.parallel.mesh import make_mesh
 
     cfg = MoEConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,

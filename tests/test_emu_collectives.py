@@ -10,7 +10,7 @@ segmentation boundaries, rx-fifo exhaustion, barrier.
 import numpy as np
 import pytest
 
-from accl_tpu import ReduceFunction, TAG_ANY
+from accl_tpu import TAG_ANY, ReduceFunction
 from accl_tpu.backends.emu import EmuWorld
 
 NRANKS = 4

@@ -36,7 +36,6 @@ def test_split_communicator_collectives(world):
         if rank not in members:
             return None
         cid = accl.create_communicator(members)
-        sub_rank = members.index(rank)
         # allreduce inside the sub-communicator
         send = accl.create_buffer_like(_data(COUNT, rank))
         recv = accl.create_buffer(COUNT, np.float32)

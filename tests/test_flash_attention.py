@@ -3,11 +3,10 @@
 The tiled online-softmax kernel must match the dense reference exactly
 (same math the ring layer applies across sequence shards).
 """
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from accl_tpu.ops.flash import flash_attention
 from accl_tpu.parallel.ring_attention import _dense_attention
@@ -48,8 +47,7 @@ def test_flash_packed_matches_bthd(causal, kernel):
     # the head-packed [N, T, D] entry is the same kernel minus the
     # layout transposes — identical numerics, including the one-shot
     # K/V cast scratch the resident schedule uses for non-MXU dtypes
-    from accl_tpu.ops.flash import (flash_attention_lse,
-                                    flash_attention_packed_lse)
+    from accl_tpu.ops.flash import flash_attention_lse, flash_attention_packed_lse
     B, T, H, D = 2, 256, 2, 64
     q, k, v = _qkv(B, T, H, D, seed=3)
     pack = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
@@ -199,8 +197,7 @@ def test_model_config_rejects_unknown_attn():
 def test_transformer_flash_matches_dense():
     from dataclasses import replace
 
-    from accl_tpu.models.transformer import (ModelConfig, forward,
-                                             init_params)
+    from accl_tpu.models.transformer import ModelConfig, forward, init_params
 
     cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=2,
                       d_head=16, d_ff=64)
@@ -485,8 +482,7 @@ def test_model_trains_with_flash_attention():
     # on real TPU hardware the ring/SP paths default to the flash
     # kernel, so a non-differentiable kernel would break training
     # exactly where CI can't see it
-    from accl_tpu.models.transformer import (ModelConfig, init_params,
-                                             loss_fn)
+    from accl_tpu.models.transformer import ModelConfig, init_params, loss_fn
     cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2,
                       d_head=16, d_ff=64, attn="flash")
     params = init_params(np.random.default_rng(0), cfg)
@@ -618,8 +614,7 @@ def test_flash_gqa_matches_expanded(causal, kernel, opts):
     # heads, so the result must be BIT-identical to running the same
     # kernel on explicitly expanded (repeated) K/V.  B > 1 exercises
     # the packed-layout fold (b*H + h) // group == b*G + h // group.
-    from accl_tpu.ops.flash import (flash_attention_lse,
-                                    flash_attention_packed_lse)
+    from accl_tpu.ops.flash import flash_attention_lse, flash_attention_packed_lse
     B, T, H, G, D = 2, 128, 4, 2, 32
     rng = np.random.default_rng(33)
     q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)

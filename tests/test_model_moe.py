@@ -1,15 +1,20 @@
 """MoE model family tests: dense-vs-EP routing equivalence and an
 expert-parallel train step over a dp x ep mesh (EP = the reference's
 alltoall enablement, SURVEY §2.8)."""
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from accl_tpu.models.moe import (
-    MoEConfig, forward, init_params, loss_fn, make_train_step, shard_params)
+    MoEConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    shard_params,
+)
 from accl_tpu.parallel.mesh import make_mesh
 
 

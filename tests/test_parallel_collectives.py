@@ -1,7 +1,6 @@
 """Tests for the functional SPMD collective layer (XLA lowerings and the
 explicit ring schedules) over the virtual 8-device CPU mesh."""
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax import shard_map

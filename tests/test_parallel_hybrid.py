@@ -8,15 +8,14 @@ the sharding/collective semantics on the 8-device virtual CPU platform
 (2 "slices" x 4 "chips"); the driver's dryrun does the same for the
 full training step.
 """
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
-from accl_tpu.parallel.mesh import make_hybrid_mesh
 from accl_tpu.parallel.collectives import hierarchical_all_reduce
+from accl_tpu.parallel.mesh import make_hybrid_mesh
 
 
 @pytest.fixture(scope="module")
@@ -54,8 +53,7 @@ def test_hierarchical_all_reduce_matches_flat(hybrid_mesh):
 def test_hybrid_train_step_compiles_and_runs(hybrid_mesh):
     # dp across slices (DCN), tp within a slice (ICI) — gradients ride
     # the hierarchy exactly as a 2-slice deployment would
-    from accl_tpu.models.transformer import (
-        ModelConfig, init_params, make_train_step, shard_params)
+    from accl_tpu.models.transformer import ModelConfig, init_params, make_train_step, shard_params
 
     cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, d_head=16,
                       n_layers=1, d_ff=128)

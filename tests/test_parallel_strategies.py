@@ -234,8 +234,7 @@ def test_ring_attention_flash_impl_matches_dense(causal):
 
 
 def test_zigzag_indices_roundtrip():
-    from accl_tpu.parallel.ring_attention import (zigzag_indices,
-                                                  zigzag_indices_inverse)
+    from accl_tpu.parallel.ring_attention import zigzag_indices, zigzag_indices_inverse
 
     T, Psp = 64, 4
     perm = np.asarray(zigzag_indices(T, Psp))
@@ -262,8 +261,7 @@ def test_ring_attention_zigzag_matches_dense(impl, P_sp):
     import jax
 
     from accl_tpu.parallel.mesh import make_mesh
-    from accl_tpu.parallel.ring_attention import (zigzag_indices,
-                                                  zigzag_indices_inverse)
+    from accl_tpu.parallel.ring_attention import zigzag_indices, zigzag_indices_inverse
 
     mesh = make_mesh(sp=P_sp)
     B, Tl, H, D = 2, 16, 2, 16
@@ -345,8 +343,7 @@ def test_ring_attention_flash_opts_passthrough():
     import jax
 
     from accl_tpu.parallel.mesh import make_mesh
-    from accl_tpu.parallel.ring_attention import (zigzag_indices,
-                                                  zigzag_indices_inverse)
+    from accl_tpu.parallel.ring_attention import zigzag_indices, zigzag_indices_inverse
 
     P_sp = 4
     mesh = make_mesh(sp=P_sp)
@@ -722,8 +719,7 @@ def test_windowed_ring_matches_banded_dense(window):
 
 
 def test_windowed_ring_gqa_matches_banded_dense():
-    from accl_tpu.parallel.ring_attention import (_dense_attention,
-                                                  expand_gqa_kv)
+    from accl_tpu.parallel.ring_attention import _dense_attention, expand_gqa_kv
 
     P_sp, B, Tl, H, G, D = 4, 1, 32, 4, 2, 16
     rng = np.random.default_rng(72)
@@ -741,8 +737,7 @@ def test_windowed_ring_grads_match_banded_dense():
     import jax
 
     from accl_tpu.parallel.mesh import make_mesh
-    from accl_tpu.parallel.ring_attention import (_dense_attention,
-                                                  ring_attention)
+    from accl_tpu.parallel.ring_attention import _dense_attention, ring_attention
 
     P_sp, B, Tl, H, D, window = 4, 1, 16, 2, 8, 11
     rng = np.random.default_rng(73)
@@ -791,8 +786,7 @@ def test_ulysses_windowed_attn_fn_matches_banded_dense():
 
     from accl_tpu.ops.flash import flash_attention
     from accl_tpu.parallel.mesh import make_mesh
-    from accl_tpu.parallel.ring_attention import (_dense_attention,
-                                                  ulysses_attention)
+    from accl_tpu.parallel.ring_attention import _dense_attention, ulysses_attention
 
     P_sp, B, Tl, H, D, W = 4, 1, 16, 4, 16, 9
     mesh = make_mesh(sp=P_sp)

@@ -6,11 +6,10 @@ block-absmax/254 per element; the ring reduce-scatter requantizes per
 hop so allreduce error grows linearly in P.  Tolerances below derive
 from those bounds, not from hand-tuning.
 """
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from accl_tpu.ops.quantized import (

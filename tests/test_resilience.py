@@ -406,8 +406,24 @@ def test_probe_liveness_names_dead_rank():
 
 def test_kill_abort_shrink_rerun():
     # the full recovery drill (the chaos_smoke acceptance path): a rank
-    # dies mid-run; survivors classify the failure, revoke the comm,
-    # agree on the surviving set, and finish on the shrunk world
+    # dies mid-run; the failure is CLASSIFIED, the comm revoked, the
+    # survivors agree on the surviving set and finish on the shrunk
+    # world.
+    #
+    # Deflaked (r14): only rank 0 — whose ring predecessor IS the dead
+    # rank — deterministically fails the first allreduce.  Ranks 1/2
+    # sit downstream of live senders, and the eager ring keeps
+    # forwarding after an upstream receive failure, so on some
+    # interleavings they complete the schedule with relayed garbage and
+    # retcode 0; asserting `raises` on EVERY survivor was the flake
+    # (r12/r13 "passed this run" notes), and the rank that hit DID NOT
+    # RAISE then skipped the shrink, starving the others into a
+    # 6-second timeout.  This is exactly the ULFM contract: ONE rank
+    # classifies and revokes; the propagated abort (or clean-looking
+    # garbage) is what everyone else may legally observe.  The native
+    # model checker documents the engine-level half of this contract
+    # (scripts/model_check.py, drill abort_vs_traffic: a raced retcode
+    # is either 0 or carries the fence bits).
     nranks = 4
     with EmuWorld(nranks) as world:
         world.kill_rank(3)
@@ -418,13 +434,27 @@ def test_kill_abort_shrink_rerun():
             accl.set_timeout(1_500_000)
             s = accl.create_buffer_like(_data(COUNT, salt=rank))
             r = accl.create_buffer(COUNT, np.float32)
-            with pytest.raises(ACCLError):
-                accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            if rank == 0:
+                # prev rank in the ring is dead: guaranteed classification
+                with pytest.raises(ACCLError):
+                    accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+            else:
+                # downstream of live senders: may fail fast via the
+                # propagated abort OR complete with relayed garbage —
+                # both are legal pre-revoke observations
+                try:
+                    accl.allreduce(s, r, COUNT, ReduceFunction.SUM)
+                except ACCLError:
+                    pass
             # ULFM pattern: whoever classifies a failure revokes; the
             # propagated abort wakes slower ranks' calls immediately
             accl.abort(0, error=int(ErrorCode.RANK_FAILED))
             new_comm = accl.shrink_communicator(0, window_s=2.0)
             assert accl.communicator(new_comm).size == nranks - 1
+            # fresh clock for the rerun: the shrink agreement already
+            # resynchronized the survivors, the budget only has to
+            # cover the collective itself (not inherited skew)
+            accl.set_timeout(5_000_000)
             accl.allreduce(s, r, COUNT, ReduceFunction.SUM,
                            comm_id=new_comm)
             return r.host.copy()

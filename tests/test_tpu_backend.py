@@ -433,7 +433,6 @@ def test_driver_allreduce_close_to_raw_psum():
     import time
 
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = 1 << 18  # 1 MiB fp32 per rank

@@ -2,7 +2,6 @@
 topology/capability probe (the hwid parse analog), debug logging."""
 import os
 
-import numpy as np
 import pytest
 
 

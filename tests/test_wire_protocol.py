@@ -151,7 +151,6 @@ def test_frame_tap_captures_real_traffic(world):
     assert MSG_TYPES["egr"] in types
     # every captured frame must round-trip the codec and re-ingest as
     # well-formed (the seed-corpus invariant the fuzzer relies on)
-    dev = world.devices[0]
     for f in frames[:8]:
         wf = WireFrame.unpack(f)
         assert wf.pack() == f
