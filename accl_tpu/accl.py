@@ -232,12 +232,17 @@ class ACCL:
         self._initialized = True
 
         # 8. observability bring-up: the always-on flight recorder (the
-        #    rank is known now) and, when ACCL_METRICS_PORT is set, the
-        #    process-wide OpenMetrics endpoint
+        #    rank is known now), the process-wide OpenMetrics endpoint
+        #    when ACCL_METRICS_PORT is set, and the regression sentinel
+        #    when ACCL_SENTINEL names a committed baseline (off = zero
+        #    threads, zero per-call work)
         if _flight.enabled():
             self.flight_recorder = _flight.register(
                 _flight.FlightRecorder(local_rank))
         _health.ensure_exporter_from_env()
+        from .observability import sentinel as _sentinel
+
+        _sentinel.ensure_sentinel_from_env()
 
         # 9. resilience bring-up: ACCL_SUPERVISE=1 arms the recovery
         #    supervisor (resilience/supervisor.py) on this rank — a

@@ -216,6 +216,11 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_frame_tap_read.argtypes = [p, i32, i32, ctypes.c_void_p, i32]
     lib.accl_frame_tap_drain.restype = i32
     lib.accl_frame_tap_drain.argtypes = [p, i32, ctypes.c_void_p, i32]
+    # engine telemetry snapshot (r14): versioned flat-array stats plane
+    lib.accl_engine_stats_version.restype = i32
+    lib.accl_engine_stats_version.argtypes = []
+    lib.accl_engine_stats.restype = i32
+    lib.accl_engine_stats.argtypes = [p, i32, ctypes.POINTER(u64), i32]
     _lib = lib
     return lib
 
@@ -564,6 +569,28 @@ class EmuDevice(CCLODevice):
         keys = ("retrans_sent", "nacks_tx", "nacks_rx", "fenced_drops")
         return dict(zip(keys, (int(v.value) for v in vals)))
 
+    def engine_stats(self) -> dict:
+        """Full engine telemetry snapshot (r14): retransmit-store depth/
+        evictions, NACK counters, rx-pool occupancy + high-water,
+        egress/ingress queue depths, seek-miss rate inputs, plan table/
+        token state, wire accept/reject, tx traffic, join counters —
+        ONE FFI for the whole plane (the sampler's poll body).  Decoded
+        through the versioned field schema so a newer engine's extra
+        fields surface as ``unknown_field_<i>`` instead of vanishing."""
+        from ..observability import telemetry as _telemetry
+
+        if not self._w:
+            raise ACCLError("engine_stats: world is closed")
+        cap = max(64, len(_telemetry.ENGINE_STATS_FIELDS_V1))
+        buf = (ctypes.c_uint64 * cap)()
+        total = int(self._lib.accl_engine_stats(self._w, self._rank,
+                                                buf, cap))
+        if total < 0:
+            raise ACCLError(f"engine_stats failed for rank {self._rank}")
+        version = int(self._lib.accl_engine_stats_version())
+        return _telemetry.decode_engine_stats(
+            buf[:min(total, cap)], version=version, total_fields=total)
+
     # -- persistent collective plans (r12) ----------------------------
     def arm_plan(self, calls, expected=None, timeout_s: float = 30.0):
         """Pre-marshal a captured descriptor stream into the engine's
@@ -783,9 +810,18 @@ class EmuRankTcp:
             kwargs["max_eager_size"] = max_eager_size
         self.accl.initialize(ranks, rank, n_egr_rx_bufs=n_egr_rx_bufs,
                              egr_rx_buf_size=egr_rx_buf_size, **kwargs)
+        # per-process telemetry sampler (multi-process worlds poll one
+        # rank each; the scrape surface merges across processes)
+        from ..observability import telemetry as _telemetry
+
+        self.telemetry = _telemetry.sampler_from_env(
+            [self.device.engine_stats], name=f"accl-tcp-r{rank}")
         _live_worlds.add(self)  # interpreter-exit safety net
 
     def close(self) -> None:
+        if getattr(self, "telemetry", None) is not None:
+            self.telemetry.stop()
+            self.telemetry = None
         if self._handle:
             _flight.mark_event(self.accl.flight_recorder,
                                _flight.TEARDOWN_EVENT, -1, lane="lifecycle")
@@ -912,6 +948,14 @@ class EmuWorld:
 
         self.board = MembershipBoard()
         self.joiners: list = []
+        # engine telemetry sampler (r14): polls every rank's native
+        # stats snapshot into the shared registry as engine/* families.
+        # None (no thread, zero work) unless ACCL_TELEMETRY_INTERVAL_MS
+        # is set > 0.
+        from ..observability import telemetry as _telemetry
+
+        self.telemetry = _telemetry.sampler_from_env(
+            [d.engine_stats for d in self.devices], name="accl-emu")
         _live_worlds.add(self)  # interpreter-exit safety net
 
     def start_watchdog(self, **kwargs) -> "_health.Watchdog":
@@ -998,6 +1042,11 @@ class EmuWorld:
         fenced drops) — the observability of the retransmission lane."""
         return [d.resilience_stats() for d in self.devices]
 
+    def engine_stats(self) -> list:
+        """Per-rank full engine telemetry snapshots (r14) — the same
+        plane the ACCL_TELEMETRY_INTERVAL_MS sampler polls."""
+        return [d.engine_stats() for d in self.devices]
+
     def run(self, fn: Callable, *args) -> list:
         """Run `fn(accl, rank, *args)` on every rank concurrently and
         return per-rank results; exceptions propagate."""
@@ -1026,6 +1075,9 @@ class EmuWorld:
 
     def close(self) -> None:
         self.watchdog.stop()
+        if self.telemetry is not None:
+            self.telemetry.stop()  # before shutdown: no poll of a dead world
+            self.telemetry = None
         self._pool.shutdown(wait=False)
         if self._handle:
             # lifecycle anchor (r13): after this record, NO successful

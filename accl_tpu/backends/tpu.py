@@ -2020,6 +2020,39 @@ class TpuDeviceView(CCLODevice):
         dispatch-lane counters under engine/ keys)."""
         return self._engine.metrics
 
+    def engine_stats(self) -> dict:
+        """Engine telemetry snapshot (r14) in the same flat schema as
+        the native engine's ``accl_engine_stats`` where the concepts
+        map (plans/replays), plus the TPU-only dispatch-lane and
+        plan-ring fields (generation = max comm fence generation,
+        refcounts = per-rank handles pinning live rings).  The
+        world-level sampler polls this exactly like the emu twin."""
+        eng = self._engine
+        counters = eng.metrics.counters()
+        with eng._plan_cv:
+            rings = [r for r in eng._plan_rings if r.invalid is None]
+            plans_live = len(rings)
+            plan_ring_refs = sum(r.refs for r in rings)
+            ring_replays = sum(r.replays for r in rings)
+        with eng._ready_cv:
+            ready_depth = len(eng._ready)
+        with eng._lock:  # _comm_gen mutates under _lock (abort/evict)
+            gen = max(eng._comm_gen.values(), default=0)
+        return {
+            "version": 1,
+            "plans_live": plans_live,
+            "plan_ring_refs": plan_ring_refs,
+            "plan_ring_generation": gen,
+            "plan_ring_replays": ring_replays,
+            "plan_replays": counters.get("plan_replays", 0),
+            "plan_auto_captures": counters.get("plan_auto_captures", 0),
+            "leader_dispatches": counters.get("leader_dispatches", 0),
+            "executor_dispatches": counters.get("executor_dispatches", 0),
+            "batches": counters.get("batches", 0),
+            "batched_gangs": counters.get("batched_gangs", 0),
+            "ready_depth": ready_depth,
+        }
+
     # memory API kept for interface completeness; TPU buffers are opaque
     # handles, not a flat address space
     def alloc_mem(self, nbytes: int, alignment: int = 64) -> int:
@@ -2140,6 +2173,13 @@ class TpuWorld:
         self.engine.start_watchdog(
             [a.flight_recorder for a in self.accls
              if a.flight_recorder is not None])
+        # engine telemetry sampler (r14): the shared TpuEngine is one
+        # stats source — polling it per rank would just re-read the
+        # same counters
+        from ..observability import telemetry as _telemetry
+
+        self.telemetry = _telemetry.sampler_from_env(
+            [self.devices[0].engine_stats], name="accl-tpu")
 
     def run(self, fn: Callable, *args) -> list:
         futures = [self._pool.submit(fn, self.accls[r], r, *args)
@@ -2147,6 +2187,9 @@ class TpuWorld:
         return [f.result(timeout=300) for f in futures]
 
     def close(self) -> None:
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
         self.engine.shutdown()
         self._pool.shutdown(wait=False)
 

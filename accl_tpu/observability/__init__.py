@@ -1,9 +1,15 @@
 """End-to-end observability: per-call trace spans (Perfetto export),
 the metrics registry both backends and the bench harnesses publish
-into, the always-on flight recorder, and the hang watchdog + health/
-OpenMetrics surface.  See docs/observability.md and docs/debugging.md
-for usage."""
+into, the always-on flight recorder, the hang watchdog + health/
+OpenMetrics surface, and the r14 performance observatory — cross-rank
+critical-path attribution, the native-engine telemetry sampler, and
+the continuous regression sentinel.  See docs/observability.md and
+docs/debugging.md for usage."""
 
+from .attribution import (  # noqa: F401
+    attribute,
+    estimate_clock_skew,
+)
 from .flight import (  # noqa: F401
     FlightRecord,
     FlightRecorder,
@@ -15,8 +21,10 @@ from .health import (  # noqa: F401
     HEALTH_DEGRADED,
     HEALTH_HUNG,
     HEALTH_OK,
+    HEALTH_SLOW,
     MetricsExporter,
     Watchdog,
+    exporter_port,
     start_exporter,
     stop_exporter,
 )
@@ -26,8 +34,18 @@ from .metrics import (  # noqa: F401
     busbw_factor,
     default_registry,
     dump_metrics,
+    metric_help_for,
     payload_factor,
     size_bucket,
+    validate_openmetrics,
+)
+from .sentinel import (  # noqa: F401
+    Baseline,
+    Sentinel,
+)
+from .telemetry import (  # noqa: F401
+    ENGINE_STATS_FIELDS_V1,
+    TelemetrySampler,
 )
 from .trace import (  # noqa: F401
     TraceCollector,

@@ -382,9 +382,38 @@ def first_divergence(seqs: dict, sig_fn) -> Optional[dict]:
 
 
 def _load(dump) -> dict:
+    """Load one dump dict or path; a path whose JSON is truncated
+    mid-record (a crash-time dump) is salvaged via
+    :func:`trace.salvage_torn_json` instead of raising — the recovered
+    dict carries ``_torn`` = {"tail_bytes_skipped"} so the merge can
+    report the skip (r14 satellite)."""
     if isinstance(dump, str):
         with open(dump) as f:
-            return json.load(f)
+            text = f.read()
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError:
+            from .trace import salvage_torn_json
+
+            # merged docs FIRST: a merged doc contains nested per-rank
+            # "records" arrays, so probing "records" first would match
+            # rank 0's array and silently drop every other rank; a
+            # per-rank dump has no "ranks" key and falls through
+            try:
+                doc, skipped = salvage_torn_json(text, "ranks")
+            except ValueError:
+                doc, skipped = salvage_torn_json(text, "records")
+            doc["_torn"] = {"path": dump,
+                            "tail_bytes_skipped": skipped}
+            doc.setdefault("rank", -1)
+            doc.setdefault("last_completed_seq", -1)
+            from ..utils.logging import get_logger
+
+            get_logger("accl_tpu.flight").warning(
+                "flight dump %s is truncated mid-record — salvaged %d "
+                "record(s), skipped %d torn tail byte(s)",
+                dump, len(doc.get("records", [])), skipped)
+            return doc
     return dump
 
 
@@ -408,17 +437,31 @@ def merge_flight_dumps(dumps: Iterable, out_path: Optional[str] = None,
       furthest rank on the same communicator.
     """
     per_rank: dict = {}
+    torn: list = []
+    torn_ranks: set = set()
     for d in dumps:
         d = _load(d)
-        for rd in (d["ranks"] if "ranks" in d else [d]):
+        rds = d["ranks"] if "ranks" in d else [d]
+        if "_torn" in d:
+            torn.append(dict(d["_torn"],
+                             records_recovered=sum(
+                                 len(rd.get("records", ())) for rd in rds)))
+        for rd in rds:
+            rd.setdefault("records", [])
+            rd.setdefault("last_completed_seq", -1)
             per_rank[rd["rank"]] = rd
+            if "_torn" in d:
+                torn_ranks.add(rd["rank"])
     ranks = sorted(per_rank)
     # a full ring has evicted its oldest records, and different ranks
     # evict DIFFERENT amounts (gang/non-gang mixes differ): positional
     # cross-rank comparison is then meaningless and would produce false
     # desync/straggler findings — those analyses are gated per comm on
-    # every contributor still holding its full history
-    wrapped = {r: len(per_rank[r]["records"])
+    # every contributor still holding its full history.  A TORN dump
+    # (crash-truncated, r14 satellite) lost its tail the same way, so
+    # its ranks gate identically.
+    wrapped = {r: r in torn_ranks
+               or len(per_rank[r]["records"])
                >= per_rank[r].get("capacity", 1 << 62) for r in ranks}
 
     # -- per-comm, per-rank ordered gang signatures --------------------
@@ -527,6 +570,10 @@ def merge_flight_dumps(dumps: Iterable, out_path: Optional[str] = None,
             # ring wrapped (uneven eviction would fake desyncs); hang
             # detection (in-flight records only) still covers them
             "truncated_comms": truncated_comms,
+            # crash-truncated dump files the tolerant loader salvaged
+            # (r14 satellite): path, records recovered, tail skipped —
+            # their ranks' order analysis is gated like a wrapped ring
+            "torn_dumps": torn,
             "ok": not desyncs and not hangs,
         },
     }

@@ -54,7 +54,15 @@ HEALTH_ABORTED = 3
 #: shrink/grow -> agree -> resume); distinct from ``aborted`` because a
 #: supervised world is actively healing, not merely revoked
 HEALTH_RECOVERING = 4
-HEALTH_NAMES = ("ok", "degraded", "hung", "aborted", "recovering")
+#: the regression sentinel (observability/sentinel.py) found live
+#: latency/bandwidth drifted past its thresholds vs the committed
+#: baseline — the world is CORRECT but slow.  Reported only while every
+#: stronger verdict (degraded/hung/aborted/recovering) is clear: a
+#: numerically-higher code must not let "slow" mask a real failure, so
+#: the aggregation special-cases it rather than relying on max().
+HEALTH_SLOW = 5
+HEALTH_NAMES = ("ok", "degraded", "hung", "aborted", "recovering",
+                "slow")
 
 #: window after a non-zero retcode during which health reads degraded
 DEGRADED_WINDOW_NS = 60 * 10 ** 9
@@ -83,6 +91,10 @@ _watchdogs: "weakref.WeakSet" = weakref.WeakSet()
 #: is actively healing, and a scrape must say so even while a sibling
 #: watchdog still reads the pre-recovery hang.
 _recovering: dict = {}
+#: registries whose regression sentinel currently holds drift findings
+#: (sentinel.py note_slow): id(registry) -> True.  Weakest verdict —
+#: surfaces only while everything stronger is clear.
+_slow: dict = {}
 
 
 def note_recovering(registry: MetricsRegistry, active: bool) -> None:
@@ -99,12 +111,28 @@ def note_recovering(registry: MetricsRegistry, active: bool) -> None:
     _publish_health(registry)
 
 
+def note_slow(registry: MetricsRegistry, active: bool) -> None:
+    """Mark (or clear) a live perf-drift verdict on a registry (the
+    regression sentinel's hook): ``accl_health`` reads ``slow`` (5)
+    while active AND no stronger verdict (degraded/hung/aborted/
+    recovering) is in effect — slow must never mask a real failure."""
+    key = id(registry)
+    with _watchdogs_lock:
+        if active:
+            _slow[key] = True
+        else:
+            _slow.pop(key, None)
+    _publish_health(registry)
+
+
 def _publish_health(registry: MetricsRegistry) -> None:
     with _watchdogs_lock:
         verdict = max((w._health for w in _watchdogs
                        if w._registry is registry), default=HEALTH_OK)
         if _recovering.get(id(registry), 0) > 0:
             verdict = HEALTH_RECOVERING
+        elif verdict == HEALTH_OK and _slow.get(id(registry)):
+            verdict = HEALTH_SLOW
     registry.set_gauge("accl_health", verdict)
 
 
@@ -233,13 +261,17 @@ class Watchdog:
                 report["watchdog"]["engine_gangs"] = self._introspect()
             except Exception:
                 report["watchdog"]["engine_gangs"] = None
-        self.last_report = report
         if self._dump_path:
             try:
                 with open(self._dump_path, "w") as f:
                     json.dump(report, f, indent=1)
             except OSError:
                 pass
+        # publish AFTER the dump write: last_report is the "fire
+        # happened" signal pollers key on, and a poller that saw it must
+        # find the dump file already on disk (the pre-r14 order lost
+        # that race on a loaded box)
+        self.last_report = report
         self._log(report)
         # ACCL_WATCHDOG_ACTION=abort: turn the diagnosis into recovery —
         # abort every hung communicator so stuck waiters fail fast with
@@ -361,25 +393,36 @@ def start_exporter(port: Optional[int] = None,
                    registry: Optional[MetricsRegistry] = None,
                    ) -> Optional[MetricsExporter]:
     """Start (or return) the process-wide exporter.  With no explicit
-    `port`, reads ``ACCL_METRICS_PORT`` (unset/empty/0 = no exporter;
-    an explicit ``port=0`` binds an ephemeral port — tests use this)."""
+    `port`, reads ``ACCL_METRICS_PORT``: unset/empty = no exporter;
+    ``0`` = bind an EPHEMERAL port (parallel CI jobs sharing one env
+    cannot collide — the r14 satellite; the chosen port is logged by
+    the structured logger and readable via :func:`exporter_port`);
+    anything else = that fixed port."""
     global _exporter
     with _exporter_lock:
         if _exporter is not None:
             return _exporter
         if port is None:
             raw = os.environ.get("ACCL_METRICS_PORT", "")
-            if not raw or raw == "0":
+            if not raw:
                 return None
             from ..constants import env_int
 
-            port = env_int("ACCL_METRICS_PORT", 0, minimum=1)
+            port = env_int("ACCL_METRICS_PORT", 0, minimum=0)
         _exporter = MetricsExporter(port, registry)
         from ..utils.logging import get_logger
 
         get_logger().info("OpenMetrics endpoint on http://%s:%d/metrics",
                           _exporter.host, _exporter.port)
         return _exporter
+
+
+def exporter_port() -> Optional[int]:
+    """The live exporter's bound port (the ephemeral-port discovery
+    surface for ``ACCL_METRICS_PORT=0``), or None when no exporter is
+    running in this process."""
+    with _exporter_lock:
+        return _exporter.port if _exporter is not None else None
 
 
 def stop_exporter() -> None:

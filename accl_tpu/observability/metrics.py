@@ -37,7 +37,7 @@ _XP_COLLECTIVES = ("allgather", "reduce_scatter", "alltoall")
 #: metric names after prefixing/sanitizing (see to_openmetrics name()).
 METRIC_HELP = {
     "accl_health": ("world health gauge: 0=ok 1=degraded 2=hung "
-                    "3=aborted 4=recovering"),
+                    "3=aborted 4=recovering 5=slow"),
     "accl_watchdog_checks": "watchdog scan sweeps executed",
     "accl_watchdog_fires": "watchdog hang detections (one per episode)",
     "accl_membership_joins": ("replacement ranks admitted through the "
@@ -70,7 +70,114 @@ METRIC_HELP = {
                                   "count mismatch/out-of-range comm) — "
                                   "nonzero means a corrupting transport "
                                   "or hostile peer"),
+    # ---- engine telemetry families (r14, observability/telemetry.py:
+    # the ACCL_TELEMETRY_INTERVAL_MS sampler over accl_engine_stats) ----
+    "accl_engine_retrans_store_depth": (
+        "live slots in the eager retransmit store (gauge, max rank)"),
+    "accl_engine_retrans_store_evictions": (
+        "retransmit-store ring wraps over a live slot — a NACK after "
+        "an eviction can no longer be served"),
+    "accl_engine_retrans_sent": "eager segments retransmitted on NACK",
+    "accl_engine_nacks_tx": "NACK solicitations sent (receiver side)",
+    "accl_engine_nacks_rx": "NACK solicitations received (sender side)",
+    "accl_engine_fenced_drops": ("ingress frames dropped at an abort/"
+                                 "epoch fence"),
+    "accl_engine_rx_occupancy": "rx-pool buffers RESERVED right now",
+    "accl_engine_rx_occupancy_hwm": ("rx-pool occupancy high-water "
+                                     "since bring-up"),
+    "accl_engine_rx_staged": ("ingress messages parked in the rx-pool "
+                              "staging queue (pool exhausted)"),
+    "accl_engine_rx_staged_hwm": "rx-pool staging-queue high-water",
+    "accl_engine_rx_pending": "rx notifications queued, not yet sought",
+    "accl_engine_egress_depth": ("segments staged in the egress "
+                                 "pipeline right now"),
+    "accl_engine_egress_hwm": "egress staging high-water since bring-up",
+    "accl_engine_ingress_depth": ("transport deliveries executing "
+                                  "inside the engine right now"),
+    "accl_engine_seeks": "recovered-seek entries (blocking rx matches)",
+    "accl_engine_seek_misses": ("seeks that timed out after the whole "
+                                "recovery budget — misses/seeks is the "
+                                "seek-miss rate"),
+    "accl_engine_plans_live": "valid persistent plans armed engine-side",
+    "accl_engine_plan_tokens": "plan replay tokens in flight/unclaimed",
+    "accl_engine_plan_replays": "plan replays queued through the ring",
+    "accl_engine_wire_accepted_frames": ("ingress frames that passed "
+                                         "structural validation"),
+    "accl_engine_wire_rejected_frames": ("ingress frames rejected as "
+                                         "malformed"),
+    "accl_engine_tx_msgs": "egress messages handed to the transport",
+    "accl_engine_tx_payload_bytes": ("egress payload bytes handed to "
+                                     "the transport"),
+    "accl_engine_joins_sponsored": "elastic joins answered as sponsor",
+    "accl_engine_joins_completed": "elastic joins completed as joiner",
+    # TPU gang-scheduler twin fields (TpuDeviceView.engine_stats)
+    "accl_engine_plan_ring_refs": ("per-rank plan handles pinning live "
+                                   "TPU plan rings"),
+    "accl_engine_plan_ring_generation": ("max per-comm fence generation "
+                                         "(abort/rebuild bumps it)"),
+    "accl_engine_plan_ring_replays": "replays issued on live TPU rings",
+    "accl_engine_plan_auto_captures": ("plan rings armed by the "
+                                       "ACCL_PLAN_AUTO streak detector"),
+    "accl_engine_leader_dispatches": ("gangs executed inline on the "
+                                      "last-arriving rank's thread"),
+    "accl_engine_executor_dispatches": "gangs executed on the executor",
+    "accl_engine_batches": "fused executor dispatch batches",
+    "accl_engine_batched_gangs": "gangs fused into executor batches",
+    "accl_engine_ready_depth": ("complete gangs queued behind the "
+                                "executor right now"),
+    # ---- per-call collective families (observe_call) ----
+    "accl_collective_calls": ("collective calls completed, per "
+                              "(collective, dtype, size_bucket)"),
+    "accl_collective_errors": "collective calls with non-zero retcode",
+    "accl_collective_bytes": "per-rank payload bytes moved",
+    "accl_collective_latency_us": ("submit→complete latency histogram "
+                                   "(power-of-4 µs buckets)"),
+    "accl_collective_algbw_gbps": "algorithmic bandwidth (nccl-tests)",
+    "accl_collective_busbw_gbps": ("bus bandwidth (nccl-tests "
+                                   "correction factors)"),
+    # ---- regression sentinel (r14, observability/sentinel.py) ----
+    "accl_sentinel_checks": "sentinel comparison sweeps executed",
+    "accl_sentinel_findings": ("sentinel drift findings (p50/p99/"
+                               "bandwidth past threshold vs baseline)"),
+    # ---- TPU per-engine registry bare names (TpuEngine.metrics — the
+    # dispatch-lane counters ACCL.metrics() merges under engine/ keys;
+    # HELP here keeps the per-engine registry itself exportable) ----
+    "accl_leader_dispatches": ("gangs executed inline on the last-"
+                               "arriving rank's thread"),
+    "accl_executor_dispatches": "gangs executed on the executor thread",
+    "accl_batches": "fused executor dispatch batches",
+    "accl_batched_gangs": "gangs fused into executor batches",
+    "accl_plan_replays": "plan replays issued through the ring",
+    "accl_plan_auto_captures": ("plan rings armed by the ACCL_PLAN_AUTO "
+                                "streak detector"),
 }
+
+#: HELP for families minted with dynamic name parts (bench lane labels,
+#: unknown newer-engine fields): matched by prefix after sanitizing.
+#: The schema-completeness test (tests/test_telemetry.py) enforces that
+#: every ``inc``/``set_gauge``/``observe_value`` literal in the tree
+#: resolves through METRIC_HELP or one of these prefixes.
+METRIC_HELP_PREFIXES = {
+    "accl_callrate_": ("callrate bench lane gauge (calls_per_s / "
+                       "latency_us / overhead_vs_raw_x per lane)"),
+    "accl_sweep_": "bench sweep peak bus-bandwidth gauge per collective",
+    "accl_engine_unknown_field_": ("engine stats field past this "
+                                   "build's schema (newer engine)"),
+}
+
+
+def metric_help_for(name: str) -> Optional[str]:
+    """HELP text for a FINAL (sanitized, prefixed) family name — exact
+    entries first, then the dynamic-name prefixes.  None = the family
+    is unknown to this build (the doctor renders it as unrecognized
+    instead of crashing; the schema test fails the build that MINTED an
+    unknown name)."""
+    if name in METRIC_HELP:
+        return METRIC_HELP[name]
+    for prefix, text in METRIC_HELP_PREFIXES.items():
+        if name.startswith(prefix):
+            return text
+    return None
 
 
 def payload_factor(coll: str, p: int) -> int:
@@ -299,8 +406,9 @@ class MetricsRegistry:
         out = []
 
         def describe(n: str) -> None:
-            if n in METRIC_HELP:
-                out.append(f"# HELP {n} {METRIC_HELP[n]}")
+            text = metric_help_for(n)
+            if text is not None:
+                out.append(f"# HELP {n} {text}")
 
         for k in sorted(snap["counters"]):
             n = name(k)
@@ -326,12 +434,14 @@ class MetricsRegistry:
             out.append(f"{n}_sum {v['sum_us']}")
             out.append(f"{n}_count {v['count']}")
         if snap["calls"]:
-            out.append("# TYPE accl_collective_calls counter")
-            out.append("# TYPE accl_collective_errors counter")
-            out.append("# TYPE accl_collective_bytes counter")
-            out.append("# TYPE accl_collective_latency_us histogram")
-            out.append("# TYPE accl_collective_algbw_gbps gauge")
-            out.append("# TYPE accl_collective_busbw_gbps gauge")
+            for fam, kind in (("accl_collective_calls", "counter"),
+                              ("accl_collective_errors", "counter"),
+                              ("accl_collective_bytes", "counter"),
+                              ("accl_collective_latency_us", "histogram"),
+                              ("accl_collective_algbw_gbps", "gauge"),
+                              ("accl_collective_busbw_gbps", "gauge")):
+                describe(fam)
+                out.append(f"# TYPE {fam} {kind}")
         for k in sorted(snap["calls"]):
             c = snap["calls"][k]
             lbl = (f'collective="{esc(c["collective"])}",'
@@ -391,3 +501,104 @@ def dump_metrics(registry: Optional[MetricsRegistry] = None,
                  as_json: bool = False) -> str:
     reg = registry if registry is not None else _default
     return reg.to_json() if as_json else reg.to_text()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics validator (r14): the schema contract, enforced by
+# construction.  tests/test_telemetry.py runs every exporter body
+# through this, and the METRIC_HELP completeness test closes the drift
+# class where a new family ships without HELP text — a scrape consumer
+# should never meet an undocumented family.
+# ---------------------------------------------------------------------------
+def validate_openmetrics(text: str, require_help: bool = True) -> list:
+    """Validate an OpenMetrics exposition body; returns a list of
+    problem strings (empty = valid).  Checks the subset of the spec the
+    exporter promises: ``# TYPE`` precedes a family's samples, sample
+    names extend their declared family correctly (``_total`` for
+    counters; ``_bucket``/``_sum``/``_count`` for histograms), values
+    parse as numbers, histogram buckets are cumulative-monotonic with a
+    ``+Inf`` bound, label syntax is well-formed, and the body ends with
+    ``# EOF``.  With ``require_help``, every declared family must also
+    resolve through :func:`metric_help_for`."""
+    import re
+
+    problems: list = []
+    lines = text.splitlines()
+    if not lines or lines[-1].strip() != "# EOF":
+        problems.append("missing terminal '# EOF' line")
+    types: dict = {}
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*)?\})?'
+        r' (\S+)$')
+    # histogram cumulative check state: (family, labels-sans-le) -> last
+    hist_last: dict = {}
+
+    def family_of(name: str) -> Optional[str]:
+        for fam, kind in types.items():
+            if kind == "counter" and name == f"{fam}_total":
+                return fam
+            if kind == "histogram" and name in (
+                    f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"):
+                return fam
+            if kind == "gauge" and name == fam:
+                return fam
+        return None
+
+    for i, ln in enumerate(lines, 1):
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(None, 3)
+            if len(parts) < 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "info"):
+                problems.append(f"line {i}: malformed TYPE line: {ln!r}")
+                continue
+            types[parts[2]] = parts[3]
+            continue
+        if ln.startswith("#"):
+            continue
+        m = sample_re.match(ln)
+        if m is None:
+            problems.append(f"line {i}: unparsable sample: {ln!r}")
+            continue
+        name, labels, value = m.group(1), m.group(3) or "", m.group(5)
+        try:
+            float(value)
+        except ValueError:
+            problems.append(f"line {i}: non-numeric value {value!r}")
+        fam = family_of(name)
+        if fam is None:
+            problems.append(
+                f"line {i}: sample {name!r} has no matching TYPE "
+                f"declaration (or wrong suffix for its family kind)")
+            continue
+        if types[fam] == "histogram" and name == f"{fam}_bucket":
+            le = None
+            rest = []
+            for pair in labels.split(","):
+                if pair.startswith('le="'):
+                    le = pair[4:-1]
+                elif pair:
+                    rest.append(pair)
+            if le is None:
+                problems.append(f"line {i}: histogram bucket without le")
+                continue
+            key = (fam, ",".join(rest))
+            cum = float(value)
+            if key in hist_last and cum < hist_last[key]:
+                problems.append(
+                    f"line {i}: histogram {fam} buckets not cumulative")
+            hist_last[key] = cum
+            if le == "+Inf":
+                hist_last.pop(key, None)
+    for key in hist_last:
+        problems.append(f"histogram {key[0]} missing le=\"+Inf\" bucket")
+    if require_help:
+        for fam in types:
+            if metric_help_for(fam) is None:
+                problems.append(
+                    f"family {fam} has no METRIC_HELP entry (add one — "
+                    f"the schema contract scrape consumers pin)")
+    return problems

@@ -1,0 +1,218 @@
+"""Native-engine telemetry plane: the r14 stats sampler.
+
+The native engine (and the TPU gang scheduler) keep cheap internal
+counters — retransmit-store depth/evictions, NACKs sent/received,
+rx-pool occupancy high-water, per-transport queue depths, seek-miss
+rate, plan table/token state, wire accept/reject — that until r14 were
+only reachable one FFI at a time (resilience_stats, frame_stats) or not
+at all.  This module is the one polling loop that snapshots them
+(``device.engine_stats()``, backed by the versioned flat-array capi
+``accl_engine_stats``) and publishes them into the r8
+:class:`~accl_tpu.observability.metrics.MetricsRegistry` as ``engine/*``
+families, so /metrics scrapes, ``accl_doctor --live`` and the
+regression sentinel all see the engine's interior without new FFI
+surface per consumer — the per-stage offload-engine visibility ACCL+
+(arxiv 2312.11742) argues turns a collective engine from a black box
+into something tunable.
+
+Overhead discipline: ``ACCL_TELEMETRY_INTERVAL_MS=0`` (the default) is
+the hard off switch — no sampler thread is ever created and the call
+hot path is untouched either way (the engine-side counters are atomics
+it already maintained; the sampler only adds a reader).  The measured
+on/off callrate record is bench/results/callrate_r14_telemetry_*.json.
+
+Schema versioning: ``ENGINE_STATS_FIELDS_V1`` names the capi field
+order (append-only ABI — native/src/engine.cpp Engine::engine_stats is
+the producer).  A newer engine returning MORE fields than this build
+knows keeps the extras as ``engine/unknown_field_<i>`` gauges; the
+doctor renders those as "unrecognized (newer world?)" instead of
+crashing the report.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from .metrics import MetricsRegistry, default_registry
+
+#: capi accl_engine_stats version-1 field order (the ABI twin of
+#: native/src/engine.cpp Engine::engine_stats — APPEND ONLY)
+ENGINE_STATS_FIELDS_V1 = (
+    "retrans_store_depth",
+    "retrans_store_evictions",
+    "retrans_sent",
+    "nacks_tx",
+    "nacks_rx",
+    "fenced_drops",
+    "rx_occupancy",
+    "rx_occupancy_hwm",
+    "rx_staged",
+    "rx_staged_hwm",
+    "rx_pending",
+    "egress_depth",
+    "egress_hwm",
+    "ingress_depth",
+    "seeks",
+    "seek_misses",
+    "plans_live",
+    "plan_tokens",
+    "plan_replays",
+    "wire_accepted_frames",
+    "wire_rejected_frames",
+    "tx_msgs",
+    "tx_payload_bytes",
+    "joins_sponsored",
+    "joins_completed",
+)
+
+#: monotonic fields — published into the registry as counter DELTAS
+#: (``engine/<name>`` counters); everything else is a point-in-time
+#: gauge (depths, occupancy, high-water marks), published as the MAX
+#: across the sampled ranks of a world.  The TPU backend's extra
+#: dispatch-lane fields are classified here too.
+COUNTER_FIELDS = frozenset((
+    "retrans_store_evictions",
+    "retrans_sent",
+    "nacks_tx",
+    "nacks_rx",
+    "fenced_drops",
+    "seeks",
+    "seek_misses",
+    "plan_replays",
+    "wire_accepted_frames",
+    "wire_rejected_frames",
+    "tx_msgs",
+    "tx_payload_bytes",
+    "joins_sponsored",
+    "joins_completed",
+    # TPU dispatch-lane counters (TpuDeviceView.engine_stats)
+    "plan_auto_captures",
+    "leader_dispatches",
+    "executor_dispatches",
+    "batches",
+    "batched_gangs",
+))
+
+
+def interval_ms() -> int:
+    """Sampler period; ``0`` (the default) = telemetry OFF — no thread,
+    zero added work anywhere.  Malformed values raise the naming
+    ACCLError (the constants.env_int clear-error contract)."""
+    from ..constants import env_int
+
+    return env_int("ACCL_TELEMETRY_INTERVAL_MS", 0, minimum=0)
+
+
+class TelemetrySampler:
+    """Daemon thread polling per-rank ``engine_stats()`` dicts into a
+    MetricsRegistry as ``engine/*`` families.
+
+    ``sources`` is a list of zero-arg callables, one per rank, each
+    returning a flat {field: int} dict (EmuDevice.engine_stats /
+    TpuDeviceView.engine_stats).  Counters are aggregated as summed
+    deltas across ranks (so the family is world-total and survives
+    sampler restarts without double counting); gauges as the max across
+    ranks (the binding resource is the hottest rank's).  A source that
+    raises (e.g. its world closed mid-poll) is skipped — telemetry must
+    never take a workload down.
+    """
+
+    def __init__(self, sources: Iterable[Callable[[], dict]],
+                 registry: Optional[MetricsRegistry] = None,
+                 interval_s: float = 1.0, name: str = "accl"):
+        self._sources = list(sources)
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self.interval_s = max(interval_s, 0.001)
+        self._name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: last published counter totals, per field (summed over ranks):
+        #: each sample publishes the positive delta
+        self._published: dict = {}
+        #: samples taken (tests assert liveness without sleeping blind)
+        self.samples = 0
+
+    # -- one poll -------------------------------------------------------
+    def sample(self) -> dict:
+        """Poll every source once and publish; returns the aggregated
+        {field: value} snapshot (counters as running totals)."""
+        counters: dict = {}
+        gauges: dict = {}
+        for src in self._sources:
+            try:
+                stats = src()
+            except Exception:  # noqa: BLE001 — a dead world mid-poll
+                continue
+            for k, v in stats.items():
+                if k == "version":
+                    continue
+                if k in COUNTER_FIELDS:
+                    counters[k] = counters.get(k, 0) + int(v)
+                else:
+                    gauges[k] = max(gauges.get(k, 0), int(v))
+        for k, total in counters.items():
+            delta = total - self._published.get(k, 0)
+            if delta > 0:
+                self._registry.inc(f"engine/{k}", delta)
+                self._published[k] = total
+        for k, v in gauges.items():
+            self._registry.set_gauge(f"engine/{k}", v)
+        self.samples += 1
+        return {**counters, **gauges}
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "TelemetrySampler":
+        if self._thread is None and self._sources:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"{self._name}-telemetry",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        # sample immediately, then on the period: a short-lived world
+        # still lands one snapshot in the registry
+        while True:
+            try:
+                self.sample()
+            except Exception:  # pragma: no cover — never kill the host
+                pass
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+
+def sampler_from_env(sources: Iterable[Callable[[], dict]],
+                     registry: Optional[MetricsRegistry] = None,
+                     name: str = "accl") -> Optional[TelemetrySampler]:
+    """Arm a sampler per ``ACCL_TELEMETRY_INTERVAL_MS`` — None (and no
+    thread, no work) when the knob is 0/unset.  Worlds call this at
+    bring-up and ``stop()`` it in close()."""
+    ms = interval_ms()
+    if ms <= 0:
+        return None
+    return TelemetrySampler(sources, registry=registry,
+                            interval_s=ms / 1000.0, name=name).start()
+
+
+def decode_engine_stats(values, version: int = 1,
+                        total_fields: Optional[int] = None) -> dict:
+    """Decode a flat capi stats array into the named dict.  Fields past
+    this build's schema knowledge (a NEWER engine) are kept as
+    ``unknown_field_<i>`` so nothing is silently dropped; the doctor
+    renders them as unrecognized instead of crashing."""
+    names = ENGINE_STATS_FIELDS_V1
+    out = {"version": version}
+    for i, v in enumerate(values):
+        if total_fields is not None and i >= total_fields:
+            break
+        key = names[i] if i < len(names) else f"unknown_field_{i}"
+        out[key] = int(v)
+    return out

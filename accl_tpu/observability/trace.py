@@ -305,6 +305,48 @@ def traced_window(label: str,
 # ---------------------------------------------------------------------------
 # multi-process merge
 # ---------------------------------------------------------------------------
+def salvage_torn_json(text: str, list_key: str) -> tuple:
+    """Best-effort parse of a JSON document truncated mid-write (a
+    crash-time dump: the process died inside ``json.dump``).  Finds the
+    ``"<list_key>": [`` array and decodes its elements one by one until
+    the torn tail, reconstructing ``{<scalars before the array>,
+    <list_key>: [complete elements]}``.  Returns ``(doc, skipped_tail_
+    bytes)`` — the caller reports the skip instead of raising, so ONE
+    rank's torn dump cannot take the whole post-mortem merge down.
+    Raises ValueError only when not even the array start is present."""
+    import re
+
+    decoder = json.JSONDecoder()
+    m = re.search(r'"%s"\s*:\s*\[' % re.escape(list_key), text)
+    if m is None:
+        raise ValueError(f"no {list_key!r} array found in torn document")
+    # scalar fields before the array (rank/capacity/... or nothing)
+    doc: dict = {}
+    for sm in re.finditer(
+            r'"([A-Za-z0-9_]+)"\s*:\s*(-?\d+(?:\.\d+)?|"(?:[^"\\]|\\.)*"'
+            r'|true|false|null)\s*,', text[:m.start()]):
+        try:
+            doc[sm.group(1)] = json.loads(sm.group(2))
+        except json.JSONDecodeError:  # pragma: no cover — regex-vetted
+            continue
+    items: list = []
+    pos = m.end()
+    n = len(text)
+    while True:
+        while pos < n and text[pos] in " \t\r\n,":
+            pos += 1
+        if pos >= n or text[pos] == "]":
+            break
+        try:
+            item, end = decoder.raw_decode(text, pos)
+        except json.JSONDecodeError:
+            break  # the torn tail starts here
+        items.append(item)
+        pos = end
+    doc[list_key] = items
+    return doc, max(n - pos, 0)
+
+
 def merge_trace_files(paths, out_path: Optional[str] = None) -> dict:
     """Merge per-process trace files (e.g. one per multihost rank) into
     one timeline, aligning clocks by shared gang ids: each file is
@@ -313,9 +355,28 @@ def merge_trace_files(paths, out_path: Optional[str] = None) -> dict:
     world gets for free from the shared monotonic clock."""
     merged: list = []
     ref_gangs: dict = {}
+    torn: list = []
     for i, path in enumerate(paths):
         with open(path) as f:
-            events = json.load(f).get("traceEvents", [])
+            text = f.read()
+        try:
+            events = json.loads(text).get("traceEvents", [])
+        except json.JSONDecodeError:
+            # crash-time dump truncated mid-record (r14 satellite):
+            # salvage the complete prefix, skip the torn tail with a
+            # warning + a count in the merged doc — one dead rank must
+            # not take the whole post-mortem timeline down
+            doc_part, skipped = salvage_torn_json(text, "traceEvents")
+            events = doc_part.get("traceEvents", [])
+            torn.append({"path": str(path),
+                         "events_recovered": len(events),
+                         "tail_bytes_skipped": skipped})
+            from ..utils.logging import get_logger
+
+            get_logger("accl_tpu.trace").warning(
+                "trace file %s is truncated mid-record — salvaged %d "
+                "event(s), skipped %d torn tail byte(s)",
+                path, len(events), skipped)
         gangs = {}
         for ev in events:
             args = ev.get("args") or {}
@@ -344,6 +405,8 @@ def merge_trace_files(paths, out_path: Optional[str] = None) -> dict:
                 ev = dict(ev, ts=ev["ts"] + offset)
             merged.append(ev)
     doc = {"traceEvents": merged, "displayTimeUnit": "ns"}
+    if torn:
+        doc["torn_files"] = torn
     if out_path:
         with open(out_path, "w") as f:
             json.dump(doc, f)

@@ -522,6 +522,20 @@ void accl_frame_stats(void* wp, int rank, uint64_t* accepted,
   if (e) e->frame_stats(accepted, rejected);
 }
 
+// ---- engine telemetry snapshot (r14): the native-engine stats plane
+// the observability sampler polls (accl_tpu/observability/telemetry.py).
+// Versioned flat-array ABI: the schema version names a fixed field
+// ORDER (append-only across versions); the caller passes a u64 buffer
+// of `cap` entries, the engine fills min(cap, fields) and returns how
+// many fields this build knows — an older caller reads a prefix, a
+// newer caller learns exactly how much arrived.  -1 = unknown rank. ----
+int accl_engine_stats_version(void) { return Engine::kEngineStatsVersion; }
+
+int accl_engine_stats(void* wp, int rank, uint64_t* out, int cap) {
+  Engine* e = world_get(wp, rank);
+  return e ? e->engine_stats(out, cap) : -1;
+}
+
 // Egress frame tap on/off (bounded ring of the last 256 staged frames).
 int accl_frame_tap(void* wp, int rank, int on) {
   Engine* e = world_get(wp, rank);

@@ -329,6 +329,7 @@ long long Engine::plan_replay(int plan_id) {
   std::vector<uint64_t> ids;
   ids.reserve(descs.size());
   for (auto& w : descs) ids.push_back(start_call(w.data()));
+  plan_replays_.fetch_add(1);
   MutexLock g(plans_mu_);
   long long token = next_plan_token_++;
   plan_tokens_[token] = std::move(ids);
@@ -425,6 +426,68 @@ int Engine::plan_count() const {
   for (const EnginePlan& p : plans_)
     if (p.valid) ++n;
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// engine telemetry snapshot (r14): the versioned flat export behind
+// capi accl_engine_stats.  FIELD ORDER IS THE ABI — append only, and
+// keep ENGINE_STATS_FIELDS_V1 in accl_tpu/observability/telemetry.py
+// in lockstep.
+// ---------------------------------------------------------------------------
+int Engine::engine_stats(uint64_t* out, int cap) {
+  uint64_t egress_depth = 0;
+  {
+    MutexLock g(egress_mu_);
+    egress_depth = egress_q_.size();
+  }
+  uint64_t plans_live = 0, plan_tokens = 0;
+  {
+    MutexLock g(plans_mu_);
+    for (const EnginePlan& p : plans_)
+      if (p.valid) ++plans_live;
+    plan_tokens = plan_tokens_.size();
+  }
+  const uint64_t fields[] = {
+      // -- retransmit store --
+      retrans_used_.load(),        // 0 retrans_store_depth
+      retrans_evictions_.load(),   // 1 retrans_store_evictions
+      retrans_sent_.load(),        // 2 retrans_sent
+      nacks_tx_.load(),            // 3 nacks_tx
+      nacks_rx_.load(),            // 4 nacks_rx
+      fenced_drops_.load(),        // 5 fenced_drops
+      // -- rx pool --
+      rx_.occupancy(),             // 6 rx_occupancy
+      rx_.occupancy_hwm(),         // 7 rx_occupancy_hwm
+      rx_.staged(),                // 8 rx_staged
+      rx_.staged_hwm(),            // 9 rx_staged_hwm
+      rx_.pending(),               // 10 rx_pending
+      // -- transport queues --
+      egress_depth,                // 11 egress_depth
+      egress_hwm_.load(),          // 12 egress_hwm
+      uint64_t(std::max(ingress_depth_.load(), 0)),  // 13 ingress_depth
+      // -- seek discipline --
+      seeks_.load(),               // 14 seeks
+      seek_misses_.load(),         // 15 seek_misses
+      // -- persistent plans --
+      plans_live,                  // 16 plans_live
+      plan_tokens,                 // 17 plan_tokens
+      plan_replays_.load(),        // 18 plan_replays
+      // -- wire validation --
+      frames_accepted_.load(),     // 19 wire_accepted_frames
+      frames_rejected_.load(),     // 20 wire_rejected_frames
+      // -- egress traffic --
+      tx_msgs_.load(),             // 21 tx_msgs
+      tx_payload_bytes_.load(),    // 22 tx_payload_bytes
+      // -- elastic membership --
+      joins_sponsored_.load(),     // 23 joins_sponsored
+      joins_completed_.load(),     // 24 joins_completed
+  };
+  const int total = int(sizeof(fields) / sizeof(fields[0]));
+  if (out) {
+    int n = cap < total ? (cap < 0 ? 0 : cap) : total;
+    for (int i = 0; i < n; ++i) out[i] = fields[i];
+  }
+  return total;
 }
 
 void Engine::push_krnl(const uint8_t* data, uint64_t n) {
@@ -606,6 +669,9 @@ void Engine::stage_egress(uint32_t session, Message&& msg) {
     });
     if (!egress_running_) return;
     egress_q_.emplace_back(session, std::move(msg));
+    uint64_t d = egress_q_.size(), h = egress_hwm_.load();
+    while (d > h && !egress_hwm_.compare_exchange_weak(h, d)) {
+    }
   }
   egress_cv_.notify_all();
 }
@@ -932,6 +998,10 @@ void Engine::store_retrans(uint32_t comm, uint32_t dst, const Message& msg) {
   if (retrans_ring_.empty()) retrans_ring_.resize(kRetransCap);
   RetransSlot& s = retrans_ring_[retrans_pos_];
   retrans_pos_ = (retrans_pos_ + 1) % kRetransCap;
+  if (s.used)
+    retrans_evictions_.fetch_add(1);  // ring wrap over a live slot
+  else
+    retrans_used_.fetch_add(1);
   s.used = true;
   s.comm = comm;
   s.dst = dst;
@@ -1052,6 +1122,7 @@ void Engine::reset_errors() {
     MutexLock g(retrans_mu_);
     for (RetransSlot& s : retrans_ring_) s.used = false;
     retrans_pos_ = 0;
+    retrans_used_.store(0);
   }
   {
     MutexLock g(strm_seq_mu_);
@@ -2004,6 +2075,7 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
                                                    uint32_t tag,
                                                    int* evicted_out) {
   CommTable& t = *comm_ptr(c.comm());
+  seeks_.fetch_add(1);
   auto budget = timeout_budget();
   auto deadline = steady_clock::now() + budget;
   uint32_t retry_max = retrans_enabled() ? retry_max_.load() : 0;
@@ -2025,7 +2097,12 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
     }
     uint32_t expect = t.inbound_seq[src];
     auto now = steady_clock::now();
-    if (now >= deadline) return std::nullopt;
+    if (now >= deadline) {
+      // a genuine matching failure (timeout after the recovery budget),
+      // not an abort/shutdown wake — the seek-miss telemetry observable
+      seek_misses_.fetch_add(1);
+      return std::nullopt;
+    }
     nanoseconds slice;
     bool fast_phase = attempts < retry_max;
     if (fast_phase) {

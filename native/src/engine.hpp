@@ -165,6 +165,19 @@ class Engine {
     if (rejected) *rejected = frames_rejected_.load();
   }
 
+  // ---- engine telemetry snapshot (r14): the versioned flat stats
+  // export behind capi accl_engine_stats.  Fills up to `cap` u64
+  // fields of the version-1 layout (field order is the ABI — APPEND
+  // ONLY; the Python twin is ENGINE_STATS_FIELDS_V1 in
+  // accl_tpu/observability/telemetry.py) and returns the total field
+  // count this build knows, so an older caller reads a prefix and a
+  // newer caller sees exactly how much the engine filled.  Cheap by
+  // construction: atomics plus three short lock holds (egress depth,
+  // plan table, rx staging) — pollable at 10 Hz without touching the
+  // call hot path. ----
+  static constexpr int kEngineStatsVersion = 1;
+  int engine_stats(uint64_t* out, int cap);
+
   // Egress frame tap: bounded ring of the last kTapCap frames this
   // engine staged (serialized header + payload) — the wire fuzzer's
   // seed-corpus capture (scripts/fuzz_wire.py records one real frame
@@ -563,6 +576,11 @@ class Engine {
   std::atomic<uint32_t> retry_base_us_{200};
   std::atomic<uint64_t> retrans_sent_{0}, nacks_tx_{0}, nacks_rx_{0};
   std::atomic<uint64_t> fenced_drops_{0};
+  // telemetry shadows (engine_stats): live slot count and the number
+  // of times a still-used slot was overwritten by ring wrap (store
+  // pressure — a NACK after an eviction can no longer be served).
+  // Written under retrans_mu_, read lock-free by the sampler.
+  std::atomic<uint64_t> retrans_used_{0}, retrans_evictions_{0};
   bool retrans_enabled() const {
     return retry_max_.load() > 0 && !lossy_transport_.load();
   }
@@ -577,6 +595,10 @@ class Engine {
   // entries themselves would have).
   std::optional<RxNotification> seek_recover(CallDesc& c, uint32_t src,
                                              uint32_t tag, int* evicted_out);
+  // telemetry: recovered-seek entries vs final misses (timeout /
+  // lossy-hole classification — NOT abort/shutdown wakes, which are
+  // fencing, not matching failures).  miss/seek is the seek-miss rate.
+  std::atomic<uint64_t> seeks_{0}, seek_misses_{0};
 
   // ---- abort + epoch fencing (resilience layer 2) ----
   static constexpr uint32_t kMaxComms = 64;  // comms_.reserve(64) twin
@@ -643,6 +665,9 @@ class Engine {
   std::deque<std::pair<uint32_t, Message>> egress_q_ ACCL_GUARDED_BY(egress_mu_);
   Mutex egress_mu_;
   CondVar egress_cv_;
+  // telemetry: egress staging high-water (depth is read live under
+  // egress_mu_ by engine_stats); written at stage time under the lock
+  std::atomic<uint64_t> egress_hwm_{0};
   std::atomic<uint32_t> pipeline_depth_{3};
   bool egress_running_ ACCL_GUARDED_BY(egress_mu_) = true;
   Thread egress_thread_;
@@ -734,6 +759,7 @@ class Engine {
   std::map<long long, std::vector<uint64_t>> plan_tokens_
       ACCL_GUARDED_BY(plans_mu_);
   long long next_plan_token_ ACCL_GUARDED_BY(plans_mu_) = 1;
+  std::atomic<uint64_t> plan_replays_{0};  // telemetry: replays queued
   // LOCK ORDER: plans_mu_ before results_mu_ (the replay token reaper
   // scans results under both); never the inverse.
   mutable Mutex plans_mu_ ACCL_ACQUIRED_BEFORE(results_mu_);

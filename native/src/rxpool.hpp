@@ -36,6 +36,7 @@ class RxPool {
     bufs_.assign(nbufs, std::vector<uint8_t>(bufsize));
     status_.assign(nbufs, Status::IDLE);
     bufsize_.store(bufsize);
+    occupancy_.store(0);  // fresh table: nothing RESERVED yet
     // The transport (and ingress) is live from engine construction, so a
     // peer racing ahead through bring-up can deliver BEFORE this pool is
     // configured; those deposits staged against zero buffers and — with
@@ -63,8 +64,25 @@ class RxPool {
         return;
       }
       staging_.push_back(std::move(msg));
+      uint64_t s = staging_.size(), h = staged_hwm_.load();
+      while (s > h && !staged_hwm_.compare_exchange_weak(h, s)) {
+      }
     }
   }
+
+  // ---- occupancy telemetry (r14 engine stats): RESERVED buffers now /
+  // high-water, staged-overflow depth/high-water, pending notification
+  // count.  Atomics written under m_ where they shadow guarded state,
+  // readable lock-free by the sampler thread — a stale read is fine,
+  // telemetry is not a synchronization primitive. ----
+  uint64_t occupancy() const { return occupancy_.load(); }
+  uint64_t occupancy_hwm() const { return occupancy_hwm_.load(); }
+  uint64_t staged() const {
+    MutexLock g(m_);
+    return staging_.size();
+  }
+  uint64_t staged_hwm() const { return staged_hwm_.load(); }
+  uint64_t pending() const { return notif_.size(); }
 
   // Seek a notification matching (comm, src, tag|TAG_ANY, seqn); blocks up
   // to `timeout`.  Returns nullopt on timeout (-> RECEIVE_TIMEOUT_ERROR).
@@ -182,6 +200,7 @@ class RxPool {
     MutexLock g(m_);
     staging_.clear();
     std::fill(status_.begin(), status_.end(), Status::IDLE);
+    occupancy_.store(0);  // forced reclaim: every buffer is IDLE again
   }
 
   // Is at least one buffer IDLE right now?  (pressure probe)
@@ -229,6 +248,8 @@ class RxPool {
   // (rxbuf_seek release path + re-enqueue).
   void release(uint32_t index) {
     MutexLock g(m_);
+    if (status_[index] == Status::RESERVED && occupancy_.load() > 0)
+      occupancy_.fetch_sub(1);
     status_[index] = Status::IDLE;
     if (!staging_.empty()) {
       Message msg = std::move(staging_.front());
@@ -259,6 +280,9 @@ class RxPool {
 
   void install_locked(uint32_t idx, Message& msg) ACCL_REQUIRES(m_) {
     status_[idx] = Status::RESERVED;
+    uint64_t o = occupancy_.fetch_add(1) + 1, h = occupancy_hwm_.load();
+    while (o > h && !occupancy_hwm_.compare_exchange_weak(h, o)) {
+    }
     size_t n = std::min<size_t>(msg.payload.size(), bufs_[idx].size());
     if (n) std::memcpy(bufs_[idx].data(), msg.payload.data(), n);
     RxNotification note;
@@ -278,6 +302,9 @@ class RxPool {
   std::deque<Message> staging_ ACCL_GUARDED_BY(m_);
   Fifo<RxNotification> notif_;  // internally locked
   std::atomic<uint64_t> bufsize_{0};  // hot-path read (frame_ok, eager segmentation)
+  // telemetry shadows (see the occupancy accessors): written under m_,
+  // read lock-free by the stats sampler
+  std::atomic<uint64_t> occupancy_{0}, occupancy_hwm_{0}, staged_hwm_{0};
 };
 
 }  // namespace accl

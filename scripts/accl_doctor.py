@@ -128,7 +128,10 @@ def scrape_live(target: str, timeout_s: float = 10.0) -> dict:
 
 
 def report_live(scraped: dict, out=sys.stdout) -> bool:
-    """Health + membership summary in front of the merged report."""
+    """Health + membership + engine-telemetry summary in front of the
+    merged report."""
+    from accl_tpu.observability.metrics import metric_help_for
+
     w = out.write
     hz = scraped["healthz"]
     w(f"live world health: {hz.get('health', '?')} "
@@ -137,7 +140,8 @@ def report_live(scraped: dict, out=sys.stdout) -> bool:
       f"{hz.get('watchdog_checks', 0)})\n")
     # surface the membership/recovery counter families from /metrics
     interesting = ("accl_membership_", "accl_recovery_",
-                   "accl_join_wait_us_count", "accl_health ")
+                   "accl_join_wait_us_count", "accl_health ",
+                   "accl_sentinel_")
     lines = [ln for ln in scraped["metrics"].splitlines()
              if ln and not ln.startswith("#")
              and any(ln.startswith(p) for p in interesting)]
@@ -145,6 +149,29 @@ def report_live(scraped: dict, out=sys.stdout) -> bool:
         w("membership / recovery metrics:\n")
         for ln in lines:
             w(f"  {ln}\n")
+    # engine telemetry families (r14 sampler: ACCL_TELEMETRY_INTERVAL_MS
+    # > 0 on the scraped world).  A family this doctor build does not
+    # know — a NEWER world exporting fields past our schema — renders as
+    # unrecognized instead of crashing the report.
+    engine_lines = [ln for ln in scraped["metrics"].splitlines()
+                    if ln and not ln.startswith("#")
+                    and ln.startswith("accl_engine_")]
+    if engine_lines:
+        w("engine telemetry (native stats sampler):\n")
+        for ln in engine_lines:
+            name = ln.split("{")[0].split(" ")[0]
+            family = name
+            for suffix in ("_total", "_bucket", "_sum", "_count"):
+                if family.endswith(suffix):
+                    family = family[: -len(suffix)]
+                    break
+            known = metric_help_for(family) or metric_help_for(name)
+            tag = "" if known else "  [unrecognized (newer world?)]"
+            w(f"  {ln}{tag}\n")
+    else:
+        w("engine telemetry: none exported (set "
+          "ACCL_TELEMETRY_INTERVAL_MS>0 on the world to sample the "
+          "native engine stats plane)\n")
     w("\n")
     return report(scraped["flight"], out)
 

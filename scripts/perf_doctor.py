@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""perf_doctor: offline collective-performance observatory report.
+
+The offline twin of the live r14 machinery — one command turns dump
+files into the same three-part report a running world exposes through
+/metrics + the sentinel:
+
+- **critical-path attribution** (observability/attribution.py): merged
+  flight dumps (+ optionally a Perfetto trace) -> per-collective phase
+  breakdown (queue / gang-wait / dispatch / wire / reduce), per-rank
+  clock skew, and straggler attribution naming the rank that arrives
+  last, how often, by how much;
+- **engine telemetry**: the ``engine/*`` counter/gauge families from a
+  metrics snapshot (``ACCL.metrics()`` JSON / trace_smoke's
+  metrics_smoke.json), rendered next to the wire/membership counters;
+- **regression sentinel** (observability/sentinel.py): the snapshot's
+  latency histograms + bandwidth compared against committed
+  ``bench/results`` baselines per (collective, dtype, size-bucket,
+  lane) with the same thresholds as the live sentinel.
+
+``--ci`` is the perf-gate mode: the REPORT SCHEMA is hard-validated
+(a malformed dump or snapshot fails the job) but threshold findings
+are advisory — shared CI cores swing 3x, so drift there is a warning
+in the artifact, not a red build.  ``--fail-on-findings`` makes drift
+fatal for local/dedicated-box use.
+
+Usage:
+  python scripts/perf_doctor.py --metrics metrics_smoke.json \\
+      --flight hang_flight_dump.json [--trace trace_smoke.json] \\
+      --baseline bench/results/callrate_r12_plan_on.json \\
+      [--baseline bench/results/sweep_gate_baseline_r12.csv] \\
+      [--out perf_doctor_report.json] [--ci | --fail-on-findings]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accl_tpu.observability import attribution  # noqa: E402
+from accl_tpu.observability.flight import merge_flight_dumps  # noqa: E402
+from accl_tpu.observability.sentinel import Baseline, Sentinel  # noqa: E402
+
+SNAPSHOT_KEYS = ("counters", "gauges", "calls")
+
+
+def load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        snap = json.load(f)
+    missing = [k for k in SNAPSHOT_KEYS if k not in snap]
+    if missing:
+        raise ValueError(
+            f"{path} is not a metrics snapshot (missing {missing}; want "
+            f"ACCL.dump_metrics(as_json=True) / metrics_smoke.json)")
+    return snap
+
+
+def engine_section(snap: dict) -> dict:
+    """The engine/* + wire/* + membership counter families."""
+    out = {"counters": {}, "gauges": {}}
+    for k, v in sorted(snap.get("counters", {}).items()):
+        if k.startswith(("engine/", "wire/", "membership/", "watchdog/",
+                         "plans/", "recovery/", "sentinel/")):
+            out["counters"][k] = v
+    for k, v in sorted(snap.get("gauges", {}).items()):
+        if k.startswith("engine/") or k == "accl_health":
+            out["gauges"][k] = v
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--metrics", default="",
+                    help="metrics snapshot JSON (dump_metrics as_json)")
+    ap.add_argument("--flight", nargs="*", default=[],
+                    help="flight dump file(s): per-rank, merged, or a "
+                         "watchdog dump (torn crash dumps are salvaged)")
+    ap.add_argument("--trace", default="",
+                    help="Perfetto trace JSON to refine the wire/reduce "
+                         "split from device windows")
+    ap.add_argument("--baseline", action="append", default=[],
+                    help="committed baseline (sentinel JSON, callrate "
+                         "record, registry snapshot, or sweep CSV); "
+                         "repeatable — later files fill gaps")
+    ap.add_argument("--out", default="",
+                    help="write the full JSON report here (CI artifact)")
+    ap.add_argument("--ci", action="store_true",
+                    help="perf-gate mode: schema failures are fatal, "
+                         "threshold findings advisory")
+    ap.add_argument("--fail-on-findings", action="store_true",
+                    help="exit 1 on any straggler dominance or sentinel "
+                         "drift finding (dedicated-box mode)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="include the per-gang timeline in the report")
+    args = ap.parse_args()
+    if not args.metrics and not args.flight:
+        ap.error("pass --metrics and/or --flight input files")
+
+    report: dict = {"version": 1}
+    schema_errors: list = []
+    findings = 0
+
+    # -- attribution over flight dumps ---------------------------------
+    if args.flight:
+        try:
+            merged = merge_flight_dumps(list(args.flight))
+            trace_doc = None
+            if args.trace:
+                with open(args.trace) as f:
+                    trace_doc = json.load(f)
+            attr = attribution.attribute(merged, trace_doc=trace_doc,
+                                         timeline=args.timeline)
+            report["attribution"] = attr
+            attribution.render(attr, sys.stdout)
+            for c in attr["collectives"].values():
+                d = c["dominant_straggler"]
+                if d is not None and d["share"] >= 0.5:
+                    findings += 1
+            torn = merged["analysis"].get("torn_dumps", [])
+            if torn:
+                print(f"note: {len(torn)} torn dump file(s) salvaged "
+                      f"(crash-time truncation) — "
+                      f"{[t['path'] for t in torn]}")
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+            schema_errors.append(f"flight/attribution: "
+                                 f"{type(e).__name__}: {e}")
+
+    # -- engine telemetry + sentinel over the metrics snapshot ---------
+    if args.metrics:
+        try:
+            snap = load_snapshot(args.metrics)
+            report["engine_telemetry"] = engine_section(snap)
+            print("\nengine telemetry:")
+            for k, v in report["engine_telemetry"]["counters"].items():
+                print(f"  {k:<40} {v}")
+            for k, v in report["engine_telemetry"]["gauges"].items():
+                print(f"  {k:<40} {v}")
+            if args.baseline:
+                base = None
+                for path in args.baseline:
+                    b = Baseline.load(path)
+                    base = b if base is None else base.merge(b)
+                sen = Sentinel(base)
+                drift = sen.compare_snapshot(snap)
+                report["sentinel"] = {
+                    "baselines": args.baseline,
+                    "thresholds": {"p50_ratio": sen.p50_ratio,
+                                   "p99_ratio": sen.p99_ratio,
+                                   "bw_ratio": sen.bw_ratio,
+                                   "min_calls": sen.min_calls},
+                    "findings": drift,
+                }
+                findings += len(drift)
+                print(f"\nregression sentinel: {len(drift)} drift "
+                      f"finding(s) vs {len(base.entries)} baseline "
+                      f"entr(ies)")
+                for f in drift:
+                    print(f"  {f['collective']} {f['dtype']} "
+                          f"{f['size_bucket']} {f['axis']}: live "
+                          f"{f['live']} vs baseline {f['baseline']} "
+                          f"({f['ratio']}x, threshold "
+                          f"{f['threshold']}x)")
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            schema_errors.append(f"metrics/sentinel: "
+                                 f"{type(e).__name__}: {e}")
+
+    report["schema_errors"] = schema_errors
+    report["findings_total"] = findings
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"\nreport written to {args.out}")
+
+    if schema_errors:
+        for e in schema_errors:
+            print(f"SCHEMA ERROR: {e}", file=sys.stderr)
+        return 2  # malformed inputs fail even (especially) in --ci
+    if args.fail_on_findings and findings:
+        return 1
+    if args.ci and findings:
+        print(f"\n--ci: {findings} finding(s) are ADVISORY on shared "
+              f"cores (see the report artifact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,8 +24,11 @@ def main() -> int:
     ap.add_argument("--count", type=int, default=256)
     args = ap.parse_args()
 
-    # arm tracing exactly as a user would (env var), before any accl use
+    # arm tracing exactly as a user would (env var), before any accl
+    # use; the engine telemetry sampler rides along so the metrics
+    # artifact carries the engine/* families perf_doctor renders (r14)
     os.environ["ACCL_TRACE"] = args.trace
+    os.environ.setdefault("ACCL_TELEMETRY_INTERVAL_MS", "100")
 
     import numpy as np
 
@@ -45,6 +48,8 @@ def main() -> int:
             return recv.host.copy()
 
         outs = world.run(body)
+        if world.telemetry is not None:
+            world.telemetry.sample()  # land one engine/* snapshot
     expected = np.sum([np.arange(args.count, dtype=np.float32) + r
                        for r in range(args.ranks)], axis=0)
     for got in outs:

@@ -442,8 +442,10 @@ def test_openmetrics_membership_schema():
     assert "accl_recovery_latency_us_sum 5000000.0" in text
     assert "accl_recovery_latency_us_count 1" in text
     # the gauge's code list stays in lockstep with HEALTH_NAMES
+    # (r14 added 5=slow — the regression sentinel's verdict)
+    assert "5=slow" in text
     assert obs_health.HEALTH_NAMES == (
-        "ok", "degraded", "hung", "aborted", "recovering")
+        "ok", "degraded", "hung", "aborted", "recovering", "slow")
 
 
 def test_flight_record_recovering_state():
