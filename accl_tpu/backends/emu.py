@@ -221,6 +221,12 @@ def _load_lib() -> ctypes.CDLL:
     lib.accl_engine_stats_version.argtypes = []
     lib.accl_engine_stats.restype = i32
     lib.accl_engine_stats.argtypes = [p, i32, ctypes.POINTER(u64), i32]
+    # per-link wire telemetry (r15): flat (comm, peer) counter rows
+    lib.accl_engine_link_stats_stride.restype = i32
+    lib.accl_engine_link_stats_stride.argtypes = []
+    lib.accl_engine_link_stats.restype = i32
+    lib.accl_engine_link_stats.argtypes = [p, i32, ctypes.POINTER(u64),
+                                           i32]
     _lib = lib
     return lib
 
@@ -591,6 +597,43 @@ class EmuDevice(CCLODevice):
         return _telemetry.decode_engine_stats(
             buf[:min(total, cap)], version=version, total_fields=total)
 
+    def link_stats(self) -> list:
+        """Per-(comm, peer) wire counters (r15): tx/rx messages+bytes,
+        retransmits served, NACKs both directions, epoch-fenced drops,
+        and seek count/blocked-wait per peer — ONE FFI for the whole
+        link plane, decoded through the strict stride-checked schema
+        (LINK_STATS_FIELDS_V2).  Returns a list of row dicts; peers are
+        comm-local ranks (global ranks on comm 0)."""
+        from ..observability import telemetry as _telemetry
+
+        if not self._w:
+            raise ACCLError("link_stats: world is closed")
+        stride = int(self._lib.accl_engine_link_stats_stride())
+        expect = len(_telemetry.LINK_STATS_FIELDS_V2)
+        if stride != expect:
+            # deterministic stride agreement BEFORE any slicing: the
+            # decoder's whole-number-of-rows check alone would pass by
+            # coincidence whenever rows * new_stride happens to divide
+            # by the old one
+            raise ACCLError(
+                f"link_stats: engine row stride {stride} != this "
+                f"build's schema ({expect} fields) — mixed-version "
+                f"world; refusing to mis-slice")
+        total = int(self._lib.accl_engine_link_stats(
+            self._w, self._rank, None, 0))
+        if total < 0:
+            raise ACCLError(f"link_stats failed for rank {self._rank}")
+        if total == 0:
+            return []
+        # headroom: rows minted between the size probe and the read
+        cap = total + 16 * stride
+        buf = (ctypes.c_uint64 * cap)()
+        got = int(self._lib.accl_engine_link_stats(self._w, self._rank,
+                                                   buf, cap))
+        if got < 0:
+            raise ACCLError(f"link_stats failed for rank {self._rank}")
+        return _telemetry.decode_link_stats(buf[:min(got, cap)])
+
     # -- persistent collective plans (r12) ----------------------------
     def arm_plan(self, calls, expected=None, timeout_s: float = 30.0):
         """Pre-marshal a captured descriptor stream into the engine's
@@ -955,7 +998,9 @@ class EmuWorld:
         from ..observability import telemetry as _telemetry
 
         self.telemetry = _telemetry.sampler_from_env(
-            [d.engine_stats for d in self.devices], name="accl-emu")
+            [d.engine_stats for d in self.devices], name="accl-emu",
+            link_sources=[(r, d.link_stats)
+                          for r, d in enumerate(self.devices)])
         _live_worlds.add(self)  # interpreter-exit safety net
 
     def start_watchdog(self, **kwargs) -> "_health.Watchdog":
@@ -1046,6 +1091,21 @@ class EmuWorld:
         """Per-rank full engine telemetry snapshots (r14) — the same
         plane the ACCL_TELEMETRY_INTERVAL_MS sampler polls."""
         return [d.engine_stats() for d in self.devices]
+
+    def link_stats(self) -> dict:
+        """Per-rank link rows (r15): global rank -> decoded
+        (comm, peer) wire-counter rows."""
+        return {r: d.link_stats() for r, d in enumerate(self.devices)}
+
+    def link_matrix(self, comm: int = 0) -> dict:
+        """World-level P×P link traffic matrix over one communicator
+        (observability/telemetry.link_matrix doc) — the measured
+        per-link bandwidth/congestion input the topology-aware
+        selection work (ROADMAP item 2) consumes."""
+        from ..observability import telemetry as _telemetry
+
+        return _telemetry.link_matrix(self.link_stats(),
+                                      nranks=self.nranks, comm=comm)
 
     def run(self, fn: Callable, *args) -> list:
         """Run `fn(accl, rank, *args)` on every rank concurrently and

@@ -310,6 +310,16 @@ class TpuEngine:
                   "batched_gangs", "plan_replays", "plan_auto_captures"):
             self.metrics.inc(k, 0)
         self._log = get_logger("accl_tpu.tpu")
+        # per-link wire telemetry twin (r15): (src rank, comm, peer
+        # rank) -> counter dict in the LINK_STATS_FIELDS_V2 vocabulary.
+        # The gang scheduler IS this backend's wire, so the twin
+        # accounts the bytes its ring/tree schedules move per rank pair
+        # at dispatch time and folds the gang-assembly straggler wait
+        # into seek_wait_ns (the emulator's blocked-receiver analog):
+        # every non-last member's wait is attributed to the LAST-
+        # arriving rank's link — the peer that actually kept it waiting.
+        self._links: dict = {}
+        self._link_lock = threading.Lock()
         #: hang watchdog (observability/health.py), armed by
         #: start_watchdog once the world's per-rank flight recorders
         #: exist; fires with this engine's gang-assembly snapshot
@@ -631,6 +641,9 @@ class TpuEngine:
                     int(ErrorCode.SEGMENTER_EXPECTED_BTT_ERROR), 0.0)
                 return
         gkey = ("coll", int(call.scenario), call.comm, call.tag)
+        # link twin (r15): gang-arrival stamp for straggler-wait
+        # attribution (one clock read per collective submit)
+        request.link_arrival_ns = _trace.now_ns()
         ready = None
         with self._lock:
             q = self._gangs.setdefault(gkey, deque())
@@ -653,6 +666,7 @@ class TpuEngine:
             _mark_flight(ready, _flight.S_GANG_READY, t=t_ready)
             if _trace.enabled():
                 _mark_spans(ready, t_ready=t_ready)
+            self._account_gang_wait(call.comm, ready, t_ready)
             # plan auto-capture (ACCL_PLAN_AUTO): arm a one-slot ring
             # when EVERY member of this instance declared intent — the
             # agreement rides the gang itself, so all ranks switch to
@@ -730,6 +744,97 @@ class TpuEngine:
         with self._ready_cv:
             self._ready.append((scenario, comm_id, gang))
             self._ready_cv.notify()
+
+    # -- per-link wire telemetry twin (r15) ----------------------------
+    def _link_add(self, src: int, comm: int, peer: int, **counts) -> None:
+        with self._link_lock:
+            row = self._links.setdefault((src, comm, peer), {})
+            for k, v in counts.items():
+                row[k] = row.get(k, 0) + int(v)
+
+    def _account_gang_links(self, op, comm_id: int, gang: dict,
+                            nbytes: int) -> None:
+        """Fold one dispatched gang into the link twin.
+
+        Ring collectives move ``busbw_factor × nbytes`` per rank to its
+        right ring neighbor over P-1 (allgather/reduce_scatter) or
+        2(P-1) (allreduce) hops — the same nccl-tests accounting the
+        metrics registry derives bandwidth from, so the matrix and the
+        busbw gauges agree by construction.  Rooted collectives
+        attribute the payload to the root<->member links."""
+        members = self._comms.get(comm_id, [])
+        P = len(members)
+        if P < 2 or nbytes <= 0:
+            return
+        name = Operation(op).name
+        if name in ("allreduce", "allgather", "reduce_scatter",
+                    "alltoall"):
+            # nbytes is the per-rank operand (plan in_len); the busbw
+            # factors apply to the TOTAL moved payload, which for
+            # allgather is P x the per-rank contribution (the
+            # nccl-tests payload_factor convention)
+            if name == "allgather":
+                nbytes *= P
+            per_link = int(nbytes * _metrics.busbw_factor(name, P))
+            hops = 2 * (P - 1) if name == "allreduce" else P - 1
+            for i, src in enumerate(members):
+                right = members[(i + 1) % P]
+                left = members[(i - 1) % P]
+                self._link_add(src, comm_id, right, tx_msgs=hops,
+                               tx_bytes=per_link)
+                self._link_add(src, comm_id, left, rx_msgs=hops,
+                               rx_bytes=per_link)
+        elif name in ("bcast", "scatter", "gather", "reduce"):
+            root_local = next(iter(gang.values()))[0].root_src_dst
+            root = members[root_local] if root_local < P else members[0]
+            to_root = name in ("gather", "reduce")
+            # scatter's operand is the root's WHOLE input (in_len =
+            # n*P); each root->member link carries only its 1/P slice
+            per_link = nbytes // P if name == "scatter" else nbytes
+            for m in members:
+                if m == root:
+                    continue
+                a, b = (m, root) if to_root else (root, m)
+                self._link_add(a, comm_id, b, tx_msgs=1,
+                               tx_bytes=per_link)
+                self._link_add(b, comm_id, a, rx_msgs=1,
+                               rx_bytes=per_link)
+
+    def _account_gang_wait(self, comm_id: int, gang: dict,
+                           t_ready: int) -> None:
+        """Straggler wait as the seek-latency analog: every non-last
+        member's (t_last − t_own) is attributed to the LAST-arriving
+        rank's link — the peer that actually kept the gang waiting."""
+        arrivals = {r: getattr(req, "link_arrival_ns", None)
+                    for r, (_c, req, _k) in gang.items()}
+        known = {r: t for r, t in arrivals.items() if t is not None}
+        if len(known) < 2:
+            return
+        last_rank = max(known, key=lambda r: known[r])
+        t_last = known[last_rank]
+        for r, t in known.items():
+            if r == last_rank:
+                continue
+            self._link_add(r, comm_id, last_rank, seeks=1,
+                           seek_wait_ns=max(t_last - t, 0))
+
+    def link_stats_for(self, rank: int) -> list:
+        """One rank's link rows in the LINK_STATS_FIELDS_V2 vocabulary
+        (TpuDeviceView.link_stats body).  Peers are GLOBAL ranks — the
+        gang scheduler addresses members globally; on comm 0 the two
+        vocabularies coincide, which is what link_matrix folds."""
+        from ..observability import telemetry as _telemetry
+
+        rows = []
+        with self._link_lock:
+            for (src, comm, peer), c in sorted(self._links.items()):
+                if src != rank:
+                    continue
+                row = {"comm": comm, "peer": peer}
+                for f in _telemetry.LINK_COUNTER_FIELDS:
+                    row[f] = int(c.get(f, 0))
+                rows.append(row)
+        return rows
 
     def abort_comm(self, comm_id: int, err_bits: int) -> bool:
         """Epoch-analog abort for the in-process TPU engine: mark the
@@ -1183,6 +1288,14 @@ class TpuEngine:
             if plan is None:  # barrier: the replay rendezvous IS it
                 return
             x = self._assemble_global(plan, slot["gang"])
+            # link twin (r15): replayed collectives are the dominant
+            # steady-state traffic under ACCL_PLAN_AUTO — without this
+            # the matrix would report near-zero for exactly the lane
+            # that matters (no gang-wait here: a replay rendezvouses
+            # on the ring sequence, not per-member arrival)
+            self._account_gang_links(
+                slot["op"], slot["comm"], slot["gang"],
+                plan["in_len"] * np.dtype(plan["dtype"]).itemsize)
             y = plan["compiled"](x)
             self._scatter_back(plan, y)
         elif kind == "local":
@@ -1473,6 +1586,10 @@ class TpuEngine:
                     _mark_spans(gang, lane="batched", t_dispatch=td)
             xs = [self._assemble_global(plan, gang)
                   for _op, _c, gang, plan in items]
+            for op_, c_, gang_, plan_ in items:
+                self._account_gang_links(
+                    op_, c_, gang_,
+                    plan_["in_len"] * np.dtype(plan_["dtype"]).itemsize)
             fnb = _collective_fn(*items[0][3]["fn_args"],
                                  nbatch=len(items))
             t0 = time.perf_counter_ns()
@@ -1695,6 +1812,9 @@ class TpuEngine:
 
         plan = self._gang_plan(op, comm_id, gang)
         x = self._assemble_global(plan, gang)
+        self._account_gang_links(
+            op, comm_id, gang,
+            plan["in_len"] * np.dtype(plan["dtype"]).itemsize)
 
         t0 = time.perf_counter_ns()
         y = plan["compiled"](x)
@@ -2038,8 +2158,12 @@ class TpuDeviceView(CCLODevice):
             ready_depth = len(eng._ready)
         with eng._lock:  # _comm_gen mutates under _lock (abort/evict)
             gen = max(eng._comm_gen.values(), default=0)
+        with eng._link_lock:
+            link_rows = sum(1 for (src, _c, _p) in eng._links
+                            if src == self._rank)
         return {
-            "version": 1,
+            "version": 2,
+            "link_rows": link_rows,
             "plans_live": plans_live,
             "plan_ring_refs": plan_ring_refs,
             "plan_ring_generation": gen,
@@ -2052,6 +2176,13 @@ class TpuDeviceView(CCLODevice):
             "batched_gangs": counters.get("batched_gangs", 0),
             "ready_depth": ready_depth,
         }
+
+    def link_stats(self) -> list:
+        """Per-(comm, peer) wire-counter rows (r15) — the TPU twin of
+        EmuDevice.link_stats: ring/tree schedule bytes accounted at
+        gang dispatch, gang-assembly straggler wait as seek_wait_ns.
+        Peers are global ranks (== comm-local on comm 0)."""
+        return self._engine.link_stats_for(self._rank)
 
     # memory API kept for interface completeness; TPU buffers are opaque
     # handles, not a flat address space
@@ -2179,12 +2310,27 @@ class TpuWorld:
         from ..observability import telemetry as _telemetry
 
         self.telemetry = _telemetry.sampler_from_env(
-            [self.devices[0].engine_stats], name="accl-tpu")
+            [self.devices[0].engine_stats], name="accl-tpu",
+            link_sources=[(r, d.link_stats)
+                          for r, d in enumerate(self.devices)])
 
     def run(self, fn: Callable, *args) -> list:
         futures = [self._pool.submit(fn, self.accls[r], r, *args)
                    for r in range(self.nranks)]
         return [f.result(timeout=300) for f in futures]
+
+    def link_stats(self) -> dict:
+        """Per-rank link rows (r15): rank -> (comm, peer) counter rows
+        from the gang scheduler's wire twin."""
+        return {r: d.link_stats() for r, d in enumerate(self.devices)}
+
+    def link_matrix(self, comm: int = 0) -> dict:
+        """World-level P×P link traffic matrix (same schema as
+        EmuWorld.link_matrix — observability/telemetry.link_matrix)."""
+        from ..observability import telemetry as _telemetry
+
+        return _telemetry.link_matrix(self.link_stats(),
+                                      nranks=self.nranks, comm=comm)
 
     def close(self) -> None:
         if self.telemetry is not None:

@@ -320,6 +320,133 @@ def attribute(dumps, trace_doc: Optional[dict] = None,
     return report
 
 
+def _compute_windows(trace_doc: Optional[dict]) -> dict:
+    """rank -> sorted, MERGED [t0_ns, t1_ns) intervals of compute
+    activity from a Perfetto doc: the r15 ``device:*`` stamp-buffer
+    COMPUTE slices (``device_phase`` = reduce — the xfer slices are
+    the collective's own communication and must never count as cover
+    it hides behind) plus host-marked ``window:*`` compute spans."""
+    per: dict = {}
+    if not trace_doc:
+        return per
+    for ev in trace_doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        is_device_compute = args.get("device_track") \
+            and args.get("device_phase") != "xfer"
+        if not (is_device_compute
+                or str(ev.get("name", "")).startswith("window:")):
+            continue
+        t0 = ev.get("ts", 0) * 1e3
+        t1 = t0 + ev.get("dur", 0) * 1e3
+        if t1 > t0:
+            per.setdefault(ev.get("pid", -1), []).append((t0, t1))
+    # merge overlapping/adjacent intervals per rank: _overlap_ns sums
+    # the wire interval's intersection with EACH window, so unmerged
+    # overlap (a host window: span containing device stamp slices)
+    # would double-count cover and let recovered_compute exceed 1.0
+    for r, wins in per.items():
+        wins.sort()
+        merged = [wins[0]]
+        for w0, w1 in wins[1:]:
+            if w0 <= merged[-1][1]:
+                if w1 > merged[-1][1]:
+                    merged[-1] = (merged[-1][0], w1)
+            else:
+                merged.append((w0, w1))
+        per[r] = merged
+    return per
+
+
+def _overlap_ns(t0: float, t1: float, windows: list) -> float:
+    """Total intersection of [t0, t1) with a sorted interval list."""
+    total = 0.0
+    for w0, w1 in windows:
+        if w0 >= t1:
+            break
+        lo, hi = max(t0, w0), min(t1, w1)
+        if hi > lo:
+            total += hi - lo
+    return total
+
+
+def overlap(dumps, trace_doc: Optional[dict] = None) -> dict:
+    """Wire-exposed vs compute-overlapped time per collective — the
+    overlap accountant (precursor metric for ROADMAP item 3's
+    device-initiated fusion: ACCL+ reports overlap as recovered
+    compute fraction, arxiv 2312.11742).
+
+    Per gang-instance member, the WIRE interval is everything after the
+    gang assembled (dispatch → completion).  The part of it intersecting
+    a compute window on the same rank (device stamp tracks, host
+    ``window:`` spans) is *overlapped* — communication the rank hid
+    behind compute; the rest is *exposed* — wall time the wire alone
+    cost.  Fusion work shrinks ``exposed_fraction`` toward zero;
+    ``recovered_compute_fraction`` is how much of the wire time compute
+    already covers.
+
+    Returns ``{"nranks", "compute_windows", "collectives": {key: {
+    "wire_us", "overlapped_us", "exposed_us", "exposed_fraction",
+    "recovered_compute_fraction", "episodes"}}}``."""
+    doc = _ensure_merged(dumps)
+    ranks = sorted(rd["rank"] for rd in doc["ranks"])
+    instances = _gang_instances(doc)
+    windows = _compute_windows(trace_doc)
+
+    groups: dict = {}
+    for key, members in sorted(instances.items()):
+        comm, coll, tag, count, dtype, occ = key
+        if len(members) < 2:
+            continue
+        nbytes = max(rec.get("nbytes", 0) for rec in members.values())
+        gkey = f"{coll}|comm{comm}|{size_bucket(nbytes)}"
+        g = groups.setdefault(gkey, {
+            "collective": coll, "comm": comm,
+            "size_bucket": size_bucket(nbytes), "episodes": 0,
+            "wire_ns": 0.0, "overlapped_ns": 0.0, "span_ns": 0.0})
+        g["episodes"] += 1
+        for r, rec in members.items():
+            t_sub = rec.get("t_submit") or 0
+            t_cmp = rec.get("t_complete") or 0
+            if not t_sub or not t_cmp or t_cmp <= t_sub:
+                continue
+            # wire = after the gang assembled: dispatch (or the best
+            # earlier stamp) to completion — matches attribute()'s
+            # wire+reduce tail
+            t_wire = rec.get("t_dispatch") or rec.get("t_gang_ready") \
+                or rec.get("t_queue") or t_sub
+            t_wire = min(max(int(t_wire), t_sub), t_cmp)
+            wire = t_cmp - t_wire
+            g["span_ns"] += t_cmp - t_sub
+            g["wire_ns"] += wire
+            g["overlapped_ns"] += _overlap_ns(t_wire, t_cmp,
+                                              windows.get(r, []))
+
+    collectives: dict = {}
+    for gkey, g in sorted(groups.items()):
+        wire, ovl, span = g["wire_ns"], g["overlapped_ns"], g["span_ns"]
+        exposed = max(wire - ovl, 0.0)
+        collectives[gkey] = {
+            "collective": g["collective"], "comm": g["comm"],
+            "size_bucket": g["size_bucket"], "episodes": g["episodes"],
+            "wire_us": round(wire / 1e3, 2),
+            "overlapped_us": round(ovl / 1e3, 2),
+            "exposed_us": round(exposed / 1e3, 2),
+            # exposed wire as a fraction of total span: the wall-clock
+            # share the wire alone cost (drops when a slow peer heals
+            # OR when fusion hides the wire behind compute)
+            "exposed_fraction": round(exposed / span, 4) if span else 0.0,
+            "recovered_compute_fraction": round(ovl / wire, 4)
+            if wire else 0.0,
+        }
+    return {
+        "nranks": len(ranks),
+        "compute_windows": sum(len(v) for v in windows.values()),
+        "collectives": collectives,
+    }
+
+
 def render(report: dict, out=None) -> str:
     """Human rendering of an attribution report (perf_doctor's body)."""
     lines = [

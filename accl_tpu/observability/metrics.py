@@ -110,6 +110,10 @@ METRIC_HELP = {
                                      "the transport"),
     "accl_engine_joins_sponsored": "elastic joins answered as sponsor",
     "accl_engine_joins_completed": "elastic joins completed as joiner",
+    # ---- per-link wire telemetry (r15, accl_engine_link_stats) ----
+    "accl_engine_link_rows": ("(comm, peer) link rows the engine's "
+                              "per-link counter plane is tracking "
+                              "(gauge, max rank)"),
     # TPU gang-scheduler twin fields (TpuDeviceView.engine_stats)
     "accl_engine_plan_ring_refs": ("per-rank plan handles pinning live "
                                    "TPU plan rings"),
@@ -163,6 +167,12 @@ METRIC_HELP_PREFIXES = {
     "accl_sweep_": "bench sweep peak bus-bandwidth gauge per collective",
     "accl_engine_unknown_field_": ("engine stats field past this "
                                    "build's schema (newer engine)"),
+    # r15 wire layer: one counter per (field, src->dst) link cell plus
+    # the world total per field — the exported P×P traffic matrix
+    # (observability/telemetry.py link_matrix / TelemetrySampler)
+    "accl_link_": ("per-link wire counter (tx/rx msgs+bytes, "
+                   "retransmits, NACKs, fenced drops, seek wait) per "
+                   "src->dst link cell, world total when unsuffixed"),
 }
 
 

@@ -21,17 +21,26 @@ hot path is untouched either way (the engine-side counters are atomics
 it already maintained; the sampler only adds a reader).  The measured
 on/off callrate record is bench/results/callrate_r14_telemetry_*.json.
 
-Schema versioning: ``ENGINE_STATS_FIELDS_V1`` names the capi field
-order (append-only ABI — native/src/engine.cpp Engine::engine_stats is
-the producer).  A newer engine returning MORE fields than this build
-knows keeps the extras as ``engine/unknown_field_<i>`` gauges; the
-doctor renders those as "unrecognized (newer world?)" instead of
-crashing the report.
+Schema versioning: ``ENGINE_STATS_FIELDS_V1``/``_V2`` name the capi
+field order per version (append-only ABI — native/src/engine.cpp
+Engine::engine_stats is the producer; v2 appends ``link_rows``).  A
+newer engine returning MORE fields than this build knows keeps the
+extras as ``engine/unknown_field_<i>`` gauges; the doctor renders
+those as "unrecognized (newer world?)" instead of crashing the report.
+
+The wire layer (r15): ``accl_engine_link_stats`` exports flat
+per-(comm, peer) counter rows — :data:`LINK_STATS_FIELDS_V2` is the
+row schema, :func:`decode_link_stats` the strict decoder (a length
+that is not a whole number of rows raises, never mis-slices), and
+:func:`link_matrix` folds every rank's rows into the world-level P×P
+traffic matrix the HiCCL-style topology autotuner (ROADMAP item 2,
+arxiv 2408.05962) will consume.  The sampler publishes the matrix as
+``link/*`` metric families.
 """
 from __future__ import annotations
 
 import threading
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from .metrics import MetricsRegistry, default_registry
 
@@ -64,6 +73,38 @@ ENGINE_STATS_FIELDS_V1 = (
     "joins_sponsored",
     "joins_completed",
 )
+
+#: v2 (r15) appends the link-plane row count — the only new scalar; the
+#: per-peer counters themselves ride the separate link_stats array
+ENGINE_STATS_FIELDS_V2 = ENGINE_STATS_FIELDS_V1 + ("link_rows",)
+
+#: version -> field table (decode_engine_stats consults this so a v1
+#: decoder over a v2 engine keeps field 25 as unknown_field_25 — the
+#: forward-compat contract the table-driven tests pin both ways)
+ENGINE_STATS_FIELDS_BY_VERSION = {
+    1: ENGINE_STATS_FIELDS_V1,
+    2: ENGINE_STATS_FIELDS_V2,
+}
+
+#: capi accl_engine_link_stats per-row field order (the ABI twin of
+#: native/src/engine.cpp Engine::link_stats — row stride is its length)
+LINK_STATS_FIELDS_V2 = (
+    "comm",
+    "peer",
+    "tx_msgs",
+    "tx_bytes",
+    "rx_msgs",
+    "rx_bytes",
+    "retrans_sent",
+    "nacks_tx",
+    "nacks_rx",
+    "fenced_drops",
+    "seeks",
+    "seek_wait_ns",
+)
+
+#: link-row fields that are per-link COUNTERS (everything but the key)
+LINK_COUNTER_FIELDS = LINK_STATS_FIELDS_V2[2:]
 
 #: monotonic fields — published into the registry as counter DELTAS
 #: (``engine/<name>`` counters); everything else is a point-in-time
@@ -119,8 +160,13 @@ class TelemetrySampler:
 
     def __init__(self, sources: Iterable[Callable[[], dict]],
                  registry: Optional[MetricsRegistry] = None,
-                 interval_s: float = 1.0, name: str = "accl"):
+                 interval_s: float = 1.0, name: str = "accl",
+                 link_sources: Optional[Iterable[
+                     Tuple[int, Callable[[], list]]]] = None):
         self._sources = list(sources)
+        #: (global rank, zero-arg callable returning decoded link rows)
+        #: — the wire layer (r15); empty = no link plane on this world
+        self._link_sources = list(link_sources or [])
         self._registry = registry if registry is not None \
             else default_registry()
         self.interval_s = max(interval_s, 0.001)
@@ -130,6 +176,11 @@ class TelemetrySampler:
         #: last published counter totals, per field (summed over ranks):
         #: each sample publishes the positive delta
         self._published: dict = {}
+        #: last published per-link counter totals, per (src, dst, field)
+        self._link_published: dict = {}
+        #: most recent world-level link matrix (link_matrix doc), for
+        #: perf_doctor/tests without re-polling the engines
+        self.last_link_matrix: Optional[dict] = None
         #: samples taken (tests assert liveness without sleeping blind)
         self.samples = 0
 
@@ -158,8 +209,48 @@ class TelemetrySampler:
                 self._published[k] = total
         for k, v in gauges.items():
             self._registry.set_gauge(f"engine/{k}", v)
+        self._sample_links()
         self.samples += 1
         return {**counters, **gauges}
+
+    def _sample_links(self) -> None:
+        """Poll the link plane (r15) and publish ``link/*`` families:
+        one counter per (field, src, dst) link cell plus the world
+        total per field — the exported form of the P×P traffic matrix.
+        Same delta discipline and same never-take-the-workload-down
+        tolerance as the scalar plane."""
+        if not self._link_sources:
+            return
+        per_rank: dict = {}
+        for rank, src in self._link_sources:
+            try:
+                per_rank[rank] = src()
+            except Exception:  # noqa: BLE001 — a dead world mid-poll
+                continue
+        if not per_rank:
+            return
+        # the real world size comes from the configured sources, not
+        # from whoever answered this poll: a dead/closing rank must
+        # not shrink the matrix and drop live ranks' cells toward it
+        nranks = max(r for r, _src in self._link_sources) + 1
+        matrix = link_matrix(per_rank, nranks=nranks)
+        self.last_link_matrix = matrix
+        for field, cells in matrix["fields"].items():
+            world_total = 0
+            for s, row in enumerate(cells):
+                for d, total in enumerate(row):
+                    world_total += total
+                    key = (field, s, d)
+                    delta = total - self._link_published.get(key, 0)
+                    if delta > 0:
+                        self._registry.inc(
+                            f"link/{field}/r{s}->r{d}", delta)
+                        self._link_published[key] = total
+            key = (field, "world")
+            delta = world_total - self._link_published.get(key, 0)
+            if delta > 0:
+                self._registry.inc(f"link/{field}", delta)
+                self._link_published[key] = world_total
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "TelemetrySampler":
@@ -191,7 +282,10 @@ class TelemetrySampler:
 
 def sampler_from_env(sources: Iterable[Callable[[], dict]],
                      registry: Optional[MetricsRegistry] = None,
-                     name: str = "accl") -> Optional[TelemetrySampler]:
+                     name: str = "accl",
+                     link_sources: Optional[Iterable[
+                         Tuple[int, Callable[[], list]]]] = None,
+                     ) -> Optional[TelemetrySampler]:
     """Arm a sampler per ``ACCL_TELEMETRY_INTERVAL_MS`` — None (and no
     thread, no work) when the knob is 0/unset.  Worlds call this at
     bring-up and ``stop()`` it in close()."""
@@ -199,16 +293,21 @@ def sampler_from_env(sources: Iterable[Callable[[], dict]],
     if ms <= 0:
         return None
     return TelemetrySampler(sources, registry=registry,
-                            interval_s=ms / 1000.0, name=name).start()
+                            interval_s=ms / 1000.0, name=name,
+                            link_sources=link_sources).start()
 
 
 def decode_engine_stats(values, version: int = 1,
                         total_fields: Optional[int] = None) -> dict:
-    """Decode a flat capi stats array into the named dict.  Fields past
-    this build's schema knowledge (a NEWER engine) are kept as
-    ``unknown_field_<i>`` so nothing is silently dropped; the doctor
-    renders them as unrecognized instead of crashing."""
-    names = ENGINE_STATS_FIELDS_V1
+    """Decode a flat capi stats array into the named dict.  ``version``
+    selects the field table THIS DECODER applies (a v1 caller decoding
+    a v2 engine's array passes 1); fields past the selected schema's
+    knowledge (a NEWER engine) are kept as ``unknown_field_<i>`` so
+    nothing is silently dropped; the doctor renders them as
+    unrecognized instead of crashing."""
+    names = ENGINE_STATS_FIELDS_BY_VERSION.get(
+        version, ENGINE_STATS_FIELDS_V2 if version > 2
+        else ENGINE_STATS_FIELDS_V1)
     out = {"version": version}
     for i, v in enumerate(values):
         if total_fields is not None and i >= total_fields:
@@ -216,3 +315,89 @@ def decode_engine_stats(values, version: int = 1,
         key = names[i] if i < len(names) else f"unknown_field_{i}"
         out[key] = int(v)
     return out
+
+
+def decode_link_stats(values: Sequence[int]) -> List[dict]:
+    """Decode a flat ``accl_engine_link_stats`` array into per-link row
+    dicts (:data:`LINK_STATS_FIELDS_V2` order).  The array length MUST
+    be a whole number of rows: anything else means the caller and the
+    engine disagree on the stride, and slicing anyway would silently
+    shift every counter into the wrong field — raise the naming error
+    instead (the compat-hardening satellite's contract)."""
+    from ..constants import ACCLError
+
+    stride = len(LINK_STATS_FIELDS_V2)
+    vals = list(values)
+    if len(vals) % stride != 0:
+        raise ACCLError(
+            f"decode_link_stats: flat array length {len(vals)} is not "
+            f"a multiple of the per-peer stride {stride} — the engine "
+            f"and this decoder disagree on the link-row schema "
+            f"(mixed-version world?); refusing to mis-slice")
+    return [
+        {name: int(vals[r * stride + i])
+         for i, name in enumerate(LINK_STATS_FIELDS_V2)}
+        for r in range(len(vals) // stride)
+    ]
+
+
+def link_matrix(per_rank_rows: dict, nranks: Optional[int] = None,
+                comm: Optional[int] = 0) -> dict:
+    """Fold per-rank link rows into the world-level P×P traffic matrix.
+
+    ``per_rank_rows`` maps GLOBAL rank -> decoded link rows (the
+    ``link_stats()`` output of that rank's device).  ``comm`` selects
+    which communicator's rows to fold (default 0, the global comm,
+    whose comm-local peer ranks ARE global ranks); ``comm=None`` folds
+    every comm — callers owning sub-communicators must map peers to
+    global ranks themselves first.
+
+    Returns ``{"nranks": P, "fields": {field: P×P list-of-lists}}``
+    with ``matrix[src][dst]`` = rank src's counter toward peer dst for
+    the tx-side fields, and rank src's RECEIVE-side observation OF dst
+    for rx/nacks_tx/fenced/seek fields (both orientations describe the
+    src<->dst link; keeping the observer as the row preserves which
+    side measured it)."""
+    ranks = sorted(per_rank_rows)
+    P = nranks if nranks is not None else (max(ranks) + 1 if ranks else 0)
+    fields = {f: [[0] * P for _ in range(P)] for f in LINK_COUNTER_FIELDS}
+    for src, rows in per_rank_rows.items():
+        if src >= P:
+            continue
+        for row in rows:
+            if comm is not None and row.get("comm") != comm:
+                continue
+            dst = int(row.get("peer", -1))
+            if not 0 <= dst < P:
+                continue
+            for f in LINK_COUNTER_FIELDS:
+                fields[f][src][dst] += int(row.get(f, 0))
+    return {"nranks": P, "comm": comm, "fields": fields}
+
+
+def slowest_link(matrix: dict,
+                 field: str = "seek_wait_ns") -> Optional[Tuple[int, int]]:
+    """The (observer, peer) pair with the largest value of ``field`` in
+    a :func:`link_matrix` document — for ``seek_wait_ns`` that is the
+    link whose peer kept its receiver blocked longest, i.e. the slowest
+    link of the world.  None when the matrix carries no signal."""
+    cells = matrix.get("fields", {}).get(field)
+    if not cells:
+        return None
+    best, best_v = None, 0
+    for src, row in enumerate(cells):
+        for dst, v in enumerate(row):
+            if v > best_v:
+                best, best_v = (src, dst), v
+    return best
+
+
+def link_imbalance(matrix: dict, field: str = "tx_bytes") -> float:
+    """Max/mean ratio over the nonzero cells of one matrix field — the
+    congestion-skew observable perf_doctor flags (1.0 = perfectly
+    balanced; large = one link carries disproportionate traffic)."""
+    cells = matrix.get("fields", {}).get(field, [])
+    vals = [v for row in cells for v in row if v > 0]
+    if not vals:
+        return 1.0
+    return max(vals) / (sum(vals) / len(vals))

@@ -20,6 +20,15 @@ Overhead discipline: tracing is OFF unless ``ACCL_TRACE`` is set
 path at exit).  When off, :func:`enabled` is a module-bool read and
 :func:`new_span` is never called — the instrumented hot paths allocate
 nothing (tests/test_observability.py pins this).
+
+Device timelines (r15): the ``ACCL_DEVICE_TRACE`` Pallas ring kernels
+(ops/ring.py) write per-step stamp rows — :data:`DEVICE_TRACE_FIELDS`
+— into an extra kernel output; :func:`record_device_steps` lands them
+here via ``jax.debug.callback`` and :meth:`TraceCollector.to_perfetto`
+renders them as per-rank ``device:<collective>`` tracks next to the
+host spans.  Stamps are LOGICAL event-order clocks (Pallas exposes no
+cycle counter): one unit = one in-kernel phase boundary, anchored at
+the host-side arrival time of the stamp buffer.
 """
 from __future__ import annotations
 
@@ -29,7 +38,17 @@ import os
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
+
+#: per-step stamp-row schema of the ACCL_DEVICE_TRACE kernel output
+#: (ops/ring.py writes rows in exactly this column order): the virtual
+#: rank, the ring step, three logical phase stamps (send-issue,
+#: recv/ack-wait done, reduce/copy done), the two ring neighbors, and
+#: the per-neighbor byte counts of the step
+DEVICE_TRACE_FIELDS = (
+    "rank", "step", "seq_send", "seq_wait", "seq_phase",
+    "tx_peer", "rx_peer", "tx_bytes", "rx_bytes",
+)
 
 #: monotonic nanosecond clock shared by every instrumentation point —
 #: comparable across threads of one process, which is exactly the
@@ -98,6 +117,9 @@ class TraceCollector:
     def __init__(self, capacity: int = 65536):
         self.capacity = capacity
         self._spans: deque = deque(maxlen=capacity)
+        #: device stamp-buffer records (r15): one entry per traced
+        #: kernel invocation — {"collective", "base_ns", "rows"}
+        self._device: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._gang_seq = 0
         # (key, occurrence) -> gang id; bounded so an unbounded run
@@ -127,12 +149,42 @@ class TraceCollector:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._device.clear()
             self._gang_ids.clear()
             self._occurrence.clear()
 
     def spans(self) -> list:
         with self._lock:
             return list(self._spans)
+
+    # -- device stamp buffers (r15, ACCL_DEVICE_TRACE) -----------------
+    def add_device_steps(self, collective: str, rows: List[list],
+                         base_ns: Optional[int] = None) -> None:
+        """One traced kernel invocation's stamp rows (DEVICE_TRACE_
+        FIELDS order), anchored at ``base_ns`` (host arrival time by
+        default — the stamps themselves are logical event counters)."""
+        with self._lock:
+            self._device.append({
+                "collective": collective,
+                "base_ns": base_ns if base_ns is not None else now_ns(),
+                "rows": [list(map(int, r)) for r in rows],
+            })
+
+    def device_records(self) -> list:
+        with self._lock:
+            return list(self._device)
+
+    def device_link_bytes(self) -> dict:
+        """Per-neighbor byte counts folded out of the stamp buffers:
+        {(rank, peer): tx_bytes} — the device-side half of the link
+        matrix (the emu/tpu engine twins measure the host side)."""
+        out: dict = {}
+        for rec in self.device_records():
+            for row in rec["rows"]:
+                r = dict(zip(DEVICE_TRACE_FIELDS, row))
+                key = (r["rank"], r["tx_peer"])
+                out[key] = out.get(key, 0) + r["tx_bytes"]
+        return out
 
     def __len__(self) -> int:
         return len(self._spans)
@@ -195,6 +247,38 @@ class TraceCollector:
                 slice_ev(pid, f"lane:{s.lane}", s.name + gid,
                          s.t_device_begin or s.t_dispatch,
                          s.t_device_end or s.t_complete, args)
+        # device stamp-buffer tracks (r15): one `device:<collective>`
+        # track per rank; each step renders its transfer window
+        # (send-issue -> recv/ack-wait done) and its reduce/copy window
+        # as consecutive slices on the logical stamp clock (1 stamp
+        # unit = 1 us), anchored at the buffer's host arrival time
+        for rec in self.device_records():
+            base = rec["base_ns"]
+            coll = rec["collective"]
+            for row in rec["rows"]:
+                r = dict(zip(DEVICE_TRACE_FIELDS, row))
+                pid = r["rank"]
+                if pid not in procs:
+                    procs.add(pid)
+                    events.append({
+                        "name": "process_name", "ph": "M", "ts": 0,
+                        "pid": pid, "tid": 0,
+                        "args": {"name": f"rank {pid}"}})
+                label = f"device:{coll}"
+                t0 = base + r["seq_send"] * 1000
+                t1 = base + r["seq_wait"] * 1000
+                t2 = base + r["seq_phase"] * 1000
+                slice_ev(pid, label,
+                         f"s{r['step']}:xfer->r{r['tx_peer']}", t0, t1,
+                         {"step": r["step"], "tx_peer": r["tx_peer"],
+                          "rx_peer": r["rx_peer"],
+                          "tx_bytes": r["tx_bytes"],
+                          "rx_bytes": r["rx_bytes"],
+                          "device_track": True,
+                          "device_phase": "xfer"})
+                slice_ev(pid, label, f"s{r['step']}:reduce", t1, t2,
+                         {"step": r["step"], "device_track": True,
+                          "device_phase": "reduce"})
         return {"traceEvents": events, "displayTimeUnit": "ns"}
 
     def dump(self, path: str) -> str:
@@ -260,6 +344,21 @@ def collector() -> TraceCollector:
             _collector = TraceCollector(
                 int(os.environ.get("ACCL_TRACE_CAP", "65536")))
         return _collector
+
+
+def record_device_steps(collective: str, buf) -> None:
+    """Land one ACCL_DEVICE_TRACE stamp buffer in the collector — the
+    ``jax.debug.callback`` target ops/ring.py arms after each traced
+    ``pallas_call``.  ``buf`` is the kernel's (steps, len(DEVICE_TRACE_
+    FIELDS)) int32 output (a leading shard/batch dim is flattened).
+    Never raises: a malformed buffer must not take the workload down."""
+    try:
+        import numpy as np
+
+        arr = np.asarray(buf).reshape(-1, len(DEVICE_TRACE_FIELDS))
+        collector().add_device_steps(collective, arr.tolist())
+    except Exception:  # noqa: BLE001 — observability must stay passive
+        pass
 
 
 def new_span(name: str, desc: str = "", rank: int = -1, count: int = 0,
@@ -356,6 +455,7 @@ def merge_trace_files(paths, out_path: Optional[str] = None) -> dict:
     merged: list = []
     ref_gangs: dict = {}
     torn: list = []
+    seen_meta: set = set()
     for i, path in enumerate(paths):
         with open(path) as f:
             text = f.read()
@@ -403,6 +503,16 @@ def merge_trace_files(paths, out_path: Optional[str] = None) -> dict:
         for ev in events:
             if ev.get("ph") == "X":
                 ev = dict(ev, ts=ev["ts"] + offset)
+            elif ev.get("ph") == "M":
+                # metadata dedup (r15 satellite): every input file
+                # re-emits its own thread_name/process_name rows, so a
+                # merge used to carry one copy per file for the same
+                # (pid, tid) — Perfetto renders duplicated track names.
+                # Keep the FIRST declaration per (event, pid, tid).
+                mkey = (ev.get("name"), ev.get("pid"), ev.get("tid"))
+                if mkey in seen_meta:
+                    continue
+                seen_meta.add(mkey)
             merged.append(ev)
     doc = {"traceEvents": merged, "displayTimeUnit": "ns"}
     if torn:

@@ -16,16 +16,92 @@ larger payloads exactly as the firmware segments to rx-buffer size.
 On non-TPU platforms the kernels run under the Pallas TPU interpreter
 (`interpret=True` → `pltpu.InterpretParams`) which simulates the remote
 DMAs — the CPU rung of the test ladder.
+
+Device tracing (r15): with ``ACCL_DEVICE_TRACE`` set, every ring
+kernel writes one stamp row per step — logical phase stamps
+(send-issue, recv/ack-wait done, reduce/copy done; Pallas exposes no
+cycle counter, so stamps are event-order clocks) plus the two ring
+neighbors and per-neighbor byte counts — into an extra kernel output
+that a ``jax.debug.callback`` lands in the trace collector
+(observability/trace.py ``device:<collective>`` Perfetto tracks).  The
+env gate is read ONCE at first kernel build; with it unset the built
+kernels are bit-identical to the uninstrumented ones (no extra output,
+no callback — the jaxpr pin in tests/test_device_trace.py).
 """
 from __future__ import annotations
 
+import functools
+import os
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from ..observability.trace import DEVICE_TRACE_FIELDS, record_device_steps
 from ..utils.compat import axis_size as _axis_size
 from ..utils.compat import tpu_compiler_params as _tpu_compiler_params
+
+#: stamp-row width of the ACCL_DEVICE_TRACE kernel output (the column
+#: schema lives with its consumer: observability/trace.py)
+DEVICE_TRACE_COLS = len(DEVICE_TRACE_FIELDS)
+
+#: env gate, read once at first kernel build (None = not read yet) —
+#: the off path must cost zero structurally, so the gate can never be
+#: consulted per call beyond one module-global read
+_DEVICE_TRACE: Optional[bool] = None
+
+
+def device_trace_enabled() -> bool:
+    """The ``ACCL_DEVICE_TRACE`` gate, cached at first kernel build."""
+    global _DEVICE_TRACE
+    if _DEVICE_TRACE is None:
+        _DEVICE_TRACE = os.environ.get(
+            "ACCL_DEVICE_TRACE", "0") not in ("", "0")
+    return bool(_DEVICE_TRACE)
+
+
+def _reset_device_trace_cache() -> None:
+    """Test hook: force the next kernel build to re-read the env."""
+    global _DEVICE_TRACE
+    _DEVICE_TRACE = None
+
+
+def _emit_device_trace(collective: str, buf: Any) -> None:
+    """Arm the host callback that lands one stamp buffer in the trace
+    collector (runs at execution time with the concrete array, inside
+    jit/shard_map)."""
+    jax.debug.callback(
+        functools.partial(record_device_steps, collective), buf)
+
+
+def _stamp_row(trace_ref: Any, step: int, my: Any, tx_peer: Any,
+               rx_peer: Any, tx_bytes: int, rx_bytes: int) -> None:
+    """Write one per-step stamp row (DEVICE_TRACE_FIELDS order).  The
+    three phase stamps are the logical event clock 3*step + {0,1,2}:
+    send-issue, recv/ack-wait done, reduce/copy done."""
+    seq = 3 * step
+    trace_ref[step, :] = jnp.stack([
+        jnp.asarray(my, jnp.int32),
+        jnp.int32(step),
+        jnp.int32(seq),
+        jnp.int32(seq + 1),
+        jnp.int32(seq + 2),
+        jnp.asarray(tx_peer, jnp.int32),
+        jnp.asarray(rx_peer, jnp.int32),
+        jnp.int32(tx_bytes),
+        jnp.int32(rx_bytes),
+    ])
+
+
+def _payload_nbytes(shape: tuple, dtype: Any) -> int:
+    """Bytes of one chunk of `shape`/`dtype` — the per-hop tx/rx byte
+    count the stamp rows carry (a Python int at kernel-build time)."""
+    n = int(np.dtype(dtype).itemsize)
+    for d in shape:
+        n *= int(d)
+    return n
 
 
 def _interp(interpret: bool):
@@ -99,9 +175,15 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
                          f"(self-ring mode); got P={P}, ring_size={V}")
     if V == 1:
         return x[None]
+    devtrace = device_trace_enabled()
+    chunk_bytes = _payload_nbytes(x.shape, x.dtype)
 
-    def kernel(x_ref, out_ref, comm_buf, send_sem, recv_sem, ack_sem,
-               copy_sem):
+    def kernel(x_ref, out_ref, *rest):
+        if devtrace:
+            trace_ref, comm_buf, send_sem, recv_sem, ack_sem, copy_sem \
+                = rest
+        else:
+            comm_buf, send_sem, recv_sem, ack_sem, copy_sem = rest
         my = lax.axis_index(axis) % V
         right = (my + 1) % P
 
@@ -154,13 +236,23 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
                                         copy_sem)
             put.start()
             put.wait()
+            if devtrace:
+                # per-step stamp row: each hop relays one chunk to the
+                # right neighbor and lands one from the left
+                _stamp_row(trace_ref, step, my, right, left,
+                           chunk_bytes, chunk_bytes)
 
-    out_shape = jax.ShapeDtypeStruct((V,) + x.shape, x.dtype)
-    return pl.pallas_call(
+    out_shape: Any = jax.ShapeDtypeStruct((V,) + x.shape, x.dtype)
+    out_specs: Any = pl.BlockSpec(memory_space=pl.ANY)
+    if devtrace:
+        out_shape = [out_shape, jax.ShapeDtypeStruct(
+            (V - 1, DEVICE_TRACE_COLS), jnp.int32)]
+        out_specs = [out_specs, pl.BlockSpec(memory_space=pltpu.SMEM)]
+    res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((2,) + x.shape, x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
@@ -172,6 +264,11 @@ def ring_all_gather_pallas(x, axis: str = "rank", interpret: bool = False,
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp(interpret),
     )(x)
+    if devtrace:
+        out, tr = res
+        _emit_device_trace("all_gather", tr)
+        return out
+    return res
 
 
 def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
@@ -199,9 +296,15 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
         return x[0]
     chunk_shape = x.shape[1:]
     is_max = op == "max"
+    devtrace = device_trace_enabled()
+    chunk_bytes = _payload_nbytes(chunk_shape, x.dtype)
 
-    def kernel(x_ref, out_ref, acc, landing, send_sem, recv_sem, ack_sem,
-               copy_sem):
+    def kernel(x_ref, out_ref, *rest):
+        if devtrace:
+            trace_ref, acc, landing, send_sem, recv_sem, ack_sem, \
+                copy_sem = rest
+        else:
+            acc, landing, send_sem, recv_sem, ack_sem, copy_sem = rest
         my = lax.axis_index(axis) % V
         right = ((my + 1) % V) % P
         left = ((my + V - 1) % V) % P
@@ -252,17 +355,27 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
                 pltpu.semaphore_signal(
                     ack_sem.at[slot], inc=1, device_id=left,
                     device_id_type=pltpu.DeviceIdType.LOGICAL)
+            if devtrace:
+                # per-step stamp row: one partial forwarded right, one
+                # landed from the left and folded into the accumulator
+                _stamp_row(trace_ref, step, my, right, left,
+                           chunk_bytes, chunk_bytes)
 
         st = pltpu.make_async_copy(acc, out_ref, copy_sem)
         st.start()
         st.wait()
 
-    out_shape = jax.ShapeDtypeStruct(chunk_shape, x.dtype)
-    return pl.pallas_call(
+    out_shape: Any = jax.ShapeDtypeStruct(chunk_shape, x.dtype)
+    out_specs: Any = pl.BlockSpec(memory_space=pl.ANY)
+    if devtrace:
+        out_shape = [out_shape, jax.ShapeDtypeStruct(
+            (V - 1, DEVICE_TRACE_COLS), jnp.int32)]
+        out_specs = [out_specs, pl.BlockSpec(memory_space=pltpu.SMEM)]
+    res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM(chunk_shape, x.dtype),
             pltpu.VMEM((2,) + chunk_shape, x.dtype),
@@ -275,6 +388,11 @@ def ring_reduce_scatter_pallas(x, axis: str = "rank", op: str = "sum",
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp(interpret),
     )(x)
+    if devtrace:
+        out, tr = res
+        _emit_device_trace("reduce_scatter", tr)
+        return out
+    return res
 
 
 def ring_all_reduce_pallas(x, axis: str = "rank", op: str = "sum",

@@ -22,6 +22,11 @@ Plan grammar (``ACCL_CHAOS`` env var or :meth:`ChaosPlan.parse`)::
 - ``delay_us``  — how long a delayed segment is held (default 2000);
   delayed segments are RE-ORDERED past their siblings, opening real
   sequence gaps for the NACK lane to close
+- ``drop_rank=R:P`` — rank R's egress ALONE drops with probability P
+  (repeatable; overrides the global ``drop`` for that rank) — the
+  targeted-peer plan the link-matrix chaos-attribution test uses: all
+  loss originates at one known rank, so every NACK/retransmit must
+  land on that peer's links
 - ``slow_rank=R:US`` — rank R stalls its egress writer US µs/message
   (repeatable for several ranks)
 - ``kill_rank=R``    — rank R is marked for :meth:`kill set <kills>`;
@@ -60,6 +65,9 @@ class ChaosPlan:
     delay: float = 0.0
     delay_us: int = 2000
     corrupt: float = 0.0
+    #: rank -> targeted egress drop probability (drop_rank=R:P);
+    #: overrides the global ``drop`` for that rank only
+    drop_ranks: dict = field(default_factory=dict)
     #: rank -> per-message egress stall in µs (slow-rank)
     slow: dict = field(default_factory=dict)
     #: ranks marked for a kill (the harness triggers the WHEN)
@@ -92,6 +100,12 @@ class ChaosPlan:
                     setattr(plan, key, p)
                 elif key == "delay_us":
                     plan.delay_us = int(val)
+                elif key == "drop_rank":
+                    rank_s, _, p_s = val.partition(":")
+                    p = float(p_s) if p_s else 0.05
+                    if not 0.0 <= p < 1.0:
+                        raise ValueError("probability must be in [0, 1)")
+                    plan.drop_ranks[int(rank_s)] = p
                 elif key == "slow_rank":
                     rank_s, _, us_s = val.partition(":")
                     plan.slow[int(rank_s)] = int(us_s) if us_s else 500
@@ -105,7 +119,8 @@ class ChaosPlan:
                 raise ACCLError(
                     f"ACCL_CHAOS item {item!r}: {e} (grammar: seed=N,"
                     f"drop=P,dup=P,delay=P,delay_us=N,corrupt=P,"
-                    f"slow_rank=R:US,kill_rank=R,join_rank=R)") from e
+                    f"drop_rank=R:P,slow_rank=R:US,kill_rank=R,"
+                    f"join_rank=R)") from e
         return plan
 
     @classmethod
@@ -116,7 +131,8 @@ class ChaosPlan:
 
     @property
     def probabilistic(self) -> bool:
-        return any(getattr(self, k) > 0 for k in _PROB_KEYS)
+        return any(getattr(self, k) > 0 for k in _PROB_KEYS) \
+            or any(p > 0 for p in self.drop_ranks.values())
 
     def apply(self, device, rank: int) -> None:
         """Arm one rank's engine with this plan (kills NOT included —
@@ -128,7 +144,7 @@ class ChaosPlan:
                 f"(chaos plans drive the emulator rungs)")
         set_chaos(
             seed=self.seed,
-            drop_ppm=_ppm(self.drop),
+            drop_ppm=_ppm(self.drop_ranks.get(rank, self.drop)),
             dup_ppm=_ppm(self.dup),
             delay_ppm=_ppm(self.delay),
             delay_us=self.delay_us,
@@ -145,6 +161,8 @@ class ChaosPlan:
                 parts.append(f"{k}={v:g}")
         if self.delay > 0 or self.delay_us != 2000:
             parts.append(f"delay_us={self.delay_us}")
+        for r, pv in sorted(self.drop_ranks.items()):
+            parts.append(f"drop_rank={r}:{pv:g}")
         for r, us in sorted(self.slow.items()):
             parts.append(f"slow_rank={r}:{us}")
         for r in self.kills:

@@ -49,6 +49,39 @@ def probe() -> Capabilities:
     )
 
 
+def link_axis(src: int, dst: int, coords=None,
+              nranks: int | None = None) -> str:
+    """Classify a src->dst link against the world's topology axes —
+    the rendering key perf_doctor uses for the r15 link matrix (and the
+    grouping the topology-aware selection work, ROADMAP item 2, will
+    tune per axis).
+
+    With per-device ICI ``coords`` (utils.topology.probe on TPU) the
+    label is the mesh axis the two devices differ on (``x``/``y``/``z``
+    single-axis, ``multi-axis`` otherwise).  Without coords (emu
+    worlds: a logical ring fabric) it is the ring distance:
+    ``ring+1``/``ring-1`` for the two neighbor directions, ``hop<k>``
+    for longer chords."""
+    if coords is not None and 0 <= src < len(coords) \
+            and 0 <= dst < len(coords) \
+            and coords[src] is not None and coords[dst] is not None:
+        diffs = [i for i, (a, b) in
+                 enumerate(zip(coords[src], coords[dst])) if a != b]
+        if len(diffs) == 1:
+            return "xyz"[diffs[0]] if diffs[0] < 3 else f"axis{diffs[0]}"
+        return "multi-axis" if diffs else "self"
+    if nranks and nranks > 1:
+        d = (dst - src) % nranks
+        if d == 0:
+            return "self"
+        if d == 1:
+            return "ring+1"
+        if d == nranks - 1:
+            return "ring-1"
+        return f"hop{min(d, nranks - d)}"
+    return "unknown"
+
+
 def dump() -> str:
     """Human-readable topology dump (the dump_* observability family)."""
     import jax

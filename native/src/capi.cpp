@@ -536,6 +536,21 @@ int accl_engine_stats(void* wp, int rank, uint64_t* out, int cap) {
   return e ? e->engine_stats(out, cap) : -1;
 }
 
+// ---- per-link wire telemetry (r15): flat (comm, peer) counter rows
+// behind the v2 stats plane.  Each row is
+// accl_engine_link_stats_stride() u64s (comm, peer, tx/rx msgs+bytes,
+// retransmits, NACKs both directions, fenced drops, seeks,
+// seek_wait_ns — see Engine::link_stats for the authoritative order);
+// only whole rows are written and the TOTAL u64 count is returned, so
+// a short buffer truncates at a row boundary and the caller retries
+// bigger.  -1 = unknown rank. ----
+int accl_engine_link_stats_stride(void) { return Engine::kLinkStatsStride; }
+
+int accl_engine_link_stats(void* wp, int rank, uint64_t* out, int cap) {
+  Engine* e = world_get(wp, rank);
+  return e ? e->link_stats(out, cap) : -1;
+}
+
 // Egress frame tap on/off (bounded ring of the last 256 staged frames).
 int accl_frame_tap(void* wp, int rank, int on) {
   Engine* e = world_get(wp, rank);

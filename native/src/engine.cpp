@@ -431,8 +431,8 @@ int Engine::plan_count() const {
 // ---------------------------------------------------------------------------
 // engine telemetry snapshot (r14): the versioned flat export behind
 // capi accl_engine_stats.  FIELD ORDER IS THE ABI — append only, and
-// keep ENGINE_STATS_FIELDS_V1 in accl_tpu/observability/telemetry.py
-// in lockstep.
+// keep ENGINE_STATS_FIELDS_V2 in accl_tpu/observability/telemetry.py
+// in lockstep (v2 appends link_rows, r15).
 // ---------------------------------------------------------------------------
 int Engine::engine_stats(uint64_t* out, int cap) {
   uint64_t egress_depth = 0;
@@ -446,6 +446,11 @@ int Engine::engine_stats(uint64_t* out, int cap) {
     for (const EnginePlan& p : plans_)
       if (p.valid) ++plans_live;
     plan_tokens = plan_tokens_.size();
+  }
+  uint64_t link_rows = 0;
+  {
+    MutexLock g(link_mu_);
+    link_rows = links_.size();
   }
   const uint64_t fields[] = {
       // -- retransmit store --
@@ -481,11 +486,72 @@ int Engine::engine_stats(uint64_t* out, int cap) {
       // -- elastic membership --
       joins_sponsored_.load(),     // 23 joins_sponsored
       joins_completed_.load(),     // 24 joins_completed
+      // -- per-link wire telemetry (v2, r15) --
+      link_rows,                   // 25 link_rows
   };
   const int total = int(sizeof(fields) / sizeof(fields[0]));
   if (out) {
     int n = cap < total ? (cap < 0 ? 0 : cap) : total;
     for (int i = 0; i < n; ++i) out[i] = fields[i];
+  }
+  return total;
+}
+
+// ---------------------------------------------------------------------------
+// per-link wire telemetry (r15): (comm, peer) counter rows behind capi
+// accl_engine_link_stats.  ROW FIELD ORDER IS THE ABI — keep
+// LINK_STATS_FIELDS_V2 in accl_tpu/observability/telemetry.py in
+// lockstep.  Bump helpers are leaf-lock one-liners so the egress/
+// ingress funnels pay one uncontended lock + map find per message.
+// ---------------------------------------------------------------------------
+void Engine::link_count(uint32_t comm, uint32_t peer,
+                        uint64_t LinkCounters::*field, uint64_t add) {
+  if (!link_peer_ok(comm, peer)) return;
+  MutexLock g(link_mu_);
+  links_[{comm, peer}].*field += add;
+}
+
+void Engine::link_tx(uint32_t comm, uint32_t peer, uint64_t bytes) {
+  if (!link_peer_ok(comm, peer)) return;
+  MutexLock g(link_mu_);
+  LinkCounters& c = links_[{comm, peer}];
+  c.tx_msgs += 1;
+  c.tx_bytes += bytes;
+}
+
+void Engine::link_rx(uint32_t comm, uint32_t peer, uint64_t bytes) {
+  if (!link_peer_ok(comm, peer)) return;
+  MutexLock g(link_mu_);
+  LinkCounters& c = links_[{comm, peer}];
+  c.rx_msgs += 1;
+  c.rx_bytes += bytes;
+}
+
+int Engine::link_stats(uint64_t* out, int cap) {
+  MutexLock g(link_mu_);
+  const int total = int(links_.size()) * kLinkStatsStride;
+  if (out && cap > 0) {
+    // whole rows only: a short buffer truncates at a row boundary so
+    // the decoder can never mis-slice a torn row
+    int rows = std::min(cap, total) / kLinkStatsStride;
+    int i = 0;
+    for (const auto& [key, c] : links_) {
+      if (i >= rows) break;
+      uint64_t* row = out + ptrdiff_t(i) * kLinkStatsStride;
+      row[0] = key.first;       // comm
+      row[1] = key.second;      // peer (comm-local rank)
+      row[2] = c.tx_msgs;
+      row[3] = c.tx_bytes;
+      row[4] = c.rx_msgs;
+      row[5] = c.rx_bytes;
+      row[6] = c.retrans_sent;
+      row[7] = c.nacks_tx;
+      row[8] = c.nacks_rx;
+      row[9] = c.fenced_drops;
+      row[10] = c.seeks;
+      row[11] = c.seek_wait_ns;
+      ++i;
+    }
   }
   return total;
 }
@@ -859,6 +925,9 @@ void Engine::classify(Message&& msg) {
   switch (static_cast<MsgType>(msg.hdr.msg_type)) {
     case MsgType::Nack:
       nacks_rx_.fetch_add(1);
+      // per-link: hdr.src is the comm-local RECEIVER soliciting us —
+      // the peer whose link the loss (and the recovery) belongs to
+      link_count(msg.hdr.comm_id, msg.hdr.src, &LinkCounters::nacks_rx);
       note_alive(msg.hdr.comm_id, msg.hdr.src);
       handle_nack(msg.hdr);
       return;
@@ -910,8 +979,14 @@ void Engine::classify(Message&& msg) {
   if (msg.hdr.comm_id < kMaxComms &&
       msg.hdr.epoch != comm_epoch_[msg.hdr.comm_id].load()) {
     fenced_drops_.fetch_add(1);
+    link_count(msg.hdr.comm_id, msg.hdr.src, &LinkCounters::fenced_drops);
     return;
   }
+  // per-link rx accounting: hdr.src is the comm-local SENDER — the
+  // peer whose link this dataplane frame crossed (the chaos-
+  // attribution test pins that counters land on the true peer, never
+  // the local rank)
+  link_rx(msg.hdr.comm_id, msg.hdr.src, msg.payload.size());
   // NB: no note_alive here — liveness piggybacks on the CONTROL plane
   // only (Heartbeat/Nack/Abort above).  The probe actively pings, so
   // stamping every data segment would buy nothing and cost the hot
@@ -1024,6 +1099,9 @@ void Engine::send_nack(uint32_t comm, uint32_t src, uint32_t tag,
   m.hdr.epoch = epoch_of(comm);
   m.hdr.dst_session = uint16_t(t->rows[src].session);
   nacks_tx_.fetch_add(1);
+  // per-link: the NACK solicits the SENDER `src` — the peer whose
+  // link lost the segment
+  link_count(comm, src, &LinkCounters::nacks_tx);
   // control plane: staged directly (not a chaos target, see send_out)
   stage_egress(t->rows[src].session, std::move(m));
 }
@@ -1054,6 +1132,8 @@ void Engine::handle_nack(const WireHeader& hdr) {
             });
   for (auto& m : out) {
     retrans_sent_.fetch_add(1);
+    // per-link: the retransmit serves requester hdr.src's link
+    link_count(hdr.comm_id, hdr.src, &LinkCounters::retrans_sent);
     // clean stored copy, staged directly: a retransmit is the recovery
     // path and must not re-enter the chaos funnel
     if (!killed_.load()) stage_egress(m.hdr.dst_session, std::move(m));
@@ -1404,8 +1484,12 @@ void Engine::land_p2p(const WireHeader& hdr, const uint8_t* payload,
   if (hdr.comm_id < kMaxComms &&
       hdr.epoch != comm_epoch_[hdr.comm_id].load()) {
     fenced_drops_.fetch_add(1);
+    link_count(hdr.comm_id, hdr.src, &LinkCounters::fenced_drops);
     return;
   }
+  // per-link rx: the direct p2p landing is the same inter-rank traffic
+  // as a wire delivery (gate-for-gate identical ingress discipline)
+  link_rx(hdr.comm_id, hdr.src, payload_bytes);
   land_one_sided(hdr, payload, payload_bytes);
 }
 
@@ -2059,6 +2143,7 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
     // machinery, so only pool-routed segments are stored.
     if (to_strm < FIRST_KRNL_STREAM && retrans_enabled())
       store_retrans(c.comm(), dst, msg);
+    link_tx(c.comm(), dst, msg.payload.size());
     send_out(t.rows[dst].session, std::move(msg));
     off += chunk;
   }
@@ -2076,6 +2161,22 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
                                                    int* evicted_out) {
   CommTable& t = *comm_ptr(c.comm());
   seeks_.fetch_add(1);
+  link_count(c.comm(), src, &LinkCounters::seeks);
+  // per-link seek latency: how long THIS peer's missing data kept the
+  // receiver blocked — the slow-link observable the link matrix ranks
+  // (a chaos-slowed peer's links dominate seek_wait_ns).  RAII so
+  // every return path (success, miss, abort, shutdown) stamps it.
+  struct SeekWaitStamp {
+    Engine* e;
+    uint32_t comm, src;
+    steady_clock::time_point t0 = steady_clock::now();
+    ~SeekWaitStamp() {
+      e->link_count(comm, src, &LinkCounters::seek_wait_ns,
+                    uint64_t(std::chrono::duration_cast<nanoseconds>(
+                                 steady_clock::now() - t0)
+                                 .count()));
+    }
+  } seek_stamp{this, c.comm(), src};
   auto budget = timeout_budget();
   auto deadline = steady_clock::now() + budget;
   uint32_t retry_max = retrans_enabled() ? retry_max_.load() : 0;
@@ -2380,6 +2481,9 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
           hdr.comm_id = c.comm();
           hdr.epoch = epoch_of(c.comm());
           hdr.compressed = 0;
+          // per-link: the p2p write moved `nbytes` across this rank
+          // pair even though the wire (and tx_stats) never saw it
+          link_tx(c.comm(), dst, nbytes);
           peer->land_p2p(hdr, pdata, nbytes);
           p.done();
           return;
@@ -2410,6 +2514,7 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
     }
     if (sticky_err_ == 0) {
       msg.hdr.count = uint32_t(msg.payload.size());
+      link_tx(c.comm(), dst, msg.payload.size());
       send_out(t.rows[dst].session, std::move(msg));
     }
   }

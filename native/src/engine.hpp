@@ -167,16 +167,35 @@ class Engine {
 
   // ---- engine telemetry snapshot (r14): the versioned flat stats
   // export behind capi accl_engine_stats.  Fills up to `cap` u64
-  // fields of the version-1 layout (field order is the ABI — APPEND
-  // ONLY; the Python twin is ENGINE_STATS_FIELDS_V1 in
+  // fields of the current layout (field order is the ABI — APPEND
+  // ONLY; the Python twin is ENGINE_STATS_FIELDS_V<n> in
   // accl_tpu/observability/telemetry.py) and returns the total field
   // count this build knows, so an older caller reads a prefix and a
   // newer caller sees exactly how much the engine filled.  Cheap by
   // construction: atomics plus three short lock holds (egress depth,
   // plan table, rx staging) — pollable at 10 Hz without touching the
-  // call hot path. ----
-  static constexpr int kEngineStatsVersion = 1;
+  // call hot path.  v2 (r15) appends link_rows: the number of
+  // (comm, peer) link rows the link plane below is tracking. ----
+  static constexpr int kEngineStatsVersion = 2;
   int engine_stats(uint64_t* out, int cap);
+
+  // ---- per-link wire telemetry (r15): the flat (comm, peer) counter
+  // plane behind capi accl_engine_link_stats.  One row of
+  // kLinkStatsStride u64s per (comm, peer comm-local rank) this engine
+  // has exchanged traffic with — tx/rx message+byte counters,
+  // retransmits served to that peer, NACKs exchanged with it, frames
+  // dropped at an epoch fence, and the seek count/blocked-wait time
+  // attributed to it (the receiver's measure of how long that peer's
+  // data kept it waiting).  Row field order is the ABI twin of
+  // LINK_STATS_FIELDS_V2 in accl_tpu/observability/telemetry.py:
+  //   0 comm, 1 peer, 2 tx_msgs, 3 tx_bytes, 4 rx_msgs, 5 rx_bytes,
+  //   6 retrans_sent, 7 nacks_tx, 8 nacks_rx, 9 fenced_drops,
+  //   10 seeks, 11 seek_wait_ns
+  // Only WHOLE rows are ever written (a short buffer truncates at a
+  // row boundary, never mid-row); the return value is the total u64
+  // count this engine holds so a caller with a small buffer can retry.
+  static constexpr int kLinkStatsStride = 12;
+  int link_stats(uint64_t* out, int cap);
 
   // Egress frame tap: bounded ring of the last kTapCap frames this
   // engine staged (serialized header + payload) — the wire fuzzer's
@@ -613,6 +632,36 @@ class Engine {
   // rendezvous/scratch teardown shared by retry expiry and abort
   void teardown_call(CallDesc& c);
   void handle_abort(const WireHeader& hdr);
+
+  // ---- per-link wire telemetry (r15): (comm, peer) counter rows ----
+  // A leaf mutex (taken around a map bump, never while holding it is
+  // any other lock acquired): the per-message cost on the egress path
+  // is one uncontended lock + map find, the same discipline as the
+  // retransmit store.  Peers are COMM-LOCAL ranks — the link matrix
+  // aggregator on the Python side maps them through the communicator.
+  struct LinkCounters {
+    uint64_t tx_msgs = 0, tx_bytes = 0, rx_msgs = 0, rx_bytes = 0;
+    uint64_t retrans_sent = 0, nacks_tx = 0, nacks_rx = 0;
+    uint64_t fenced_drops = 0, seeks = 0, seek_wait_ns = 0;
+  };
+  mutable Mutex link_mu_;
+  std::map<std::pair<uint32_t, uint32_t>, LinkCounters> links_
+      ACCL_GUARDED_BY(link_mu_);
+  // Row-mint guard: the rx-side bump sites key rows off WIRE-HEADER
+  // fields (hdr.comm_id is bounded by frame_ok, hdr.src is NOT) — a
+  // fuzzed/hostile src must not mint unbounded map entries, so every
+  // bump validates the peer against the comm table first.  The tx
+  // sites pass table-derived values and the check is a cheap true.
+  bool link_peer_ok(uint32_t comm, uint32_t peer) const {
+    const CommTable* t = comm_ptr(comm);
+    return t && peer < t->rows.size();
+  }
+  // one-counter bump via pointer-to-member (the common case)
+  void link_count(uint32_t comm, uint32_t peer,
+                  uint64_t LinkCounters::*field, uint64_t add = 1);
+  // paired msg+byte bumps for the tx / rx funnels
+  void link_tx(uint32_t comm, uint32_t peer, uint64_t bytes);
+  void link_rx(uint32_t comm, uint32_t peer, uint64_t bytes);
 
   // ---- liveness (resilience layer 3) ----
   mutable Mutex live_mu_;

@@ -16,7 +16,15 @@ files into the same three-part report a running world exposes through
 - **regression sentinel** (observability/sentinel.py): the snapshot's
   latency histograms + bandwidth compared against committed
   ``bench/results`` baselines per (collective, dtype, size-bucket,
-  lane) with the same thresholds as the live sentinel.
+  lane) with the same thresholds as the live sentinel;
+- **link matrix** (r15): the ``link/*`` families of the snapshot
+  reassembled into the world-level P×P per-link traffic matrix,
+  rendered against the topology axes (utils/topology.link_axis) with
+  slowest-link and imbalance findings — the measured per-link model
+  the topology-aware autotuner (ROADMAP item 2) consumes;
+- **overlap accounting** (r15, needs --trace + --flight): wire-exposed
+  vs compute-overlapped time per collective — the recovered-compute
+  precursor metric for device-initiated fusion (ROADMAP item 3).
 
 ``--ci`` is the perf-gate mode: the REPORT SCHEMA is hard-validated
 (a malformed dump or snapshot fails the job) but threshold findings
@@ -34,15 +42,25 @@ Usage:
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from accl_tpu.observability import attribution  # noqa: E402
+from accl_tpu.observability import attribution, telemetry  # noqa: E402
 from accl_tpu.observability.flight import merge_flight_dumps  # noqa: E402
 from accl_tpu.observability.sentinel import Baseline, Sentinel  # noqa: E402
+from accl_tpu.utils.topology import link_axis  # noqa: E402
 
 SNAPSHOT_KEYS = ("counters", "gauges", "calls")
+
+#: link/<field>/r<src>->r<dst> — the per-cell counter names the
+#: telemetry sampler publishes (observability/telemetry.py)
+_LINK_CELL = re.compile(r"^link/([a-z_]+)/r(\d+)->r(\d+)$")
+
+#: imbalance past this max/mean ratio over nonzero tx_bytes cells is
+#: flagged (shared CI cores swing schedules, so stay conservative)
+IMBALANCE_RATIO = 4.0
 
 
 def load_snapshot(path: str) -> dict:
@@ -67,6 +85,116 @@ def engine_section(snap: dict) -> dict:
         if k.startswith("engine/") or k == "accl_health":
             out["gauges"][k] = v
     return out
+
+
+def link_matrix_section(snap: dict) -> dict:
+    """Reassemble the snapshot's ``link/*`` cell counters into the
+    world-level matrix document + findings.  Empty dict when the
+    snapshot carries no link families (pre-r15 world, or the sampler
+    never ran)."""
+    cells: dict = {}
+    nranks = 0
+    for name, v in snap.get("counters", {}).items():
+        m = _LINK_CELL.match(name)
+        if not m:
+            continue
+        field, s, d = m.group(1), int(m.group(2)), int(m.group(3))
+        cells[(field, s, d)] = int(v)
+        nranks = max(nranks, s + 1, d + 1)
+    if not cells:
+        return {}
+    fields = {f: [[0] * nranks for _ in range(nranks)]
+              for f in telemetry.LINK_COUNTER_FIELDS}
+    for (field, s, d), v in cells.items():
+        if field in fields:
+            fields[field][s][d] = v
+    matrix = {"nranks": nranks, "comm": 0, "fields": fields}
+    return {"matrix": matrix, "findings": link_findings(matrix)}
+
+
+def link_findings(matrix: dict) -> dict:
+    """Slowest-link + imbalance findings over one link_matrix doc —
+    the shape the future topology autotuner (ROADMAP item 2) reads."""
+    P = matrix["nranks"]
+    out: dict = {}
+    slow = telemetry.slowest_link(matrix, "seek_wait_ns")
+    if slow is not None:
+        s, d = slow
+        out["slowest_link"] = {
+            "observer": s, "peer": d,
+            "axis": link_axis(s, d, nranks=P),
+            "seek_wait_ms": round(
+                matrix["fields"]["seek_wait_ns"][s][d] / 1e6, 3)}
+    busiest = telemetry.slowest_link(matrix, "tx_bytes")
+    if busiest is not None:
+        s, d = busiest
+        out["busiest_link"] = {
+            "src": s, "dst": d, "axis": link_axis(s, d, nranks=P),
+            "tx_bytes": matrix["fields"]["tx_bytes"][s][d]}
+    ratio = telemetry.link_imbalance(matrix, "tx_bytes")
+    out["tx_imbalance_ratio"] = round(ratio, 2)
+    out["imbalanced"] = ratio > IMBALANCE_RATIO
+    retrans = telemetry.slowest_link(matrix, "retrans_sent")
+    if retrans is not None:
+        s, d = retrans
+        total = sum(v for row in matrix["fields"]["retrans_sent"]
+                    for v in row)
+        out["lossiest_link"] = {
+            "src": s, "dst": d, "axis": link_axis(s, d, nranks=P),
+            "retransmits": matrix["fields"]["retrans_sent"][s][d],
+            "share": round(
+                matrix["fields"]["retrans_sent"][s][d] / total, 3)
+            if total else 0.0}
+    return out
+
+
+def validate_link_section(section: dict) -> list:
+    """--ci schema gate for the link_matrix report section: square
+    matrices over every counter field, integer cells."""
+    errors = []
+    matrix = section.get("matrix", {})
+    P = matrix.get("nranks", 0)
+    fields = matrix.get("fields", {})
+    for f in telemetry.LINK_COUNTER_FIELDS:
+        cells = fields.get(f)
+        if cells is None:
+            errors.append(f"link_matrix: missing field {f}")
+            continue
+        if len(cells) != P or any(len(row) != P for row in cells):
+            errors.append(f"link_matrix: field {f} is not {P}x{P}")
+        elif any(not isinstance(v, int) or v < 0
+                 for row in cells for v in row):
+            errors.append(f"link_matrix: field {f} has non-counter "
+                          f"cells")
+    if "findings" not in section:
+        errors.append("link_matrix: missing findings")
+    return errors
+
+
+def render_link_matrix(section: dict, out) -> None:
+    matrix = section["matrix"]
+    P = matrix["nranks"]
+    f = section["findings"]
+    out.write(f"\nlink matrix ({P}x{P}, comm 0):\n")
+    tx = matrix["fields"]["tx_bytes"]
+    wait = matrix["fields"]["seek_wait_ns"]
+    for s in range(P):
+        for d in range(P):
+            if tx[s][d] == 0 and wait[s][d] == 0:
+                continue
+            axis = link_axis(s, d, nranks=P)
+            out.write(
+                f"  r{s}->r{d} [{axis:>7}] tx {tx[s][d]:>12} B  "
+                f"wait {wait[s][d] / 1e6:9.3f} ms  "
+                f"retrans {matrix['fields']['retrans_sent'][s][d]}  "
+                f"nacks {matrix['fields']['nacks_tx'][s][d]}\n")
+    if "slowest_link" in f:
+        sl = f["slowest_link"]
+        out.write(f"  SLOWEST link: r{sl['observer']} blocked on "
+                  f"r{sl['peer']} [{sl['axis']}] for "
+                  f"{sl['seek_wait_ms']:.3f} ms\n")
+    out.write(f"  tx imbalance max/mean: {f['tx_imbalance_ratio']}x"
+              f"{'  (IMBALANCED)' if f['imbalanced'] else ''}\n")
 
 
 def main() -> int:
@@ -113,6 +241,18 @@ def main() -> int:
                                          timeline=args.timeline)
             report["attribution"] = attr
             attribution.render(attr, sys.stdout)
+            # overlap accounting (r15): wire-exposed vs compute-
+            # overlapped per collective (device windows from --trace)
+            ovl = attribution.overlap(merged, trace_doc=trace_doc)
+            report["overlap"] = ovl
+            print(f"\noverlap accounting ({ovl['compute_windows']} "
+                  f"compute window(s)):")
+            for key, c in sorted(ovl["collectives"].items()):
+                print(f"  {key}: wire {c['wire_us']:.1f}us, exposed "
+                      f"{c['exposed_us']:.1f}us "
+                      f"({c['exposed_fraction'] * 100:.1f}% of span), "
+                      f"recovered-compute "
+                      f"{c['recovered_compute_fraction'] * 100:.1f}%")
             for c in attr["collectives"].values():
                 d = c["dominant_straggler"]
                 if d is not None and d["share"] >= 0.5:
@@ -136,6 +276,14 @@ def main() -> int:
                 print(f"  {k:<40} {v}")
             for k, v in report["engine_telemetry"]["gauges"].items():
                 print(f"  {k:<40} {v}")
+            # link matrix (r15): reassembled from the link/* families
+            links = link_matrix_section(snap)
+            if links:
+                report["link_matrix"] = links
+                schema_errors.extend(validate_link_section(links))
+                render_link_matrix(links, sys.stdout)
+                if links["findings"].get("imbalanced"):
+                    findings += 1
             if args.baseline:
                 base = None
                 for path in args.baseline:

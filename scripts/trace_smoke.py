@@ -1,9 +1,17 @@
 #!/usr/bin/env python
 """CI trace smoke: run a short emu-backend allreduce with ACCL_TRACE
 on, assert the dumped Perfetto JSON parses and contains >= 1 span per
-rank with the required trace_event keys, and land the dump_metrics
-JSON next to it as a build artifact (see .github/workflows/
-build-and-test.yml perf-gate job).
+rank with the required trace_event keys (and NO duplicated
+thread_name/process_name metadata per (pid, tid) — the r15 merge-dedup
+contract), and land the dump_metrics JSON next to it as a build
+artifact (see .github/workflows/build-and-test.yml perf-gate job).
+
+With ``ACCL_DEVICE_TRACE`` set (the CI perf-gate passes 1) the smoke
+additionally runs a 4-virtual-rank ring allreduce through the Pallas
+kernels on the tpu-interpret rung and schema-validates the per-rank
+``device:*`` stamp tracks in the same Perfetto doc.  On a jax too old
+to interpret remote DMAs the device rung self-skips with a note (the
+same skew that parks the pallas test files locally).
 
 Usage: python scripts/trace_smoke.py [--ranks N] [--trace PATH]
        [--metrics PATH]
@@ -16,6 +24,67 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def check_no_duplicate_metadata(events) -> list:
+    """The r15 schema rule: one thread_name/process_name declaration
+    per (event, pid, tid) — duplicates are exactly what the
+    merge_trace_files dedup exists to prevent."""
+    seen = set()
+    dups = []
+    for ev in events:
+        if ev.get("ph") != "M":
+            continue
+        key = (ev.get("name"), ev.get("pid"), ev.get("tid"))
+        if key in seen:
+            dups.append(key)
+        seen.add(key)
+    return dups
+
+
+def run_device_trace_rung(ranks: int) -> bool:
+    """The tpu-interpret device rung: a segmented ring allreduce whose
+    kernels carry the ACCL_DEVICE_TRACE stamp rows.  Returns True when
+    the rung ran (False = jax-skew self-skip)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    import accl_tpu.ops.ring as ring
+    from accl_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < ranks:
+        print(f"note: device rung needs {ranks} devices (set XLA_FLAGS="
+              f"--xla_force_host_platform_device_count={ranks}); skipped")
+        return False
+    mesh = make_mesh(dp=ranks)
+
+    def body(xb):
+        return ring.ring_all_reduce_segmented(
+            xb[0], "dp", seg_elems=64, interpret=True)[None]
+
+    try:
+        f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                      out_specs=P("dp", None), check_vma=False)
+    except TypeError:  # older shard_map spells the flag check_rep
+        f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                      out_specs=P("dp", None), check_rep=False)
+    x = np.stack([np.arange(256, dtype=np.float32) + r
+                  for r in range(ranks)])
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    try:
+        out = np.asarray(jax.jit(f)(xs))
+    except NotImplementedError as e:
+        print(f"note: tpu-interpret rung self-skipped (jax-skew: {e})")
+        return False
+    np.testing.assert_allclose(out[0], x.sum(axis=0))
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ranks", type=int, default=4)
@@ -26,9 +95,11 @@ def main() -> int:
 
     # arm tracing exactly as a user would (env var), before any accl
     # use; the engine telemetry sampler rides along so the metrics
-    # artifact carries the engine/* families perf_doctor renders (r14)
+    # artifact carries the engine/* + link/* families perf_doctor
+    # renders (r14/r15)
     os.environ["ACCL_TRACE"] = args.trace
     os.environ.setdefault("ACCL_TELEMETRY_INTERVAL_MS", "100")
+    devtrace = os.environ.get("ACCL_DEVICE_TRACE", "0") not in ("", "0")
 
     import numpy as np
 
@@ -49,11 +120,15 @@ def main() -> int:
 
         outs = world.run(body)
         if world.telemetry is not None:
-            world.telemetry.sample()  # land one engine/* snapshot
+            world.telemetry.sample()  # land one engine/link snapshot
     expected = np.sum([np.arange(args.count, dtype=np.float32) + r
                        for r in range(args.ranks)], axis=0)
     for got in outs:
         np.testing.assert_allclose(got, expected)
+
+    # device rung (r15): stamp buffers land in the same collector and
+    # export as device:* tracks in the same Perfetto doc
+    device_ran = devtrace and run_device_trace_rung(args.ranks)
 
     path = obs_trace.collector().dump(args.trace)
     with open(path) as f:
@@ -65,6 +140,11 @@ def main() -> int:
         if missing:
             print(f"FAIL: event missing keys {missing}: {ev}")
             return 1
+    dups = check_no_duplicate_metadata(events)
+    if dups:
+        print(f"FAIL: duplicated track metadata (merge-dedup "
+              f"violation): {dups}")
+        return 1
     per_rank = {r: sum(1 for ev in slices if ev["pid"] == r)
                 for r in range(args.ranks)}
     if any(n < 1 for n in per_rank.values()):
@@ -75,6 +155,27 @@ def main() -> int:
     if not gangs:
         print("FAIL: no gang ids in trace")
         return 1
+    if device_ran:
+        dev_tracks = {(ev["pid"], (ev.get("args") or {}).get("name"))
+                      for ev in events if ev.get("ph") == "M"
+                      and str((ev.get("args") or {}).get(
+                          "name", "")).startswith("device:")}
+        dev_ranks = {pid for pid, _n in dev_tracks}
+        if dev_ranks != set(range(args.ranks)):
+            print(f"FAIL: device tracks missing ranks: have "
+                  f"{sorted(dev_ranks)}, want 0..{args.ranks - 1}")
+            return 1
+        dev_slices = [ev for ev in slices
+                      if (ev.get("args") or {}).get("device_track")]
+        if not dev_slices:
+            print("FAIL: ACCL_DEVICE_TRACE on but no device slices")
+            return 1
+        bad = [ev for ev in dev_slices
+               if not {"step", "device_track"} <=
+               set((ev.get("args") or {}))]
+        if bad:
+            print(f"FAIL: device slices missing schema keys: {bad[:3]}")
+            return 1
 
     with open(args.metrics, "w") as f:
         f.write(obs_metrics.dump_metrics(as_json=True))
@@ -84,9 +185,19 @@ def main() -> int:
         print(f"FAIL: metrics registry missing the allreduce rows: "
               f"{list(snap['calls'])}")
         return 1
+    # link plane (r15): the sampler must have published the P×P cells
+    link_cells = [k for k in snap["counters"]
+                  if k.startswith("link/tx_bytes/")]
+    if not link_cells:
+        print(f"FAIL: no link/tx_bytes/* cells in the metrics snapshot "
+              f"(link sampler never landed): "
+              f"{sorted(snap['counters'])[:10]}")
+        return 1
 
     print(f"OK: {len(slices)} slices over {args.ranks} ranks "
-          f"({per_rank}), {len(gangs)} gang(s); trace={path} "
+          f"({per_rank}), {len(gangs)} gang(s), "
+          f"{len(link_cells)} link cell(s), device rung "
+          f"{'ran' if device_ran else 'off/skipped'}; trace={path} "
           f"metrics={args.metrics}")
     return 0
 

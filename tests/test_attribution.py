@@ -259,3 +259,116 @@ def test_attribute_accepts_merged_and_raw():
     t = attribution.attribute(merged, timeline=True)
     assert len(t["timeline"]) == t["gangs_analyzed"]
     assert all(row["last_rank"] == 1 for row in t["timeline"])
+
+
+# ---------------------------------------------------------------------------
+# r15: the overlap accountant (wire-exposed vs compute-overlapped)
+# ---------------------------------------------------------------------------
+def test_overlap_math_with_fabricated_windows():
+    """Synthetic dump + trace doc: exact interval arithmetic.  Two
+    ranks, one gang; rank 0's wire interval [2us, 10us) is half-covered
+    by a device window [4us, 8us) -> 4us overlapped, 4us exposed."""
+    def rec(rank):
+        return {"seq": 1, "gang": True, "state": "complete",
+                "comm": 0, "collective": "allreduce", "tag": 0,
+                "count": 64, "dtype": "float32", "nbytes": 256,
+                "t_submit": 1000, "t_queue": 1500, "t_dispatch": 2000,
+                "t_complete": 10000}
+
+    doc = {"ranks": [{"rank": 0, "records": [rec(0)]},
+                     {"rank": 1, "records": [rec(1)]}]}
+    trace_doc = {"traceEvents": [
+        # device COMPUTE window on rank 0: ts/dur in us, 4us..8us (an
+        # xfer-phase slice would be excluded — it IS the wire)
+        {"ph": "X", "pid": 0, "tid": 5, "name": "s0:reduce",
+         "ts": 4.0, "dur": 4.0,
+         "args": {"device_track": True, "device_phase": "reduce"}},
+        # an unrelated non-compute slice must be ignored
+        {"ph": "X", "pid": 0, "tid": 1, "name": "allreduce",
+         "ts": 0.0, "dur": 100.0, "args": {}},
+    ]}
+    report = attribution.overlap(doc, trace_doc=trace_doc)
+    assert report["compute_windows"] == 1
+    row = report["collectives"]["allreduce|comm0|<=256B"]
+    # rank 0: wire 8us, overlap 4us; rank 1: wire 8us, overlap 0
+    assert row["wire_us"] == pytest.approx(16.0)
+    assert row["overlapped_us"] == pytest.approx(4.0)
+    assert row["exposed_us"] == pytest.approx(12.0)
+    assert row["recovered_compute_fraction"] == pytest.approx(0.25)
+    # span total 9us + 9us -> exposed fraction 12/18 (report rounds
+    # fractions to 4 decimals)
+    assert row["exposed_fraction"] == pytest.approx(12.0 / 18.0,
+                                                    abs=1e-4)
+    # without the trace doc nothing is overlapped
+    bare = attribution.overlap(doc)
+    assert bare["collectives"]["allreduce|comm0|<=256B"][
+        "overlapped_us"] == 0.0
+
+
+def test_wire_exposed_fraction_drops_without_delay_emu():
+    """Acceptance drill (emu): a chaos-slowed peer produces a nonzero
+    wire-exposed fraction that DROPS when the delay is removed."""
+    slow = attribution.overlap(_emu_dump(10, slow_rank=SLOW_RANK))
+    clean = attribution.overlap(_emu_dump(10, slow_rank=None))
+    s = [c for c in slow["collectives"].values()
+         if c["collective"] == "allreduce"][0]
+    c = [c for c in clean["collectives"].values()
+         if c["collective"] == "allreduce"][0]
+    assert s["exposed_fraction"] > 0
+    assert c["exposed_fraction"] > 0
+    # the 3ms/iteration artificial delay dominates the slow world's
+    # spans; removing it must shrink the exposed wire share
+    assert s["exposed_us"] > c["exposed_us"]
+    assert s["exposed_fraction"] >= c["exposed_fraction"]
+
+
+def test_wire_exposed_fraction_drops_without_delay_tpu_interpret():
+    """Acceptance drill (tpu-interpret rung): same contract through
+    the gang-scheduler backend."""
+    slow = attribution.overlap(_tpu_dump(8, slow_rank=SLOW_RANK))
+    clean = attribution.overlap(_tpu_dump(8, slow_rank=None))
+    s = [c for c in slow["collectives"].values()
+         if c["collective"] == "allreduce"][0]
+    c = [c for c in clean["collectives"].values()
+         if c["collective"] == "allreduce"][0]
+    assert s["exposed_fraction"] > 0
+    assert s["exposed_us"] > c["exposed_us"]
+
+
+def test_overlap_counts_window_spans():
+    """Host-marked window: spans (trace.traced_window) count as
+    compute cover too — the pre-device-trace way to mark compute."""
+    windows = attribution._compute_windows({"traceEvents": [
+        {"ph": "X", "pid": 3, "tid": 0, "name": "window:ffn",
+         "ts": 10.0, "dur": 5.0, "args": {}},
+        {"ph": "X", "pid": 3, "tid": 0, "name": "window:moe",
+         "ts": 30.0, "dur": 5.0, "args": {}},
+    ]})
+    assert windows == {3: [(10000.0, 15000.0), (30000.0, 35000.0)]}
+    assert attribution._overlap_ns(12000.0, 32000.0, windows[3]) == \
+        pytest.approx(5000.0)
+
+
+def test_overlap_windows_merge_never_double_count():
+    """Overlapping cover (a host window: span CONTAINING device stamp
+    slices, the common shape) must merge to its union — summing the
+    intersections per window would let recovered_compute exceed 1.0."""
+    windows = attribution._compute_windows({"traceEvents": [
+        {"ph": "X", "pid": 0, "tid": 0, "name": "window:step",
+         "ts": 10.0, "dur": 20.0, "args": {}},
+        {"ph": "X", "pid": 0, "tid": 5, "name": "s0:reduce",
+         "ts": 12.0, "dur": 4.0,
+         "args": {"device_track": True, "device_phase": "reduce"}},
+        {"ph": "X", "pid": 0, "tid": 5, "name": "s1:reduce",
+         "ts": 28.0, "dur": 6.0,
+         "args": {"device_track": True, "device_phase": "reduce"}},
+        # the collective's own transfer slice is NOT compute cover
+        {"ph": "X", "pid": 0, "tid": 5, "name": "s1:xfer->r1",
+         "ts": 40.0, "dur": 6.0,
+         "args": {"device_track": True, "device_phase": "xfer"}},
+    ]})
+    # 10-30 + 12-16 (contained) + 28-34 (extends) -> one 10-34 window
+    assert windows == {0: [(10000.0, 34000.0)]}
+    # cover can never exceed the wire interval itself
+    assert attribution._overlap_ns(0.0, 100000.0, windows[0]) == \
+        pytest.approx(24000.0)

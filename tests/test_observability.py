@@ -4,6 +4,7 @@ merge, Perfetto JSON schema validity, metrics registry content, and the
 satellite fixes riding this PR (get_duration error paths, Timer/timed
 unification, time_fn per-iteration sync)."""
 import json
+import os
 
 import numpy as np
 import pytest
@@ -305,3 +306,71 @@ def test_time_fn_blocks_each_iteration():
     per_call = time_fn(f, x, iters=3, warmup=1)
     overlapped = time_fn(f, x, iters=3, warmup=1, pipelined=True)
     assert per_call > 0 and overlapped > 0
+
+
+def test_merge_dedupes_track_metadata(tmp_path):
+    """r15 satellite: merging N per-process trace files must emit ONE
+    thread_name/process_name declaration per (pid, tid), not one per
+    input file — Perfetto renders duplicates as repeated track names."""
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"m{i}.json")
+        events = [
+            {"name": "process_name", "ph": "M", "ts": 0, "pid": 0,
+             "tid": 0, "args": {"name": "rank 0"}},
+            {"name": "thread_name", "ph": "M", "ts": 0, "pid": 0,
+             "tid": 1, "args": {"name": "call"}},
+            {"name": "g", "ph": "X", "ts": 10.0 + i, "dur": 2.0,
+             "pid": 0, "tid": 1, "args": {"gang_id": 4}},
+        ]
+        with open(p, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        paths.append(p)
+    doc = obs_trace.merge_trace_files(paths)
+    meta = [(ev["name"], ev["pid"], ev["tid"])
+            for ev in doc["traceEvents"] if ev["ph"] == "M"]
+    assert len(meta) == len(set(meta)), f"duplicated metadata: {meta}"
+    assert ("process_name", 0, 0) in meta
+    assert ("thread_name", 0, 1) in meta
+    # slices all survive the dedup
+    assert sum(1 for ev in doc["traceEvents"] if ev["ph"] == "X") == 3
+    # and the smoke's schema checker agrees
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location(
+        "trace_smoke", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "trace_smoke.py"))
+    smoke = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(smoke)
+    assert smoke.check_no_duplicate_metadata(doc["traceEvents"]) == []
+    dup_doc = doc["traceEvents"] + [doc["traceEvents"][0]]
+    assert smoke.check_no_duplicate_metadata(dup_doc)
+
+
+def test_device_steps_render_as_perfetto_tracks(tracing):
+    """r15: stamp buffers land as per-rank device:<collective> tracks
+    whose slices carry the step/peer/bytes schema."""
+    rows = [
+        [0, 0, 0, 1, 2, 1, 3, 512, 512],
+        [0, 1, 3, 4, 5, 1, 3, 512, 512],
+        [1, 0, 0, 1, 2, 2, 0, 512, 512],
+    ]
+    obs_trace.record_device_steps("all_gather", np.array(rows, np.int32))
+    assert len(tracing.device_records()) == 1
+    assert tracing.device_link_bytes() == {(0, 1): 1024, (1, 2): 512}
+    doc = tracing.to_perfetto()
+    tracks = {(ev["pid"], ev["args"]["name"])
+              for ev in doc["traceEvents"] if ev.get("ph") == "M"
+              and str((ev.get("args") or {}).get("name", "")
+                      ).startswith("device:")}
+    assert (0, "device:all_gather") in tracks
+    assert (1, "device:all_gather") in tracks
+    dev = [ev for ev in doc["traceEvents"]
+           if (ev.get("args") or {}).get("device_track")]
+    # two slices (xfer + reduce) per stamp row
+    assert len(dev) == 2 * len(rows)
+    xfer = [ev for ev in dev if "xfer" in ev["name"]]
+    assert all(ev["args"]["tx_bytes"] == 512 for ev in xfer)
+    # clear() drops device records too
+    tracing.clear()
+    assert tracing.device_records() == []

@@ -49,9 +49,9 @@ def test_engine_stats_schema_and_traffic():
         stats = world.engine_stats()
         assert len(stats) == world.nranks
         for st in stats:
-            assert st["version"] == 1
-            for field in obs_telemetry.ENGINE_STATS_FIELDS_V1:
-                assert field in st, f"missing v1 field {field}"
+            assert st["version"] == 2
+            for field in obs_telemetry.ENGINE_STATS_FIELDS_V2:
+                assert field in st, f"missing v2 field {field}"
             # no unknown fields from a same-version engine
             assert not any(k.startswith("unknown_field_") for k in st)
         # traffic really flowed through the counters
@@ -80,12 +80,63 @@ def test_engine_stats_closed_world_raises():
 
 
 def test_decode_keeps_newer_engine_fields():
-    n = len(obs_telemetry.ENGINE_STATS_FIELDS_V1)
+    n = len(obs_telemetry.ENGINE_STATS_FIELDS_V2)
     values = list(range(n + 2))  # a newer engine returned 2 extra
-    st = obs_telemetry.decode_engine_stats(values, total_fields=n + 2)
-    assert st[obs_telemetry.ENGINE_STATS_FIELDS_V1[0]] == 0
+    st = obs_telemetry.decode_engine_stats(values, version=2,
+                                           total_fields=n + 2)
+    assert st[obs_telemetry.ENGINE_STATS_FIELDS_V2[0]] == 0
     assert st[f"unknown_field_{n}"] == n
     assert st[f"unknown_field_{n + 1}"] == n + 1
+
+
+@pytest.mark.parametrize("decoder_version,engine_fields,expect_known", [
+    # v1 decoder over a v2 engine's array: field 25 (link_rows) must
+    # surface as unknown_field_25, never silently vanish or mis-name
+    (1, obs_telemetry.ENGINE_STATS_FIELDS_V2,
+     obs_telemetry.ENGINE_STATS_FIELDS_V1),
+    # v2 decoder over a v1 engine's (shorter) array: a clean prefix
+    (2, obs_telemetry.ENGINE_STATS_FIELDS_V1,
+     obs_telemetry.ENGINE_STATS_FIELDS_V1),
+    # same-version both ways
+    (1, obs_telemetry.ENGINE_STATS_FIELDS_V1,
+     obs_telemetry.ENGINE_STATS_FIELDS_V1),
+    (2, obs_telemetry.ENGINE_STATS_FIELDS_V2,
+     obs_telemetry.ENGINE_STATS_FIELDS_V2),
+])
+def test_decode_engine_stats_version_table(decoder_version,
+                                           engine_fields, expect_known):
+    """Table-driven forward/backward compat: the decoder's version
+    selects ITS field table; extra engine fields become
+    unknown_field_<i>, missing ones are simply absent."""
+    values = list(range(len(engine_fields)))
+    st = obs_telemetry.decode_engine_stats(
+        values, version=decoder_version,
+        total_fields=len(engine_fields))
+    for i, name in enumerate(expect_known):
+        assert st[name] == i, name
+    known = obs_telemetry.ENGINE_STATS_FIELDS_BY_VERSION[decoder_version]
+    for i in range(len(known), len(engine_fields)):
+        assert st[f"unknown_field_{i}"] == i
+    # nothing mis-sliced: every value accounted for exactly once
+    assert sorted(v for k, v in st.items() if k != "version") == \
+        list(range(len(engine_fields)))
+
+
+def test_decode_link_stats_strict_stride():
+    """The link decoder must refuse a flat array that is not a whole
+    number of rows — mis-slicing would shift every counter into the
+    wrong field (the compat-hardening satellite)."""
+    from accl_tpu.constants import ACCLError
+
+    stride = len(obs_telemetry.LINK_STATS_FIELDS_V2)
+    rows = obs_telemetry.decode_link_stats(list(range(2 * stride)))
+    assert len(rows) == 2
+    assert rows[0]["comm"] == 0 and rows[0]["peer"] == 1
+    assert rows[1]["comm"] == stride
+    assert obs_telemetry.decode_link_stats([]) == []
+    for bad_len in (1, stride - 1, stride + 1, 2 * stride - 3):
+        with pytest.raises(ACCLError, match="stride"):
+            obs_telemetry.decode_link_stats(list(range(bad_len)))
 
 
 # ---------------------------------------------------------------------------
@@ -158,7 +209,8 @@ def test_tpu_engine_stats_schema():
 
         world.run(body)
         st = world.devices[0].engine_stats()
-        assert st["version"] == 1
+        assert st["version"] == 2
+        assert st["link_rows"] >= 1  # the link twin saw ring traffic
         assert st["leader_dispatches"] + st["executor_dispatches"] > 0
         for k in ("plans_live", "plan_ring_refs",
                   "plan_ring_generation", "ready_depth"):
@@ -494,3 +546,285 @@ def test_doctor_live_renders_unknown_engine_family():
     known_line = [ln for ln in text.splitlines()
                   if "accl_engine_rx_occupancy_hwm" in ln][0]
     assert "unrecognized" not in known_line
+
+
+# ---------------------------------------------------------------------------
+# r15 wire layer: per-link counters, the world link matrix, chaos
+# attribution, and the slowest-link acceptance drills
+# ---------------------------------------------------------------------------
+def test_link_stats_schema_and_ring_traffic():
+    world = _run_world(nranks=4)
+    try:
+        per_rank = world.link_stats()
+        assert set(per_rank) == {0, 1, 2, 3}
+        for rank, rows in per_rank.items():
+            for row in rows:
+                assert set(row) == set(obs_telemetry.LINK_STATS_FIELDS_V2)
+                assert row["peer"] != rank  # never the local rank
+        m = world.link_matrix()
+        assert m["nranks"] == 4
+        tx = m["fields"]["tx_bytes"]
+        # the ring schedule sends to the right neighbor and receives
+        # from the left: every rank's tx row names (r+1) % 4
+        for r in range(4):
+            assert tx[r][(r + 1) % 4] > 0
+            assert m["fields"]["rx_msgs"][r][(r + 3) % 4] > 0
+        # link_rows gauge agrees with the decoded row count
+        for rank, st in enumerate(world.engine_stats()):
+            assert st["link_rows"] == len(per_rank[rank])
+    finally:
+        world.close()
+
+
+def _pairwise_world_matrix(chaos: str, nranks: int = 4,
+                           count: int = 64, rounds: int = 4) -> dict:
+    """Run independent pairwise EAGER transfers under a chaos plan and
+    return the link matrix.  Pairwise — NOT a ring schedule: a ring's
+    serial relay makes every late hop solicit its upstream, so only
+    independent routes can pin WHICH peer a counter belongs to.  Every
+    send (all rounds) stages before any recv blocks — the egress
+    writer drains them independently of the blocked engine loop — so
+    only routes FROM the chaos-targeted rank ever need recovery or run
+    slow.  Payloads stay small enough for the eager lane (the
+    rendezvous lane's in-process p2p fast path bypasses the wire and
+    the chaos funnel entirely) and few enough that every outstanding
+    segment fits the rx pool — recovery must never fight head-of-line
+    pool exhaustion in this drill."""
+    from accl_tpu.backends.emu import EmuWorld
+
+    with EmuWorld(nranks, chaos=chaos) as world:
+        def body(accl, rank):
+            src = accl.create_buffer_like(
+                np.arange(count, dtype=np.float32) + rank)
+            dst = accl.create_buffer(count, np.float32)
+            reqs = [accl.send(src, count, q, tag=10 + it,
+                              run_async=True)
+                    for it in range(rounds)
+                    for q in range(nranks) if q != rank]
+            for it in range(rounds):
+                for q in range(nranks):
+                    if q != rank:
+                        accl.recv(dst, count, q, tag=10 + it)
+            for r_ in reqs:
+                r_.wait()
+
+        world.run(body)
+        return world.link_matrix()
+
+
+def test_chaos_attribution_to_true_peer():
+    """Under a seeded drop plan targeting ONE peer's egress, >= 95% of
+    the world's NACK/retransmit link counters must sit on links naming
+    that peer — pinning that per-peer counters are stamped at the TRUE
+    peer, not the local rank (a local-rank stamp would spread them
+    across the observers' own cells instead)."""
+    culprit = 1
+    P = 4
+    m = _pairwise_world_matrix(f"seed=11,drop_rank={culprit}:0.25",
+                               nranks=P)
+    nacks = m["fields"]["nacks_tx"]
+    retrans = m["fields"]["retrans_sent"]
+    nacks_total = sum(v for row in nacks for v in row)
+    assert nacks_total > 0, "drop plan produced no NACK traffic"
+    # NACKs are sent BY receivers TOWARD the losing sender: column
+    # `culprit` holds them; retransmits are served BY the culprit
+    # toward its requesters: row `culprit`
+    nacks_at_culprit = sum(nacks[r][culprit] for r in range(P))
+    retrans_total = sum(v for row in retrans for v in row)
+    retrans_by_culprit = sum(retrans[culprit])
+    assert nacks_at_culprit / nacks_total >= 0.95, (
+        f"NACKs mis-attributed: {nacks}")
+    if retrans_total:
+        assert retrans_by_culprit / retrans_total >= 0.95, (
+            f"retransmits mis-attributed: {retrans}")
+
+
+def test_slowest_link_names_chaos_slowed_peer_emu():
+    """Acceptance drill (emu): a 4-rank world with one chaos-slowed
+    peer must produce a link matrix whose slowest link names that
+    peer."""
+    slow = 2
+    m = _pairwise_world_matrix(f"seed=3,slow_rank={slow}:5000")
+    link = obs_telemetry.slowest_link(m, "seek_wait_ns")
+    assert link is not None
+    observer, peer = link
+    assert peer == slow, (
+        f"slowest link {link} does not name the slowed peer {slow}: "
+        f"{m['fields']['seek_wait_ns']}")
+    # and the wait concentrates there: the slowed peer's column
+    # dominates the world's total blocked time
+    wait = m["fields"]["seek_wait_ns"]
+    col = sum(wait[r][slow] for r in range(4))
+    total = sum(v for row in wait for v in row)
+    assert col / total >= 0.5
+
+
+def test_slowest_link_names_straggler_peer_tpu():
+    """Acceptance drill (tpu-interpret rung): the gang scheduler's link
+    twin must attribute assembly wait to the straggling peer's links."""
+    import time as _time
+
+    from accl_tpu.backends.tpu import TpuWorld
+
+    slow = 2
+    with TpuWorld(4) as world:
+        def body(accl, rank):
+            send = accl.create_buffer_like(
+                np.arange(32, dtype=np.float32) + rank)
+            recv = accl.create_buffer(32, np.float32)
+            for _ in range(4):
+                if rank == slow:
+                    _time.sleep(0.004)
+                accl.allreduce(send, recv, 32, ReduceFunction.SUM,
+                               from_fpga=True, to_fpga=True)
+
+        world.run(body)
+        m = world.link_matrix()
+        # ring byte accounting: every rank's tx row names its right
+        # ring neighbor with the busbw-corrected payload
+        tx = m["fields"]["tx_bytes"]
+        for r in range(4):
+            assert tx[r][(r + 1) % 4] > 0
+    link = obs_telemetry.slowest_link(m, "seek_wait_ns")
+    assert link is not None and link[1] == slow, (
+        f"straggler wait mis-attributed: {m['fields']['seek_wait_ns']}")
+
+
+def test_sampler_publishes_link_families():
+    reg = obs_metrics.MetricsRegistry()
+    world = _run_world(nranks=2)
+    try:
+        sampler = obs_telemetry.TelemetrySampler(
+            [d.engine_stats for d in world.devices], registry=reg,
+            interval_s=30.0,
+            link_sources=[(r, d.link_stats)
+                          for r, d in enumerate(world.devices)])
+        sampler.sample()
+        snap = reg.snapshot()
+        cells = {k: v for k, v in snap["counters"].items()
+                 if k.startswith("link/")}
+        assert cells.get("link/tx_bytes", 0) > 0  # world total
+        assert any(k.startswith("link/tx_bytes/r") for k in cells)
+        # delta discipline: a second sample with no traffic publishes 0
+        total_first = snap["counters"]["link/tx_bytes"]
+        sampler.sample()
+        assert reg.counter("link/tx_bytes") == total_first
+        # world total equals the matrix sum
+        msum = sum(v for row in
+                   sampler.last_link_matrix["fields"]["tx_bytes"]
+                   for v in row)
+        assert total_first == msum
+    finally:
+        world.close()
+
+
+def test_link_matrix_helpers_synthetic():
+    rows = {
+        0: [{"comm": 0, "peer": 1, "tx_msgs": 2, "tx_bytes": 100,
+             "rx_msgs": 0, "rx_bytes": 0, "retrans_sent": 0,
+             "nacks_tx": 0, "nacks_rx": 0, "fenced_drops": 0,
+             "seeks": 1, "seek_wait_ns": 500},
+            {"comm": 7, "peer": 1, "tx_msgs": 9, "tx_bytes": 999,
+             "rx_msgs": 0, "rx_bytes": 0, "retrans_sent": 0,
+             "nacks_tx": 0, "nacks_rx": 0, "fenced_drops": 0,
+             "seeks": 0, "seek_wait_ns": 0}],
+        1: [{"comm": 0, "peer": 0, "tx_msgs": 1, "tx_bytes": 40,
+             "rx_msgs": 2, "rx_bytes": 100, "retrans_sent": 3,
+             "nacks_tx": 0, "nacks_rx": 0, "fenced_drops": 0,
+             "seeks": 2, "seek_wait_ns": 9000}],
+    }
+    m = obs_telemetry.link_matrix(rows, nranks=2)
+    assert m["fields"]["tx_bytes"][0][1] == 100  # comm 7 filtered out
+    assert m["fields"]["tx_bytes"][1][0] == 40
+    assert obs_telemetry.slowest_link(m, "seek_wait_ns") == (1, 0)
+    assert obs_telemetry.slowest_link(m, "fenced_drops") is None
+    # comm=None folds every comm
+    m_all = obs_telemetry.link_matrix(rows, nranks=2, comm=None)
+    assert m_all["fields"]["tx_bytes"][0][1] == 1099
+    # imbalance over nonzero cells
+    assert obs_telemetry.link_imbalance(m, "tx_bytes") == \
+        pytest.approx(100 / 70)
+
+
+def test_perf_doctor_link_matrix_section(tmp_path):
+    """The --ci report grows a schema-validated link_matrix section
+    whenever the snapshot carries link/* families."""
+    reg = obs_metrics.MetricsRegistry()
+    world = _run_world(nranks=2)
+    try:
+        sampler = obs_telemetry.TelemetrySampler(
+            [d.engine_stats for d in world.devices], registry=reg,
+            interval_s=30.0,
+            link_sources=[(r, d.link_stats)
+                          for r, d in enumerate(world.devices)])
+        sampler.sample()
+    finally:
+        world.close()
+    mdump = tmp_path / "metrics.json"
+    mdump.write_text(json.dumps(reg.snapshot()))
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/perf_doctor.py"),
+         "--ci", "--metrics", str(mdump), "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["schema_errors"] == []
+    lm = report["link_matrix"]
+    P = lm["matrix"]["nranks"]
+    assert P == 2
+    for f in obs_telemetry.LINK_COUNTER_FIELDS:
+        assert len(lm["matrix"]["fields"][f]) == P
+    assert "tx_imbalance_ratio" in lm["findings"]
+    assert "link matrix" in proc.stdout
+
+
+def test_tpu_plan_replay_traffic_lands_in_link_matrix():
+    """The plan-replay lane is the dominant steady-state traffic under
+    ACCL_PLAN_AUTO — replayed collectives must account into the link
+    twin exactly like eager gang dispatches (a matrix that goes dark
+    when plans kick in would mis-model precisely the hot traffic)."""
+    from accl_tpu.backends.tpu import TpuWorld
+
+    with TpuWorld(4) as world:
+        def body(accl, rank):
+            send = accl.create_buffer_like(
+                np.arange(32, dtype=np.float32) + rank)
+            recv = accl.create_buffer(32, np.float32)
+            plan = accl.capture_plan(
+                lambda a: a.allreduce(send, recv, 32, ReduceFunction.SUM,
+                                      from_fpga=True, to_fpga=True))
+            for _ in range(3):
+                plan.replay()
+
+        base = world.link_matrix()["fields"]["tx_bytes"]
+        world.run(body)
+        m = world.link_matrix()
+    tx = m["fields"]["tx_bytes"]
+    # capture (1 eager) + 3 replays = 4 instances; allreduce of 128 B
+    # at busbw 2*(P-1)/P -> 192 B per right-neighbor link each
+    for r in range(4):
+        assert tx[r][(r + 1) % 4] - base[r][(r + 1) % 4] == 4 * 192, tx
+
+
+def test_sampler_dead_rank_keeps_world_shape():
+    """A source that dies mid-poll must not shrink the matrix: live
+    ranks' cells toward the dead rank keep publishing."""
+    reg = obs_metrics.MetricsRegistry()
+
+    def dead():
+        raise RuntimeError("rank 3 closed mid-poll")
+
+    rows0 = [{"comm": 0, "peer": 3, "tx_msgs": 1, "tx_bytes": 64,
+              "rx_msgs": 0, "rx_bytes": 0, "retrans_sent": 0,
+              "nacks_tx": 0, "nacks_rx": 0, "fenced_drops": 0,
+              "seeks": 0, "seek_wait_ns": 0}]
+    sampler = obs_telemetry.TelemetrySampler(
+        [], registry=reg,
+        link_sources=[(0, lambda: rows0), (1, lambda: []),
+                      (2, lambda: []), (3, dead)])
+    sampler.sample()
+    m = sampler.last_link_matrix
+    assert m["nranks"] == 4  # NOT shrunk to the answering ranks
+    assert m["fields"]["tx_bytes"][0][3] == 64
+    assert reg.counter("link/tx_bytes/r0->r3") == 64
