@@ -43,6 +43,7 @@ from .constants import (
     Operation,
     ReduceFunction,
     StreamFlags,
+    TuningKey,
 )
 from .observability import flight as _flight
 from .observability import health as _health
@@ -156,6 +157,11 @@ class ACCL:
         self._auto_rings: Optional[dict] = None
         self._auto_last = None
         self._auto_streak = 0
+        #: learned algorithm-selection policy (accl_tpu/tuning): armed
+        #: at initialize from ACCL_TUNE_TABLE (ACCL_TUNE=0 disarms).
+        #: None adds ONE falsy read to _execute — with the knobs unset
+        #: dispatch behavior is bit-identical to the static thresholds.
+        self._tune_policy = None
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -220,12 +226,20 @@ class ACCL:
         #    configure_tuning_parameters, accl.cpp:1214-1224): gather
         #    fan-in 2 above 32 KB, bcast flat <= 3 ranks, reduce flat
         #    <= 4 ranks or <= min(rndzv/4, 32 KB)
-        self.set_tuning(self.GATHER_FLAT_TREE_MAX_FANIN, 2)
-        self.set_tuning(self.GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024)
-        self.set_tuning(self.BCAST_FLAT_TREE_MAX_RANKS, 3)
-        self.set_tuning(self.REDUCE_FLAT_TREE_MAX_RANKS, 4)
-        self.set_tuning(self.REDUCE_FLAT_TREE_MAX_COUNT,
-                        min(max_rendezvous_size // 4, 32 * 1024))
+        self.apply_static_tuning()
+
+        # 6.5 learned selection policy (accl_tpu/tuning/autotune.py):
+        #     ACCL_TUNE_TABLE names a persisted selection table and
+        #     ACCL_TUNE != 0 — the policy's derived crossovers are
+        #     written over the firmware-ported constants above, so
+        #     Engine::set_tuning / the TPU ring threshold become the
+        #     backend of the LEARNED policy.  Both knobs unset: policy
+        #     is None and the static writes above stand bit-for-bit.
+        from .tuning import autotune as _autotune
+
+        self._tune_policy = _autotune.policy_from_env()
+        if self._tune_policy is not None:
+            self._tune_policy.install(self)
 
         # 7. enable transport engines (reference: accl.cpp:1122-1125)
         self._config_call(CfgFunc.enable_pkt)
@@ -336,6 +350,25 @@ class ACCL:
         self._communicators.append(sub)
         return new_id
 
+    def reserve_communicator(self) -> int:
+        """Burn one communicator id with an inert slot, so a sub-group
+        this rank is NOT a member of can occupy the same id on its
+        members — the :meth:`create_communicator` ordering discipline
+        applied to disjoint group families (the hierarchical
+        composer's per-axis sub-communicators, accl_tpu/tuning).
+
+        On a world-shared comm table (TPU backend) the pad is
+        driver-side only — the members' upload covers the world and a
+        second upload with different membership would be rejected.
+        Per-rank engine tables (emulator) additionally get an inert
+        self-only communicator so the engine-side id spaces stay
+        aligned with the wire protocol's comm ids."""
+        cid = len(self._communicators)
+        if getattr(self._device, "comm_table_is_shared", False):
+            self._pad_communicators(cid + 1)
+            return cid
+        return self.create_communicator([self.rank])
+
     def set_max_eager_msg_size(self, nbytes: int) -> None:
         """Runtime eager↔rendezvous threshold (reference:
         accl.cpp:1415-1423; validated ≥ rx buffer size by the engine,
@@ -354,15 +387,50 @@ class ACCL:
         self.engine_timeout_us = int(timeout)
 
     # flat-tree schedule thresholds (reference exchange-memory tuning
-    # registers, accl.cpp:1214-1224 / ccl_offload_control.h:86-90)
-    BCAST_FLAT_TREE_MAX_RANKS = 0
-    REDUCE_FLAT_TREE_MAX_RANKS = 1
-    GATHER_FLAT_TREE_MAX_FANIN = 2
-    EGRESS_PIPELINE_DEPTH = 3
-    GATHER_FLAT_TREE_MAX_COUNT = 4
-    REDUCE_FLAT_TREE_MAX_COUNT = 5
+    # registers, accl.cpp:1214-1224 / ccl_offload_control.h:86-90) —
+    # aliases of the ONE authoritative table, constants.TuningKey
+    BCAST_FLAT_TREE_MAX_RANKS = int(TuningKey.BCAST_FLAT_TREE_MAX_RANKS)
+    REDUCE_FLAT_TREE_MAX_RANKS = int(
+        TuningKey.REDUCE_FLAT_TREE_MAX_RANKS)
+    GATHER_FLAT_TREE_MAX_FANIN = int(
+        TuningKey.GATHER_FLAT_TREE_MAX_FANIN)
+    EGRESS_PIPELINE_DEPTH = int(TuningKey.EGRESS_PIPELINE_DEPTH)
+    GATHER_FLAT_TREE_MAX_COUNT = int(
+        TuningKey.GATHER_FLAT_TREE_MAX_COUNT)
+    REDUCE_FLAT_TREE_MAX_COUNT = int(
+        TuningKey.REDUCE_FLAT_TREE_MAX_COUNT)
+
+    def static_tuning(self) -> dict:
+        """The firmware-ported static tuning-register values
+        (reference configure_tuning_parameters, accl.cpp:1214-1224) —
+        the ONE place they are written down: initialize applies them,
+        and the autotuner's algorithm lanes restore them after a sweep
+        so "static" always means exactly this."""
+        return {
+            int(TuningKey.GATHER_FLAT_TREE_MAX_FANIN): 2,
+            int(TuningKey.GATHER_FLAT_TREE_MAX_COUNT): 32 * 1024,
+            int(TuningKey.BCAST_FLAT_TREE_MAX_RANKS): 3,
+            int(TuningKey.REDUCE_FLAT_TREE_MAX_RANKS): 4,
+            int(TuningKey.REDUCE_FLAT_TREE_MAX_COUNT):
+                min(self.max_rendezvous_size // 4, 32 * 1024),
+        }
+
+    def apply_static_tuning(self) -> None:
+        """Write the static register values of :meth:`static_tuning`."""
+        for key, value in self.static_tuning().items():
+            self.set_tuning(key, value)
 
     def set_tuning(self, key: int, value: int) -> None:
+        """Write one runtime tuning register (constants.TuningKey).
+        Unknown keys raise an ACCLError naming the key and the known
+        set — never a silent no-op (clear-error contract, r16); the
+        backend additionally rejects keys it does not implement (e.g.
+        RING_THRESHOLD_BYTES on the emulator engine)."""
+        from .constants import TUNING_KEY_NAMES, unknown_tuning_key_error
+
+        if key not in TUNING_KEY_NAMES:
+            raise unknown_tuning_key_error(
+                key, frozenset(TUNING_KEY_NAMES), "any")
         setter = getattr(self._device, "set_tuning", None)
         if setter is not None:
             setter(key, value)
@@ -536,6 +604,12 @@ class ACCL:
             self._auto_rings.clear()
         self._auto_last = None
         self._auto_streak = 0
+        # the selection-policy memo keys on (scenario, arithcfg,
+        # count, comm): after a membership change the same comm id can
+        # mean a different size (and payload bucket), so drop the
+        # cached decisions — the next call re-resolves at current size
+        if self._tune_policy is not None:
+            self._tune_policy._memo.clear()
 
     def _replay_auto(self, entry, desc: str) -> Optional[Request]:
         """Route one auto-captured call through its plan ring; returns
@@ -1258,6 +1332,14 @@ class ACCL:
         # placeholder fast-fail (elastic join): same falsy-set cost
         if self._placeholder_comms and call.comm in self._placeholder_comms:
             self.communicator(call.comm)  # raises the naming ACCLError
+        # learned selection policy (accl_tpu/tuning): one falsy read
+        # when no table is armed; armed, one memoized dict probe per
+        # descriptor signature — the policy's threshold derivations
+        # were written into the backend registers at install, so this
+        # consult only records/serves the per-call decision (metrics
+        # family tuning/selected/<algorithm>)
+        if self._tune_policy is not None:
+            self._tune_policy.on_call(self, call)
         # plan auto-replay (ACCL_PLAN_AUTO, accl_tpu/plans.py): a call
         # whose gang agreed to arm a one-step ring replays through it —
         # no descriptor work, no gang assembly, no per-call request
@@ -1374,14 +1456,13 @@ class ACCL:
             self._auto_rings[id(call)] = (call, req.plan_ring)
         return req
 
-    def _observe_call(self, call: CCLOCall, desc: str, req: Request,
-                      t_submit: int) -> None:
-        """Attach the observability record(s) to one outgoing request:
-        the metrics signature (collective, dtype, size bucket — published
-        by Request.complete) and, when tracing is on, the TraceSpan with
-        its submit timestamp and gang id.  The gang-id key matches the
-        engines' FIFO pairing key (scenario, comm, tag), so rank R's Nth
-        instance joins the same gang id every engine would assemble."""
+    def resolve_call_signature(self, call: CCLOCall) -> tuple:
+        """(op, nranks, rank, dtype_name, nbytes) for one descriptor —
+        the ONE derivation of the metrics signature, shared by the
+        observability gate below and the r16 selection policy's table
+        lookup (accl_tpu/tuning/autotune.SelectionPolicy.on_call), so
+        the tuner always buckets a call exactly the way the metrics it
+        was trained on did."""
         op = Operation(call.scenario)
         comm = (self._communicators[call.comm]
                 if call.comm < len(self._communicators) else None)
@@ -1393,6 +1474,18 @@ class ACCL:
         elem_bytes = (DATA_TYPE_SIZE.get(pair[0], 0) // 8) if pair else 0
         nbytes = (call.count * elem_bytes
                   * _metrics.payload_factor(op.name, nranks))
+        return op, nranks, rank, dtype_name, nbytes
+
+    def _observe_call(self, call: CCLOCall, desc: str, req: Request,
+                      t_submit: int) -> None:
+        """Attach the observability record(s) to one outgoing request:
+        the metrics signature (collective, dtype, size bucket — published
+        by Request.complete) and, when tracing is on, the TraceSpan with
+        its submit timestamp and gang id.  The gang-id key matches the
+        engines' FIFO pairing key (scenario, comm, tag), so rank R's Nth
+        instance joins the same gang id every engine would assemble."""
+        op, nranks, rank, dtype_name, nbytes = \
+            self.resolve_call_signature(call)
         if self.flight_recorder is not None and _flight.enabled():
             req.flight = self.flight_recorder.new_record(
                 req.id, op.name, call.comm, call.tag, dtype_name,

@@ -475,10 +475,17 @@ class EmuDevice(CCLODevice):
 
     def set_tuning(self, key: int, value: int) -> None:
         """Write a flat-tree tuning register (reference:
-        configure_tuning_parameters, accl.cpp:1214-1224).
-        Keys: 0=BCAST_FLAT_TREE_MAX_RANKS, 1=REDUCE_FLAT_TREE_MAX_RANKS,
-        2=GATHER_FLAT_TREE_MAX_FANIN."""
-        self._lib.accl_set_tuning(self._w, self._rank, key, value)
+        configure_tuning_parameters, accl.cpp:1214-1224; keys named in
+        constants.TuningKey).  Unknown keys raise an ACCLError naming
+        the key and the engine's known set — the engine rejects them
+        instead of silently writing nothing (clear-error contract)."""
+        from ..constants import EMU_TUNING_KEYS, unknown_tuning_key_error
+
+        rc = self._lib.accl_set_tuning(self._w, self._rank, key, value)
+        if rc == -2 or (rc != 0 and key not in EMU_TUNING_KEYS):
+            raise unknown_tuning_key_error(key, EMU_TUNING_KEYS, "emu")
+        if rc != 0:
+            raise ACCLError(f"set_tuning({key}, {value}) failed (rc={rc})")
 
     # -- streams (PL-kernel equivalent) -------------------------------
     def push_krnl(self, data: np.ndarray) -> None:

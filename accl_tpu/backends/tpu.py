@@ -249,6 +249,12 @@ class TpuEngine:
 
         self.ring_threshold_bytes = int(
             _os.environ.get("ACCL_RING_THRESHOLD", str(4 << 20)))
+        # flat-tree tuning-register hints (constants.TuningKey 0..5):
+        # written through TpuDeviceView.set_tuning for parity with the
+        # native engine's registers; the XLA collective owns the
+        # schedule below the ring threshold so these are stored (and
+        # observable) rather than consulted per dispatch
+        self.tuning_registers: dict = {}
         # per-call completion barrier.  False (default): a collective
         # call completes at DISPATCH — jax arrays are async futures and
         # every consumer (the next collective's operand, sync_from_device
@@ -2082,8 +2088,17 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
             out = jax.lax.psum_scatter(masked, "rank", scatter_dimension=0,
                                        tiled=True)
         elif op == Operation.reduce_scatter:
-            out = jax.lax.psum_scatter(v, "rank", scatter_dimension=0,
-                                       tiled=True)
+            if is_max:
+                # XLA has no pmax_scatter: reduce fully, keep own chunk
+                # (correct first; MAX reduce_scatter is a cold lane —
+                # the SUM path keeps the bandwidth-optimal ring)
+                idx = jax.lax.axis_index("rank")
+                out = jax.lax.dynamic_slice_in_dim(
+                    jax.lax.pmax(v, "rank"), idx * n, n)
+            else:
+                out = jax.lax.psum_scatter(v, "rank",
+                                           scatter_dimension=0,
+                                           tiled=True)
         elif op == Operation.alltoall:
             blocks = v.reshape(nranks, n)
             out = jax.lax.all_to_all(blocks, "rank", split_axis=0,
@@ -2118,6 +2133,14 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
 class TpuDeviceView(CCLODevice):
     """One rank's CCLO handle over the shared TpuEngine (the per-rank
     driver-facing face of the world-level backend)."""
+
+    #: all ranks share one TpuEngine comm table keyed by comm id, so a
+    #: disjoint sub-group must get a DISTINCT id world-wide; the
+    #: hierarchical composer (accl_tpu/tuning/compose.py) reads this to
+    #: decide whether a non-member rank pads its id space driver-side
+    #: only (shared table: the members' upload covers the world) or
+    #: must upload an inert pad comm (per-rank engine tables: emu)
+    comm_table_is_shared = True
 
     def __init__(self, engine: TpuEngine, rank: int):
         self._engine = engine
@@ -2222,6 +2245,28 @@ class TpuDeviceView(CCLODevice):
         # registered so the gang can recover each call's wire dtype
         # (f16 vs bf16 compression pair) from the descriptor's arithcfg id
         return self._engine.register_arithcfg(cfg)
+
+    def set_tuning(self, key: int, value: int) -> None:
+        """TPU twin of the engine tuning registers (clear-error
+        contract, constants.TuningKey): RING_THRESHOLD_BYTES is live —
+        it moves the ring/HLO crossover the gang planner compiles
+        against (`_gang_plan` keys its signature on it, so a write
+        recompiles affected shapes) — and the flat-tree registers are
+        stored as schedule hints (the XLA collective owns the schedule
+        below the ring threshold).  Unknown keys raise an ACCLError
+        naming the key and the known set."""
+        from ..constants import (
+            TPU_TUNING_KEYS,
+            TuningKey,
+            unknown_tuning_key_error,
+        )
+
+        if key not in TPU_TUNING_KEYS:
+            raise unknown_tuning_key_error(key, TPU_TUNING_KEYS, "tpu")
+        if key == int(TuningKey.RING_THRESHOLD_BYTES):
+            self._engine.ring_threshold_bytes = int(value)
+        else:
+            self._engine.tuning_registers[int(key)] = int(value)
 
     def push_krnl(self, data: np.ndarray) -> None:
         self._engine.push_krnl(self._rank, data)

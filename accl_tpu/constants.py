@@ -187,6 +187,56 @@ NOT_READY_ERROR = 1 << 31
 SANITIZER_ABORT_ERROR = 1 << 30
 
 
+class TuningKey(enum.IntEnum):
+    """Runtime tuning-register keys (reference exchange-memory flat-tree
+    thresholds, ccl_offload_control.h:86-90, plus the TPU-backend ring
+    crossover).  The ONE authoritative name/value table: the driver
+    (`ACCL.set_tuning`), the native engine twin (engine.hpp TuningKey)
+    and the TPU backend twin all validate against it, so an unknown key
+    raises an ACCLError naming the key and this set instead of silently
+    writing nothing (the clear-error contract, r16)."""
+
+    BCAST_FLAT_TREE_MAX_RANKS = 0
+    REDUCE_FLAT_TREE_MAX_RANKS = 1
+    GATHER_FLAT_TREE_MAX_FANIN = 2
+    EGRESS_PIPELINE_DEPTH = 3
+    GATHER_FLAT_TREE_MAX_COUNT = 4
+    REDUCE_FLAT_TREE_MAX_COUNT = 5
+    #: TPU-backend extension: byte threshold above which allreduce /
+    #: allgather / reduce_scatter ride the Pallas ring kernels instead
+    #: of the XLA HLO collective (backends/tpu.py ring_threshold_bytes,
+    #: env default ACCL_RING_THRESHOLD).  The native emulator engine
+    #: has no ring/flat crossover register and REJECTS this key.
+    RING_THRESHOLD_BYTES = 6
+
+
+#: key -> name for every tuning register any backend knows; the known
+#: set quoted by the clear-error message of `set_tuning` rejections.
+TUNING_KEY_NAMES = {int(k): k.name for k in TuningKey}
+
+#: the subset the native emulator engine implements (engine.hpp
+#: TuningKey 0..5; RING_THRESHOLD_BYTES is TPU-only)
+EMU_TUNING_KEYS = frozenset(
+    int(k) for k in TuningKey if k != TuningKey.RING_THRESHOLD_BYTES)
+
+#: the subset the TPU backend implements (flat-tree registers are
+#: stored for schedule hints/observability; RING_THRESHOLD_BYTES is
+#: live — it reshapes `TpuEngine._gang_plan` signatures)
+TPU_TUNING_KEYS = frozenset(int(k) for k in TuningKey)
+
+
+def unknown_tuning_key_error(key: int, known: "frozenset[int]",
+                             backend: str) -> "ACCLError":
+    """The shared rejection message: names the offending key and the
+    backend's known register set (constants.TuningKey names)."""
+    names = ", ".join(f"{k}={TUNING_KEY_NAMES[k]}" for k in sorted(known))
+    label = (f"{key} ({TUNING_KEY_NAMES[key]})"
+             if key in TUNING_KEY_NAMES else repr(key))
+    return ACCLError(
+        f"set_tuning: unknown tuning key {label} for the {backend} "
+        f"backend — known keys: {names}")
+
+
 class OperationStatus(enum.IntEnum):
     """Lifecycle of an async request (reference: constants.hpp:226-230)."""
 

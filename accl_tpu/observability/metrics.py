@@ -173,6 +173,12 @@ METRIC_HELP_PREFIXES = {
     "accl_link_": ("per-link wire counter (tx/rx msgs+bytes, "
                    "retransmits, NACKs, fenced drops, seek wait) per "
                    "src->dst link cell, world total when unsuffixed"),
+    # r16 learned algorithm selection (accl_tpu/tuning): one counter
+    # per algorithm the armed SelectionPolicy chose for a descriptor
+    # signature (flat/tree/ring/hierarchical/static)
+    "accl_tuning_selected_": ("calls whose descriptor signature the "
+                              "ACCL_TUNE_TABLE selection policy "
+                              "resolved to this algorithm lane"),
 }
 
 

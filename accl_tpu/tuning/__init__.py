@@ -1,0 +1,34 @@
+"""Topology-aware algorithm selection + persistent autotuning (r16).
+
+Three layers (HiCCL, arxiv 2408.05962; ACCL+ crossover points, arxiv
+2312.11742 — ROADMAP item 2):
+
+- :mod:`~accl_tpu.tuning.topology` — :class:`Fabric`, the axis model
+  over :mod:`accl_tpu.utils.topology`: ICI mesh axes on TPU, a
+  configurable ``ACCL_FABRIC=AxB`` layout for emu worlds, and
+  ``from_link_matrix`` ingestion of r15 measured per-link traffic so a
+  slow link demotes its axis out of the heavy-traffic role.
+- :mod:`~accl_tpu.tuning.compose` — :class:`HierarchicalComm`, two-level
+  collectives assembled from the existing driver primitives
+  (reduce_scatter-within → allreduce-across → allgather-within and the
+  scatter/gather/bcast analogues); ordinary driver calls, so a
+  composition is capturable with ``ACCL.capture_plan`` and the
+  decomposition overhead is paid once per r12 plan.
+- :mod:`~accl_tpu.tuning.autotune` — the persistent autotuner: sweeps
+  (collective, dtype, size-bucket, world-shape, algorithm) through the
+  bench sweep harness, persists a versioned JSON
+  :class:`SelectionTable`, and a :class:`SelectionPolicy` the driver
+  consults in ``_execute`` — ``Engine::set_tuning`` / the TPU ring
+  threshold become the backend of the learned policy.  Knobs:
+  ``ACCL_TUNE_TABLE=path`` arms it, ``ACCL_TUNE=0`` restores the static
+  thresholds bit-for-bit.
+"""
+from .autotune import (  # noqa: F401
+    SelectionPolicy,
+    SelectionTable,
+    TuneConfig,
+    policy_from_env,
+    tune,
+)
+from .compose import HierarchicalComm  # noqa: F401
+from .topology import Fabric  # noqa: F401

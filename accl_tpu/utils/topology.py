@@ -49,19 +49,63 @@ def probe() -> Capabilities:
     )
 
 
+def parse_shape(spec: str) -> tuple:
+    """Parse an axis-layout spec like ``"4x2"`` / ``"2x2x2"`` into a
+    shape tuple.  Raises a naming error on malformed specs (the env
+    clear-error contract for ``ACCL_FABRIC``)."""
+    try:
+        shape = tuple(int(tok) for tok in str(spec).lower().split("x"))
+    except ValueError:
+        shape = ()
+    if not shape or any(a < 1 for a in shape):
+        raise ValueError(
+            f"axis layout {spec!r} is not AxBxC... with positive "
+            f"extents (e.g. ACCL_FABRIC=4x2)")
+    return shape
+
+
+def grid_coords(nranks: int, shape) -> list:
+    """Row-major mesh coordinates for an emu world's configurable axis
+    layout (the explicit-coords path of :func:`link_axis`): rank r ->
+    (c0, c1, ...) over ``shape``.  The product of the extents must
+    cover the world; surplus positions are simply never minted."""
+    shape = tuple(int(a) for a in shape)
+    total = 1
+    for a in shape:
+        total *= a
+    if total < nranks:
+        raise ValueError(
+            f"axis layout {'x'.join(map(str, shape))} holds {total} "
+            f"ranks but the world has {nranks}")
+    coords = []
+    for r in range(nranks):
+        c, rem = [], r
+        for a in reversed(shape):
+            c.append(rem % a)
+            rem //= a
+        coords.append(tuple(reversed(c)))
+    return coords
+
+
 def link_axis(src: int, dst: int, coords=None,
-              nranks: int | None = None) -> str:
+              nranks: int | None = None, shape=None) -> str:
     """Classify a src->dst link against the world's topology axes —
-    the rendering key perf_doctor uses for the r15 link matrix (and the
-    grouping the topology-aware selection work, ROADMAP item 2, will
-    tune per axis).
+    the rendering key perf_doctor uses for the r15 link matrix and the
+    grouping the topology-aware tuner (accl_tpu/tuning) selects per
+    axis; both go through the same Fabric so the labels never
+    disagree.
 
     With per-device ICI ``coords`` (utils.topology.probe on TPU) the
     label is the mesh axis the two devices differ on (``x``/``y``/``z``
-    single-axis, ``multi-axis`` otherwise).  Without coords (emu
-    worlds: a logical ring fabric) it is the ring distance:
-    ``ring+1``/``ring-1`` for the two neighbor directions, ``hop<k>``
-    for longer chords."""
+    single-axis, ``multi-axis`` otherwise).  ``shape`` (an emu world's
+    configurable axis layout, e.g. ``(4, 2)`` from ``ACCL_FABRIC=4x2``)
+    derives the same labels from row-major grid coordinates — the
+    explicit-coords path for worlds whose coords would otherwise
+    default from rank.  With neither (emu worlds: a logical ring
+    fabric) it is the ring distance: ``ring+1``/``ring-1`` for the two
+    neighbor directions, ``hop<k>`` for longer chords."""
+    if coords is None and shape is not None and nranks:
+        coords = grid_coords(nranks, shape)
     if coords is not None and 0 <= src < len(coords) \
             and 0 <= dst < len(coords) \
             and coords[src] is not None and coords[dst] is not None:

@@ -187,6 +187,20 @@ CPU_TIMEOUT_S = 420
 # ---------------------------------------------------------------------------
 
 def _measure(platform: str) -> dict:
+    # claim fail-fast (r16): libtpu metadata retries can wedge the
+    # claim for the worker's WHOLE budget (the kill-at-60s ritual
+    # ROADMAP documented); a watchdog aborts the claim after
+    # ACCL_TPU_CLAIM_TIMEOUT_S (default 60) with a clear message so
+    # the orchestrator retries / falls to the CPU rung immediately
+    # instead of burning the full attempt timeout.
+    claim_guard = None
+    if platform == "tpu":
+        from accl_tpu.bench.sweep import claim_watchdog
+
+        claim_guard = claim_watchdog(
+            "bench worker", advice="the orchestrator will retry and "
+            "fall back to the CPU rung")
+
     import jax
 
     from accl_tpu.utils.compile_cache import enable as _enable_cache
@@ -202,6 +216,8 @@ def _measure(platform: str) -> dict:
 
     t0 = time.perf_counter()
     backend = jax.default_backend()
+    if claim_guard is not None:
+        claim_guard.cancel()  # claim landed: measurement may run long
     print(f"[bench worker] backend={backend} init took "
           f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
     on_tpu = backend not in ("cpu",)

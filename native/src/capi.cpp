@@ -176,8 +176,7 @@ int accl_set_arithcfg(void* wp, int rank, const uint32_t* words, int n) {
 int accl_set_tuning(void* wp, int rank, uint32_t key, uint32_t value) {
   Engine* e = world_get(wp, rank);
   if (!e) return -1;
-  e->set_tuning(key, value);
-  return 0;
+  return e->set_tuning(key, value) == 0 ? 0 : -2;  // -2: unknown key
 }
 
 int accl_inject_fault(void* wp, int rank, uint32_t kind) {

@@ -1635,7 +1635,7 @@ void Engine::teardown_call(CallDesc& c) {
   if (c.scratch1) { free_addr(c.scratch1); c.scratch1 = 0; }
 }
 
-void Engine::set_tuning(uint32_t key, uint32_t value) {
+int Engine::set_tuning(uint32_t key, uint32_t value) {
   switch (key) {
     case BCAST_FLAT_TREE_MAX_RANKS: bcast_flat_max_ranks_ = value; break;
     case REDUCE_FLAT_TREE_MAX_RANKS: reduce_flat_max_ranks_ = value; break;
@@ -1651,7 +1651,10 @@ void Engine::set_tuning(uint32_t key, uint32_t value) {
     case REDUCE_FLAT_TREE_MAX_COUNT:
       reduce_flat_max_count_ = value;
       break;
+    default:
+      return -1;  // unknown register: reject, never silently ignore
   }
+  return 0;
 }
 
 uint32_t Engine::execute(CallDesc& c) {

@@ -784,7 +784,10 @@ class Engine {
     GATHER_FLAT_TREE_MAX_COUNT = 4,
     REDUCE_FLAT_TREE_MAX_COUNT = 5,
   };
-  void set_tuning(uint32_t key, uint32_t value);
+  // returns 0 on success, -1 for an unknown key (the clear-error
+  // contract: the Python twin raises an ACCLError naming the key and
+  // the known set instead of silently writing nothing)
+  int set_tuning(uint32_t key, uint32_t value);
 
  private:
   // tuning registers: written by the host thread (set_tuning) while

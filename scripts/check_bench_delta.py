@@ -111,7 +111,7 @@ def sweep_gate(ratio: float) -> int:
         key=_round_of)
     if not baselines:
         print("sweep gate: no committed baseline — record-only pass")
-        return 0
+        return tuned_lane_gate()
     base_path = baselines[-1]
     base_round = _round_of(base_path)
     sweeps = [p for p in glob.glob(
@@ -120,7 +120,7 @@ def sweep_gate(ratio: float) -> int:
     if not sweeps:
         print(f"sweep gate: no sweep newer than baseline r{base_round:02d}"
               " — record-only pass")
-        return 0
+        return tuned_lane_gate()
     new_path = max(sweeps, key=_round_of)
     base = _sweep_best(base_path)
     new = _sweep_best(new_path)
@@ -144,6 +144,54 @@ def sweep_gate(ratio: float) -> int:
               file=sys.stderr)
         return 1
     print("sweep gate: OK")
+    return tuned_lane_gate()
+
+
+def tuned_lane_gate(slow_ratio: float = 1.05,
+                    win_ratio: float = 1.15) -> int:
+    """The tuned lane of the sweep gate (r16): validate the committed
+    ``sweep_r*_tuned_vs_static.csv`` record — the autotuned policy must
+    never be more than ``slow_ratio`` slower than static on any cell,
+    and the record's ``win_ratio`` wins are counted for the log.  A
+    tree without a tuned record passes (the lane is optional until a
+    tuner run commits one)."""
+    import csv
+    import re
+
+    def _tuned_round(path: str) -> int:
+        m = re.search(r"sweep_r(\d+)_tuned_vs_static\.csv$", path)
+        return int(m.group(1)) if m else -1
+
+    results = os.path.join(ROOT, "bench", "results")
+    records = sorted(glob.glob(
+        os.path.join(results, "sweep_r*_tuned_vs_static.csv")),
+        key=_tuned_round)
+    if not records:
+        print("sweep gate: no tuned-vs-static record — tuned lane "
+              "skipped")
+        return 0
+    path = records[-1]
+    bad, wins, rows = [], 0, 0
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            rows += 1
+            r = float(row["ratio"])
+            if r < 1.0 / slow_ratio:
+                bad.append((row["collective"], row["size_bucket"], r))
+            if r >= win_ratio:
+                wins += 1
+    print(f"sweep gate (tuned lane): {os.path.basename(path)} — "
+          f"{rows} cells, {wins} at >= {win_ratio}x busbw vs static")
+    for coll, bucket, r in bad:
+        print(f"sweep gate (tuned lane): {coll} {bucket} is {r}x "
+              f"static (< {1.0 / slow_ratio:.3f}) — the committed "
+              f"policy regresses this cell", file=sys.stderr)
+    if bad:
+        print("sweep gate (tuned lane): re-run scripts/accl_tune.py "
+              "--record (compare() prunes unreproducible selections) "
+              "before committing the table", file=sys.stderr)
+        return 1
+    print("sweep gate (tuned lane): OK")
     return 0
 
 

@@ -19,9 +19,11 @@ files into the same three-part report a running world exposes through
   lane) with the same thresholds as the live sentinel;
 - **link matrix** (r15): the ``link/*`` families of the snapshot
   reassembled into the world-level P×P per-link traffic matrix,
-  rendered against the topology axes (utils/topology.link_axis) with
+  rendered against the topology axes of the SAME Fabric the r16
+  autotuner builds (accl_tpu/tuning/topology.Fabric.for_world —
+  ACCL_FABRIC / device coords / near-square default) with
   slowest-link and imbalance findings — the measured per-link model
-  the topology-aware autotuner (ROADMAP item 2) consumes;
+  ``Fabric.from_link_matrix`` ingests for axis demotion;
 - **overlap accounting** (r15, needs --trace + --flight): wire-exposed
   vs compute-overlapped time per collective — the recovered-compute
   precursor metric for device-initiated fusion (ROADMAP item 3).
@@ -50,7 +52,35 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from accl_tpu.observability import attribution, telemetry  # noqa: E402
 from accl_tpu.observability.flight import merge_flight_dumps  # noqa: E402
 from accl_tpu.observability.sentinel import Baseline, Sentinel  # noqa: E402
-from accl_tpu.utils.topology import link_axis  # noqa: E402
+from accl_tpu.tuning.topology import Fabric  # noqa: E402
+from accl_tpu.utils.topology import link_axis as _ring_link_axis  # noqa: E402
+
+
+_FABRIC_CACHE: dict = {}
+
+
+def _world_fabric(P: int):
+    """(fabric_or_None, link_axis_fn) for a P-rank snapshot — the
+    SAME Fabric the r16 tuner builds, but a snapshot must still render
+    when this analyst's ACCL_FABRIC / probed coords do not fit the
+    snapshot's world: fall back to the r15 ring labels rather than
+    aborting the whole report.  Memoized per P so the findings and
+    the rendering always label a link identically (and the fallback
+    note prints once)."""
+    if P in _FABRIC_CACHE:
+        return _FABRIC_CACHE[P]
+    try:
+        # probe=False: an OFFLINE report must never import jax /
+        # touch jax.devices() — on a TPU host that claims (or wedges
+        # on) the very chip this tool is diagnosing
+        fab = Fabric.for_world(P, probe=False)
+        out = (fab, fab.link_axis)
+    except Exception as e:  # noqa: BLE001 — a report must still render
+        print(f"note: no fabric for a {P}-rank snapshot ({e}); "
+              f"falling back to ring link labels", file=sys.stderr)
+        out = (None, (lambda s, d: _ring_link_axis(s, d, nranks=P)))
+    _FABRIC_CACHE[P] = out
+    return out
 
 SNAPSHOT_KEYS = ("counters", "gauges", "calls")
 
@@ -114,22 +144,27 @@ def link_matrix_section(snap: dict) -> dict:
 
 def link_findings(matrix: dict) -> dict:
     """Slowest-link + imbalance findings over one link_matrix doc —
-    the shape the future topology autotuner (ROADMAP item 2) reads."""
+    the shape the r16 topology autotuner (accl_tpu/tuning) consumes.
+    Axis names come from the SAME Fabric the tuner builds
+    (Fabric.for_world honors ACCL_FABRIC / device coords), so the
+    report and the tuner can never disagree about which axis a link
+    belongs to."""
     P = matrix["nranks"]
+    _, link_axis = _world_fabric(P)
     out: dict = {}
     slow = telemetry.slowest_link(matrix, "seek_wait_ns")
     if slow is not None:
         s, d = slow
         out["slowest_link"] = {
             "observer": s, "peer": d,
-            "axis": link_axis(s, d, nranks=P),
+            "axis": link_axis(s, d),
             "seek_wait_ms": round(
                 matrix["fields"]["seek_wait_ns"][s][d] / 1e6, 3)}
     busiest = telemetry.slowest_link(matrix, "tx_bytes")
     if busiest is not None:
         s, d = busiest
         out["busiest_link"] = {
-            "src": s, "dst": d, "axis": link_axis(s, d, nranks=P),
+            "src": s, "dst": d, "axis": link_axis(s, d),
             "tx_bytes": matrix["fields"]["tx_bytes"][s][d]}
     ratio = telemetry.link_imbalance(matrix, "tx_bytes")
     out["tx_imbalance_ratio"] = round(ratio, 2)
@@ -140,7 +175,7 @@ def link_findings(matrix: dict) -> dict:
         total = sum(v for row in matrix["fields"]["retrans_sent"]
                     for v in row)
         out["lossiest_link"] = {
-            "src": s, "dst": d, "axis": link_axis(s, d, nranks=P),
+            "src": s, "dst": d, "axis": link_axis(s, d),
             "retransmits": matrix["fields"]["retrans_sent"][s][d],
             "share": round(
                 matrix["fields"]["retrans_sent"][s][d] / total, 3)
@@ -174,15 +209,17 @@ def validate_link_section(section: dict) -> list:
 def render_link_matrix(section: dict, out) -> None:
     matrix = section["matrix"]
     P = matrix["nranks"]
+    fabric, axis_fn = _world_fabric(P)
     f = section["findings"]
-    out.write(f"\nlink matrix ({P}x{P}, comm 0):\n")
+    spec = f", fabric {fabric.spec()}" if fabric is not None else ""
+    out.write(f"\nlink matrix ({P}x{P}, comm 0{spec}):\n")
     tx = matrix["fields"]["tx_bytes"]
     wait = matrix["fields"]["seek_wait_ns"]
     for s in range(P):
         for d in range(P):
             if tx[s][d] == 0 and wait[s][d] == 0:
                 continue
-            axis = link_axis(s, d, nranks=P)
+            axis = axis_fn(s, d)
             out.write(
                 f"  r{s}->r{d} [{axis:>7}] tx {tx[s][d]:>12} B  "
                 f"wait {wait[s][d] / 1e6:9.3f} ms  "

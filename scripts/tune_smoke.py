@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""tune_smoke: CI gate for the r16 topology-aware autotuner.
+
+One command proves the whole tuning pipeline on a 4-rank emu world:
+
+1. mini-sweep the algorithm lanes (static/flat/tree/hierarchical) and
+   build a selection table (accl_tpu/tuning/autotune.tune);
+2. verify tuned-vs-static in the same session (interleaved best-of;
+   unreproducible selections pruned) — HARD gate: no cell of the
+   verified record may be > 1.05x slower than static;
+3. persist the table, re-load it through the ACCL_TUNE_TABLE policy
+   path in a FRESH world, and assert the policy actually armed (the
+   tuning/selected metric family appears, registers were rewritten);
+4. advisory comparison of the tuned lane against the committed
+   ``sweep_gate_baseline_r12.csv`` durations (shared CI cores swing
+   3x, so only a catastrophic ratio fails).
+
+Artifacts: tune_table.json (the table, uploaded by CI) and
+tune_compare.csv (the verification record).
+"""
+import argparse
+import csv
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def baseline_durations() -> dict:
+    """(collective, count) -> best duration_us of the newest committed
+    sweep-gate baseline — parsing + newest-round selection shared with
+    scripts/check_bench_delta.py (one schema, one rule)."""
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    from check_bench_delta import _round_of, _sweep_best
+
+    paths = sorted(
+        glob.glob(os.path.join(ROOT, "bench", "results",
+                               "sweep_gate_baseline_r*.csv")),
+        key=_round_of)
+    return _sweep_best(paths[-1]) if paths else {}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--table", default="tune_table.json")
+    ap.add_argument("--compare-out", default="tune_compare.csv")
+    ap.add_argument("--baseline-ratio", type=float, default=10.0,
+                    help="fresh tuned duration vs committed baseline "
+                         "fail ratio (generous: shared CI cores)")
+    args = ap.parse_args()
+
+    # same receive-budget widening as tests/conftest.py: a loaded CI
+    # core can stall a rank past the reference 1 s default mid-sweep
+    os.environ.setdefault("ACCL_DEFAULT_TIMEOUT", "30000000")
+
+    from accl_tpu.backends.emu import EmuWorld
+    from accl_tpu.observability import metrics as _metrics
+    from accl_tpu.tuning import TuneConfig, autotune
+
+    cfg = TuneConfig(
+        collectives=("allreduce", "bcast", "gather", "reduce"),
+        count_pows=(8, 12, 14), repetitions=2, shape=(2, 2),
+        measured_demotion=False)
+
+    def world():
+        return EmuWorld(args.ranks, devmem_bytes=256 << 20,
+                        n_egr_rx_bufs=64, max_eager_size=16384,
+                        max_rendezvous_size=64 << 20)
+
+    # -- 1+2: tune, verify, prune ------------------------------------
+    w = world()
+    try:
+        table = autotune.tune(w, cfg, log=print)
+        assert table.entries, "tuner produced an empty table"
+        rows = autotune.compare(w, table, cfg, log=print)
+    finally:
+        w.close()
+    slow = [r for r in rows if r["ratio"] < 1.0 / 1.05]
+    if slow:
+        print(f"tune_smoke: FAIL — {len(slow)} verified cells slower "
+              f"than 1/1.05x static: {slow}", file=sys.stderr)
+        return 1
+    tuned_cells = [r for r in rows if r["algorithm"] != "static"]
+    print(f"tune_smoke: verified {len(rows)} cells, "
+          f"{len(tuned_cells)} non-static selections, "
+          f"{sum(1 for r in rows if r['ratio'] >= 1.15)} wins >= 1.15x")
+
+    table.save(args.table)
+    with open(args.compare_out, "w", newline="") as f:
+        cw = csv.DictWriter(f, fieldnames=list(rows[0]))
+        cw.writeheader()
+        cw.writerows(rows)
+
+    # -- 3: the ACCL_TUNE_TABLE policy path in a fresh world ----------
+    doc = json.load(open(args.table))
+    assert doc["format"] == "accl-tune-table" and doc["version"] == 1, doc
+    os.environ["ACCL_TUNE_TABLE"] = os.path.abspath(args.table)
+    try:
+        import numpy as np
+
+        w = world()
+        try:
+            assert all(a._tune_policy is not None for a in w.accls), \
+                "policy did not arm from ACCL_TUNE_TABLE"
+
+            def body(accl, rank):
+                s = accl.create_buffer_like(
+                    np.arange(4096, dtype=np.float32))
+                r = accl.create_buffer(4096, np.float32)
+                accl.allreduce(s, r, 4096)
+                return r.host.copy()
+
+            outs = w.run(body)
+            assert all(np.array_equal(o, outs[0]) for o in outs)
+            counters = _metrics.default_registry().snapshot()["counters"]
+            selected = {k: v for k, v in counters.items()
+                        if k.startswith("tuning/selected/")}
+            assert selected, (
+                "armed policy published no tuning/selected counters: "
+                f"{sorted(counters)[:20]}")
+            print(f"tune_smoke: policy armed, selections {selected}")
+        finally:
+            w.close()
+    finally:
+        del os.environ["ACCL_TUNE_TABLE"]
+
+    # -- 4: advisory gate vs the committed sweep baseline -------------
+    base = baseline_durations()
+    bad = []
+    for r in rows:
+        key = (r["collective"], r["count"])
+        if key not in base or not base[key]:
+            continue
+        # reconstruct the tuned duration from the verified busbw
+        from accl_tpu.observability.metrics import busbw_factor
+
+        bw = r["tuned_busbw_GBps"]
+        if not bw:
+            continue
+        dur_us = (r["bytes"] * busbw_factor(r["collective"], args.ranks)
+                  / bw / 1e9) * 1e6
+        ratio = dur_us / base[key]
+        if ratio > args.baseline_ratio:
+            bad.append((key, round(ratio, 1)))
+        else:
+            print(f"tune_smoke: {r['collective']} count={r['count']} "
+                  f"tuned {dur_us:.0f}us vs baseline {base[key]:.0f}us "
+                  f"({ratio:.1f}x)")
+    if bad:
+        print(f"tune_smoke: FAIL — tuned lane catastrophically slower "
+              f"than the committed baseline: {bad}", file=sys.stderr)
+        return 1
+    print("tune_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,559 @@
+"""Topology-aware hierarchical collectives + persistent autotuner
+(accl_tpu/tuning, r16).
+
+Pins the ISSUE-14 acceptance surface: hierarchical compositions
+bitwise-exact vs the flat engine collectives for lossless lanes on
+BOTH backends (including non-divisible counts and non-square fabrics),
+the versioned selection-table round-trip with corrupt-table rejection,
+``ACCL_TUNE=0`` parity, measured axis demotion from a (chaos-)slowed
+link, the clear-error contract of the tuning registers, and a tuned
+composition captured as an r12 plan — replaying bitwise and fenced by
+abort/shrink like any plan.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError
+from accl_tpu.backends.emu import EmuWorld
+from accl_tpu.backends.tpu import TpuWorld
+from accl_tpu.constants import ReduceFunction, TuningKey
+from accl_tpu.tuning import (
+    Fabric,
+    HierarchicalComm,
+    SelectionTable,
+    autotune,
+)
+from accl_tpu.utils.topology import grid_coords, link_axis, parse_shape
+
+WORLDS = pytest.mark.parametrize("world_cls", [EmuWorld, TpuWorld],
+                                 ids=["emu", "tpu-interpret"])
+
+
+def _mk_world(world_cls, nranks):
+    if world_cls is EmuWorld:
+        return EmuWorld(nranks, devmem_bytes=128 << 20, n_egr_rx_bufs=32,
+                        max_eager_size=16384,
+                        max_rendezvous_size=16 << 20)
+    return TpuWorld(nranks)
+
+
+def _hier(world, shape):
+    fab = Fabric.for_world(world.nranks, shape=shape)
+    return [HierarchicalComm(a, fab) for a in world.accls]
+
+
+# ---------------------------------------------------------------------------
+# fabric / topology model
+# ---------------------------------------------------------------------------
+
+def test_fabric_shapes_groups_and_labels():
+    fab = Fabric(8, shape=(4, 2))
+    assert fab.groups(1) == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert fab.across_groups() == [[0, 2, 4, 6], [1, 3, 5, 7]]
+    # one label source: Fabric delegates to utils.topology.link_axis
+    assert fab.link_axis(0, 1) == "y"
+    assert fab.link_axis(0, 2) == "x"
+    assert fab.link_axis(0, 3) == "multi-axis"
+    assert link_axis(0, 1, nranks=8, shape=(4, 2)) == "y"
+    assert grid_coords(4, (2, 2)) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def test_fabric_env_and_errors(monkeypatch):
+    monkeypatch.setenv("ACCL_FABRIC", "2x2")
+    assert Fabric.for_world(4).shape == (2, 2)
+    monkeypatch.setenv("ACCL_FABRIC", "3x2")
+    with pytest.raises(ACCLError, match="holds 6"):
+        Fabric.for_world(4)
+    monkeypatch.setenv("ACCL_FABRIC", "bogus")
+    with pytest.raises(ACCLError, match="ACCL_FABRIC"):
+        Fabric.for_world(4)
+    monkeypatch.delenv("ACCL_FABRIC")
+    assert Fabric.for_world(8).shape == (2, 4)  # near-square default
+    assert Fabric.for_world(7).trivial          # prime -> single axis
+    with pytest.raises(ValueError):
+        parse_shape("4x-2")
+
+
+def test_measured_demotion_flips_axis_order():
+    """A slowed link along the default within axis demotes it: the
+    fabric built from the measured matrix moves the healthy axis into
+    the heavy-traffic role (and the composer swaps stages)."""
+    P = 4
+    fields = {f: [[0] * P for _ in range(P)]
+              for f in ("seek_wait_ns", "retrans_sent", "tx_bytes")}
+    fab0 = Fabric.for_world(P, shape=(2, 2))
+    assert fab0.within_axis() == 1  # default: inner/contiguous axis
+    # blocked time observed on the y links (0<->1, 2<->3)
+    for s, d in ((0, 1), (1, 0), (2, 3), (3, 2)):
+        fields["seek_wait_ns"][s][d] = 5_000_000
+    matrix = {"nranks": P, "comm": 0, "fields": fields}
+    fab = Fabric.from_link_matrix(matrix, shape=(2, 2))
+    assert fab.within_axis() == 0, fab.axis_order
+    assert fab.axis_order == (0, 1)
+    # and a lossy link demotes the same way (retransmit penalty)
+    fields["seek_wait_ns"] = [[0] * P for _ in range(P)]
+    fields["retrans_sent"][0][2] = 50  # an x link
+    fab2 = Fabric.from_link_matrix(
+        {"nranks": P, "comm": 0, "fields": fields}, shape=(2, 2))
+    assert fab2.within_axis() == 1
+
+
+def test_chaos_slowed_link_demotes_measured_axis():
+    """The real pipeline end-to-end: chaos-lossy eager traffic on the
+    y-axis links lands retransmits + seek waits in
+    ``world.link_matrix()``, and the fabric built from that measured
+    snapshot demotes y out of the heavy-traffic within role (the
+    default preference) — the tuner then composes within x."""
+    with EmuWorld(4, chaos="seed=7,drop=0.08") as w:
+        def body(accl, rank):
+            # traffic ONLY along the y (inner) links of a 2x2 fabric:
+            # pairs (0,1) and (2,3) — the faulty funnel makes exactly
+            # those links lossy, x stays pristine
+            peer = rank ^ 1
+            s = accl.create_buffer_like(
+                np.full(128, rank + 1, np.float32))
+            r = accl.create_buffer(128, np.float32)
+            for i in range(10):
+                if rank < peer:
+                    accl.send(s, 128, peer, tag=i)
+                    accl.recv(r, 128, peer, tag=100 + i)
+                else:
+                    accl.recv(r, 128, peer, tag=i)
+                    accl.send(s, 128, peer, tag=100 + i)
+
+        w.run(body)
+        matrix = w.link_matrix()
+        measured = sum(v for row in matrix["fields"]["seek_wait_ns"]
+                       for v in row) + 1e6 * sum(
+            v for row in matrix["fields"]["retrans_sent"] for v in row)
+        assert measured > 0, matrix["fields"]
+        fab = Fabric.from_link_matrix(matrix, shape=(2, 2))
+        assert fab.within_axis() == 0, (fab.axis_order, fab.axis_scores)
+        assert fab.axis_scores["y"] > fab.axis_scores["x"]
+
+
+# ---------------------------------------------------------------------------
+# hierarchical composition: bitwise vs flat on both backends
+# ---------------------------------------------------------------------------
+
+@WORLDS
+@pytest.mark.parametrize("nranks,shape", [(4, (2, 2)), (6, (3, 2))],
+                         ids=["2x2", "3x2"])
+@pytest.mark.parametrize("count", [64, 7], ids=["divisible", "ragged"])
+def test_hier_allreduce_bitwise_vs_flat(world_cls, nranks, shape, count):
+    if world_cls is TpuWorld and nranks > 4:
+        nranks, shape = 4, (2, 2)  # 8 virtual devices; keep it light
+    w = _mk_world(world_cls, nranks)
+    try:
+        hier = _hier(w, shape)
+
+        def body(accl, rank):
+            data = (np.arange(count) % 13 + rank).astype(np.int32)
+            s = accl.create_buffer_like(data)
+            h = accl.create_buffer(count, np.int32)
+            f = accl.create_buffer(count, np.int32)
+            hier[rank].allreduce(s, h, count)
+            accl.allreduce(s, f, count)
+            hm = accl.create_buffer(count, np.int32)
+            fm = accl.create_buffer(count, np.int32)
+            hier[rank].allreduce(s, hm, count, ReduceFunction.MAX)
+            accl.allreduce(s, fm, count, ReduceFunction.MAX)
+            return (h.host.copy(), f.host.copy(), hm.host.copy(),
+                    fm.host.copy())
+
+        for h, f, hm, fm in w.run(body):
+            np.testing.assert_array_equal(h, f)
+            np.testing.assert_array_equal(hm, fm)
+    finally:
+        w.close()
+
+
+@WORLDS
+def test_hier_reduce_scatter_bitwise_vs_flat(world_cls):
+    w = _mk_world(world_cls, 4)
+    try:
+        hier = _hier(w, (2, 2))
+        count = 5  # per-rank chunk; global input 20 (no padding by
+        # construction — the composed slabs must still land flat)
+
+        def body(accl, rank):
+            data = (np.arange(count * 4) + rank * 100).astype(np.int32)
+            s = accl.create_buffer_like(data)
+            h = accl.create_buffer(count, np.int32)
+            f = accl.create_buffer(count, np.int32)
+            hier[rank].reduce_scatter(s, h, count)
+            accl.reduce_scatter(s, f, count)
+            return h.host.copy(), f.host.copy()
+
+        for h, f in w.run(body):
+            np.testing.assert_array_equal(h, f)
+    finally:
+        w.close()
+
+
+@WORLDS
+def test_hier_bcast_allgather_scatter_gather_bitwise(world_cls):
+    w = _mk_world(world_cls, 4)
+    try:
+        hier = _hier(w, (2, 2))
+        count, root = 9, 2
+
+        def body(accl, rank):
+            out = {}
+            # bcast
+            data = np.arange(count, dtype=np.float32) + \
+                (1000 if rank == root else 0)
+            b = accl.create_buffer_like(data)
+            hier[rank].bcast(b, count, root)
+            out["bcast"] = b.host.copy()
+            # allgather
+            s = accl.create_buffer_like(
+                np.arange(count, dtype=np.float32) + rank * 10)
+            g = accl.create_buffer(count * 4, np.float32)
+            hier[rank].allgather(s, g, count)
+            out["allgather"] = g.host.copy()
+            # scatter (root holds 4*count)
+            sd = accl.create_buffer_like(
+                np.arange(count * 4, dtype=np.float32)
+                * (1 if rank == root else 0))
+            sr = accl.create_buffer(count, np.float32)
+            hier[rank].scatter(sd, sr, count, root)
+            out["scatter"] = sr.host.copy()
+            # gather
+            gs = accl.create_buffer_like(
+                np.arange(count, dtype=np.float32) + rank * 10)
+            gr = (accl.create_buffer(count * 4, np.float32)
+                  if rank == root else None)
+            hier[rank].gather(gs, gr, count, root)
+            out["gather"] = gr.host.copy() if gr is not None else None
+            return out
+
+        res = w.run(body)
+        bexp = np.arange(count, dtype=np.float32) + 1000
+        agexp = np.concatenate(
+            [np.arange(count, dtype=np.float32) + rk * 10
+             for rk in range(4)])
+        for rk in range(4):
+            np.testing.assert_array_equal(res[rk]["bcast"], bexp)
+            np.testing.assert_array_equal(res[rk]["allgather"], agexp)
+            np.testing.assert_array_equal(
+                res[rk]["scatter"],
+                np.arange(count * 4,
+                          dtype=np.float32)[rk * count:(rk + 1) * count])
+        np.testing.assert_array_equal(res[root]["gather"], agexp)
+    finally:
+        w.close()
+
+
+def test_hier_trivial_fabric_falls_back_flat():
+    with EmuWorld(2) as w:
+        fab = Fabric.for_world(2, shape=(1, 2))
+        assert fab.trivial
+        hier = [HierarchicalComm(a, fab) for a in w.accls]
+        assert all(h.flat for h in hier)
+
+        def body(accl, rank):
+            s = accl.create_buffer_like(
+                np.full(8, rank + 1.0, np.float32))
+            r = accl.create_buffer(8, np.float32)
+            hier[rank].allreduce(s, r, 8)
+            return r.host.copy()
+
+        for out in w.run(body):
+            np.testing.assert_array_equal(out, np.full(8, 3.0))
+
+
+# ---------------------------------------------------------------------------
+# tuning registers: clear-error contract
+# ---------------------------------------------------------------------------
+
+def test_tuning_register_clear_errors():
+    with EmuWorld(2) as w:
+        a = w.accls[0]
+        # driver-level: unknown key names the key and the known set
+        with pytest.raises(ACCLError, match="42.*BCAST_FLAT_TREE"):
+            a.set_tuning(42, 1)
+        # emu backend: RING_THRESHOLD_BYTES is TPU-only
+        with pytest.raises(ACCLError, match="RING_THRESHOLD_BYTES"):
+            a.set_tuning(int(TuningKey.RING_THRESHOLD_BYTES), 0)
+        # known keys still write (no raise)
+        a.set_tuning(int(TuningKey.REDUCE_FLAT_TREE_MAX_COUNT), 4096)
+        a.apply_static_tuning()
+
+
+def test_tpu_tuning_register_twin():
+    with TpuWorld(2) as w:
+        a = w.accls[0]
+        with pytest.raises(ACCLError, match="unknown tuning key 42"):
+            a.set_tuning(42, 1)
+        a.set_tuning(int(TuningKey.RING_THRESHOLD_BYTES), 777)
+        assert w.engine.ring_threshold_bytes == 777
+        a.set_tuning(int(TuningKey.BCAST_FLAT_TREE_MAX_RANKS), 5)
+        assert w.engine.tuning_registers[
+            int(TuningKey.BCAST_FLAT_TREE_MAX_RANKS)] == 5
+
+
+# ---------------------------------------------------------------------------
+# selection table + policy
+# ---------------------------------------------------------------------------
+
+def _toy_table(nranks=4):
+    entries = {
+        f"allreduce|float32|<=64KiB|{nranks}": {
+            "algorithm": "ring", "busbw_GBps": 1.0,
+            "static_busbw_GBps": 0.5, "bytes": 65536},
+        f"reduce|float32|<=64KiB|{nranks}": {
+            "algorithm": "tree", "busbw_GBps": 1.0,
+            "static_busbw_GBps": 0.5, "bytes": 65536},
+        f"reduce|float32|<=1KiB|{nranks}": {
+            "algorithm": "flat", "busbw_GBps": 1.0,
+            "static_busbw_GBps": 0.9, "bytes": 1024},
+    }
+    return SelectionTable(entries, {"nranks": nranks, "backend": "emu",
+                                    "dtype": "float32"})
+
+
+def test_selection_table_round_trip(tmp_path):
+    path = str(tmp_path / "t.json")
+    table = _toy_table()
+    table.save(path)
+    loaded = SelectionTable.load(path)
+    assert loaded.entries == table.entries
+    assert loaded.lookup("allreduce", "float32", 40000, 4)[
+        "algorithm"] == "ring"
+    assert loaded.lookup("allreduce", "float32", 40000, 8) is None
+
+
+def test_selection_table_rejects_corruption(tmp_path):
+    path = str(tmp_path / "bad.json")
+    with open(path, "w") as f:
+        f.write("{ not json")
+    with pytest.raises(ACCLError, match="corrupt"):
+        SelectionTable.load(path)
+    with pytest.raises(ACCLError, match="cannot read"):
+        SelectionTable.load(str(tmp_path / "missing.json"))
+    doc = _toy_table().to_doc()
+    doc["version"] = 99
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ACCLError, match="version 99"):
+        SelectionTable.load(path)
+    doc["version"] = 1
+    doc["format"] = "something-else"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ACCLError, match="not a selection table"):
+        SelectionTable.load(path)
+    doc["format"] = "accl-tune-table"
+    doc["entries"]["reduce|float32|<=1KiB|4"] = {"algorithm": "warp"}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ACCLError, match="corrupt selection-table entry"):
+        SelectionTable.load(path)
+
+
+def test_policy_armed_installs_and_records(tmp_path, monkeypatch):
+    path = str(tmp_path / "t.json")
+    _toy_table().save(path)
+    monkeypatch.setenv("ACCL_TUNE_TABLE", path)
+    with EmuWorld(4) as w:
+        assert all(a._tune_policy is not None for a in w.accls)
+
+        def body(accl, rank):
+            s = accl.create_buffer_like(np.ones(256, np.float32))
+            r = accl.create_buffer(256, np.float32)
+            accl.reduce(s, r, 256, 0)
+            return r.host[0] if rank == 0 else 0.0
+
+        w.run(body)
+        snap = w.accls[0].metrics()
+        selected = {k: v for k, v in snap["counters"].items()
+                    if k.startswith("tuning/selected/")}
+        assert selected, snap["counters"].keys()
+
+
+def test_policy_install_programs_tpu_ring_crossover(tmp_path,
+                                                    monkeypatch):
+    path = str(tmp_path / "t.json")
+    _toy_table().save(path)
+    monkeypatch.setenv("ACCL_TUNE_TABLE", path)
+    with TpuWorld(4) as w:
+        # the learned ring crossover replaced the env-default constant
+        assert w.engine.ring_threshold_bytes == 65536
+
+
+def test_policy_ring_crossover_deflates_allgather_bytes(tmp_path,
+                                                        monkeypatch):
+    """Table bytes carry the nccl-tests payload factor (P for
+    allgather); the installed ring threshold must be in the gang
+    planner's per-rank units, so an allgather cell deflates by P."""
+    table = _toy_table()
+    table.entries["allgather|float32|<=16KiB|4"] = {
+        "algorithm": "ring", "busbw_GBps": 1.0,
+        "static_busbw_GBps": 0.5, "bytes": 16384}  # per-rank 4096
+    path = str(tmp_path / "t.json")
+    table.save(path)
+    monkeypatch.setenv("ACCL_TUNE_TABLE", path)
+    with TpuWorld(4) as w:
+        assert w.engine.ring_threshold_bytes == 4096
+
+
+def test_fabric_for_world_survives_mismatched_probe():
+    """A world smaller than the probed coord grid degrades to the
+    factorization fallback instead of refusing a default fabric."""
+    coords = [(0, 0), (0, 1), (1, 0), (1, 1)]
+    fab = Fabric.from_coords(4, coords)
+    assert fab.shape == (2, 2)
+    # 3 ranks cannot fill that grid: for_world must not raise
+    import accl_tpu.tuning.topology as topo_mod
+
+    orig = Fabric._probe_coords
+    try:
+        Fabric._probe_coords = staticmethod(
+            lambda nranks: coords[:nranks])
+        fab3 = topo_mod.Fabric.for_world(3)
+        assert fab3.nranks == 3
+    finally:
+        Fabric._probe_coords = orig
+
+
+def test_compare_verifies_the_tuned_fabric(tmp_path):
+    """compare() rebuilds the fabric from the table's persisted world
+    meta — including a demoted axis order — so verification measures
+    the SAME composition tune() selected."""
+    table = _toy_table()
+    table.world = {"nranks": 4, "shape": [2, 2], "axis_order": [0, 1],
+                   "backend": "emu", "dtype": "float32"}
+    fab = autotune.fabric_of_table(table, 4)
+    assert fab.shape == (2, 2)
+    assert fab.axis_order == (0, 1)  # the demoted order, not default
+    assert fab.within_axis() == 0
+
+
+def test_tune_zero_restores_static_bit_for_bit(tmp_path, monkeypatch):
+    path = str(tmp_path / "t.json")
+    _toy_table().save(path)
+    monkeypatch.setenv("ACCL_TUNE_TABLE", path)
+    monkeypatch.setenv("ACCL_TUNE", "0")
+    with TpuWorld(2) as w:
+        assert all(a._tune_policy is None for a in w.accls)
+        # the env-default constant stands — no learned write happened
+        assert w.engine.ring_threshold_bytes == int(
+            os.environ.get("ACCL_RING_THRESHOLD", str(4 << 20)))
+    monkeypatch.delenv("ACCL_TUNE")
+    monkeypatch.delenv("ACCL_TUNE_TABLE")
+    # no table present at all: same static state
+    with TpuWorld(2) as w:
+        assert all(a._tune_policy is None for a in w.accls)
+        assert w.engine.ring_threshold_bytes == int(
+            os.environ.get("ACCL_RING_THRESHOLD", str(4 << 20)))
+
+
+def test_policy_table_naming_error_on_missing_file(monkeypatch):
+    monkeypatch.setenv("ACCL_TUNE_TABLE", "/nonexistent/table.json")
+    with pytest.raises(ACCLError, match="ACCL_TUNE_TABLE"):
+        EmuWorld(2)
+
+
+# ---------------------------------------------------------------------------
+# autotuner pipeline (mini)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tune_builds_table_and_compare_never_slower():
+    w = EmuWorld(4, devmem_bytes=128 << 20, n_egr_rx_bufs=32,
+                 max_eager_size=16384, max_rendezvous_size=16 << 20)
+    try:
+        cfg = autotune.TuneConfig(
+            collectives=("allreduce", "reduce"), count_pows=(8, 12),
+            repetitions=2, shape=(2, 2), measured_demotion=False)
+        table = autotune.tune(w, cfg)
+        assert table.entries
+        for e in table.entries.values():
+            assert e["algorithm"] in autotune.ALGORITHMS
+        rows = autotune.compare(w, table, cfg)
+        assert rows
+        # pruning guarantees the verified table never regresses a cell
+        assert all(r["ratio"] >= 1.0 / 1.05 for r in rows), rows
+    finally:
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# tuned composition as an r12 plan
+# ---------------------------------------------------------------------------
+
+def test_hier_composition_captured_as_plan_replays_bitwise():
+    w = _mk_world(EmuWorld, 4)
+    try:
+        hier = _hier(w, (2, 2))
+        count = 48
+        plans = [None] * 4
+
+        def captured(accl, rank):
+            s = accl.create_buffer_like(
+                (np.arange(count) + rank).astype(np.int32))
+            r = accl.create_buffer(count, np.int32)
+            plan = accl.capture_plan(
+                lambda a: hier[rank].allreduce(s, r, count))
+            plans[rank] = plan
+            first = r.host.copy()
+            r.host[:] = 0
+            plan.replay()
+            return first, r.host.copy()
+
+        for first, replayed in w.run(captured):
+            np.testing.assert_array_equal(first, replayed)
+    finally:
+        w.close()
+
+
+def test_hier_plan_fenced_by_abort_and_reset():
+    """A captured composition is an ordinary r12 plan: aborting the
+    sub-communicator it runs on fences the replay (raises, never runs
+    the dead epoch), and reset_errors invalidates every plan — the
+    same contract shrink/grow apply through _invalidate_plans."""
+    w = _mk_world(EmuWorld, 4)
+    try:
+        hier = _hier(w, (2, 2))
+        count = 16
+        plans = [None] * 4
+
+        def cap(accl, rank):
+            s = accl.create_buffer_like(
+                np.full(count, rank + 1, np.int32))
+            r = accl.create_buffer(count, np.int32)
+            plans[rank] = accl.capture_plan(
+                lambda a: hier[rank].allreduce(s, r, count),
+                validate=False)
+
+        w.run(cap)
+
+        def abort_then_replay(accl, rank):
+            # each rank aborts its own within-group communicator (the
+            # composition's heavy stage) — the epoch fence must refuse
+            # the replay on every member
+            accl.abort(hier[rank]._inner_comm)
+            with pytest.raises(ACCLError):
+                plans[rank].replay()
+            return True
+
+        assert all(w.run(abort_then_replay))
+        w.reset_errors()
+
+        # re-capture on the recovered world, then reset_errors fences
+        # again (the shrink/grow-equivalent all-plans invalidation)
+        w.run(cap)
+        w.reset_errors()
+
+        def replay_after_reset(accl, rank):
+            with pytest.raises(ACCLError):
+                plans[rank].replay()
+            return True
+
+        assert all(w.run(replay_after_reset))
+    finally:
+        w.close()
