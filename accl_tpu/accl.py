@@ -87,6 +87,10 @@ class ACCL:
         self._communicators: list[Communicator] = []
         self._arith_ids: dict[tuple[DataType, DataType], int] = {}
         self._arith_pairs: dict[int, tuple] = {}
+        #: error-feedback twins of block-scaled pairs (r17): same dtype
+        #: pair, distinct engine config id whose error_feedback word
+        #: arms the per-site EQuARX residual fold on egress
+        self._arith_ids_ef: dict[tuple[DataType, DataType], int] = {}
         self._initialized = False
         self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
         self.max_rendezvous_size = DEFAULT_MAX_RENDEZVOUS_SIZE
@@ -162,6 +166,12 @@ class ACCL:
         #: None adds ONE falsy read to _execute — with the knobs unset
         #: dispatch behavior is bit-identical to the static thresholds.
         self._tune_policy = None
+        #: wire-compression policy (arithconfig.CompressionPolicy; r17):
+        #: armed at initialize from ACCL_COMPRESS (or set_compression /
+        #: a tuned table whose cells select a compression lane).  None
+        #: (the default) adds one falsy read in _build's memo-miss path
+        #: — dispatch is bit-identical static with the knob unset.
+        self._compress_policy = None
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -198,14 +208,30 @@ class ACCL:
         self._device.upload_communicator(comm)
         self._communicators = [comm]
 
-        # 4. arithmetic configs (reference: accl.cpp:1132-1141)
+        # 4. arithmetic configs (reference: accl.cpp:1132-1141), plus
+        #    the r17 int8 block-scaled wire pair — registered here (not
+        #    in DEFAULT_ARITH_CONFIG) so the scale-block geometry can
+        #    follow ACCL_COMPRESS_BLOCK, with an error-feedback twin
+        #    whose engine config arms the EQuARX residual fold
+        from .arithconfig import compress_block_from_env, int8_block_config
+
         for key, cfg in DEFAULT_ARITH_CONFIG.items():
             self._arith_ids[key] = self._device.upload_arithconfig(cfg)
+        block = compress_block_from_env()
+        i8_pair = (DataType.float32, DataType.int8)
+        self._arith_ids[i8_pair] = self._device.upload_arithconfig(
+            int8_block_config(block))
+        self._arith_ids_ef = {
+            i8_pair: self._device.upload_arithconfig(
+                int8_block_config(block, error_feedback=True)),
+        }
         # reverse map id -> (uncompressed, compressed): observability
         # recovers each call's datapath dtype from the descriptor's
         # arithcfg id (first pair wins on backend-deduplicated ids)
         self._arith_pairs = {}
         for pair, aid in self._arith_ids.items():
+            self._arith_pairs.setdefault(aid, pair)
+        for pair, aid in self._arith_ids_ef.items():
             self._arith_pairs.setdefault(aid, pair)
         self._call_memo.clear()  # memoized arithcfg ids may predate this
 
@@ -240,6 +266,26 @@ class ACCL:
         self._tune_policy = _autotune.policy_from_env()
         if self._tune_policy is not None:
             self._tune_policy.install(self)
+
+        # 6.7 wire-compression policy (arithconfig.CompressionPolicy,
+        #     r17): ACCL_COMPRESS arms automatic compress_dtype
+        #     selection per size/dtype/collective threshold.  The env
+        #     knob wins over anything a tuned table installed above —
+        #     INCLUDING an explicit ACCL_COMPRESS=0, which disarms a
+        #     table-armed policy; both unset leaves dispatch
+        #     bit-identical static.
+        from .arithconfig import (
+            COMPRESS_OFF_TOKENS,
+            compression_policy_from_env,
+        )
+
+        raw_compress = os.environ.get("ACCL_COMPRESS", "").strip().lower()
+        if raw_compress in COMPRESS_OFF_TOKENS:
+            self.set_compression(None)
+        else:
+            env_compress = compression_policy_from_env()
+            if env_compress is not None:
+                self.set_compression(env_compress)
 
         # 7. enable transport engines (reference: accl.cpp:1122-1125)
         self._config_call(CfgFunc.enable_pkt)
@@ -419,6 +465,22 @@ class ACCL:
         """Write the static register values of :meth:`static_tuning`."""
         for key, value in self.static_tuning().items():
             self.set_tuning(key, value)
+
+    def set_compression(self, policy) -> None:
+        """Arm (or disarm, with ``None``) the wire-compression policy
+        (:class:`~accl_tpu.arithconfig.CompressionPolicy`, r17): calls
+        matching its collective/dtype/size thresholds get their
+        ``compress_dtype`` selected automatically — int8 rides the
+        block-scaled engine lane (with the EQuARX error-feedback twin
+        when the policy asks), float16/bfloat16 the cast lanes.  The
+        descriptor memo is dropped: cached descriptors predate the
+        policy's decisions."""
+        self._compress_policy = policy
+        self._call_memo.clear()
+
+    @property
+    def compression_policy(self):
+        return self._compress_policy
 
     def set_tuning(self, key: int, value: int) -> None:
         """Write one runtime tuning register (constants.TuningKey).
@@ -1213,6 +1275,18 @@ class ACCL:
         dtypes.discard(DataType.none)
         compression = CompressionFlags.NO_COMPRESSION
 
+        # wire-compression policy (r17): fill in compress_dtype for
+        # eligible calls when the caller left it unset.  Deterministic
+        # in the memo key's fields + the (static-after-arming) policy,
+        # so the descriptor memo above stays sound; stream-flagged and
+        # mixed-dtype calls are never auto-compressed.  One falsy read
+        # when no policy is armed — bit-identical static dispatch.
+        if compress_dtype is None and self._compress_policy is not None \
+                and stream_flags == StreamFlags.NO_STREAM \
+                and len(dtypes) == 1:
+            compress_dtype = self._compress_policy.select(
+                scenario, count, comm_id, next(iter(dtypes)))
+
         def flag_operands(compressed_dtype: DataType) -> CompressionFlags:
             flags = CompressionFlags.NO_COMPRESSION
             if not op0.is_dummy and op0.data_type == compressed_dtype:
@@ -1260,6 +1334,31 @@ class ACCL:
                 if pair not in self._arith_ids:
                     raise ACCLError(f"unsupported dtype {uncompressed!r}")
                 arithcfg = self._arith_ids[pair]
+                compression = CompressionFlags.ETH_COMPRESSED
+            elif compress_dtype == DataType.int8:
+                # block-scaled wire lane (r17): the wire form is
+                # (int8, per-block fp32 scales) — it has no flat-buffer
+                # residence, so per-operand int8 marking is rejected
+                # and the ETH flag stands alone.  The EQuARX
+                # error-feedback twin is selected per the armed policy.
+                pair = (uncompressed, compress_dtype)
+                if pair not in self._arith_ids:
+                    raise ACCLError(f"no arithmetic config for dtype pair {pair}")
+                if uncompressed != DataType.float32:
+                    raise ACCLError(
+                        f"int8 block-scaled wire lane supports float32 "
+                        f"operands only (got {uncompressed.name})")
+                if any(not b.is_dummy and b.data_type == DataType.int8
+                       for b in (op0, op1, res)):
+                    raise ACCLError(
+                        "int8 block-scaled wire lane: operands must be "
+                        "float32 — a flat int8 buffer cannot hold the "
+                        "(int8, per-block scale) wire representation")
+                use_ef = (self._compress_policy is not None
+                          and self._compress_policy.wants_error_feedback(
+                              comm_id))
+                arithcfg = (self._arith_ids_ef[pair] if use_ef
+                            else self._arith_ids[pair])
                 compression = CompressionFlags.ETH_COMPRESSED
             else:
                 pair = (uncompressed, compress_dtype)

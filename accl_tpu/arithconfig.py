@@ -18,7 +18,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .constants import DATA_TYPE_SIZE, DataType
+from .constants import DATA_TYPE_SIZE, DataType, Operation
 
 
 @dataclass(frozen=True)
@@ -29,6 +29,11 @@ class ArithConfig:
     (arithconfig.hpp:32-100): element widths, elems-per-word ratio,
     routing ids for compressor / decompressor / arithmetic function, and
     whether arithmetic runs on the compressed representation.
+
+    r17 extension (append-only serialization): ``block`` selects the
+    int8 block-scaled wire lane's geometry (elements per fp32 scale;
+    0 = plain cast lane) and ``error_feedback`` arms the engine's
+    per-site EQuARX residual fold on egress quantization.
     """
 
     uncompressed_elem_bits: int
@@ -38,6 +43,8 @@ class ArithConfig:
     decompressor_tdest: int
     arith_is_compressed: bool
     arith_tdest: tuple[int, ...]  # per ReduceFunction (SUM, MAX)
+    block: int = 0
+    error_feedback: bool = False
 
     @property
     def compression_ratio(self) -> int:
@@ -45,7 +52,9 @@ class ArithConfig:
 
     def to_words(self) -> list[int]:
         """Serialize for upload into the engine's config region
-        (reference: common.cpp:50-73)."""
+        (reference: common.cpp:50-73).  The r17 block/error-feedback
+        words trail the lane list — the native parser reads them when
+        present, so pre-r17 7+nlanes-word streams stay decodable."""
         words = [
             self.uncompressed_elem_bits,
             self.compressed_elem_bits,
@@ -56,6 +65,8 @@ class ArithConfig:
             len(self.arith_tdest),
         ]
         words.extend(self.arith_tdest)
+        words.append(self.block)
+        words.append(int(self.error_feedback))
         return words
 
 
@@ -80,25 +91,38 @@ ARITH_LANE = {
 
 # Compression lane ids (reference hp_compression plugin: TDEST 0=compress
 # fp32->fp16, 1=decompress; hp_compression.cpp:70-144).  The bf16 lanes
-# are a TPU-native extension (bf16 is the MXU's 16-bit wire format).
+# are a TPU-native extension (bf16 is the MXU's 16-bit wire format); the
+# int8 block-scaled lane (r17) is the EQuARX-style 4:1 quantized wire —
+# int8 payload + one fp32 scale per `block` elements, fp32 accumulate.
 COMPRESS_F32_F16 = 0
 DECOMPRESS_F16_F32 = 1
 COMPRESS_F32_BF16 = 2
 DECOMPRESS_BF16_F32 = 3
+COMPRESS_F32_I8 = 4
+DECOMPRESS_I8_F32 = 5
+
+#: default elements per fp32 scale on the int8 wire (ops/quantized.py
+#: DEFAULT_BLOCK twin; overridable via ACCL_COMPRESS_BLOCK)
+DEFAULT_COMPRESS_BLOCK = 256
 
 _COMPRESSOR_LANES = {
     (DataType.float32, DataType.float16): (COMPRESS_F32_F16,
                                            DECOMPRESS_F16_F32),
     (DataType.float32, DataType.bfloat16): (COMPRESS_F32_BF16,
                                             DECOMPRESS_BF16_F32),
+    (DataType.float32, DataType.int8): (COMPRESS_F32_I8,
+                                        DECOMPRESS_I8_F32),
 }
 
 #: Compressor lane id -> numpy/jnp dtype name of the wire representation
 #: (single source of truth for backends that emulate the wire hop by
-#: dtype roundtrip, e.g. backends/tpu.py _wire_roundtrip).
+#: dtype roundtrip, e.g. backends/tpu.py _wire_roundtrip).  The int8
+#: lane's wire form is (int8, per-block fp32 scales), not a flat dtype —
+#: backends that see "int8" here must route through ops/quantized.py.
 COMPRESSOR_WIRE_DTYPE = {
     COMPRESS_F32_F16: "float16",
     COMPRESS_F32_BF16: "bfloat16",
+    COMPRESS_F32_I8: "int8",
 }
 
 
@@ -143,6 +167,159 @@ DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
         DataType.float32, DataType.bfloat16, arith_compressed=True
     ),
 }
+
+
+def int8_block_config(block: int = DEFAULT_COMPRESS_BLOCK,
+                      error_feedback: bool = False) -> ArithConfig:
+    """The (float32, int8) block-scaled wire pair (r17): 4:1 wire width,
+    one fp32 scale per ``block`` elements, fp32 accumulate
+    (``arith_is_compressed=False`` — the reduce funnel dequantizes into
+    the fp32 accumulator, the EQuARX discipline).  Registered at
+    ``ACCL.initialize`` (not in DEFAULT_ARITH_CONFIG) so the block
+    geometry can follow ``ACCL_COMPRESS_BLOCK``."""
+    if block <= 0 or block > 65536:
+        from .constants import ACCLError
+
+        raise ACCLError(
+            f"int8 wire lane: block {block} out of range (1..65536)")
+    return ArithConfig(
+        uncompressed_elem_bits=DATA_TYPE_SIZE[DataType.float32],
+        compressed_elem_bits=DATA_TYPE_SIZE[DataType.int8],
+        elem_ratio_log=2,
+        compressor_tdest=COMPRESS_F32_I8,
+        decompressor_tdest=DECOMPRESS_I8_F32,
+        arith_is_compressed=False,
+        arith_tdest=(
+            ARITH_LANE[(DataType.float32, "sum")],
+            ARITH_LANE[(DataType.float32, "max")],
+        ),
+        block=int(block),
+        error_feedback=error_feedback,
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire-compression policy (r17): per-communicator, size/dtype-threshold
+# selection of the compressed wire lane — the ACCL+ "compression on the
+# wire path itself" stage, armed at ACCL.initialize like the r16 tuning
+# policy.  Disarmed (None) the driver's dispatch is bit-identical static.
+# ---------------------------------------------------------------------------
+
+#: collectives the policy may compress by default: the reduce family
+#: plus the relay collectives whose wire traffic dominates serving
+#: gradients/activations.  p2p and alltoall stay per-call opt-in.
+COMPRESSIBLE_OPS = frozenset(int(op) for op in (
+    Operation.allreduce, Operation.reduce_scatter, Operation.allgather,
+    Operation.reduce, Operation.bcast))
+
+
+@dataclass
+class CompressionPolicy:
+    """Arms automatic ``compress_dtype`` selection on a driver.
+
+    ``dtype`` is the wire representation (int8 = block-scaled,
+    float16/bfloat16 = the cast lanes); a call is compressed when its
+    operands are float32, its scenario is in ``collectives``, and its
+    payload is at least ``min_bytes``.  ``per_comm`` overrides the
+    decision per communicator id (a nested CompressionPolicy, or None
+    to exempt that comm).  ``error_feedback`` selects the EQuARX
+    residual lane for int8 (per-comm via per_comm overrides).
+
+    Env arming (read once at ``ACCL.initialize``):
+      ``ACCL_COMPRESS``        int8 | float16 | bfloat16 | 0/unset (off)
+      ``ACCL_COMPRESS_MIN_BYTES``  payload floor (default 65536)
+      ``ACCL_COMPRESS_BLOCK``  int8 scale-block elements (default 256)
+      ``ACCL_COMPRESS_EF``     1 = error feedback on the int8 lane
+    """
+
+    dtype: DataType = DataType.int8
+    min_bytes: int = 64 * 1024
+    block: int = DEFAULT_COMPRESS_BLOCK
+    error_feedback: bool = False
+    collectives: frozenset = COMPRESSIBLE_OPS
+    per_comm: dict = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.per_comm is None:
+            self.per_comm = {}
+
+    def for_comm(self, comm_id: int) -> "CompressionPolicy | None":
+        if comm_id in self.per_comm:
+            return self.per_comm[comm_id]
+        return self
+
+    def select(self, scenario: int, count: int, comm_id: int,
+               elem_dtype: DataType) -> "DataType | None":
+        """The per-descriptor decision: the wire dtype to compress with,
+        or None (leave the call on the lossless lane).  Pure in its
+        arguments + this policy's fields, so the driver's descriptor
+        memo stays sound."""
+        pol = self.for_comm(comm_id)
+        if pol is None:
+            return None
+        if int(scenario) not in pol.collectives:
+            return None
+        if elem_dtype != DataType.float32:
+            return None
+        nbytes = count * (DATA_TYPE_SIZE[DataType.float32] // 8)
+        if nbytes < pol.min_bytes:
+            return None
+        return pol.dtype
+
+    def wants_error_feedback(self, comm_id: int) -> bool:
+        pol = self.for_comm(comm_id)
+        return bool(pol is not None and pol.error_feedback
+                    and pol.dtype == DataType.int8)
+
+    def spec(self) -> dict:
+        return {
+            "dtype": self.dtype.name,
+            "min_bytes": self.min_bytes,
+            "block": self.block,
+            "error_feedback": self.error_feedback,
+            "per_comm": sorted(self.per_comm),
+        }
+
+
+def compress_block_from_env() -> int:
+    from .constants import env_int
+
+    return env_int("ACCL_COMPRESS_BLOCK", DEFAULT_COMPRESS_BLOCK,
+                   minimum=1)
+
+
+#: ACCL_COMPRESS values that mean "explicitly off" — shared with
+#: ACCL.initialize, which uses an explicit off to DISARM a policy a
+#: tuned table installed (unset merely leaves the table's choice)
+COMPRESS_OFF_TOKENS = frozenset(("0", "off", "none"))
+
+
+def compression_policy_from_env() -> "CompressionPolicy | None":
+    """``ACCL_COMPRESS`` names the wire dtype (or 0/empty = off, the
+    bit-identical default); malformed values raise the naming ACCLError
+    (the env clear-error contract)."""
+    import os as _os
+
+    from .constants import ACCLError, env_int
+
+    raw = _os.environ.get("ACCL_COMPRESS", "").strip().lower()
+    if raw == "" or raw in COMPRESS_OFF_TOKENS:
+        return None
+    names = {"int8": DataType.int8, "float16": DataType.float16,
+             "fp16": DataType.float16, "bfloat16": DataType.bfloat16,
+             "bf16": DataType.bfloat16}
+    if raw not in names:
+        raise ACCLError(
+            f"ACCL_COMPRESS={raw!r} is not a wire dtype — want one of "
+            f"int8, float16, bfloat16 (or 0/unset for the lossless "
+            f"lanes)")
+    return CompressionPolicy(
+        dtype=names[raw],
+        min_bytes=env_int("ACCL_COMPRESS_MIN_BYTES", 64 * 1024,
+                          minimum=0),
+        block=compress_block_from_env(),
+        error_feedback=_os.environ.get("ACCL_COMPRESS_EF", "0") == "1",
+    )
 
 
 #: numpy dtype <-> DataType mapping used by the buffer layer.

@@ -412,7 +412,10 @@ class TpuEngine:
     def wire_dtype_for(self, arithcfg_id: int) -> str:
         """Wire (compressed) representation of an arithcfg pair: "" when
         the pair is identity, else the jnp dtype name selected by the
-        compressor lane (arithconfig.py COMPRESS_* ids)."""
+        compressor lane (arithconfig.py COMPRESS_* ids).  The int8
+        block-scaled lane (r17) is a SPEC, not a flat dtype —
+        ``int8:<block>:<ef>`` — parsed by :func:`_parse_wire_spec` and
+        routed through the ops/quantized.py kernels."""
         if not (0 <= arithcfg_id < len(self._arithcfgs)):
             return ""
         from ..arithconfig import COMPRESSOR_WIRE_DTYPE
@@ -420,7 +423,13 @@ class TpuEngine:
         cfg = self._arithcfgs[arithcfg_id]
         if cfg.elem_ratio_log == 0:
             return ""
-        return COMPRESSOR_WIRE_DTYPE.get(cfg.compressor_tdest, "")
+        from ..arithconfig import DEFAULT_COMPRESS_BLOCK
+
+        name = COMPRESSOR_WIRE_DTYPE.get(cfg.compressor_tdest, "")
+        if name == "int8":
+            return (f"int8:{cfg.block or DEFAULT_COMPRESS_BLOCK}"
+                    f":{int(bool(cfg.error_feedback))}")
+        return name
 
     @lru_cache(maxsize=64)
     def _mesh_for(self, members: tuple) -> "object":
@@ -758,8 +767,20 @@ class TpuEngine:
             for k, v in counts.items():
                 row[k] = row.get(k, 0) + int(v)
 
+    @staticmethod
+    def _wire_ratio(wire_dtype: str) -> float:
+        """Wire bytes per logical byte for a wire spec ("" = 1.0): the
+        cast lanes halve the payload; the int8 block-scaled lane packs
+        ~4:1 plus one fp32 scale per block."""
+        if not wire_dtype:
+            return 1.0
+        name, block, _ef = _parse_wire_spec(wire_dtype)
+        if name == "int8":
+            return (1.0 + 4.0 / max(block, 1)) / 4.0
+        return 0.5  # float16 / bfloat16
+
     def _account_gang_links(self, op, comm_id: int, gang: dict,
-                            nbytes: int) -> None:
+                            nbytes: int, wire_dtype: str = "") -> None:
         """Fold one dispatched gang into the link twin.
 
         Ring collectives move ``busbw_factor × nbytes`` per rank to its
@@ -767,12 +788,27 @@ class TpuEngine:
         2(P-1) (allreduce) hops — the same nccl-tests accounting the
         metrics registry derives bandwidth from, so the matrix and the
         busbw gauges agree by construction.  Rooted collectives
-        attribute the payload to the root<->member links."""
+        attribute the payload to the root<->member links.  With a
+        compressed ``wire_dtype`` the same logical traffic is also
+        accounted at its compressed wire width (comp_tx_bytes per link,
+        compressed_tx_* engine counters — the r17 bytes-saved plane)."""
         members = self._comms.get(comm_id, [])
         P = len(members)
         if P < 2 or nbytes <= 0:
             return
         name = Operation(op).name
+        ratio = self._wire_ratio(wire_dtype)
+        if ratio < 1.0:
+            # nbytes is in_len * itemsize, which ALREADY carries the P
+            # factor for the n*P-operand collectives — divide it back
+            # out so logical = descriptor count x payload_factor, the
+            # same convention the native engine and metrics use
+            per_count = nbytes // (
+                P if name in ("scatter", "reduce_scatter", "alltoall")
+                else 1)
+            logical = int(per_count * _metrics.payload_factor(name, P))
+            self.metrics.inc("compressed_tx_logical_bytes", logical)
+            self.metrics.inc("compressed_tx_bytes", int(logical * ratio))
         if name in ("allreduce", "allgather", "reduce_scatter",
                     "alltoall"):
             # nbytes is the per-rank operand (plan in_len); the busbw
@@ -783,11 +819,12 @@ class TpuEngine:
                 nbytes *= P
             per_link = int(nbytes * _metrics.busbw_factor(name, P))
             hops = 2 * (P - 1) if name == "allreduce" else P - 1
+            comp = int(per_link * ratio) if ratio < 1.0 else 0
             for i, src in enumerate(members):
                 right = members[(i + 1) % P]
                 left = members[(i - 1) % P]
                 self._link_add(src, comm_id, right, tx_msgs=hops,
-                               tx_bytes=per_link)
+                               tx_bytes=per_link, comp_tx_bytes=comp)
                 self._link_add(src, comm_id, left, rx_msgs=hops,
                                rx_bytes=per_link)
         elif name in ("bcast", "scatter", "gather", "reduce"):
@@ -797,12 +834,13 @@ class TpuEngine:
             # scatter's operand is the root's WHOLE input (in_len =
             # n*P); each root->member link carries only its 1/P slice
             per_link = nbytes // P if name == "scatter" else nbytes
+            comp = int(per_link * ratio) if ratio < 1.0 else 0
             for m in members:
                 if m == root:
                     continue
                 a, b = (m, root) if to_root else (root, m)
                 self._link_add(a, comm_id, b, tx_msgs=1,
-                               tx_bytes=per_link)
+                               tx_bytes=per_link, comp_tx_bytes=comp)
                 self._link_add(b, comm_id, a, rx_msgs=1,
                                rx_bytes=per_link)
 
@@ -1301,7 +1339,8 @@ class TpuEngine:
             # on the ring sequence, not per-member arrival)
             self._account_gang_links(
                 slot["op"], slot["comm"], slot["gang"],
-                plan["in_len"] * np.dtype(plan["dtype"]).itemsize)
+                plan["in_len"] * np.dtype(plan["dtype"]).itemsize,
+                wire_dtype=plan["fn_args"][6])
             y = plan["compiled"](x)
             self._scatter_back(plan, y)
         elif kind == "local":
@@ -1595,7 +1634,8 @@ class TpuEngine:
             for op_, c_, gang_, plan_ in items:
                 self._account_gang_links(
                     op_, c_, gang_,
-                    plan_["in_len"] * np.dtype(plan_["dtype"]).itemsize)
+                    plan_["in_len"] * np.dtype(plan_["dtype"]).itemsize,
+                    wire_dtype=plan_["fn_args"][6])
             fnb = _collective_fn(*items[0][3]["fn_args"],
                                  nbatch=len(items))
             t0 = time.perf_counter_ns()
@@ -1820,7 +1860,8 @@ class TpuEngine:
         x = self._assemble_global(plan, gang)
         self._account_gang_links(
             op, comm_id, gang,
-            plan["in_len"] * np.dtype(plan["dtype"]).itemsize)
+            plan["in_len"] * np.dtype(plan["dtype"]).itemsize,
+            wire_dtype=plan["fn_args"][6])
 
         t0 = time.perf_counter_ns()
         y = plan["compiled"](x)
@@ -1943,15 +1984,43 @@ class TpuEngine:
             return np.asarray(self._streams[key].popleft())
 
 
+def _parse_wire_spec(wire_dtype: str):
+    """Decode a wire_dtype_for() spec: ("float16"|"bfloat16"|"", 0,
+    False) for the cast lanes, ("int8", block, error_feedback) for the
+    block-scaled lane."""
+    if wire_dtype.startswith("int8"):
+        from ..arithconfig import DEFAULT_COMPRESS_BLOCK
+
+        parts = wire_dtype.split(":")
+        block = int(parts[1]) if len(parts) > 1 else \
+            DEFAULT_COMPRESS_BLOCK
+        ef = len(parts) > 2 and parts[2] == "1"
+        return "int8", block, ef
+    return wire_dtype, 0, False
+
+
 def _wire_roundtrip(x, wire_dtype: str):
-    """Model one wire hop of compression: the payload crosses the link in
-    the arithcfg's compressed representation (f16 or bf16) and is
-    decompressed on arrival (hp_compression lane / bf16 TPU lane)."""
+    """Model one wire hop of compression: the payload crosses the link
+    in the arithcfg's compressed representation and is decompressed on
+    arrival — a dtype cast pair for the f16/bf16 lanes, a blockwise
+    quantize/dequantize (ops/quantized.py) for the int8 block-scaled
+    lane.  Idempotent: the absmax element of every quantized block maps
+    to exactly ±127, so re-quantizing an already-roundtripped payload
+    reproduces it bit-for-bit."""
     import jax.numpy as jnp
 
     if not wire_dtype:
         return x
-    wd = jnp.dtype(wire_dtype)
+    name, block, _ef = _parse_wire_spec(wire_dtype)
+    if name == "int8":
+        from ..ops.quantized import dequantize_blockwise, quantize_blockwise
+
+        if x.dtype.itemsize <= 1:
+            return x
+        flat = x.reshape(-1).astype(jnp.float32)
+        q, sc, n = quantize_blockwise(flat, block)
+        return dequantize_blockwise(q, sc, n).reshape(x.shape).astype(x.dtype)
+    wd = jnp.dtype(name)
     if x.dtype.itemsize > wd.itemsize:
         return x.astype(wd).astype(x.dtype)
     return x
@@ -2064,9 +2133,40 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
         return ring_ops.ring_reduce_scatter_segmented(
             v, "rank", op=red, interpret=interpret)
 
+    # r17 quantized ring lane: with the int8 block-scaled wire spec the
+    # ppermute payload IS the packed (int8, scale) block stream
+    # (ops/quantized.py), with optional EQuARX error feedback carried
+    # hop to hop — not a roundtrip model around a lossless ring.  SUM
+    # only (the EQuARX algebra); MAX and ragged chunkings fall back to
+    # the wire-roundtrip model around the plain ring below.
+    wire_name, wire_block, wire_ef = _parse_wire_spec(wire_dtype)
+
+    def q_ring_body(v):
+        from ..ops import quantized as q_ops
+
+        if op == Operation.allreduce:
+            return q_ops.quantized_all_reduce(
+                v, "rank", block=wire_block,
+                error_feedback=wire_ef).astype(v.dtype)
+        if op == Operation.allgather:
+            return q_ops.quantized_ring_all_gather(
+                v, "rank", block=wire_block).astype(v.dtype)
+        return q_ops.quantized_ring_reduce_scatter(
+            v, "rank", block=wire_block,
+            error_feedback=wire_ef).astype(v.dtype)
+
+    q_ring = (ring and wire_name == "int8" and not is_max
+              and op in (Operation.allreduce, Operation.allgather,
+                         Operation.reduce_scatter)
+              and in_len % nranks == 0)
+
     def body(v):  # v: [in_len] block on each device (1-D global layout:
         # the per-rank shard IS the member's buffer, no reshape on the
         # way in or out — the gang hot path stays dispatch-free)
+        if q_ring:
+            # the quantized kernels own the wire hops end to end — no
+            # extra entry/exit roundtrip (that would double-quantize)
+            return q_ring_body(v.astype(jnp.float32)).astype(v.dtype)
         v = quant(v)
         if ring:
             out = ring_body(v)
@@ -2185,8 +2285,12 @@ class TpuDeviceView(CCLODevice):
             link_rows = sum(1 for (src, _c, _p) in eng._links
                             if src == self._rank)
         return {
-            "version": 2,
+            "version": 3,
             "link_rows": link_rows,
+            "compressed_tx_bytes":
+                counters.get("compressed_tx_bytes", 0),
+            "compressed_tx_logical_bytes":
+                counters.get("compressed_tx_logical_bytes", 0),
             "plans_live": plans_live,
             "plan_ring_refs": plan_ring_refs,
             "plan_ring_generation": gen,

@@ -200,8 +200,168 @@ def run_sweep(world, config: SweepConfig = SweepConfig(),
     return rows
 
 
-def _run_once(world, coll: str, count: int, dtype, root: int) -> float:
-    """One timed collective across all ranks; returns max duration (s)."""
+# ---------------------------------------------------------------------------
+# compression-lane sweep (r17): bandwidth vs exactness per wire lane
+# ---------------------------------------------------------------------------
+
+#: measurable wire lanes: the lossless baseline, the cast pairs, and
+#: the int8 block-scaled lane with and without EQuARX error feedback
+COMPRESSION_LANES = ("lossless", "float16", "bfloat16", "int8", "int8_ef")
+
+
+def _lane_compress_dtype(lane: str):
+    from ..constants import DataType
+
+    return {"float16": DataType.float16, "bfloat16": DataType.bfloat16,
+            "int8": DataType.int8, "int8_ef": None,
+            "lossless": None}[lane]
+
+
+def run_compression_sweep(world, collectives=("allreduce",
+                                              "reduce_scatter"),
+                          count_pows=range(12, 18), repetitions: int = 3,
+                          writer: Optional[io.TextIOBase] = None,
+                          log=None) -> list[dict]:
+    """Sweep the wire-compression lanes: per (lane, collective, size),
+    best-of-reps bus bandwidth PLUS the exactness columns — max
+    absolute error and max ULP distance vs the fp64-accumulated
+    reference.  The lossless lane comes back within summation-order
+    noise (a few ULP — the engine's ring sums f32 sequentially; the
+    BITWISE lossless gate runs on integer-valued data in
+    tests/test_quantized_wire.py); the int8 lanes trade bounded error
+    for ~4:1 wire width (the bandwidth-vs-exactness record
+    scripts/check_bench_delta.py --quantized gates).  ``int8_ef`` runs
+    through an armed
+    CompressionPolicy (error feedback is a per-comm policy property,
+    not a per-call flag)."""
+    from ..arithconfig import CompressionPolicy
+    from ..constants import DataType
+
+    P = world.nranks
+    dtype = np.dtype(np.float32)
+    rows: list[dict] = []
+    csv_writer = None
+    if writer is not None:
+        csv_writer = csv.DictWriter(writer, fieldnames=[
+            "lane", "collective", "count", "bytes", "duration_us",
+            "algbw_GBps", "busbw_GBps", "max_abs_err", "max_ulp"])
+        csv_writer.writeheader()
+
+    def arm(lane):
+        pol = None
+        if lane == "int8_ef":
+            pol = CompressionPolicy(dtype=DataType.int8, min_bytes=0,
+                                    error_feedback=True)
+        for a in world.accls:
+            a.set_compression(pol)
+
+    def body_factory(coll, count, lane):
+        cd = _lane_compress_dtype(lane)
+
+        def body(accl, rank):
+            made = []
+
+            def mk(factory, *a):
+                buf = factory(*a)
+                made.append(buf)
+                return buf
+
+            data = (np.random.default_rng(rank)
+                    .standard_normal(count * (P if coll ==
+                                              "reduce_scatter" else 1))
+                    .astype(np.float32))
+            try:
+                src = mk(accl.create_buffer_like, data)
+                recv_n = count
+                dst = mk(accl.create_buffer, recv_n, dtype)
+                t0 = time.perf_counter()
+                if coll == "allreduce":
+                    accl.allreduce(src, dst, count, ReduceFunction.SUM,
+                                   compress_dtype=cd)
+                else:
+                    accl.reduce_scatter(src, dst, count,
+                                        ReduceFunction.SUM,
+                                        compress_dtype=cd)
+                dur = time.perf_counter() - t0
+                dst.sync_from_device()
+                return dur, data, dst.host.copy()
+            finally:
+                for buf in made:
+                    free = getattr(buf, "free", None)
+                    if free is not None:
+                        free()
+
+        return body
+
+    try:
+        for coll in collectives:
+            for pw in count_pows:
+                count = 1 << pw
+                bodies = {}
+                for lane in COMPRESSION_LANES:
+                    arm(lane)
+                    bodies[lane] = body_factory(coll, count, lane)
+                    world.run(bodies[lane])  # warmup (jit/path setup)
+                # INTERLEAVED rep rounds (the r16 compare() discipline):
+                # every round measures every lane once, best-of per
+                # lane, so box drift hits all lanes alike instead of
+                # skewing whichever lane ran in the slow phase
+                best: dict = {}
+                for _ in range(repetitions):
+                    for lane in COMPRESSION_LANES:
+                        arm(lane)
+                        out = world.run(bodies[lane])
+                        dur = max(d for d, _i, _g in out)
+                        if lane not in best or dur < best[lane][0]:
+                            best[lane] = (dur, out)
+                for lane in COMPRESSION_LANES:
+                    dur, out = best[lane]
+                    inputs = [i for _d, i, _g in out]
+                    exact = np.sum(inputs, axis=0, dtype=np.float64) \
+                        .astype(np.float32)
+                    max_err = max_ulp = 0.0
+                    for rank, (_d, _i, got) in enumerate(out):
+                        exp = (exact if coll == "allreduce"
+                               else exact.reshape(P, count)[rank])
+                        err = np.abs(got.astype(np.float64)
+                                     - exp.astype(np.float64))
+                        max_err = max(max_err, float(err.max()))
+                        ulp = err / np.spacing(np.abs(exp) + 1e-30)
+                        max_ulp = max(max_ulp, float(ulp.max()))
+                    nbytes = count * _payload_factor(coll, P) \
+                        * dtype.itemsize
+                    algbw = nbytes / dur / 1e9 if dur > 0 else 0.0
+                    row = {
+                        "lane": lane,
+                        "collective": coll,
+                        "count": count,
+                        "bytes": nbytes,
+                        "duration_us": round(dur * 1e6, 2),
+                        "algbw_GBps": round(algbw, 4),
+                        "busbw_GBps": round(
+                            algbw * _busbw_factor(coll, P), 4),
+                        "max_abs_err": float(f"{max_err:.6g}"),
+                        "max_ulp": float(f"{max_ulp:.6g}"),
+                    }
+                    rows.append(row)
+                    if csv_writer:
+                        csv_writer.writerow(row)
+                    if log:
+                        log(f"  {lane:>9} {coll:<14} {count:>8} elems "
+                            f"{row['busbw_GBps']:>8.3f} GB/s  "
+                            f"err {row['max_abs_err']:.3g} "
+                            f"ulp {row['max_ulp']:.3g}")
+    finally:
+        arm("lossless")
+    return rows
+
+
+def _run_once(world, coll: str, count: int, dtype, root: int,
+              compress=None) -> float:
+    """One timed collective across all ranks; returns max duration (s).
+    ``compress`` optionally selects a wire-compression dtype
+    (constants.DataType) for the collectives that take one — the r17
+    compression lanes of the autotuner sweep through here."""
     P = world.nranks
 
     def body(accl, rank):
@@ -230,50 +390,56 @@ def _run_once(world, coll: str, count: int, dtype, root: int) -> float:
             dst = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             nxt, prv = (rank + 1) % P, (rank - 1) % P
-            sreq = accl.send(src, count, nxt, tag=1, run_async=True)
-            accl.recv(dst, count, prv, tag=1)
+            sreq = accl.send(src, count, nxt, tag=1, run_async=True,
+                             compress_dtype=compress)
+            accl.recv(dst, count, prv, tag=1, compress_dtype=compress)
             sreq.wait(60)
             return time.perf_counter() - t0
         if coll == "bcast":
             buf = mk(accl.create_buffer_like, data)
             t0 = time.perf_counter()
-            accl.bcast(buf, count, root)
+            accl.bcast(buf, count, root, compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "scatter":
             send = mk(accl.create_buffer_like, np.tile(data, P))
             recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
-            accl.scatter(send, recv, count, root)
+            accl.scatter(send, recv, count, root,
+                         compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "gather":
             send = mk(accl.create_buffer_like, data)
             recv = mk(accl.create_buffer, count * P, dtype)
             t0 = time.perf_counter()
-            accl.gather(send, recv, count, root)
+            accl.gather(send, recv, count, root,
+                        compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "allgather":
             send = mk(accl.create_buffer_like, data)
             recv = mk(accl.create_buffer, count * P, dtype)
             t0 = time.perf_counter()
-            accl.allgather(send, recv, count)
+            accl.allgather(send, recv, count, compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "reduce":
             send = mk(accl.create_buffer_like, data)
             recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
-            accl.reduce(send, recv, count, root, ReduceFunction.SUM)
+            accl.reduce(send, recv, count, root, ReduceFunction.SUM,
+                        compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "allreduce":
             send = mk(accl.create_buffer_like, data)
             recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
-            accl.allreduce(send, recv, count, ReduceFunction.SUM)
+            accl.allreduce(send, recv, count, ReduceFunction.SUM,
+                           compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "reduce_scatter":
             send = mk(accl.create_buffer_like, np.tile(data, P))
             recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
-            accl.reduce_scatter(send, recv, count, ReduceFunction.SUM)
+            accl.reduce_scatter(send, recv, count, ReduceFunction.SUM,
+                                compress_dtype=compress)
             return time.perf_counter() - t0
         if coll == "alltoall":
             send = mk(accl.create_buffer_like, np.tile(data, P))

@@ -110,6 +110,19 @@ METRIC_HELP = {
                                      "the transport"),
     "accl_engine_joins_sponsored": "elastic joins answered as sponsor",
     "accl_engine_joins_completed": "elastic joins completed as joiner",
+    # ---- quantized wire lane (r17, engine stats v3) ----
+    "accl_engine_compressed_tx_bytes": (
+        "egress payload bytes that left through a compressed wire lane "
+        "(f16/bf16 cast or int8 block-scaled)"),
+    "accl_engine_compressed_tx_logical_bytes": (
+        "uncompressed-equivalent bytes of the compressed egress "
+        "traffic (saved = logical - compressed)"),
+    "accl_wire_compressed_tx_bytes": (
+        "wire bytes sent compressed, summed across the world's "
+        "compressed lanes (r17 quantized wire)"),
+    "accl_wire_compressed_saved_bytes": (
+        "wire bytes SAVED by compression vs the logical uncompressed "
+        "traffic — the bandwidth-multiplier observable"),
     # ---- per-link wire telemetry (r15, accl_engine_link_stats) ----
     "accl_engine_link_rows": ("(comm, peer) link rows the engine's "
                               "per-link counter plane is tracking "
@@ -154,6 +167,10 @@ METRIC_HELP = {
     "accl_plan_replays": "plan replays issued through the ring",
     "accl_plan_auto_captures": ("plan rings armed by the ACCL_PLAN_AUTO "
                                 "streak detector"),
+    "accl_compressed_tx_bytes": ("wire bytes the gang scheduler moved "
+                                 "through a compressed lane (r17)"),
+    "accl_compressed_tx_logical_bytes": (
+        "uncompressed-equivalent bytes of the compressed gang traffic"),
 }
 
 #: HELP for families minted with dynamic name parts (bench lane labels,

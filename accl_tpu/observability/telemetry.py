@@ -78,17 +78,30 @@ ENGINE_STATS_FIELDS_V1 = (
 #: per-peer counters themselves ride the separate link_stats array
 ENGINE_STATS_FIELDS_V2 = ENGINE_STATS_FIELDS_V1 + ("link_rows",)
 
+#: v3 (r17) appends the quantized-wire accounting pair: wire bytes
+#: that left through a compressed lane and their uncompressed
+#: equivalent (saved bytes = logical - compressed, published by the
+#: sampler as the wire/compressed_saved_bytes family)
+ENGINE_STATS_FIELDS_V3 = ENGINE_STATS_FIELDS_V2 + (
+    "compressed_tx_bytes",
+    "compressed_tx_logical_bytes",
+)
+
 #: version -> field table (decode_engine_stats consults this so a v1
 #: decoder over a v2 engine keeps field 25 as unknown_field_25 — the
 #: forward-compat contract the table-driven tests pin both ways)
 ENGINE_STATS_FIELDS_BY_VERSION = {
     1: ENGINE_STATS_FIELDS_V1,
     2: ENGINE_STATS_FIELDS_V2,
+    3: ENGINE_STATS_FIELDS_V3,
 }
 
 #: capi accl_engine_link_stats per-row field order (the ABI twin of
-#: native/src/engine.cpp Engine::link_stats — row stride is its length)
-LINK_STATS_FIELDS_V2 = (
+#: native/src/engine.cpp Engine::link_stats — row stride is its
+#: length).  v3 (r17) appends comp_tx_bytes: compressed wire bytes
+#: sent to the peer, so the link matrix can attribute quantized
+#: traffic per link.
+LINK_STATS_FIELDS_V3 = (
     "comm",
     "peer",
     "tx_msgs",
@@ -101,10 +114,13 @@ LINK_STATS_FIELDS_V2 = (
     "fenced_drops",
     "seeks",
     "seek_wait_ns",
+    "comp_tx_bytes",
 )
+#: kept as an alias: r15 consumers named the schema by version
+LINK_STATS_FIELDS_V2 = LINK_STATS_FIELDS_V3
 
 #: link-row fields that are per-link COUNTERS (everything but the key)
-LINK_COUNTER_FIELDS = LINK_STATS_FIELDS_V2[2:]
+LINK_COUNTER_FIELDS = LINK_STATS_FIELDS_V3[2:]
 
 #: monotonic fields — published into the registry as counter DELTAS
 #: (``engine/<name>`` counters); everything else is a point-in-time
@@ -126,6 +142,9 @@ COUNTER_FIELDS = frozenset((
     "tx_payload_bytes",
     "joins_sponsored",
     "joins_completed",
+    # quantized wire accounting (v3, r17)
+    "compressed_tx_bytes",
+    "compressed_tx_logical_bytes",
     # TPU dispatch-lane counters (TpuDeviceView.engine_stats)
     "plan_auto_captures",
     "leader_dispatches",
@@ -202,11 +221,23 @@ class TelemetrySampler:
                     counters[k] = counters.get(k, 0) + int(v)
                 else:
                     gauges[k] = max(gauges.get(k, 0), int(v))
+        deltas: dict = {}
         for k, total in counters.items():
             delta = total - self._published.get(k, 0)
             if delta > 0:
                 self._registry.inc(f"engine/{k}", delta)
                 self._published[k] = total
+                deltas[k] = delta
+        # quantized-wire families (r17): compressed bytes on the wire
+        # and the bytes the compressed lanes SAVED vs their logical
+        # (uncompressed) traffic — the headline multiplier observable
+        comp = deltas.get("compressed_tx_bytes", 0)
+        logical = deltas.get("compressed_tx_logical_bytes", 0)
+        if comp:
+            self._registry.inc("wire/compressed_tx_bytes", comp)
+        if logical > comp:
+            self._registry.inc("wire/compressed_saved_bytes",
+                               logical - comp)
         for k, v in gauges.items():
             self._registry.set_gauge(f"engine/{k}", v)
         self._sample_links()
@@ -306,7 +337,7 @@ def decode_engine_stats(values, version: int = 1,
     nothing is silently dropped; the doctor renders them as
     unrecognized instead of crashing."""
     names = ENGINE_STATS_FIELDS_BY_VERSION.get(
-        version, ENGINE_STATS_FIELDS_V2 if version > 2
+        version, ENGINE_STATS_FIELDS_V3 if version > 3
         else ENGINE_STATS_FIELDS_V1)
     out = {"version": version}
     for i, v in enumerate(values):

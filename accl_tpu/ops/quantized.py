@@ -36,15 +36,27 @@ def _blocks(x, block: int):
     return x.reshape(rows, block), n
 
 
-def quantize_blockwise(x, block: int = DEFAULT_BLOCK):
+def quantize_blockwise(x, block: int = DEFAULT_BLOCK, key=None):
     """Flat fp array -> (q int8 [rows, block], scale f32 [rows, 1], n).
 
     Symmetric per-block absmax scaling; all-zero blocks get scale 1 so
-    dequantization is exact for them."""
+    dequantization is exact for them.  With ``key`` (a jax PRNG key)
+    rounding is STOCHASTIC — floor(r + u), u ~ U[0,1) — the same
+    bias-breaking role the Pallas compress lanes' on-core PRNG plays
+    (ops/compression.py stochastic_round); callers fold the ring
+    hop/rank into the key so hops decorrelate."""
     x2, n = _blocks(x.astype(jnp.float32), block)
     amax = jnp.max(jnp.abs(x2), axis=1, keepdims=True)
     scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
-    q = jnp.clip(jnp.round(x2 / scale), -127, 127).astype(jnp.int8)
+    r = x2 / scale
+    if key is not None:
+        import jax
+
+        u = jax.random.uniform(key, r.shape, jnp.float32)
+        rounded = jnp.floor(r + u)
+    else:
+        rounded = jnp.round(r)
+    q = jnp.clip(rounded, -127, 127).astype(jnp.int8)
     return q, scale, n
 
 
@@ -53,11 +65,30 @@ def dequantize_blockwise(q, scale, n: int):
     return (q.astype(jnp.float32) * scale).reshape(-1)[:n]
 
 
-def _ring_reduce_scatter_q(x, axis: str, block: int):
+def _hop_key(seed: int, axis: str, hop):
+    """PRNG key decorrelated per (seed, rank, hop) for stochastic
+    rounding inside the ring loop."""
+    import jax
+
+    key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                             lax.axis_index(axis))
+    return jax.random.fold_in(key, hop)
+
+
+def _ring_reduce_scatter_q(x, axis: str, block: int,
+                           error_feedback: bool = False,
+                           stochastic: bool = False, seed: int = 0):
     """Quantized ring reduce-scatter returning the WIRE-FORM carry
     (q, scale, n) of this member's reduced chunk — so the all-reduce can
     feed it straight into the gather phase without a dequant/requant
-    round at the seam."""
+    round at the seam.
+
+    ``error_feedback`` (EQuARX, arxiv 2506.17615): each hop's
+    requantization error is carried into the NEXT hop's quantization
+    input instead of being dropped, so per-hop bias dithers out instead
+    of accumulating linearly in P.  ``stochastic`` rounds with PRNG
+    bits per (rank, hop) — the jnp twin of the Pallas compress lanes'
+    on-core stochastic_round."""
     size = _axis_size(axis)
     idx = lax.axis_index(axis)
     if x.shape[0] % size != 0:
@@ -68,18 +99,28 @@ def _ring_reduce_scatter_q(x, axis: str, block: int):
     n = x.shape[0] // size
     chunks = x.astype(jnp.float32).reshape(size, n)
 
-    q0, s0, _ = quantize_blockwise(chunks[(idx - 1) % size], block)
+    x0 = chunks[(idx - 1) % size]
+    q0, s0, _ = quantize_blockwise(
+        x0, block, key=_hop_key(seed, axis, 0) if stochastic else None)
+    err0 = (x0 - dequantize_blockwise(q0, s0, n)) if error_feedback \
+        else jnp.zeros((n,), jnp.float32)
     fwd = [(i, (i + 1) % size) for i in range(size)]
 
     def step(s, carry):
-        q, sc = carry
+        q, sc, err = carry
         q = lax.ppermute(q, axis, fwd)
         sc = lax.ppermute(sc, axis, fwd)
         acc = dequantize_blockwise(q, sc, n) + chunks[(idx - 2 - s) % size]
-        qn, scn, _ = quantize_blockwise(acc, block)
-        return qn, scn
+        if error_feedback:
+            acc = acc + err
+        qn, scn, _ = quantize_blockwise(
+            acc, block,
+            key=_hop_key(seed, axis, s + 1) if stochastic else None)
+        if error_feedback:
+            err = acc - dequantize_blockwise(qn, scn, n)
+        return qn, scn, err
 
-    q, sc = lax.fori_loop(0, size - 1, step, (q0, s0))
+    q, sc, _err = lax.fori_loop(0, size - 1, step, (q0, s0, err0))
     return q, sc, n
 
 
@@ -108,35 +149,46 @@ def _ring_all_gather_q(q, sc, n: int, axis: str):
 
 
 def quantized_ring_reduce_scatter(x, axis: str = "rank",
-                                  block: int = DEFAULT_BLOCK):
+                                  block: int = DEFAULT_BLOCK,
+                                  error_feedback: bool = False,
+                                  stochastic: bool = False,
+                                  seed: int = 0):
     """Ring reduce-scatter whose wire traffic is int8 + per-block scales.
 
     `x`: flat [P * n] per member -> this member's reduced chunk [n] f32.
     Each hop sends the quantized running partial one hop forward; the
     receiver dequantizes, folds its own chunk in fp32, and requantizes —
     the fused recv-reduce-send of the firmware's ring (fw :1782-1850)
-    with a 4:1 wire format."""
-    q, sc, n = _ring_reduce_scatter_q(x, axis, block)
+    with a 4:1 wire format.  ``error_feedback``/``stochastic``: see
+    :func:`_ring_reduce_scatter_q`."""
+    q, sc, n = _ring_reduce_scatter_q(x, axis, block, error_feedback,
+                                      stochastic, seed)
     return dequantize_blockwise(q, sc, n)
 
 
 def quantized_ring_all_gather(x, axis: str = "rank",
-                              block: int = DEFAULT_BLOCK):
+                              block: int = DEFAULT_BLOCK,
+                              stochastic: bool = False, seed: int = 0):
     """Ring all-gather whose wire traffic is int8 + per-block scales.
 
     `x`: flat [n] f32 per member -> [P * n] f32 (rank-major).  Each
     member's contribution is quantized ONCE and relayed; the error is a
     single round-trip regardless of P."""
-    q, sc, _ = quantize_blockwise(x.astype(jnp.float32), block)
+    q, sc, _ = quantize_blockwise(
+        x.astype(jnp.float32), block,
+        key=_hop_key(seed, axis, 0) if stochastic else None)
     return _ring_all_gather_q(q, sc, x.shape[0], axis)
 
 
 def quantized_all_reduce(x, axis: str = "rank",
-                         block: int = DEFAULT_BLOCK):
+                         block: int = DEFAULT_BLOCK,
+                         error_feedback: bool = False,
+                         stochastic: bool = False, seed: int = 0):
     """Segmented ring allreduce with int8 wire traffic: quantized ring
     reduce-scatter + quantized ring all-gather (the fused schedule of fw
     :1888-2071 at 4:1 wire width).  `x`: flat [P * n] -> [P * n] f32.
     The reduce-scatter's wire-form carry feeds the gather directly — no
     dequant/requant round at the seam."""
-    q, sc, n = _ring_reduce_scatter_q(x, axis, block)
+    q, sc, n = _ring_reduce_scatter_q(x, axis, block, error_feedback,
+                                      stochastic, seed)
     return _ring_all_gather_q(q, sc, n, axis)

@@ -43,8 +43,30 @@ TABLE_FORMAT = "accl-tune-table"
 TABLE_VERSION = 1
 
 #: every algorithm a table may name; per backend only a subset is
-#: measurable (see :func:`algorithms_for`)
-ALGORITHMS = ("static", "flat", "tree", "ring", "hierarchical")
+#: measurable (see :func:`algorithms_for`).  The compress_* lanes
+#: (r17) are the quantized wire widths: the schedule is static's, the
+#: payload crosses the wire block-scaled int8 or cast-fp16 — a win in
+#: a cell arms the driver's CompressionPolicy at install.
+ALGORITHMS = ("static", "flat", "tree", "ring", "hierarchical",
+              "compress_int8", "compress_fp16")
+
+#: the measurable compression lanes and their wire dtypes (per-dtype
+#: tables: these lanes only cover float32 cells — cell keys already
+#: carry the dtype, so the never-slower compare() prune is per dtype
+#: by construction)
+COMPRESSION_ALGS = ("compress_int8", "compress_fp16")
+
+#: collectives the compression lanes can touch (the CompressionPolicy
+#: default set minus p2p; alltoall has no compress_dtype)
+COMPRESS_COLLECTIVES = frozenset((
+    "allreduce", "reduce_scatter", "allgather", "reduce", "bcast"))
+
+
+def _compress_dtype_of(alg: str):
+    from ..constants import DataType
+
+    return {"compress_int8": DataType.int8,
+            "compress_fp16": DataType.float16}[alg]
 
 ENV_TABLE = "ACCL_TUNE_TABLE"
 ENV_TUNE = "ACCL_TUNE"
@@ -159,14 +181,17 @@ def backend_of(obj) -> str:
     return "tpu" if getattr(dev, "comm_table_is_shared", False) else "emu"
 
 
-def algorithms_for(world) -> tuple:
+def algorithms_for(world, dtype: str = "float32") -> tuple:
     """The measurable lanes per backend: the emulator engine's flat vs
     binomial-tree schedule registers (its rendezvous allreduce is
-    already ring-based), the TPU backend's ring/HLO crossover, plus the
-    composer on both."""
+    already ring-based), the TPU backend's ring/HLO crossover, the
+    composer on both, and — for float32 cells — the r17 compression
+    lanes (the per-dtype-table REMAINING item: other dtypes simply
+    have no compressed pair registered)."""
+    comp = COMPRESSION_ALGS if dtype == "float32" else ()
     if backend_of(world) == "tpu":
-        return ("static", "flat", "ring", "hierarchical")
-    return ("static", "flat", "tree", "hierarchical")
+        return ("static", "flat", "ring", "hierarchical") + comp
+    return ("static", "flat", "tree", "hierarchical") + comp
 
 
 #: which collectives each REGISTER lane can touch at all.  The emu
@@ -218,6 +243,10 @@ def lane_covers(backend: str, alg: str, coll: str,
         return True
     if alg == "hierarchical":
         return coll in HierarchicalComm.COMPOSABLE
+    if alg in COMPRESSION_ALGS:
+        # a compressed wire is a genuinely different datapath than
+        # static at every size; coverage is by collective only
+        return coll in COMPRESS_COLLECTIVES
     covered = LANE_COLLECTIVES.get((backend, alg))
     if covered is not None and coll not in covered:
         return False
@@ -272,8 +301,8 @@ def apply_algorithm(world, alg: str) -> None:
                         TuningKey.GATHER_FLAT_TREE_MAX_COUNT):
                 a.set_tuning(int(key), 0)
             a.set_tuning(int(TuningKey.GATHER_FLAT_TREE_MAX_FANIN), 2)
-        else:  # static / hierarchical measure against the static regs
-            a.apply_static_tuning()
+        else:  # static / hierarchical / compress_* measure against the
+            a.apply_static_tuning()  # static registers
 
 
 # ---------------------------------------------------------------------------
@@ -352,7 +381,7 @@ def measure(world, config: TuneConfig = TuneConfig(),
     shared-core noise would otherwise thrash the argmax)."""
     P = world.nranks
     dtype = _sweep._resolve_dtype(config.dtype)
-    algs = config.algorithms or algorithms_for(world)
+    algs = config.algorithms or algorithms_for(world, config.dtype)
     own_hier = False
     if "hierarchical" in algs and hier is None:
         fabric = fabric or Fabric.for_world(
@@ -384,6 +413,10 @@ def measure(world, config: TuneConfig = TuneConfig(),
                             return _run_once_hier(world, hier, coll,
                                                   count, dtype,
                                                   config.root)
+                        if alg in COMPRESSION_ALGS:
+                            return _sweep._run_once(
+                                world, coll, count, dtype, config.root,
+                                compress=_compress_dtype_of(alg))
                         return _sweep._run_once(world, coll, count,
                                                 dtype, config.root)
 
@@ -537,6 +570,11 @@ def compare(world, table: SelectionTable,
                 apply_algorithm(world, "static")
                 return _run_once_hier(world, hier, coll, count, dtype,
                                       config.root)
+            if lane in COMPRESSION_ALGS:
+                apply_algorithm(world, "static")
+                return _sweep._run_once(world, coll, count, dtype,
+                                        config.root,
+                                        compress=_compress_dtype_of(lane))
             apply_algorithm(world, lane)
             return _sweep._run_once(world, coll, count, dtype,
                                     config.root)
@@ -626,7 +664,13 @@ class SelectionPolicy:
         ``Engine::set_tuning`` (emu flat/tree) and the TPU ring
         threshold become the backend of the measured policy.  Cells
         the registers cannot express (``hierarchical``) are served by
-        the composer entry points and only recorded here."""
+        the composer entry points and only recorded here; cells won by
+        a compress_* lane arm the driver's CompressionPolicy (the wire
+        dtype with the most winning cells, thresholded at the smallest
+        winning payload, scoped to the winning collectives).  An
+        explicit ACCL_COMPRESS env knob overrides this (the driver
+        arms it after the table install)."""
+        self._install_compression(accl)
         nranks = accl.size
         if backend_of(accl) == "tpu":
             # convert table payload bytes to the units the gang planner
@@ -671,6 +715,33 @@ class SelectionPolicy:
                 # no size register (bcast): majority vote
                 accl.set_tuning(int(ranks_key),
                                 _HUGE if len(flat) >= len(tree) else 0)
+
+    def _install_compression(self, accl) -> None:
+        from ..arithconfig import CompressionPolicy
+        from ..constants import Operation
+
+        nranks = accl.size
+        wins: dict = {}
+        for key, e in self.table.entries.items():
+            coll, dt, _b, n = key.split("|")
+            if int(n) != nranks or e.get("algorithm") \
+                    not in COMPRESSION_ALGS or dt != "float32":
+                continue
+            wins.setdefault(e["algorithm"], []).append((coll, e))
+        if not wins:
+            return
+        alg = max(wins, key=lambda a: len(wins[a]))
+        cells = wins[alg]
+        # table bytes carry the nccl payload factor (P x for allgather
+        # AND reduce_scatter/alltoall); the policy thresholds on the
+        # DESCRIPTOR payload (count x elem size), so divide it back out
+        accl.set_compression(CompressionPolicy(
+            dtype=_compress_dtype_of(alg),
+            min_bytes=int(min(
+                e["bytes"] // _metrics.payload_factor(c, nranks)
+                for c, e in cells)),
+            collectives=frozenset(int(Operation[c]) for c, _e in cells),
+        ))
 
     def on_call(self, accl, call) -> Optional[str]:
         """The ``_execute`` consult: one memoized dict probe per

@@ -130,6 +130,12 @@ int Engine::set_arithcfg(const uint32_t* words, int nwords) {
   uint32_t nlanes = words[6];
   for (uint32_t i = 0; i < nlanes && int(7 + i) < nwords; ++i)
     a->lanes.push_back(words[7 + i]);
+  // r17 append-only trailing words (arithconfig.py to_words): block
+  // geometry of the int8 block-scaled wire lane + error-feedback flag.
+  // Older 7+nlanes-word uploads simply leave the defaults (0 = cast).
+  if (int(7 + nlanes) < nwords) a->block = words[7 + nlanes];
+  if (int(8 + nlanes) < nwords) a->error_feedback = words[8 + nlanes];
+  if (a->block > I8_BLOCK_MAX) return -1;
   MutexLock g(cfg_mu_);
   arithcfgs_.push_back(std::move(a));
   return int(arithcfgs_.size()) - 1;
@@ -431,8 +437,9 @@ int Engine::plan_count() const {
 // ---------------------------------------------------------------------------
 // engine telemetry snapshot (r14): the versioned flat export behind
 // capi accl_engine_stats.  FIELD ORDER IS THE ABI — append only, and
-// keep ENGINE_STATS_FIELDS_V2 in accl_tpu/observability/telemetry.py
-// in lockstep (v2 appends link_rows, r15).
+// keep ENGINE_STATS_FIELDS_V3 in accl_tpu/observability/telemetry.py
+// in lockstep (v2 appends link_rows, r15; v3 appends the quantized
+// wire accounting pair, r17).
 // ---------------------------------------------------------------------------
 int Engine::engine_stats(uint64_t* out, int cap) {
   uint64_t egress_depth = 0;
@@ -488,6 +495,9 @@ int Engine::engine_stats(uint64_t* out, int cap) {
       joins_completed_.load(),     // 24 joins_completed
       // -- per-link wire telemetry (v2, r15) --
       link_rows,                   // 25 link_rows
+      // -- quantized wire accounting (v3, r17) --
+      compressed_tx_bytes_.load(),          // 26 compressed_tx_bytes
+      compressed_tx_logical_bytes_.load(),  // 27 compressed_tx_logical_bytes
   };
   const int total = int(sizeof(fields) / sizeof(fields[0]));
   if (out) {
@@ -550,6 +560,7 @@ int Engine::link_stats(uint64_t* out, int cap) {
       row[9] = c.fenced_drops;
       row[10] = c.seeks;
       row[11] = c.seek_wait_ns;
+      row[12] = c.comp_tx_bytes;
       ++i;
     }
   }
@@ -793,11 +804,23 @@ void Engine::egress_loop() {
 //    silently TRUNCATED at install — rejected instead.
 // Join/Welcome are session-addressed (pre-communicator) and carry no
 // payload contract; RndzvsInit's count is an element count, not bytes.
-bool Engine::frame_ok(const WireHeader& hdr, uint64_t payload_bytes) {
+// Block-scaled segments (hdr.compressed == 2, the r17 int8 wire lane)
+// additionally carry a self-describing framing header whose scale-row/
+// count consistency is validated HERE — a truncated scale row, a
+// count/block mismatch or an oversized block is a counted rejection
+// before any routing interprets the payload.
+static bool i8_segment_ok(const std::vector<uint8_t>& payload) {
+  return i8_wire_elems(payload.data(), payload.size()) != UINT64_MAX;
+}
+
+bool Engine::frame_ok(const WireHeader& hdr,
+                      const std::vector<uint8_t>& payload) {
+  const uint64_t payload_bytes = payload.size();
   switch (static_cast<MsgType>(hdr.msg_type)) {
     case MsgType::EgrMsg:
       if (hdr.count != payload_bytes) return false;
       if (hdr.comm_id >= kMaxComms) return false;
+      if (hdr.compressed == 2 && !i8_segment_ok(payload)) return false;
       if (hdr.strm < FIRST_KRNL_STREAM && rx_.buf_size() &&
           payload_bytes > rx_.buf_size())
         return false;
@@ -830,6 +853,7 @@ bool Engine::frame_ok(const WireHeader& hdr, uint64_t payload_bytes) {
       }
       return true;
     case MsgType::RndzvsMsg:
+      if (hdr.compressed == 2 && !i8_segment_ok(payload)) return false;
       return hdr.comm_id < kMaxComms && hdr.count == payload_bytes;
     case MsgType::RndzvsInit:
     case MsgType::RndzvsWrDone:
@@ -862,7 +886,7 @@ void Engine::ingress(Message&& msg) {
   // kill-rank chaos: a dead engine hears nothing — no pongs, no
   // completions, no deposits (the peer-visible half of kill())
   if (killed_.load()) return;
-  if (!frame_ok(msg.hdr, msg.payload.size())) {
+  if (!frame_ok(msg.hdr, msg.payload)) {
     frames_rejected_.fetch_add(1);
     return;
   }
@@ -881,7 +905,7 @@ int Engine::ingest_bytes(const uint8_t* data, uint64_t nbytes) {
   Message msg;
   std::memcpy(&msg.hdr, data, sizeof(WireHeader));
   msg.payload.assign(data + sizeof(WireHeader), data + nbytes);
-  if (!frame_ok(msg.hdr, msg.payload.size())) {
+  if (!frame_ok(msg.hdr, msg.payload)) {
     frames_rejected_.fetch_add(1);
     return 1;
   }
@@ -1152,6 +1176,9 @@ int Engine::abort_comm(uint32_t comm_id, uint32_t err_bits, bool propagate) {
   // every persistent plan armed against the pre-abort epoch
   rx_.evict_comm(comm_id);
   invalidate_plans(int(comm_id));
+  // stale quantization residuals must not leak into the healed world's
+  // error-feedback stream (the dead epoch's error is not ours to carry)
+  drop_ef_residuals(int(comm_id));
   if (propagate && !killed_.load()) {
     for (uint32_t i = 0; i < t->rows.size(); ++i) {
       if (i == t->local) continue;
@@ -1180,6 +1207,7 @@ void Engine::handle_abort(const WireHeader& hdr) {
   comm_abort_[comm].fetch_or(hdr.count | COMM_ABORTED);
   rx_.evict_comm(comm);
   invalidate_plans(int(comm));
+  drop_ef_residuals(int(comm));
   // pending calls on this comm finalize on the engine loop's next
   // sweep; blocked eager seeks notice within one recovery slice
 }
@@ -1214,6 +1242,7 @@ void Engine::reset_errors() {
   // plan-cache eviction fires here too (not only on abort): a healed
   // world must re-capture, never replay pre-reset descriptor state
   invalidate_plans(-1);
+  drop_ef_residuals(-1);
 }
 
 // ---------------------------------------------------------------------------
@@ -1396,7 +1425,30 @@ void Engine::land_one_sided(const WireHeader& hdr, const uint8_t* payload,
     MutexLock g(mem_mu_);
     auto& region = (hdr.vaddr & HOST_ADDR_BIT) ? hostmem_ : devicemem_;
     uint64_t vaddr = hdr.vaddr & ~HOST_ADDR_BIT;
-    if (post->wire_c != post->lnd_c) {
+    if (post->wire_c && post->blk) {
+      // block-scaled rendezvous landing: the segment is
+      // self-describing — decode/validate against our posted geometry
+      // and dequantize into the fp32 landing buffer (lnd_c is always
+      // false for the int8 pair; the driver rejects int8 residence).
+      // A segment that fails the pinned-geometry decode (divergent
+      // block size, elems beyond the posted count) must NOT surface a
+      // completion: the landing buffer was never written, and a
+      // completed recv over stale bytes would be silent corruption —
+      // withholding RndzvDone lets the receiver's budget classify the
+      // failure loudly (sticky_err_ is loop-thread-only, so the
+      // ingress thread cannot stamp COMPRESSION_ERROR itself).
+      uint64_t elems = i8_wire_elems(payload, payload_bytes, post->blk);
+      uint64_t lnd_bytes =
+          elems == UINT64_MAX ? 0 : elems * post->ub;
+      if (elems == UINT64_MAX || elems > post->elems ||
+          vaddr + lnd_bytes > region.size() ||
+          dequantize_i8_block(payload, payload_bytes,
+                              reinterpret_cast<float*>(region.data() + vaddr),
+                              elems, post->blk) != OK) {
+        frames_rejected_.fetch_add(1);
+        return;
+      }
+    } else if (post->wire_c != post->lnd_c) {
       // clamp to what actually arrived: a short payload (divergent
       // arithcfg, stale posted entry) must not read past the wire
       // buffer
@@ -1919,6 +1971,13 @@ Engine::Dom Engine::dom(const CallDesc& c) const {
   d.op1 = d.pair && (f & OP1_COMPRESSED);
   d.res = d.pair && (f & RES_COMPRESSED);
   d.eth = d.pair && (f & ETH_COMPRESSED);
+  if (d.pair && a.compressor == I8_BLOCK_COMPRESSOR) {
+    d.blk = a.block ? a.block : I8_BLOCK_DEFAULT;
+    d.ef = a.error_feedback != 0;
+    // per-operand residence is undefined for a scaled segment (the
+    // driver rejects it too); only the wire bit is meaningful
+    d.op0 = d.op1 = d.res = false;
+  }
   return d;
 }
 
@@ -1929,13 +1988,83 @@ uint32_t Engine::convert_elems(const Dom& d, const uint8_t* in, bool in_c,
   // memmove/the lanes declare their pointers nonnull (UBSan)
   if (elems == 0) return OK;
   if (in_c == out_c) {
-    std::memmove(out, in, elems * d.eb(in_c));
+    std::memmove(out, in, d.wbytes(elems, in_c));
     return OK;
   }
-  uint32_t err = in_c ? run_decompress_lane(d.comp_kind, in, out, elems)
-                      : run_compress_lane(d.comp_kind, in, out, elems);
+  uint32_t err;
+  if (d.blk) {
+    // int8 block-scaled lane: the compressed side is a self-describing
+    // segment (arith.hpp framing); accumulate/operand side is fp32
+    err = in_c ? dequantize_i8_block(in, d.wbytes(elems, true),
+                                     reinterpret_cast<float*>(out), elems,
+                                     d.blk)
+               : (quantize_i8_block(reinterpret_cast<const float*>(in), out,
+                                    elems, d.blk),
+                  OK);
+  } else {
+    err = in_c ? run_decompress_lane(d.comp_kind, in, out, elems)
+               : run_compress_lane(d.comp_kind, in, out, elems);
+  }
   sticky_err_ |= err;
   return err;
+}
+
+// Egress quantization with optional EQuARX error feedback: the plain
+// path is quantize_i8_block; with the arithcfg's error_feedback word
+// set, the per-site residual (comm, dst, source address) is folded in
+// and refreshed.  Sites whose element count changed (buffer reuse at a
+// different size) reset their residual; the total float budget is
+// bounded — saturated worlds quantize feedback-free rather than grow.
+void Engine::quantize_egress(const Dom& d, bool use_ef, uint32_t comm,
+                             uint32_t dst, uint64_t src_addr,
+                             const float* in, uint8_t* out,
+                             uint64_t elems) {
+  if (!use_ef || elems == 0) {
+    quantize_i8_block(in, out, elems, d.blk);
+    return;
+  }
+  MutexLock g(ef_mu_);
+  auto it = ef_residual_.find(EfKey{comm, dst, src_addr});
+  if (it == ef_residual_.end()) {
+    if (ef_floats_ + elems > kEfResidualCapFloats) {
+      quantize_i8_block(in, out, elems, d.blk);
+      return;
+    }
+    it = ef_residual_.emplace(EfKey{comm, dst, src_addr},
+                              std::vector<float>(elems, 0.0f)).first;
+    ef_floats_ += elems;
+  } else if (it->second.size() != elems) {
+    // same cap discipline as creation: a site regrowing past the
+    // budget drops its residual and quantizes feedback-free rather
+    // than blowing the bound (buffer reuse at a new size)
+    uint64_t grown = ef_floats_ - uint64_t(it->second.size()) + elems;
+    if (grown > kEfResidualCapFloats) {
+      ef_floats_ -= uint64_t(it->second.size());
+      ef_residual_.erase(it);
+      quantize_i8_block(in, out, elems, d.blk);
+      return;
+    }
+    ef_floats_ = grown;
+    it->second.assign(elems, 0.0f);
+  }
+  quantize_i8_block(in, out, elems, d.blk, it->second.data());
+}
+
+void Engine::drop_ef_residuals(int comm_id) {
+  MutexLock g(ef_mu_);
+  if (comm_id < 0) {
+    ef_residual_.clear();
+    ef_floats_ = 0;
+    return;
+  }
+  for (auto it = ef_residual_.begin(); it != ef_residual_.end();) {
+    if (std::get<0>(it->first) == uint32_t(comm_id)) {
+      ef_floats_ -= it->second.size();
+      it = ef_residual_.erase(it);
+    } else {
+      ++it;
+    }
+  }
 }
 
 uint32_t Engine::reduce_mixed(const CallDesc& c, const uint8_t* a0, bool a0c,
@@ -1998,7 +2127,7 @@ bool Engine::use_rendezvous(const CallDesc& c, uint64_t elems) {
   // identically from their own arithcfg + ETH flag, so protocol choice
   // can never diverge across ranks.
   Dom d = dom(c);
-  uint64_t bytes = elems * d.eb(d.eth);
+  uint64_t bytes = d.wbytes(elems, d.eth);
   if (bytes <= max_eager_) return false;
   if (c.stream_flags() != 0) return false;
   // enforce the rendezvous size register as a hard cap (the reference
@@ -2076,7 +2205,7 @@ uint32_t Engine::local_reduce(uint32_t lane, uint64_t a, uint64_t b,
 // ---------------------------------------------------------------------------
 void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
                         uint64_t elems, bool from_stream, uint32_t to_strm,
-                        uint32_t comp) {
+                        uint32_t comp, bool reduce_stream) {
   // loop() already finalized calls on unknown/placeholder comms, so the
   // fetch cannot miss here (same contract the old direct index relied on)
   CommTable& t = *comm_ptr(c.comm());
@@ -2088,8 +2217,10 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
                                                             : 1024);
   // segmentation is against the rx buffer in WIRE representation: a
   // compressed wire carries ratio-more elements per segment (fw :621-623
-  // computes max_seg_count from the element size the same way)
-  uint64_t seg_elems = std::max<uint64_t>(1, seg_wire / d.eb(wire_c));
+  // computes max_seg_count from the element size the same way); the
+  // block-scaled lane additionally rounds to whole blocks so every
+  // segment is a self-contained (scales, data) unit
+  uint64_t seg_elems = d.seg_elems(seg_wire, wire_c);
 
   uint64_t off = 0;
   bool first = true;
@@ -2108,7 +2239,7 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
       }
       msg.payload = std::move(*v);
       if (wire_c) {
-        std::vector<uint8_t> packed(chunk * d.cb);
+        std::vector<uint8_t> packed(d.wbytes(chunk, true));
         if (convert_elems(d, msg.payload.data(), false, packed.data(), true,
                           chunk))
           return;
@@ -2117,11 +2248,28 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
     } else {
       MutexLock g(mem_mu_);
       uint8_t* p = mem(addr + off * d.eb(src_c), chunk * d.eb(src_c));
-      msg.payload.resize(chunk * d.eb(wire_c));
-      if (convert_elems(d, p, src_c, msg.payload.data(), wire_c, chunk))
+      msg.payload.resize(d.wbytes(chunk, wire_c));
+      if (wire_c && d.blk && !src_c) {
+        // block-scaled egress: quantize (with the per-site EQuARX
+        // residual when the arithcfg arms error feedback AND this is
+        // a reduction-stream hop)
+        if (sticky_err_) return;
+        quantize_egress(d, d.ef && reduce_stream, c.comm(), dst,
+                        addr + off * d.eb(src_c),
+                        reinterpret_cast<const float*>(p),
+                        msg.payload.data(), chunk);
+      } else if (convert_elems(d, p, src_c, msg.payload.data(), wire_c,
+                               chunk)) {
         return;
+      }
     }
-    msg.hdr.compressed = wire_c ? 1 : 0;
+    if (wire_c) {
+      compressed_tx_bytes_.fetch_add(msg.payload.size());
+      compressed_tx_logical_bytes_.fetch_add(chunk * d.ub);
+      link_count(c.comm(), dst, &LinkCounters::comp_tx_bytes,
+                 msg.payload.size());
+    }
+    msg.hdr.compressed = wire_c ? (d.blk ? 2 : 1) : 0;
     msg.hdr.count = uint32_t(msg.payload.size());
     msg.hdr.tag = tag;
     msg.hdr.src = t.local;
@@ -2259,7 +2407,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
                           ? t.rows[t.local].max_seg
                           : (rx_.buf_size() ? rx_.buf_size() : 1024);
   // must mirror the sender's wire-domain segmentation exactly
-  uint64_t seg_elems = std::max<uint64_t>(1, seg_wire / d.eb(wire_c));
+  uint64_t seg_elems = d.seg_elems(seg_wire, wire_c);
 
   uint64_t off = 0;
   uint64_t consumed_chunks = 0;
@@ -2337,7 +2485,19 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     // the wire representation from its arithcfg + ETH flag, which is what
     // makes directional pairs (f16 sender / f32+compress receiver) agree
     bool got_c = wire_c;
-    uint64_t got_elems = note->bytes / std::max<uint64_t>(1, d.eb(got_c));
+    uint64_t got_elems;
+    if (got_c && d.blk) {
+      // self-describing block-scaled segment: decode + validate the
+      // framing against our own arithcfg geometry (a mismatched or
+      // truncated segment is a compression error, never an OOB read)
+      got_elems = i8_wire_elems(data, note->bytes, d.blk);
+      if (got_elems == UINT64_MAX) {
+        sticky_err_ |= COMPRESSION_ERROR;
+        got_elems = 0;
+      }
+    } else {
+      got_elems = note->bytes / std::max<uint64_t>(1, d.eb(got_c));
+    }
     if (got_elems != chunk) sticky_err_ |= SEGMENTER_EXPECTED_BTT_ERROR;
     uint64_t n = std::min(got_elems, chunk);
     switch (mode) {
@@ -2390,7 +2550,7 @@ void Engine::rndzv_post_addr(CallDesc& c, Progress& p, uint32_t src,
       MutexLock g(posted_mu_);
       posted_[PostedKey{c.comm(), src, tag, addr}] =
           PostedRndzv{elems, d.eth, dst_c && d.pair, d.comp_kind,
-                      uint32_t(d.ub), uint32_t(d.cb)};
+                      uint32_t(d.ub), uint32_t(d.cb), d.blk};
     }
     c.rndzv_posts.push_back({c.comm(), src, tag, addr});
     // advertise our landing address to the sender (RNDZVS_INIT)
@@ -2507,16 +2667,34 @@ void Engine::rndzv_send(CallDesc& c, Progress& p, uint32_t dst, uint32_t tag,
       // the ETH-compressed rendezvous the reference leaves as a TODO
       MutexLock g(mem_mu_);
       uint8_t* pdata = mem(addr, elems * d.eb(src_c));
-      msg.payload.resize(elems * d.eb(d.eth));
+      msg.payload.resize(d.wbytes(elems, d.eth));
       // on conversion failure (unknown compressor lane) fall through to
       // p.done() with the sticky error set and no wire message — an
       // early return here would desynchronize the schedule's resume
       // cursor after the RNDZVS_INIT was already consumed
-      convert_elems(d, pdata, src_c, msg.payload.data(), d.eth, elems);
-      msg.hdr.compressed = d.eth ? 1 : 0;
+      if (d.eth && d.blk && !src_c && sticky_err_ == 0) {
+        // rendezvous sends: EF only for reduction scenarios (tree
+        // reduce contributions) — bcast/gather/scatter one-sided
+        // writes must quantize cleanly
+        Op sc = c.scenario();
+        bool use_ef = d.ef && (sc == Op::Reduce || sc == Op::Allreduce ||
+                               sc == Op::ReduceScatter);
+        quantize_egress(d, use_ef, c.comm(), dst, addr,
+                        reinterpret_cast<const float*>(pdata),
+                        msg.payload.data(), elems);
+      } else {
+        convert_elems(d, pdata, src_c, msg.payload.data(), d.eth, elems);
+      }
+      msg.hdr.compressed = d.eth ? (d.blk ? 2 : 1) : 0;
     }
     if (sticky_err_ == 0) {
       msg.hdr.count = uint32_t(msg.payload.size());
+      if (d.eth) {
+        compressed_tx_bytes_.fetch_add(msg.payload.size());
+        compressed_tx_logical_bytes_.fetch_add(elems * d.ub);
+        link_count(c.comm(), dst, &LinkCounters::comp_tx_bytes,
+                   msg.payload.size());
+      }
       link_tx(c.comm(), dst, msg.payload.size());
       send_out(t.rows[dst].session, std::move(msg));
     }
@@ -2648,7 +2826,7 @@ void Engine::coll_gather(CallDesc& c, Progress& p) {
       // the fan-in window caps concurrent inbound writes
       // root-only decision, so cross-rank divergence is impossible, but
       // wire width keeps the threshold meaning consistent with reduce
-      uint32_t fanin = (elems * d.eb(d.eth) > gather_flat_max_count_.load())
+      uint32_t fanin = (d.wbytes(elems, d.eth) > gather_flat_max_count_.load())
                            ? gather_flat_max_fanin_.load()
                            : P - 1;
       fanin = std::max(1u, fanin);
@@ -2780,7 +2958,7 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
     // pairs and a schedule-selection split would wedge the rendezvous
     // handshake (fw :1533 consults its own width, but its compression is
     // symmetric by construction — ours is not)
-    uint64_t wire_bytes = elems * d.eb(d.eth);
+    uint64_t wire_bytes = d.wbytes(elems, d.eth);
     if (P <= reduce_flat_max_ranks_ || wire_bytes <= reduce_flat_max_count_) {
       // flat when the world is small OR the payload is small: tree setup
       // overhead beats the flat fan-in only for large payloads on large
@@ -2822,9 +3000,11 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
   uint32_t next = (t.local + 1) % P;
   uint32_t prev = (t.local + P - 1) % P;
   if (pos == 1) {
-    // head of the chain: just forward our contribution
+    // head of the chain: just forward our contribution (a reduction
+    // operand — the EF residual's legal habitat)
     send_eager(c, next, c.tag(), op_addr, elems, false, 0,
-               (op_c ? uint32_t(OP0_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED));
+               (op_c ? uint32_t(OP0_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED),
+               /*reduce_stream=*/true);
   } else if (pos != 0) {
     // interior: receive partial, fold our contribution, forward through
     // an uncompressed scratch accumulator
@@ -2833,7 +3013,7 @@ void Engine::coll_reduce(CallDesc& c, Progress& p) {
     recv_eager(c, prev, c.tag(), tmp, elems, RecvMode::REDUCE, 0,
                comp & ETH_COMPRESSED);
     send_eager(c, next, c.tag(), tmp, elems, false, 0,
-               comp & ETH_COMPRESSED);
+               comp & ETH_COMPRESSED, /*reduce_stream=*/true);
     free_addr(tmp);
   } else {
     // root: receive the chain's partial, fold our contribution into res
@@ -2868,10 +3048,13 @@ void Engine::ring_reduce_scatter(CallDesc& c, uint64_t src_base,
   }
   uint32_t first = (r + P - 1) % P;
   // per-step algebra (fw :1929-1955): sends keep OP0, replace RES by the
-  // wire bit; the fused recv-reduce takes the wire payload as OP1
+  // wire bit; the fused recv-reduce takes the wire payload as OP1.
+  // Every send here carries a reduction partial — the EF residual's
+  // legal habitat (reduce_stream=true).
   send_eager(c, next, c.tag(), src_base + off[first] * d.eb(d.op0),
              len[first], false, 0,
-             (d.op0 ? uint32_t(OP0_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED));
+             (d.op0 ? uint32_t(OP0_COMPRESSED) : 0u) | (comp & ETH_COMPRESSED),
+             /*reduce_stream=*/true);
   uint64_t maxlen = *std::max_element(len.begin(), len.end());
   uint64_t tmp = alloc(std::max<uint64_t>(maxlen * d.ub, 64), 64);
   for (uint32_t s = 1; s <= P - 1; ++s) {
@@ -2884,10 +3067,36 @@ void Engine::ring_reduce_scatter(CallDesc& c, uint64_t src_base,
     recv_eager(c, prev, c.tag(), tmp, len[chunk], RecvMode::REDUCE, 0,
                comp & ETH_COMPRESSED);
     if (chunk == r) {
+      // wire-form agreement (EQuARX discipline): under an allreduce's
+      // compressed wire the owner's finished chunk will be RELAYED to
+      // every peer in the gather phase as quant(chunk) — consume the
+      // SAME wire form locally, or ranks would disagree on exactly
+      // the chunks they own by a full quantization step.  The
+      // roundtrip mirrors the gather phase's SEGMENTATION (block
+      // partitions are segment-relative), so owner and peers land
+      // within one ulp of scale arithmetic of each other.
+      // reduce_scatter keeps the exact accumulate: its chunk is
+      // rank-private by contract.
+      if (d.eth && d.blk && c.scenario() == Op::Allreduce &&
+          sticky_err_ == 0) {
+        uint64_t seg_wire = t.rows[next].max_seg
+                                ? t.rows[next].max_seg
+                                : (rx_.buf_size() ? rx_.buf_size() : 1024);
+        uint64_t seg = d.seg_elems(seg_wire, true);
+        thread_local std::vector<uint8_t> rt;
+        MutexLock g(mem_mu_);
+        for (uint64_t o = 0; o < len[chunk]; o += seg) {
+          uint64_t n = std::min<uint64_t>(seg, len[chunk] - o);
+          rt.resize(d.wbytes(n, true));
+          uint8_t* p = mem(tmp + o * d.ub, n * d.ub);
+          if (convert_elems(d, p, false, rt.data(), true, n) != OK) break;
+          convert_elems(d, rt.data(), true, p, false, n);
+        }
+      }
       local_move(c, tmp, own_dst, len[chunk], false, d.res);
     } else {
       send_eager(c, next, c.tag(), tmp, len[chunk], false, 0,
-                 comp & ETH_COMPRESSED);
+                 comp & ETH_COMPRESSED, /*reduce_stream=*/true);
     }
   }
   free_addr(tmp);

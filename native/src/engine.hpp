@@ -43,6 +43,13 @@ struct ArithCfgN {
   uint32_t compressor = 0, decompressor = 0;
   uint32_t arith_compressed = 0;
   std::vector<uint32_t> lanes;  // indexed by ReduceFunction
+  // r17 block-scaled extension (append-only serialization in
+  // arithconfig.py to_words: two trailing words after the lanes):
+  // elements per fp32 scale on the int8 wire (0 = cast lane), and
+  // whether egress quantization folds an EQuARX error-feedback
+  // residual (per (comm, dst, source-address) site) into each pass.
+  uint32_t block = 0;
+  uint32_t error_feedback = 0;
 };
 
 // Rendezvous bookkeeping records (reference: firmware pending queues,
@@ -176,7 +183,11 @@ class Engine {
   // plan table, rx staging) — pollable at 10 Hz without touching the
   // call hot path.  v2 (r15) appends link_rows: the number of
   // (comm, peer) link rows the link plane below is tracking. ----
-  static constexpr int kEngineStatsVersion = 2;
+  // v3 (r17) appends the quantized-wire accounting pair:
+  // compressed_tx_bytes (wire bytes actually sent through a compressed
+  // lane) and compressed_tx_logical_bytes (their uncompressed
+  // equivalent — the difference is the "bytes saved" family).
+  static constexpr int kEngineStatsVersion = 3;
   int engine_stats(uint64_t* out, int cap);
 
   // ---- per-link wire telemetry (r15): the flat (comm, peer) counter
@@ -190,11 +201,12 @@ class Engine {
   // LINK_STATS_FIELDS_V2 in accl_tpu/observability/telemetry.py:
   //   0 comm, 1 peer, 2 tx_msgs, 3 tx_bytes, 4 rx_msgs, 5 rx_bytes,
   //   6 retrans_sent, 7 nacks_tx, 8 nacks_rx, 9 fenced_drops,
-  //   10 seeks, 11 seek_wait_ns
+  //   10 seeks, 11 seek_wait_ns, 12 comp_tx_bytes (r17: wire bytes
+  //   sent to this peer through a compressed lane)
   // Only WHOLE rows are ever written (a short buffer truncates at a
   // row boundary, never mid-row); the return value is the total u64
   // count this engine holds so a caller with a small buffer can retry.
-  static constexpr int kLinkStatsStride = 12;
+  static constexpr int kLinkStatsStride = 13;
   int link_stats(uint64_t* out, int cap);
 
   // Egress frame tap: bounded ring of the last kTapCap frames this
@@ -365,10 +377,13 @@ class Engine {
   void classify(Message&& msg);
   // Structural validation of one frame BEFORE any routing touches it:
   // a malformed frame must be counted and dropped, never interpreted.
-  // Non-const: the stream-route pressure checks read the resequencer
-  // maps under their mutex so rejection happens BEFORE any per-route
-  // state is minted from attacker-controlled header fields.
-  bool frame_ok(const WireHeader& hdr, uint64_t payload_bytes);
+  // Takes the payload (not just its size): block-scaled segments
+  // (hdr.compressed == 2, r17) carry a self-describing framing header
+  // whose scale-row/count consistency is validated here.  Non-const:
+  // the stream-route pressure checks read the resequencer maps under
+  // their mutex so rejection happens BEFORE any per-route state is
+  // minted from attacker-controlled header fields.
+  bool frame_ok(const WireHeader& hdr, const std::vector<uint8_t>& payload);
   //: bounds on state minted from inbound stream headers (comm, src and
   //: strm are attacker-controlled): max distinct inbound stream routes,
   //: and max total parked out-of-order payloads across ALL routes
@@ -408,9 +423,40 @@ class Engine {
     uint32_t comp_kind = 0;       // compressor id (arithconfig.py)
     bool pair = false;            // a real compressed representation exists
     bool op0 = false, op1 = false, res = false, eth = false;
+    // r17 int8 block-scaled wire lane: block != 0 selects the
+    // self-describing segment format (arith.hpp i8_* helpers) whose
+    // byte size is NOT linear per element — every wire-size site must
+    // go through wbytes()/welems(), never eb(), for the wire domain.
+    // Per-operand compressed residence is meaningless for a scaled
+    // segment (the scales don't fit a flat int8 buffer), so dom()
+    // forces op0/op1/res off when blk is set.
+    uint32_t blk = 0;
+    bool ef = false;              // error-feedback egress quantization
     uint64_t eb(bool compressed) const { return compressed ? cb : ub; }
+    // wire/operand byte size of `elems` elements in a representation
+    uint64_t wbytes(uint64_t elems, bool compressed) const {
+      return (compressed && blk) ? i8_wire_bytes(elems, blk)
+                                 : elems * eb(compressed);
+    }
+    // elements per segment against a wire-byte budget
+    uint64_t seg_elems(uint64_t wire_cap, bool compressed) const {
+      if (compressed && blk) return i8_seg_elems(wire_cap, blk);
+      return std::max<uint64_t>(1, wire_cap / eb(compressed));
+    }
   };
   Dom dom(const CallDesc& c) const;
+
+  // Egress quantization for the block-scaled lane: plain unless
+  // `use_ef` (the arithcfg arms error feedback AND the send carries a
+  // REDUCTION stream — relays/gathers/bcasts must quantize cleanly,
+  // folding a residual into non-reduced data would corrupt it), in
+  // which case the per-site residual (key = (comm, dst, source
+  // address)) is folded in and refreshed — a training loop's repeated
+  // collective re-quantizes the same sites every iteration, so the
+  // error of pass k rides into pass k+1, EQuARX-style.
+  void quantize_egress(const Dom& d, bool use_ef, uint32_t comm,
+                       uint32_t dst, uint64_t src_addr, const float* in,
+                       uint8_t* out, uint64_t elems);
 
   // Convert `elems` elements between representations (identity when the
   // domains match); returns sticky error bits on unknown compressor.
@@ -427,9 +473,12 @@ class Engine {
   // the kernel stream when from_stream).  comp bits: OP0_COMPRESSED =
   // memory at addr holds the compressed representation; ETH_COMPRESSED =
   // compress payloads on the wire (fw send :575-651).
+  // `reduce_stream`: this send carries a reduction partial/operand (a
+  // ring reduce-scatter or reduce-chain hop) — the only sends the
+  // error-feedback residual may legally fold into.
   void send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
                   uint64_t elems, bool from_stream, uint32_t to_strm,
-                  uint32_t comp);
+                  uint32_t comp, bool reduce_stream = false);
   // Eager segmented receive of `elems` elements into devicemem `addr`;
   // mode selects plain copy, reduce-accumulate into dst (fused
   // recv-reduce), or routing to a kernel stream.  comp bits:
@@ -542,7 +591,8 @@ class Engine {
     uint64_t elems;
     bool wire_c, lnd_c;
     uint32_t comp_kind;
-    uint32_t ub, cb;  // bytes/element in each representation
+    uint32_t ub, cb;   // bytes/element in each representation
+    uint32_t blk = 0;  // block-scaled wire geometry (0 = cast lane)
   };
   using PostedKey = std::tuple<uint32_t, uint32_t, uint32_t, uint64_t>;
   std::map<PostedKey, PostedRndzv> posted_ ACCL_GUARDED_BY(posted_mu_);
@@ -559,6 +609,24 @@ class Engine {
   // set once at world wiring, before traffic (no guard needed)
   std::function<Engine*(uint32_t session)> peer_hook_;
   std::atomic<uint64_t> tx_msgs_{0}, tx_payload_bytes_{0};
+  // r17 quantized-wire accounting: bytes that left through a
+  // compressed lane (any pair — f16/bf16 cast or int8 block-scaled)
+  // and their uncompressed equivalent; saved = logical - compressed.
+  std::atomic<uint64_t> compressed_tx_bytes_{0};
+  std::atomic<uint64_t> compressed_tx_logical_bytes_{0};
+
+  // ---- error-feedback residuals (r17, EQuARX arxiv 2506.17615) ----
+  // One fp32 residual vector per quantization site (comm, dst,
+  // source address), written by quantize_egress when the arithcfg's
+  // error_feedback word is set.  Leaf lock taken under mem_mu_ (the
+  // egress conversion sites hold mem_mu_); total floats are bounded —
+  // sites past the cap quantize without feedback rather than grow.
+  static constexpr uint64_t kEfResidualCapFloats = 8ull << 20;  // 32 MiB
+  using EfKey = std::tuple<uint32_t, uint32_t, uint64_t>;
+  std::map<EfKey, std::vector<float>> ef_residual_ ACCL_GUARDED_BY(ef_mu_);
+  uint64_t ef_floats_ ACCL_GUARDED_BY(ef_mu_) = 0;
+  Mutex ef_mu_ ACCL_ACQUIRED_AFTER(mem_mu_);
+  void drop_ef_residuals(int comm_id);  // -1 = all (reset_errors)
   // LOCK ORDER: posted_mu_ comes BEFORE mem_mu_ (see mem_mu_ above);
   // acquiring posted_mu_ under mem_mu_ would invert the order = deadlock.
   Mutex posted_mu_;
@@ -643,6 +711,7 @@ class Engine {
     uint64_t tx_msgs = 0, tx_bytes = 0, rx_msgs = 0, rx_bytes = 0;
     uint64_t retrans_sent = 0, nacks_tx = 0, nacks_rx = 0;
     uint64_t fenced_drops = 0, seeks = 0, seek_wait_ns = 0;
+    uint64_t comp_tx_bytes = 0;  // r17: compressed wire bytes to peer
   };
   mutable Mutex link_mu_;
   std::map<std::pair<uint32_t, uint32_t>, LinkCounters> links_

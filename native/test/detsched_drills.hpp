@@ -285,6 +285,102 @@ inline void drill_detach_race() {
 }
 
 // ---- registry ------------------------------------------------------------
+// ---- drill: concurrent sub-communicator allgathers ----------------------
+// The ROADMAP item 2 KNOWN ISSUE's shape, scaled for exhaustive
+// exploration: a 2x2 grid of 2-rank sub-comms (rows {0,1}/{2,3},
+// columns {0,2}/{1,3}) over one 4-rank world; every rank allgathers on
+// its row comm then on its column comm, so row completions on fast
+// ranks overlap column starts on slow ones — the cross-comm
+// concurrency the 8-rank emu wedge (intermittent RECEIVE_TIMEOUT)
+// arises from, with all four comms contending for ONE small rx pool.
+// Invariant: every allgather completes CLEAN on every schedule — a
+// schedule that classifies a timeout/seq error is the wedge, minimized
+// into a replayable artifact.
+inline void subcomm_allgather_impl(int P) {
+  DetWorld w(P);
+  // sub-comm uploads in identical id order on every engine; ranks
+  // outside a group upload an inert self-comm so engine-side comm ids
+  // stay aligned with the wire protocol's (the driver's
+  // reserve_communicator discipline)
+  auto sub = [&](int r, const std::vector<int>& m) {
+    auto it = std::find(m.begin(), m.end(), r);
+    if (it == m.end()) {
+      std::vector<uint32_t> ww{1, 0, 0, 0, uint32_t(r), 0};
+      w.eng[size_t(r)]->set_comm(ww.data(), int(ww.size()));
+      return;
+    }
+    std::vector<uint32_t> ww{uint32_t(m.size()),
+                             uint32_t(it - m.begin())};
+    for (int g : m) {
+      ww.push_back(0);            // ip
+      ww.push_back(0);            // port
+      ww.push_back(uint32_t(g));  // session == global rank
+      ww.push_back(0);            // max_seg
+    }
+    w.eng[size_t(r)]->set_comm(ww.data(), int(ww.size()));
+  };
+  // rows of width P/2, columns of height 2 — at P=8 exactly the
+  // ROADMAP repro's comm family (two 4-rank rows, four 2-rank cols)
+  const int W = P / 2;
+  std::vector<std::vector<int>> rows(2);
+  std::vector<std::vector<int>> cols(static_cast<size_t>(W));
+  for (int r = 0; r < P; ++r) {
+    rows[size_t(r / W)].push_back(r);
+    cols[size_t(r % W)].push_back(r);
+  }
+  std::vector<uint32_t> row_comm(static_cast<size_t>(P), 0u);
+  std::vector<uint32_t> col_comm(static_cast<size_t>(P), 0u);
+  uint32_t cid = 1;
+  for (auto& m : rows) {
+    for (int r = 0; r < P; ++r) sub(r, m);
+    for (int g : m) row_comm[size_t(g)] = cid;
+    ++cid;
+  }
+  for (auto& m : cols) {
+    for (int r = 0; r < P; ++r) sub(r, m);
+    for (int g : m) col_comm[size_t(g)] = cid;
+    ++cid;
+  }
+  // row allgather 128 elems (512 B = 2 rx segments per slice), column
+  // 256 (4 segments) — the repro's small-then-large shape with real
+  // multi-segment relay pressure on the 4 x 256 B rx pool ALL comms
+  // share (the suspected wedge mechanism: cross-comm pool pinning)
+  const uint32_t row_n = 128, col_n = 256;
+  std::vector<Thread> ranks;
+  for (int r = 0; r < P; ++r) {
+    ranks.emplace_back(Thread([&w, &rows, &cols, &row_comm, &col_comm,
+                               r, W, row_n, col_n] {
+      Engine& e = *w.eng[size_t(r)];
+      for (int phase = 0; phase < 2; ++phase) {
+        uint32_t comm = phase == 0 ? row_comm[size_t(r)]
+                                   : col_comm[size_t(r)];
+        uint32_t n = phase == 0 ? row_n : col_n;
+        uint32_t members = phase == 0 ? uint32_t(W) : 2u;
+        uint64_t src = e.alloc(n * 4, 64);
+        uint64_t dst = e.alloc(uint64_t(n) * members * 4, 64);
+        auto d = DetWorld::desc(Op::Allgather, n, comm, 0, TAG_ANY,
+                                src, dst);
+        uint64_t id = e.start_call(d.data());
+        uint32_t ret = w.wait_call(r, id, "sub-comm allgather never "
+                                          "completed");
+        det::expect(ret == 0,
+                    phase == 0 ? "row allgather classified an error "
+                                 "(the sub-comm wedge)"
+                               : "column allgather classified an "
+                                 "error (the sub-comm wedge)");
+        e.free_addr(src);
+        e.free_addr(dst);
+      }
+    }));
+  }
+  for (auto& t : ranks) t.join();
+}
+
+inline void drill_subcomm_allgather() { subcomm_allgather_impl(4); }
+// the full ROADMAP repro scale (heavier per schedule — run with an
+// explicit budget, not in the default --ci sweep)
+inline void drill_subcomm_allgather8() { subcomm_allgather_impl(8); }
+
 inline const std::map<std::string, std::function<void()>>& registry() {
   static const auto* m = new std::map<std::string, std::function<void()>>{
       {"replay_vs_invalidate", drill_replay_vs_invalidate},
@@ -292,6 +388,8 @@ inline const std::map<std::string, std::function<void()>>& registry() {
       {"join_vs_traffic", drill_join_vs_traffic},
       {"shutdown_vs_waiters", drill_shutdown_vs_waiters},
       {"detach_race", drill_detach_race},
+      {"subcomm_allgather", drill_subcomm_allgather},
+      {"subcomm_allgather8", drill_subcomm_allgather8},
   };
   return *m;
 }

@@ -195,6 +195,76 @@ def tuned_lane_gate(slow_ratio: float = 1.05,
     return 0
 
 
+def quantized_gate(min_ratio: float = 1.5,
+                   min_bytes: int = 64 * 1024) -> int:
+    """Quantized wire-lane gate (r17): validate the newest committed
+    ``sweep_r*_quantized_*.csv`` record — the int8 lane must beat the
+    lossless lane's busbw by >= ``min_ratio`` on every allreduce row at
+    or above ``min_bytes``, the lossless lane's max_ulp must stay in
+    summation-order-noise territory, and the int8 error columns must be
+    finite and bounded.  A tree without a quantized record passes (the
+    lane is optional until a capture commits one)."""
+    import csv
+    import re
+
+    def _q_round(path: str) -> int:
+        m = re.search(r"sweep_r(\d+)_quantized", path)
+        return int(m.group(1)) if m else -1
+
+    results = os.path.join(ROOT, "bench", "results")
+    records = sorted(glob.glob(
+        os.path.join(results, "sweep_r*_quantized_*.csv")),
+        key=_q_round)
+    if not records:
+        print("quantized gate: no committed quantized sweep record — "
+              "skipped")
+        return 0
+    path = records[-1]
+    cells: dict = {}
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            key = (row["collective"], int(row["count"]))
+            cells.setdefault(key, {})[row["lane"]] = row
+    bad = []
+    checked = 0
+    for (coll, count), lanes in sorted(cells.items()):
+        lossless, int8 = lanes.get("lossless"), lanes.get("int8")
+        if lossless is None or int8 is None:
+            continue
+        # lossless exactness: summation-order noise only (the bitwise
+        # gate runs on integer-valued data in the test suite; random
+        # f32 data legitimately differs from the f64 reference by a
+        # relative handful of ULP, which scales with count)
+        if float(lossless["max_abs_err"]) > 1e-4:
+            bad.append(f"{coll}/{count}: lossless max_abs_err "
+                       f"{lossless['max_abs_err']} — the lossless lane "
+                       f"is no longer lossless")
+        if not float(int8["max_abs_err"]) < 1.0:
+            bad.append(f"{coll}/{count}: int8 max_abs_err "
+                       f"{int8['max_abs_err']} outside the documented "
+                       f"bound")
+        if coll == "allreduce" and int(lossless["bytes"]) >= min_bytes:
+            checked += 1
+            r = (float(int8["busbw_GBps"])
+                 / max(float(lossless["busbw_GBps"]), 1e-12))
+            if r < min_ratio:
+                bad.append(f"{coll}/{count}: int8 busbw only {r:.2f}x "
+                           f"lossless (< {min_ratio}x) — the quantized "
+                           f"lane no longer pays for itself")
+    print(f"quantized gate: {os.path.basename(path)} — "
+          f"{len(cells)} cells, {checked} allreduce rows >= "
+          f"{min_bytes // 1024} KiB checked at >= {min_ratio}x")
+    for b in bad:
+        print(f"quantized gate: {b}", file=sys.stderr)
+    if bad:
+        print("quantized gate: re-capture the record "
+              "(scripts/run_sweep.py --quantized) or root-cause the "
+              "lane regression before committing", file=sys.stderr)
+        return 1
+    print("quantized gate: OK")
+    return 0
+
+
 def plan_gate(tolerance: float, ratio: float) -> int:
     """Plan-replay rung: fresh short callrate vs the committed
     callrate_r*_plan_on baseline (see module docstring)."""
@@ -263,12 +333,19 @@ def main() -> int:
                          "callrate plan lanes vs the committed "
                          "callrate_r*_plan_on baseline)")
     ap.add_argument("--plan-ratio", type=float, default=1.5)
+    ap.add_argument("--quantized", action="store_true",
+                    help="validate the committed r17 quantized "
+                         "wire-lane record (int8 >= 1.5x lossless "
+                         "busbw for allreduce >= 64 KiB)")
+    ap.add_argument("--quantized-ratio", type=float, default=1.5)
     args = ap.parse_args()
 
     if args.sweep:
         return sweep_gate(args.sweep_ratio)
     if args.plan:
         return plan_gate(args.tolerance, args.plan_ratio)
+    if args.quantized:
+        return quantized_gate(args.quantized_ratio)
 
     try:
         proc = subprocess.run(

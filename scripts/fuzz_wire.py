@@ -98,6 +98,19 @@ def capture_corpus(verbose: bool = True) -> list:
             dst = accl.create_buffer(16, np.float32)
             accl.allreduce(src, dst, 16)
 
+        def eager_quantized(accl, rank):
+            # int8 block-scaled wire lane (r17): captures EgrMsg frames
+            # with hdr.compressed == 2 and the self-describing
+            # [nblocks][block][scales][q] segment framing, so the
+            # mutator exercises the block-frame validation path
+            from accl_tpu.constants import DataType
+
+            src = accl.create_buffer(512, np.float32)
+            src.host[:] = float(rank + 1) * 0.5
+            src.sync_to_device()
+            dst = accl.create_buffer(512, np.float32)
+            accl.allreduce(src, dst, 512, compress_dtype=DataType.int8)
+
         def rendezvous(accl, rank):
             # 2048 B payload > the 1024 B eager ceiling -> rendezvous
             n = 512
@@ -111,6 +124,7 @@ def capture_corpus(verbose: bool = True) -> list:
                 accl.recv(dst, n, src=0, tag=11)
 
         w.run(eager)
+        w.run(eager_quantized)
         w.run(rendezvous)
         # dropped segment -> receiver NACKs -> sender retransmits
         w.devices[1].inject_fault(w.devices[1].FAULT_DROP)
@@ -162,7 +176,7 @@ def capture_corpus(verbose: bool = True) -> list:
 def mutate(rng: XorShift, corpus: list) -> bytes:
     frame = bytearray(rng.choice(corpus))
     for _ in range(1 + rng.below(3)):  # stack 1-3 mutations
-        op = rng.below(7)
+        op = rng.below(8)
         if op == 0 and frame:  # byte flips
             for _ in range(1 + rng.below(8)):
                 frame[rng.below(len(frame))] ^= 1 << rng.below(8)
@@ -187,6 +201,26 @@ def mutate(rng: XorShift, corpus: list) -> bytes:
             other = rng.choice(corpus)
             frame = bytearray(frame[:HEADER_SIZE]) + bytearray(
                 other[HEADER_SIZE:])
+        elif op == 7 and len(frame) >= HEADER_SIZE + 8:
+            # block-scale segment framing smash (r17): hit the payload's
+            # [u32 nblocks][u32 block] header with boundary values —
+            # truncated scale rows (huge nblocks), count/block mismatch
+            # (off-by-one nblocks), oversized/zero blocks — and flip the
+            # wire header's compressed marker so cast-lane payloads get
+            # re-interpreted as block segments and vice versa
+            which = rng.below(3)
+            if which == 0:  # nblocks smash
+                val = rng.choice([0, 1, 2, 3, 255, 0xFFFF, 0xFFFFFFFF])
+                frame[HEADER_SIZE:HEADER_SIZE + 4] = int(val).to_bytes(
+                    4, "little")
+            elif which == 1:  # block smash
+                val = rng.choice([0, 1, 255, 256, 257, 65536, 65537,
+                                  0xFFFFFFFF])
+                frame[HEADER_SIZE + 4:HEADER_SIZE + 8] = int(
+                    val).to_bytes(4, "little")
+            else:  # compressed-marker flip (offset 36: WireHeader)
+                frame[36:40] = int(rng.choice([0, 1, 2, 3])).to_bytes(
+                    4, "little")
     return bytes(frame)
 
 

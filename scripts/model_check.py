@@ -46,6 +46,11 @@ ENGINE_DRILLS = (
     "abort_vs_traffic",
     "join_vs_traffic",
     "shutdown_vs_waiters",
+    # r17: the ROADMAP item 2 KNOWN-ISSUE shape (concurrent sub-comm
+    # allgathers over one rx pool) at its 4-rank exhaustive scale; the
+    # full 8-rank repro is `--drill subcomm_allgather8` with an
+    # explicit budget (heavier per schedule)
+    "subcomm_allgather",
 )
 SENSITIVITY_DRILL = "detach_race"
 

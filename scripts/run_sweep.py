@@ -25,6 +25,10 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--collectives", nargs="*", default=None)
     ap.add_argument("--out", default="-")
+    ap.add_argument("--quantized", action="store_true",
+                    help="run the r17 compression-lane sweep "
+                         "(bandwidth vs exactness per wire lane) "
+                         "instead of the plain collective sweep")
     args = ap.parse_args()
 
     if args.design == "tpu":
@@ -51,7 +55,18 @@ def main() -> int:
         if args.design == "emu-inproc" else initialize_world(design,
                                                              args.nranks)
     try:
-        run_sweep(world, cfg, writer=out)
+        if args.quantized:
+            from accl_tpu.bench.sweep import run_compression_sweep
+
+            run_compression_sweep(
+                world,
+                collectives=tuple(args.collectives)
+                if args.collectives else ("allreduce", "reduce_scatter"),
+                count_pows=range(args.pows[0], args.pows[1] + 1),
+                repetitions=args.reps, writer=out,
+                log=lambda s: print(s, file=sys.stderr))
+        else:
+            run_sweep(world, cfg, writer=out)
     finally:
         world.close()
         if out is not sys.stdout:

@@ -89,6 +89,17 @@ def main() -> int:
     print(f"tune_smoke: verified {len(rows)} cells, "
           f"{len(tuned_cells)} non-static selections, "
           f"{sum(1 for r in rows if r['ratio'] >= 1.15)} wins >= 1.15x")
+    # per-dtype table (r17): the float32 sweep must have MEASURED the
+    # compression lanes — the argmax may or may not pick them on a
+    # given box, but the lanes must be in the candidate set
+    lanes_measured = autotune.algorithms_for(w, cfg.dtype)
+    assert set(autotune.COMPRESSION_ALGS) <= set(lanes_measured), \
+        f"compression lanes missing from the float32 sweep: " \
+        f"{lanes_measured}"
+    comp_cells = [e for e in table.entries.values()
+                  if e["algorithm"] in autotune.COMPRESSION_ALGS]
+    print(f"tune_smoke: compression lanes swept "
+          f"({len(comp_cells)} cells selected a compressed wire)")
 
     table.save(args.table)
     with open(args.compare_out, "w", newline="") as f:
@@ -116,7 +127,19 @@ def main() -> int:
                 return r.host.copy()
 
             outs = w.run(body)
-            assert all(np.array_equal(o, outs[0]) for o in outs)
+            if any(a.compression_policy is not None for a in w.accls):
+                # a table that armed a compressed wire is a LOSSY lane
+                # by contract: ranks agree within relay requantization
+                # ulp, not bitwise (docs/performance.md error model)
+                exact = np.arange(4096, dtype=np.float32) * args.ranks
+                # documented bound: ~P half-steps of the block absmax
+                bound = args.ranks * float(exact.max()) / 127.0
+                for o in outs:
+                    np.testing.assert_allclose(o, exact, atol=bound)
+                    np.testing.assert_allclose(o, outs[0], rtol=1e-5,
+                                               atol=1e-2)
+            else:
+                assert all(np.array_equal(o, outs[0]) for o in outs)
             counters = _metrics.default_registry().snapshot()["counters"]
             selected = {k: v for k, v in counters.items()
                         if k.startswith("tuning/selected/")}
@@ -124,6 +147,15 @@ def main() -> int:
                 "armed policy published no tuning/selected counters: "
                 f"{sorted(counters)[:20]}")
             print(f"tune_smoke: policy armed, selections {selected}")
+            # a table whose cells picked a compress_* lane must have
+            # armed the driver's CompressionPolicy at install (r17)
+            if comp_cells:
+                assert all(a.compression_policy is not None
+                           for a in w.accls), \
+                    "compress_* table cells did not arm a " \
+                    "CompressionPolicy"
+                print("tune_smoke: compression policy armed from the "
+                      f"table: {w.accls[0].compression_policy.spec()}")
         finally:
             w.close()
     finally:

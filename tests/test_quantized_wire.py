@@ -1,0 +1,332 @@
+"""The r17 quantized wire lane end to end: int8 block-scaled
+collectives through the real driver dispatch on both backends.
+
+Gates (the ISSUE-15 acceptance matrix):
+- bitwise gate for the lossless lanes: no policy / ACCL_COMPRESS=0 is
+  bit-identical static dispatch, and lossless results stay exact;
+- per-P error-bound gate for int8 with and without error feedback —
+  one symmetric absmax quantization rounds within scale/2 per element
+  and the ring requantizes per hop, so allreduce error is bounded by
+  ~P half-steps of the partial's block absmax (documented in
+  docs/performance.md "Quantized wire lanes");
+- plan capture/replay carries the quantization config bitwise-stably,
+  and a fenced (abort/reset) plan RAISES instead of replaying stale;
+- policy on/off parity on emu AND tpu-interpret backends;
+- the wire accounting families (engine stats v3 + per-link
+  comp_tx_bytes) actually attribute the compressed traffic.
+"""
+import numpy as np
+import pytest
+
+from accl_tpu.arithconfig import CompressionPolicy
+from accl_tpu.backends.emu import EmuWorld
+from accl_tpu.constants import ACCLError, DataType, ErrorCode, TuningKey
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(n).astype(
+        np.float32)
+
+
+def _err_bound(P: int, inputs) -> float:
+    """Documented per-element bound for the int8 ring allreduce: each
+    of the ~P requantizations rounds within half a step of its running
+    partial, whose block absmax is at most the exact sum's absmax plus
+    accumulated error — bounded loosely by P * max|partial| / 254 per
+    hop, P hops."""
+    amax = float(np.abs(np.sum(inputs, axis=0)).max()) + float(
+        max(np.abs(x).max() for x in inputs))
+    return P * amax / 254.0 * 2.0
+
+
+@pytest.fixture
+def emu4():
+    w = EmuWorld(4, max_eager_size=8192, max_rendezvous_size=1 << 22)
+    yield w
+    w.close()
+
+
+@pytest.fixture
+def tpu4():
+    from accl_tpu.backends.tpu import TpuWorld
+
+    w = TpuWorld(4)
+    yield w
+    w.close()
+
+
+def _allreduce_int8(accl, rank, n, seed_base=0, compress=DataType.int8,
+                    reps=1):
+    data = _rand(n, seed=seed_base + rank)
+    src = accl.create_buffer_like(data)
+    dst = accl.create_buffer(n, np.float32)
+    outs = []
+    for _ in range(reps):
+        accl.allreduce(src, dst, n, compress_dtype=compress)
+        dst.sync_from_device()
+        outs.append(dst.host.copy())
+    return data, outs
+
+
+# ---------------------------------------------------------------------------
+# emu backend: eager ring + rendezvous, error bounds, EF, accounting
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("n,lane", [(1024, "eager"), (8192, "rendezvous")])
+def test_emu_int8_allreduce_error_bound(emu4, n, lane):
+    out = emu4.run(lambda a, r: _allreduce_int8(a, r, n, seed_base=7))
+    inputs = [d for d, _ in out]
+    exact = np.sum(inputs, axis=0)
+    bound = _err_bound(4, inputs)
+    for _, (got,) in out:
+        err = np.abs(got - exact)
+        assert err.max() <= bound, (lane, err.max(), bound)
+        assert err.max() > 0  # genuinely quantized, not lossless
+    # the compressed traffic is attributed: engine stats v3 + per-link
+    st = emu4.devices[0].engine_stats()
+    assert st["version"] >= 3
+    assert st["compressed_tx_bytes"] > 0
+    # ~4:1 — the logical bytes must dominate the wire bytes
+    assert st["compressed_tx_logical_bytes"] > 3 * st["compressed_tx_bytes"]
+    rows = emu4.devices[0].link_stats()
+    assert any(r["comp_tx_bytes"] > 0 for r in rows)
+
+
+def test_emu_int8_reduce_scatter_and_lossless_bitwise(emu4):
+    n = 512
+
+    def body(accl, rank):
+        data = _rand(n * 4, seed=30 + rank)
+        src = accl.create_buffer_like(data)
+        dst = accl.create_buffer(n, np.float32)
+        accl.reduce_scatter(src, dst, n, compress_dtype=DataType.int8)
+        dst.sync_from_device()
+        q = dst.host.copy()
+        # lossless lane stays bitwise on integer-valued data
+        ones = accl.create_buffer_like(np.full(n, rank + 1, np.float32))
+        out = accl.create_buffer(n, np.float32)
+        accl.allreduce(ones, out, n)
+        out.sync_from_device()
+        return data, q, out.host.copy()
+
+    out = emu4.run(body)
+    exact = np.sum([d for d, _q, _l in out], axis=0).reshape(4, n)
+    bound = _err_bound(4, [d for d, _q, _l in out])
+    for rank, (_, q, lossless) in enumerate(out):
+        assert np.abs(q - exact[rank]).max() <= bound
+        assert np.array_equal(lossless, np.full(n, 10.0, np.float32))
+
+
+def test_emu_error_feedback_policy_lane(emu4):
+    """EF selects a distinct arithcfg (the engine-side residual fold);
+    repeated allreduce stays inside the bound and the wire stays 4:1."""
+    n = 2048
+    pol = CompressionPolicy(dtype=DataType.int8, min_bytes=1024,
+                            error_feedback=True)
+
+    def body(accl, rank):
+        accl.set_compression(pol)
+        pair = (DataType.float32, DataType.int8)
+        assert accl._arith_ids_ef[pair] != accl._arith_ids[pair]
+        return _allreduce_int8(accl, rank, n, seed_base=50,
+                               compress=None, reps=4)
+
+    out = emu4.run(body)
+    inputs = [d for d, _ in out]
+    exact = np.sum(inputs, axis=0)
+    bound = _err_bound(4, inputs)
+    for _, outs in out:
+        for got in outs:
+            assert np.abs(got - exact).max() <= bound
+
+
+def test_emu_policy_threshold_and_off_parity(emu4):
+    """Below min_bytes the policy leaves the call lossless (bitwise);
+    disarmed (None) the descriptors are bit-identical to never-armed."""
+    def body(accl, rank):
+        from accl_tpu.constants import Operation
+
+        buf = accl.create_buffer(4096, np.float32)
+        out = accl.create_buffer(4096, np.float32)
+
+        def build(count):
+            return accl._build(Operation.allreduce, count, 0,
+                               op0=buf, res=out)
+
+        baseline = build(4096)
+        pol = CompressionPolicy(dtype=DataType.int8, min_bytes=4096)
+        accl.set_compression(pol)
+        small = build(64)
+        big = build(4096)
+        accl.set_compression(None)
+        off = build(4096)
+        return (baseline.arithcfg, baseline.compression_flags,
+                small.compression_flags, big.compression_flags,
+                big.arithcfg, off.arithcfg, off.compression_flags)
+
+    for (b_cfg, b_fl, small_fl, big_fl, big_cfg, off_cfg,
+         off_fl) in emu4.run(body):
+        assert small_fl == 0  # below the floor: untouched
+        assert big_fl == 8  # ETH_COMPRESSED
+        assert big_cfg != b_cfg  # the int8 pair, not the identity cfg
+        # disarmed == never armed, bit for bit
+        assert (off_cfg, off_fl) == (b_cfg, b_fl)
+
+
+def test_emu_int8_operand_guards(emu4):
+    def body(accl, rank):
+        src8 = accl.create_buffer(256, np.int8)
+        dst = accl.create_buffer(256, np.float32)
+        with pytest.raises(ACCLError, match="float32"):
+            accl.allreduce(src8, dst, 256, compress_dtype=DataType.int8)
+        src64 = accl.create_buffer(256, np.float64)
+        dst64 = accl.create_buffer(256, np.float64)
+        with pytest.raises(ACCLError):
+            accl.allreduce(src64, dst64, 256,
+                           compress_dtype=DataType.int8)
+        return True
+
+    assert all(emu4.run(body))
+
+
+def test_emu_plan_captures_quantization_config(emu4):
+    """Plan capture/replay: the quantization config rides the captured
+    descriptors (zero re-selection on replay), replays are bitwise
+    stable on the no-EF lane, and a fenced plan RAISES."""
+    n = 1024
+
+    def body(accl, rank):
+        data = _rand(n, seed=80 + rank)
+        src = accl.create_buffer_like(data)
+        dst = accl.create_buffer(n, np.float32)
+
+        def step(a):
+            a.allreduce(src, dst, n, compress_dtype=DataType.int8)
+
+        plan = accl.capture_plan(step)
+        dst.sync_from_device()
+        captured = dst.host.copy()
+        results = []
+        for _ in range(2):
+            plan.replay()
+            dst.sync_from_device()
+            results.append(dst.host.copy())
+        return data, captured, results, plan, accl, dst
+
+    out = emu4.run(body)
+    inputs = [d for d, *_ in out]
+    exact = np.sum(inputs, axis=0)
+    bound = _err_bound(4, inputs)
+    for _, captured, results, _pl, _a, _d in out:
+        # same descriptors, same engine lanes, same inputs -> replay
+        # reproduces the capture iteration bit for bit (no EF state)
+        for got in results:
+            assert np.array_equal(got, captured)
+        assert np.abs(captured - exact).max() <= bound
+
+    # fence the world: a stale replay must raise, never run
+    def fence(accl, rank):
+        accl.reset_errors()
+        return True
+
+    assert all(emu4.run(fence))
+    for _, _c, _r, plan, _a, _d in out:
+        with pytest.raises(ACCLError) as ei:
+            plan.replay()
+        assert (int(getattr(ei.value, "code", 0))
+                & int(ErrorCode.COMM_ABORTED)) or "invalid" in str(
+                    ei.value).lower() or "fenc" in str(ei.value).lower()
+
+
+def test_emu_compress_env_off_is_static(emu4, monkeypatch):
+    monkeypatch.setenv("ACCL_COMPRESS", "0")
+    from accl_tpu.arithconfig import compression_policy_from_env
+
+    assert compression_policy_from_env() is None
+    monkeypatch.setenv("ACCL_COMPRESS", "granite")
+    with pytest.raises(ACCLError, match="ACCL_COMPRESS"):
+        compression_policy_from_env()
+
+
+# ---------------------------------------------------------------------------
+# tpu-interpret backend: quantized ring + flat lanes, policy parity
+# ---------------------------------------------------------------------------
+def test_tpu_int8_ring_and_flat_error_bound(tpu4):
+    n = 2048
+    for thr, lane in ((0, "ring"), (1 << 30, "flat")):
+        for a in tpu4.accls:
+            a.set_tuning(int(TuningKey.RING_THRESHOLD_BYTES), thr)
+        out = tpu4.run(lambda a, r: _allreduce_int8(a, r, n,
+                                                    seed_base=90))
+        inputs = [d for d, _ in out]
+        exact = np.sum(inputs, axis=0)
+        bound = _err_bound(4, inputs)
+        for _, (got,) in out:
+            err = np.abs(got - exact)
+            assert 0 < err.max() <= bound, (lane, err.max(), bound)
+    # accounting twin: compressed bytes attributed at gang dispatch
+    st = tpu4.devices[0].engine_stats()
+    assert st["version"] >= 3
+    assert st["compressed_tx_bytes"] > 0
+    rows = tpu4.devices[0].link_stats()
+    assert any(r.get("comp_tx_bytes", 0) > 0 for r in rows)
+
+
+def test_tpu_policy_on_off_parity(tpu4):
+    n = 1024
+
+    def body(accl, rank):
+        from accl_tpu.constants import Operation
+
+        buf = accl.create_buffer(n, np.float32)
+        out = accl.create_buffer(n, np.float32)
+
+        def build():
+            return accl._build(Operation.allreduce, n, 0,
+                               op0=buf, res=out)
+
+        base = build()
+        accl.set_compression(CompressionPolicy(dtype=DataType.int8,
+                                               min_bytes=256))
+        armed = build()
+        accl.set_compression(None)
+        off = build()
+        return base.arithcfg, base.compression_flags, \
+            armed.compression_flags, off.arithcfg, off.compression_flags
+
+    for b_cfg, b_fl, armed_fl, off_cfg, off_fl in tpu4.run(body):
+        assert armed_fl == 8
+        assert (off_cfg, off_fl) == (b_cfg, b_fl)
+
+
+def test_tpu_lossless_bitwise_with_lane_registered(tpu4):
+    """Registering the int8 arithcfg must not perturb the lossless
+    lanes: integer-valued allreduce stays exact."""
+    n = 512
+
+    def body(accl, rank):
+        src = accl.create_buffer_like(np.full(n, rank + 1, np.float32))
+        dst = accl.create_buffer(n, np.float32)
+        accl.allreduce(src, dst, n)
+        dst.sync_from_device()
+        return dst.host.copy()
+
+    for got in tpu4.run(body):
+        assert np.array_equal(got, np.full(n, 10.0, np.float32))
+
+
+def test_wire_saved_bytes_metric_families(emu4):
+    """The sampler publishes wire/compressed_tx_bytes and the derived
+    bytes-saved family from the engine's v3 counters."""
+    from accl_tpu.observability import telemetry as obs_telemetry
+    from accl_tpu.observability.metrics import MetricsRegistry
+
+    emu4.run(lambda a, r: _allreduce_int8(a, r, 2048, seed_base=3))
+    reg = MetricsRegistry()
+    sampler = obs_telemetry.TelemetrySampler(
+        [d.engine_stats for d in emu4.devices], registry=reg)
+    sampler.sample()
+    counters = reg.counters()
+    assert counters.get("wire/compressed_tx_bytes", 0) > 0
+    assert counters.get("wire/compressed_saved_bytes", 0) > 0
+    assert counters["wire/compressed_saved_bytes"] > \
+        2 * counters["wire/compressed_tx_bytes"]

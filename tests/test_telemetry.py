@@ -49,9 +49,9 @@ def test_engine_stats_schema_and_traffic():
         stats = world.engine_stats()
         assert len(stats) == world.nranks
         for st in stats:
-            assert st["version"] == 2
-            for field in obs_telemetry.ENGINE_STATS_FIELDS_V2:
-                assert field in st, f"missing v2 field {field}"
+            assert st["version"] == 3
+            for field in obs_telemetry.ENGINE_STATS_FIELDS_V3:
+                assert field in st, f"missing v3 field {field}"
             # no unknown fields from a same-version engine
             assert not any(k.startswith("unknown_field_") for k in st)
         # traffic really flowed through the counters
@@ -102,6 +102,11 @@ def test_decode_keeps_newer_engine_fields():
      obs_telemetry.ENGINE_STATS_FIELDS_V1),
     (2, obs_telemetry.ENGINE_STATS_FIELDS_V2,
      obs_telemetry.ENGINE_STATS_FIELDS_V2),
+    # v3 (r17 quantized-wire pair) both ways
+    (2, obs_telemetry.ENGINE_STATS_FIELDS_V3,
+     obs_telemetry.ENGINE_STATS_FIELDS_V2),
+    (3, obs_telemetry.ENGINE_STATS_FIELDS_V3,
+     obs_telemetry.ENGINE_STATS_FIELDS_V3),
 ])
 def test_decode_engine_stats_version_table(decoder_version,
                                            engine_fields, expect_known):
@@ -209,7 +214,7 @@ def test_tpu_engine_stats_schema():
 
         world.run(body)
         st = world.devices[0].engine_stats()
-        assert st["version"] == 2
+        assert st["version"] == 3
         assert st["link_rows"] >= 1  # the link twin saw ring traffic
         assert st["leader_dispatches"] + st["executor_dispatches"] > 0
         for k in ("plans_live", "plan_ring_refs",
