@@ -172,6 +172,12 @@ class ACCL:
         #: (the default) adds one falsy read in _build's memo-miss path
         #: — dispatch is bit-identical static with the knob unset.
         self._compress_policy = None
+        #: fused compute/communication lane default (r18): per-call
+        #: ``fused=`` overrides; None here resolves to the ACCL_FUSED
+        #: env read once at construction.  Unset, every descriptor
+        #: carries fused=False and dispatch is bit-identical to r17.
+        self._fused_default = os.environ.get(
+            "ACCL_FUSED", "0") not in ("", "0")
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -1054,12 +1060,15 @@ class ACCL:
         to_fpga: bool = False,
         compress_dtype: Optional[DataType] = None,
         run_async: bool = False,
+        fused: Optional[bool] = None,
     ):
-        """All-gather (reference: accl.cpp:571)."""
+        """All-gather (reference: accl.cpp:571).  ``fused``: see
+        allreduce."""
         comm = self.communicator(comm_id)
         call = self._build(
             Operation.allgather, count, comm_id,
             op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
+            fused=fused,
         )
         return self._execute(call,
                              sync_in=[] if from_fpga else [(sendbuf, count)],
@@ -1123,11 +1132,15 @@ class ACCL:
         to_fpga: bool = False,
         compress_dtype: Optional[DataType] = None,
         run_async: bool = False,
+        fused: Optional[bool] = None,
     ):
-        """All-reduce (reference: accl.cpp:796)."""
+        """All-reduce (reference: accl.cpp:796).  ``fused`` opts the call
+        into the r18 chunked compute/communication-overlap lane (None =
+        the driver's ACCL_FUSED default)."""
         call = self._build(
             Operation.allreduce, count, comm_id, function=int(function),
             op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
+            fused=fused,
         )
         return self._execute(call, sync_in=[] if from_fpga else [(sendbuf, count)],
                              sync_out=[] if to_fpga else [(recvbuf, count)],
@@ -1144,13 +1157,15 @@ class ACCL:
         to_fpga: bool = False,
         compress_dtype: Optional[DataType] = None,
         run_async: bool = False,
+        fused: Optional[bool] = None,
     ):
         """Reduce-scatter: each rank ends with `count` reduced elements
-        (reference: accl.cpp:844)."""
+        (reference: accl.cpp:844).  ``fused``: see allreduce."""
         comm = self.communicator(comm_id)
         call = self._build(
             Operation.reduce_scatter, count, comm_id, function=int(function),
             op0=sendbuf, res=recvbuf, compress_dtype=compress_dtype,
+            fused=fused,
         )
         return self._execute(call,
                              sync_in=[] if from_fpga else [(sendbuf, count * comm.size)],
@@ -1205,6 +1220,7 @@ class ACCL:
         compress_dtype: Optional[DataType] = None,
         op0_dtype: Optional[DataType] = None,
         res_dtype: Optional[DataType] = None,
+        fused: Optional[bool] = None,
     ) -> CCLOCall:
         """Build a call descriptor: select the arithmetic config from the
         (uncompressed, compressed) dtype pair, derive per-operand and
@@ -1250,9 +1266,14 @@ class ACCL:
             return (None if b is None
                     else (b.address, b.data_type, b.is_host_only))
 
+        # per-call fused=None resolves to the driver default HERE so the
+        # memo key carries the resolved bool (two calls differing only
+        # in fused must not share a descriptor)
+        fused = self._fused_default if fused is None else bool(fused)
         memo_key = (scenario, count, comm_id, root_src_dst, function, tag,
                     _bkey(op0), _bkey(op1), _bkey(res),
-                    stream_flags, compress_dtype, op0_dtype, res_dtype)
+                    stream_flags, compress_dtype, op0_dtype, res_dtype,
+                    fused)
         cached = self._call_memo.get(memo_key)
         if cached is not None:
             self._call_memo.move_to_end(memo_key)
@@ -1392,6 +1413,7 @@ class ACCL:
             addr_0=op0.address,
             addr_1=op1.address,
             addr_2=res.address,
+            fused=fused,
         )
         self._call_memo[memo_key] = call
         while len(self._call_memo) > self._call_memo_cap:
@@ -1438,7 +1460,14 @@ class ACCL:
         # consult only records/serves the per-call decision (metrics
         # family tuning/selected/<algorithm>)
         if self._tune_policy is not None:
-            self._tune_policy.on_call(self, call)
+            alg = self._tune_policy.on_call(self, call)
+            # the r18 fused lane is a DESCRIPTOR opt-in, not a backend
+            # register: a table cell won by "fused" arms the memoized
+            # call object once (idempotent — _build returns the same
+            # object per signature, so every later call of this
+            # signature rides the fused gang plan)
+            if alg == "fused" and not call.fused:
+                call.fused = True
         # plan auto-replay (ACCL_PLAN_AUTO, accl_tpu/plans.py): a call
         # whose gang agreed to arm a one-step ring replays through it —
         # no descriptor work, no gang assembly, no per-call request

@@ -1552,7 +1552,7 @@ class TpuEngine:
             if not self._ready:
                 return None
         plan = self._gang_plan(op, comm_id, gang)
-        if plan["fn_args"][-1]:
+        if plan["fn_args"][8]:
             # ring=True: the Pallas ring kernels assign fixed
             # collective_ids per segment parity; fusing two instances
             # into one program would give data-independent rings the
@@ -1691,7 +1691,8 @@ class TpuEngine:
         # silently keep serving the previously-compiled lowering
         sig = (int(op), comm_id, self.ring_threshold_bytes, tuple(
             (g, c.addr_0, c.addr_2, c.count, c.root_src_dst, c.function,
-             c.compression_flags, c.arithcfg, c.stream_flags, c.tag)
+             c.compression_flags, c.arithcfg, c.stream_flags, c.tag,
+             c.fused)
             for g, c in ((m, gang[m][0]) for m in members)))
         # _gang_plan runs only on the dispatching context — the
         # executor thread or (leader-dispatch lane) the one inline
@@ -1791,10 +1792,18 @@ class TpuEngine:
                 and in_len * np.dtype(dtype).itemsize
                 >= self.ring_threshold_bytes)
 
+        # r18 fused lane (descriptor opt-in): the chunked pipelined ring
+        # that overlaps chunk k+1's wire hop with chunk k's fold; takes
+        # precedence over the threshold-selected ring/HLO paths
+        fused = (bool(any_call.fused)
+                 and op in (Operation.allreduce, Operation.allgather,
+                            Operation.reduce_scatter)
+                 and nranks > 1)
+
         # compiled once per (mesh, op, shape, root, func, ...) and
         # cached (no donation — see _collective_fn)
         fn_args = (mesh, op, nranks, in_len, root, func, wire_dtype,
-                   str(np.dtype(dtype)), ring)
+                   str(np.dtype(dtype)), ring, fused)
         compiled = (None if op == Operation.barrier
                     else _collective_fn(*fn_args))
         plan = {
@@ -2090,7 +2099,8 @@ def _tree_gather(v, nranks: int, root: int):
 @lru_cache(maxsize=256)
 def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
                    func: int, wire_dtype: str, dtype: str,
-                   ring: bool = False, nbatch: int = 1) -> Callable:
+                   ring: bool = False, fused: bool = False,
+                   nbatch: int = 1) -> Callable:
     """Build + AOT-compile the SPMD program for one collective: a
     shard_map whose inner program is the XLA HLO collective (or the
     ppermute tree schedule) over ICI — or, with ``ring=True``, the
@@ -2160,9 +2170,41 @@ def _collective_fn(mesh, op: Operation, nranks: int, in_len: int, root: int,
                          Operation.reduce_scatter)
               and in_len % nranks == 0)
 
+    # r18 fused lane twin of q_ring: the int8 quantize/dequantize runs
+    # INSIDE the chunked pipeline loop (no whole-buffer pack/unpack)
+    fused_q = fused and wire_name == "int8" and not is_max
+
+    def fused_body(v):
+        from ..ops import fused as fused_ops
+
+        if fused_q:
+            w = (wire_block, wire_ef)
+            if op == Operation.allreduce:
+                return fused_ops.chunked_ring_all_reduce(
+                    v.astype(jnp.float32), "rank",
+                    wire=w).astype(v.dtype)
+            if op == Operation.allgather:
+                return fused_ops.chunked_ring_all_gather(
+                    v.astype(jnp.float32), "rank",
+                    wire=w).astype(v.dtype)
+            return fused_ops.chunked_ring_reduce_scatter(
+                v.astype(jnp.float32), "rank", wire=w).astype(v.dtype)
+        v = quant(v)
+        if op == Operation.allreduce:
+            out = fused_ops.chunked_ring_all_reduce(v, "rank", op=red)
+        elif op == Operation.allgather:
+            out = fused_ops.chunked_ring_all_gather(v, "rank")
+        else:
+            out = fused_ops.chunked_ring_reduce_scatter(v, "rank", op=red)
+        return quant(out)
+
     def body(v):  # v: [in_len] block on each device (1-D global layout:
         # the per-rank shard IS the member's buffer, no reshape on the
         # way in or out — the gang hot path stays dispatch-free)
+        if fused:
+            # the fused lane owns its wire hops end to end (int8 inside
+            # the loop body; cast lanes roundtrip at the endpoints)
+            return fused_body(v)
         if q_ring:
             # the quantized kernels own the wire hops end to end — no
             # extra entry/exit roundtrip (that would double-quantize)

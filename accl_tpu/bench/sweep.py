@@ -12,6 +12,7 @@ allreduce-busbw metric of record (BASELINE.md).
 """
 from __future__ import annotations
 
+import contextlib
 import csv
 import io
 import os
@@ -356,12 +357,224 @@ def run_compression_sweep(world, collectives=("allreduce",
     return rows
 
 
+# ---------------------------------------------------------------------------
+# fused-overlap A/B lane (r18): exposed wire vs compute cover per cell
+# ---------------------------------------------------------------------------
+
+#: wire lanes the fused A/B measures: lossless fp32 and the r17 int8
+#: block-scaled lane fused into the chunk loop (no whole-buffer pack)
+FUSED_WIRE_LANES = ("fp32", "int8")
+
+
+@contextlib.contextmanager
+def _rank_window(rank: int, label: str):
+    """Per-RANK compute window span (trace.traced_window stamps the
+    host pseudo-rank 9999; the overlap accountant intersects wire
+    intervals with compute windows on the SAME rank, so the A/B lane
+    needs the span pinned to the calling rank's pid)."""
+    from ..observability import trace as _trace
+
+    span = _trace.new_span(f"window:{label}", rank=rank)
+    if span is not None:
+        span.t_submit = span.t_queue = span.t_dispatch = _trace.now_ns()
+        span.lane = "window"
+    try:
+        yield
+    finally:
+        if span is not None:
+            span.t_device_begin = span.t_submit
+            span.t_device_end = span.t_complete = _trace.now_ns()
+            _trace.collector().add(span)
+
+
+def _flight_marks() -> dict:
+    """Per-recorder flight-ring seq watermark — records landed after
+    this mark belong to the current cell (same discipline as the
+    autotuner's overlap column, tuning/autotune._overlap_marks)."""
+    from ..observability import flight as _flight
+
+    return {id(r): (r, max((rec.seq for rec in r.records()),
+                           default=-1))
+            for r in _flight.recorders()}
+
+
+def _exposed_since(marks: dict) -> Optional[float]:
+    """Measured ``attribution.overlap`` exposed-wire fraction
+    (exposed_us / wire_us summed over collectives) of the flight
+    records landed since ``marks``, against the trace collector's
+    current compute cover (host ``window:`` spans + device stamp
+    slices).  None when nothing completed."""
+    from ..constants import ACCLError
+    from ..observability import attribution as _attr
+    from ..observability import flight as _flight
+    from ..observability import trace as _trace
+
+    docs = []
+    for rec, mark in marks.values():
+        d = rec.dump()
+        d["records"] = [r for r in d["records"] if r["seq"] > mark]
+        docs.append(d)
+    if not docs:
+        return None
+    try:
+        rep = _attr.overlap(_flight.merge_flight_dumps(docs),
+                            trace_doc=_trace.collector().to_perfetto())
+    except (ACCLError, ValueError, KeyError):
+        return None
+    wire = sum(c["wire_us"] for c in rep["collectives"].values())
+    exposed = sum(c["exposed_us"] for c in rep["collectives"].values())
+    return round(exposed / wire, 4) if wire > 0 else None
+
+
+def run_fused_overlap_sweep(world, collectives=("allreduce",
+                                                "reduce_scatter"),
+                            count_pows=range(14, 17),
+                            repetitions: int = 3, mm_dim: int = 256,
+                            mm_loops: int = 2,
+                            writer: Optional[io.TextIOBase] = None,
+                            log=None) -> list[dict]:
+    """A/B the r18 fused compute/communication lane against the
+    sequential schedule, per (wire lane, collective, size) cell.
+
+    Both arms run the SAME matmul workload and the SAME collective:
+
+    - ``sequential`` — compute first, then issue the collective
+      synchronously: zero cover, the wire is fully exposed (the
+      measured exposed-wire fraction sits at ~1.0).
+    - ``fused`` — dispatch the chunked fused collective async
+      (``fused=True, run_async=True``) and run the matmul while the
+      wire drains, then wait: the wire interval intersects the
+      rank's compute window and the exposed fraction drops by the
+      covered share.
+
+    Columns per row: best-of-reps step time, busbw of the collective
+    payload, and the measured ``attribution.overlap`` exposed-wire
+    fraction over the cell's timed reps (host ``window:mxu`` spans as
+    compute cover — the same accountant scripts/perf_doctor.py and the
+    autotuner's overlap column run).  Sizes default to 64-256 KiB
+    fp32 payloads (the ISSUE's >= 64 KiB floor)."""
+    import jax.numpy as jnp
+
+    from ..constants import DataType
+    from ..observability import trace as _trace
+
+    if not _trace.enabled():
+        _trace.enable()
+    P = world.nranks
+    dtype = np.dtype(np.float32)
+    rows: list[dict] = []
+    csv_writer = None
+    if writer is not None:
+        csv_writer = csv.DictWriter(writer, fieldnames=[
+            "wire", "collective", "count", "bytes", "mode",
+            "duration_us", "busbw_GBps", "exposed_wire_fraction"])
+        csv_writer.writeheader()
+
+    def body_factory(coll, count, cd, mode):
+        fused = mode == "fused"
+
+        def compute(rank):
+            # fixed per-rank matmul chain — the "MXU work" both arms
+            # pay identically; block_until_ready keeps the window span
+            # honest (jax would otherwise return before the FLOPs)
+            with _rank_window(rank, "mxu"):
+                a = jnp.full((mm_dim, mm_dim), (rank + 1) / mm_dim,
+                             jnp.float32)
+                for _ in range(mm_loops):
+                    a = (a @ a) * (1.0 / mm_dim)
+                a.block_until_ready()
+
+        def body(accl, rank):
+            made = []
+
+            def mk(factory, *a):
+                buf = factory(*a)
+                made.append(buf)
+                return buf
+
+            data = np.full(count * (P if coll == "reduce_scatter"
+                                    else 1), rank + 1, dtype)
+            try:
+                src = mk(accl.create_buffer_like, data)
+                dst = mk(accl.create_buffer, count, dtype)
+
+                def issue(run_async):
+                    if coll == "allreduce":
+                        return accl.allreduce(
+                            src, dst, count, ReduceFunction.SUM,
+                            compress_dtype=cd, run_async=run_async,
+                            fused=fused)
+                    return accl.reduce_scatter(
+                        src, dst, count, ReduceFunction.SUM,
+                        compress_dtype=cd, run_async=run_async,
+                        fused=fused)
+
+                t0 = time.perf_counter()
+                if mode == "sequential":
+                    compute(rank)
+                    issue(run_async=False)
+                else:
+                    req = issue(run_async=True)
+                    compute(rank)
+                    req.wait(60)
+                return time.perf_counter() - t0
+            finally:
+                for buf in made:
+                    free = getattr(buf, "free", None)
+                    if free is not None:
+                        free()
+
+        return body
+
+    for coll in collectives:
+        for pw in count_pows:
+            count = 1 << pw
+            for wire in FUSED_WIRE_LANES:
+                cd = DataType.int8 if wire == "int8" else None
+                for mode in ("sequential", "fused"):
+                    body = body_factory(coll, count, cd, mode)
+                    world.run(body)  # warmup: jit + gang plan
+                    # isolate the cell's cover windows + flight records
+                    _trace.collector().clear()
+                    marks = _flight_marks()
+                    dur = min(max(world.run(body))
+                              for _ in range(repetitions))
+                    exposed = _exposed_since(marks)
+                    nbytes = count * _payload_factor(coll, P) \
+                        * dtype.itemsize
+                    algbw = nbytes / dur / 1e9 if dur > 0 else 0.0
+                    row = {
+                        "wire": wire,
+                        "collective": coll,
+                        "count": count,
+                        "bytes": nbytes,
+                        "mode": mode,
+                        "duration_us": round(dur * 1e6, 2),
+                        "busbw_GBps": round(
+                            algbw * _busbw_factor(coll, P), 4),
+                        "exposed_wire_fraction": exposed,
+                    }
+                    rows.append(row)
+                    if csv_writer:
+                        csv_writer.writerow(row)
+                    if log:
+                        ex = ("-" if exposed is None
+                              else f"{exposed:.3f}")
+                        log(f"  {wire:>5} {coll:<14} {count:>8} elems "
+                            f"{mode:>10} {row['duration_us']:>10.1f} us"
+                            f"  exposed {ex}")
+    return rows
+
+
 def _run_once(world, coll: str, count: int, dtype, root: int,
-              compress=None) -> float:
+              compress=None, fused=None) -> float:
     """One timed collective across all ranks; returns max duration (s).
     ``compress`` optionally selects a wire-compression dtype
     (constants.DataType) for the collectives that take one — the r17
-    compression lanes of the autotuner sweep through here."""
+    compression lanes of the autotuner sweep through here.  ``fused``
+    opts the call into the r18 chunked fused lane (allreduce /
+    reduce_scatter / allgather only); None leaves the driver default
+    (ACCL_FUSED env) in charge."""
     P = world.nranks
 
     def body(accl, rank):
@@ -418,7 +631,8 @@ def _run_once(world, coll: str, count: int, dtype, root: int,
             send = mk(accl.create_buffer_like, data)
             recv = mk(accl.create_buffer, count * P, dtype)
             t0 = time.perf_counter()
-            accl.allgather(send, recv, count, compress_dtype=compress)
+            accl.allgather(send, recv, count, compress_dtype=compress,
+                           fused=fused)
             return time.perf_counter() - t0
         if coll == "reduce":
             send = mk(accl.create_buffer_like, data)
@@ -432,14 +646,14 @@ def _run_once(world, coll: str, count: int, dtype, root: int,
             recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             accl.allreduce(send, recv, count, ReduceFunction.SUM,
-                           compress_dtype=compress)
+                           compress_dtype=compress, fused=fused)
             return time.perf_counter() - t0
         if coll == "reduce_scatter":
             send = mk(accl.create_buffer_like, np.tile(data, P))
             recv = mk(accl.create_buffer, count, dtype)
             t0 = time.perf_counter()
             accl.reduce_scatter(send, recv, count, ReduceFunction.SUM,
-                                compress_dtype=compress)
+                                compress_dtype=compress, fused=fused)
             return time.perf_counter() - t0
         if coll == "alltoall":
             send = mk(accl.create_buffer_like, np.tile(data, P))

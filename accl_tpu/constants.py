@@ -317,6 +317,11 @@ class CCLOCall:
     addr_0: int = 0
     addr_1: int = 0
     addr_2: int = 0
+    #: r18 fused-lane hint — NOT part of the 15-word wire ABI (the
+    #: reference has no such field; fusion is a backend scheduling
+    #: decision).  Riding on the call object keeps it visible to plan
+    #: capture/replay and the gang scheduler without widening to_words.
+    fused: bool = False
 
     def to_words(self) -> list[int]:
         """Serialize to the 15-word stream format pushed to the engine."""

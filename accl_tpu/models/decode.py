@@ -95,7 +95,7 @@ def _grouped_cached_attention(q, kc, vc, pos, window=None):
 
 
 def prefill(params, tokens, cache: dict, cfg: ModelConfig,
-            tp_axis: Optional[str] = None):
+            tp_axis: Optional[str] = None, fused: bool = False):
     """Run the prompt once, filling the cache: tokens [B, Tp] →
     (logits [B, Tp, vocab], cache with pos = prior pos + Tp).
     Continuation prefills (non-zero starting pos) append after the
@@ -126,19 +126,19 @@ def prefill(params, tokens, cache: dict, cfg: ModelConfig,
         new_layers.append({"k": kc, "v": vc})
         attn = _grouped_cached_attention(
             q, kc, vc, pos0, window=cfg.attn_window).astype(cfg.jdtype)
-        x = block_attn_out(x, attn, blk, cfg, tp_axis)
-        x = block_mlp(x, blk, cfg, tp_axis)
+        x = block_attn_out(x, attn, blk, cfg, tp_axis, fused=fused)
+        x = block_mlp(x, blk, cfg, tp_axis, fused=fused)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(cfg.jdtype))
     return logits, {"pos": pos0 + Tp, "layers": new_layers}
 
 
 def decode_step(params, token, cache: dict, cfg: ModelConfig,
-                tp_axis: Optional[str] = None):
+                tp_axis: Optional[str] = None, fused: bool = False):
     """One autoregressive step: token [B] int32 → (logits [B, vocab],
     cache advanced by one)."""
     logits, cache = prefill(params, token[:, None], cache, cfg,
-                            tp_axis=tp_axis)
+                            tp_axis=tp_axis, fused=fused)
     return logits[:, 0], cache
 
 
@@ -163,19 +163,20 @@ def _select(lg, key, temperature: float, top_k):
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new", "tp_axis",
-                                   "temperature", "top_k"))
+                                   "temperature", "top_k", "fused"))
 def _generate_impl(params, prompt, key, cfg: ModelConfig, max_new: int,
-                   tp_axis, temperature: float, top_k):
+                   tp_axis, temperature: float, top_k, fused: bool = False):
     B, Tp = prompt.shape
     cache = init_kv_cache(cfg, B, Tp + max_new)
-    logits, cache = prefill(params, prompt, cache, cfg, tp_axis=tp_axis)
+    logits, cache = prefill(params, prompt, cache, cfg, tp_axis=tp_axis,
+                            fused=fused)
     key, sub = jax.random.split(key)
     first = _select(logits[:, -1], sub, temperature, top_k)
 
     def step(carry, skey):
         token, cache = carry
         lg, cache = decode_step(params, token, cache, cfg,
-                                tp_axis=tp_axis)
+                                tp_axis=tp_axis, fused=fused)
         nxt = _select(lg, skey, temperature, top_k)
         return (nxt, cache), token
 
@@ -186,7 +187,7 @@ def _generate_impl(params, prompt, key, cfg: ModelConfig, max_new: int,
 
 def generate(params, prompt, cfg: ModelConfig, max_new: int,
              tp_axis: Optional[str] = None, temperature: float = 0.0,
-             top_k: Optional[int] = None, key=None):
+             top_k: Optional[int] = None, key=None, fused: bool = False):
     """Autoregressive generation: prompt [B, Tp] int32 → generated
     [B, max_new] int32.  The whole pipeline (prefill + the scan of
     decode steps) is one jit-compiled program; the cache capacity is
@@ -195,7 +196,10 @@ def generate(params, prompt, cfg: ModelConfig, max_new: int,
     `temperature=0` (default) is greedy argmax; a positive temperature
     samples from the scaled distribution, optionally truncated to the
     `top_k` most likely tokens — pass a `jax.random` key for
-    reproducible sampling (defaults to PRNGKey(0))."""
+    reproducible sampling (defaults to PRNGKey(0)).
+
+    ``fused=True`` routes the per-block tp combines through the r18
+    fused (pipelined) allreduce — meaningful only with a tp axis."""
     if top_k is not None and not 1 <= top_k <= cfg.vocab:
         # validate eagerly (top_k is static): under jit an invalid k
         # would be clamped and silently turn top-k sampling into plain
@@ -205,4 +209,4 @@ def generate(params, prompt, cfg: ModelConfig, max_new: int,
     if key is None:
         key = jax.random.PRNGKey(0)
     return _generate_impl(params, prompt, key, cfg, max_new, tp_axis,
-                          float(temperature), top_k)
+                          float(temperature), top_k, bool(fused))

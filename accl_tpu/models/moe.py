@@ -86,14 +86,19 @@ def param_specs(cfg: MoEConfig, ep: Optional[str] = "ep") -> dict:
 
 
 def _moe_ffn(h, blk, cfg: MoEConfig, ep_axis: Optional[str],
-             capacity: Optional[int] = None):
+             capacity: Optional[int] = None, fused: bool = False):
     """Top-1 routed FFN.  h: [B, T, D] -> [B, T, D] + aux loss scalar.
 
     `capacity` overrides the training-time per-expert budget (ceil of
     B*T*capacity_factor/E).  Serving callers pass the full token count:
     at decode the per-call token count is tiny, so the training formula
     would drop (zero out) any token beyond ~B/E routed to one expert —
-    a silent divergence from the dense reference (moe_decode.py)."""
+    a silent divergence from the dense reference (moe_decode.py).
+
+    ``fused=True`` (r18, ep path only) splits the capacity dimension
+    into chunks and pipelines the dispatch/combine alltoalls under the
+    expert FFN compute (ops.fused.fused_expert_ffn) — the chunked
+    routing is bitwise-equal to dispatch → FFN → combine."""
     B, T, D = h.shape
     x = h.reshape(B * T, D)
     logits = jnp.einsum("nd,de->ne", x, blk["router"].astype(cfg.jdtype))
@@ -117,14 +122,23 @@ def _moe_ffn(h, blk, cfg: MoEConfig, ep_axis: Optional[str],
         from ..parallel.strategies import expert_combine, expert_dispatch
         cap = (capacity if capacity is not None else
                int(np.ceil(B * T * cfg.capacity_factor / cfg.n_experts)))
-        inputs, info = expert_dispatch(x, expert_idx, ep_axis, capacity=cap)
         # this member's expert bank slice: [1, D, F] under ep sharding
         w1 = blk["we1"].astype(cfg.jdtype)[0]
         w2 = blk["we2"].astype(cfg.jdtype)[0]
-        y_e = jnp.einsum("nd,df->nf", inputs, w1)
-        y_e = jax.nn.gelu(y_e)
-        y_e = jnp.einsum("nf,fd->nd", y_e, w2)
-        y = expert_combine(y_e, info, ep_axis)
+
+        def expert_body(t):
+            z = jnp.einsum("nd,df->nf", t, w1)
+            z = jax.nn.gelu(z)
+            return jnp.einsum("nf,fd->nd", z, w2)
+
+        if fused:
+            from ..ops.fused import fused_expert_ffn
+            y = fused_expert_ffn(x, expert_idx, expert_body, ep_axis,
+                                 capacity=cap)
+        else:
+            inputs, info = expert_dispatch(x, expert_idx, ep_axis,
+                                           capacity=cap)
+            y = expert_combine(expert_body(inputs), info, ep_axis)
 
     y = y * gate.astype(cfg.jdtype)[:, None]
     return y.reshape(B, T, D), aux
@@ -148,7 +162,8 @@ def moe_block_attn_out(x, attn, blk, cfg: MoEConfig):
                           blk["wo"].astype(cfg.jdtype))
 
 
-def forward(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
+def forward(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None,
+            fused: bool = False):
     """Token ids [B, T] -> (logits [B, T, vocab], total aux loss)."""
     x = params["embed"][tokens].astype(cfg.jdtype)
     aux_total = jnp.zeros((), jnp.float32)
@@ -158,7 +173,7 @@ def forward(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
         attn = _dense_attention(q, k, v, causal=True)
         x = moe_block_attn_out(x, attn, blk, cfg)
         h = _rmsnorm(x, blk["ln2"])
-        m, aux = _moe_ffn(h, blk, cfg, ep_axis)
+        m, aux = _moe_ffn(h, blk, cfg, ep_axis, fused=fused)
         aux_total = aux_total + aux
         x = x + m
     x = _rmsnorm(x, params["ln_f"])
@@ -166,7 +181,8 @@ def forward(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
     return logits, aux_total
 
 
-def loss_fn(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
+def loss_fn(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None,
+            fused: bool = False):
     """Next-token cross entropy + router load-balance aux.
 
     Returns ``(loss_sum, count)`` local to the device — the same
@@ -175,7 +191,7 @@ def loss_fn(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
     (``aux * count``) so that after global division by total count the
     result is the token-weighted mean of per-device aux losses."""
     B, T = tokens.shape
-    logits, aux = forward(params, tokens, cfg, ep_axis)
+    logits, aux = forward(params, tokens, cfg, ep_axis, fused=fused)
     logits = logits.astype(jnp.float32)
     labels = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
@@ -189,7 +205,8 @@ def loss_fn(params, tokens, cfg: MoEConfig, ep_axis: Optional[str] = None):
 
 
 def make_train_step(mesh, cfg: MoEConfig, lr: float = 1e-3,
-                    dp: Optional[str] = "dp", ep: Optional[str] = "ep"):
+                    dp: Optional[str] = "dp", ep: Optional[str] = "ep",
+                    fused: bool = False):
     """Jitted SPMD train step: tokens shard over dp, expert banks over
     ep; routing rides the ep alltoall inside the step.
 
@@ -212,7 +229,8 @@ def make_train_step(mesh, cfg: MoEConfig, lr: float = 1e-3,
         # only by the vma transpose); everything else follows the shared
         # sum-and-count discipline
         return sum_count_device_step(
-            lambda p: loss_fn(p, tokens, cfg, ep), params, data_axes, lr)
+            lambda p: loss_fn(p, tokens, cfg, ep, fused=fused),
+            params, data_axes, lr)
 
     step = _shard_map(device_step, mesh=mesh,
                          in_specs=(specs, tok_spec),

@@ -213,18 +213,41 @@ def block_qkv(h, blk, cfg: ModelConfig, positions):
     return q, k, v
 
 
-def block_attn_out(x, attn, blk, cfg: ModelConfig, tp_axis):
+def _fused_row_combine(h, w, tp_axis, out_shape, jdtype):
+    """r18 fused lane for the row-parallel projections: the matmul and
+    the tp allreduce pipeline each other (chunk k+1's wire hop hides
+    under chunk k's MXU pass) instead of serializing matmul → psum.
+    `h` [..., K] against `w` [K, D]; reduces over `tp_axis`."""
+    from ..ops.fused import fused_chunks, fused_matmul_allreduce
+    out = fused_matmul_allreduce(h.reshape(-1, h.shape[-1]), w,
+                                 axis=tp_axis, use_pallas=False,
+                                 chunks=fused_chunks())
+    return out.reshape(out_shape).astype(jdtype)
+
+
+def block_attn_out(x, attn, blk, cfg: ModelConfig, tp_axis,
+                   fused: bool = False):
     """Attention-out projection + row-parallel combine + residual
-    (shared with models/decode.py)."""
-    o = jnp.einsum("bthk,hkd->btd", attn, blk["wo"].astype(cfg.jdtype))
+    (shared with models/decode.py).  ``fused=True`` overlaps the tp
+    combine with the projection matmul (r18); default is the
+    sequential einsum + psum, bit-identical to r17."""
+    wo = blk["wo"].astype(cfg.jdtype)
+    if fused and tp_axis is not None:
+        B, T, H, K = attn.shape
+        o = _fused_row_combine(attn.reshape(B * T, H * K),
+                               wo.reshape(H * K, -1), tp_axis,
+                               (B, T, wo.shape[-1]), cfg.jdtype)
+        return x + o
+    o = jnp.einsum("bthk,hkd->btd", attn, wo)
     if tp_axis is not None:
         o = lax.psum(o, tp_axis)  # row-parallel combine
     return x + o
 
 
-def block_mlp(x, blk, cfg: ModelConfig, tp_axis):
+def block_mlp(x, blk, cfg: ModelConfig, tp_axis, fused: bool = False):
     """Post-attention MLP (gelu or the Llama-family swiglu) + residual
-    (shared with models/decode.py)."""
+    (shared with models/decode.py).  ``fused=True`` overlaps the tp
+    combine with the down projection (r18)."""
     h = _rmsnorm(x, blk["ln2"])
     m = jnp.einsum("btd,df->btf", h, blk["w1"].astype(cfg.jdtype))
     if cfg.mlp == "swiglu":
@@ -233,19 +256,27 @@ def block_mlp(x, blk, cfg: ModelConfig, tp_axis):
         m = jax.nn.silu(m) * gate
     else:
         m = jax.nn.gelu(m)
-    m = jnp.einsum("btf,fd->btd", m, blk["w2"].astype(cfg.jdtype))
+    w2 = blk["w2"].astype(cfg.jdtype)
+    if fused and tp_axis is not None:
+        B, T, F = m.shape
+        m = _fused_row_combine(m.reshape(B * T, F), w2, tp_axis,
+                               (B, T, w2.shape[-1]), cfg.jdtype)
+        return x + m
+    m = jnp.einsum("btf,fd->btd", m, w2)
     if tp_axis is not None:
         m = lax.psum(m, tp_axis)
     return x + m
 
 
 def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
-            sp_axis: Optional[str] = None):
+            sp_axis: Optional[str] = None, fused: bool = False):
     """Token ids [B, T_local] → logits [B, T_local, vocab].
 
     Inside shard_map: `tp_axis` marks head/hidden shards (row-parallel
     psum after attention-out and MLP-down), `sp_axis` marks sequence
     shards (ring attention).  Outside shard_map pass None for both.
+    ``fused=True`` pipelines the row-parallel combines under the
+    projection matmuls (r18 fused lane; no-op without a tp axis).
     """
     if cfg.sp_schedule == "zigzag" and sp_axis is None:
         # the zigzag layout is only meaningful under sequence
@@ -298,8 +329,8 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
         else:
             attn = _dense_attention(q, k, v, causal=True,
                                     window=cfg.attn_window)
-        x = block_attn_out(x, attn, blk, cfg, tp_axis)
-        return block_mlp(x, blk, cfg, tp_axis)
+        x = block_attn_out(x, attn, blk, cfg, tp_axis, fused=fused)
+        return block_mlp(x, blk, cfg, tp_axis, fused=fused)
 
     if cfg.remat:
         # rematerialize each block on the backward pass: only the
@@ -317,14 +348,15 @@ def forward(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
 
 
 def loss_fn(params, tokens, cfg: ModelConfig, tp_axis: Optional[str] = None,
-            sp_axis: Optional[str] = None):
+            sp_axis: Optional[str] = None, fused: bool = False):
     """Next-token cross entropy.  With sequence parallelism, the label
     for a shard's last position lives on the next shard — fetched with
     one ppermute hop (the pipeline-neighbor send/recv pattern); the
     global last position is masked.  Returns (sum_loss, count) local to
     the device."""
     B, Tl = tokens.shape
-    logits = forward(params, tokens, cfg, tp_axis, sp_axis).astype(jnp.float32)
+    logits = forward(params, tokens, cfg, tp_axis, sp_axis,
+                     fused=fused).astype(jnp.float32)
     if sp_axis is not None and cfg.sp_schedule == "zigzag":
         # zigzag layout: the local row is [chunk idx ; chunk 2P-1-idx].
         # Each chunk's last label is its GLOBAL successor's first token:
@@ -397,7 +429,8 @@ def sum_count_device_step(loss_closure, params, data_axes, lr):
 def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
                     dp: Optional[str] = "dp", tp: Optional[str] = "tp",
                     sp: Optional[str] = "sp", optimizer=None,
-                    params=None, check_vma: Optional[bool] = None):
+                    params=None, check_vma: Optional[bool] = None,
+                    fused: bool = False):
     """Build the jitted SPMD train step over `mesh`.
 
     `check_vma` defaults per backend: on the CPU rung with
@@ -451,8 +484,8 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
     if optimizer is None:
         def device_step(params, tokens):
             return sum_count_device_step(
-                lambda p: loss_fn(p, tokens, cfg, tp, sp), params,
-                data_axes, lr)
+                lambda p: loss_fn(p, tokens, cfg, tp, sp, fused=fused),
+                params, data_axes, lr)
 
         step = _shard_map(device_step, mesh=mesh,
                              in_specs=(specs, tok_spec),
@@ -484,7 +517,8 @@ def make_train_step(mesh, cfg: ModelConfig, lr: float = 1e-3,
 
     def device_step(params, opt_state, tokens):
         g_mean, mean_loss = _mean_grads(
-            lambda p: loss_fn(p, tokens, cfg, tp, sp), params, data_axes)
+            lambda p: loss_fn(p, tokens, cfg, tp, sp, fused=fused),
+            params, data_axes)
         updates, new_state = optimizer.update(g_mean, opt_state, params)
         new_params = _optax.apply_updates(params, updates)
         return new_params, new_state, mean_loss

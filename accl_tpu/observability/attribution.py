@@ -447,6 +447,99 @@ def overlap(dumps, trace_doc: Optional[dict] = None) -> dict:
     }
 
 
+def device_overlap(trace_doc: Optional[dict]) -> dict:
+    """Overlap accounting from the DEVICE stamp timeline alone (r18).
+
+    For every ``device:<collective>`` track in a Perfetto doc, measure
+    how much of the transfer (``device_phase`` = xfer) time runs
+    concurrently with reduce/compute slices on the *same rank and
+    collective* — the device-side twin of :func:`overlap`, which
+    accounts host flight records against host compute windows.
+
+    The sequential ring's stamp clock serializes every step
+    (xfer [3s, 3s+1] then reduce [3s+1, 3s+2]) so its xfer∩reduce is
+    zero and ``exposed_fraction`` is 1.0.  The fused lanes stamp the
+    overlapped clock — chunk k+1's xfer spans chunk k's reduce — so
+    all but the first transfer are covered and the exposed fraction
+    falls to ~1/slots.  ``recovered_mxu_fraction`` is the share of
+    wire time the MXU (reduce/compute phase) already hides.
+
+    Returns::
+
+        {"tracks": N,
+         "collectives": {"<coll>": {"xfer_us", "overlapped_us",
+             "exposed_us", "exposed_fraction",
+             "recovered_mxu_fraction", "slices", "ranks"}}}
+    """
+    if not trace_doc:
+        return {"tracks": 0, "collectives": {}}
+    # (pid, tid) -> track label, from the thread_name metadata events
+    labels: dict = {}
+    for ev in trace_doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            labels[(ev.get("pid"), ev.get("tid"))] = \
+                (ev.get("args") or {}).get("name", "")
+
+    xfers: dict = {}    # (coll, pid) -> [(t0_ns, t1_ns)]
+    reduces: dict = {}
+    for ev in trace_doc.get("traceEvents", []):
+        args = ev.get("args") or {}
+        if ev.get("ph") != "X" or not args.get("device_track"):
+            continue
+        label = labels.get((ev.get("pid"), ev.get("tid")), "")
+        coll = label[len("device:"):] if label.startswith("device:") \
+            else label
+        t0 = ev.get("ts", 0) * 1e3
+        t1 = t0 + ev.get("dur", 0) * 1e3
+        if t1 <= t0:
+            continue
+        key = (coll, ev.get("pid", -1))
+        if args.get("device_phase") == "xfer":
+            xfers.setdefault(key, []).append((t0, t1))
+        else:
+            reduces.setdefault(key, []).append((t0, t1))
+
+    def _merge(wins: list) -> list:
+        wins = sorted(wins)
+        out = [wins[0]]
+        for w0, w1 in wins[1:]:
+            if w0 <= out[-1][1]:
+                if w1 > out[-1][1]:
+                    out[-1] = (out[-1][0], w1)
+            else:
+                out.append((w0, w1))
+        return out
+
+    agg: dict = {}
+    for (coll, pid), xs in sorted(xfers.items()):
+        cover = _merge(reduces.get((coll, pid), [])) \
+            if (coll, pid) in reduces else []
+        a = agg.setdefault(coll, {"xfer_ns": 0.0, "ovl_ns": 0.0,
+                                  "slices": 0, "ranks": set()})
+        a["ranks"].add(pid)
+        for t0, t1 in xs:
+            a["slices"] += 1
+            a["xfer_ns"] += t1 - t0
+            a["ovl_ns"] += _overlap_ns(t0, t1, cover)
+
+    collectives: dict = {}
+    for coll, a in sorted(agg.items()):
+        xfer, ovl = a["xfer_ns"], a["ovl_ns"]
+        exposed = max(xfer - ovl, 0.0)
+        collectives[coll] = {
+            "xfer_us": round(xfer / 1e3, 2),
+            "overlapped_us": round(ovl / 1e3, 2),
+            "exposed_us": round(exposed / 1e3, 2),
+            "exposed_fraction": round(exposed / xfer, 4) if xfer else 0.0,
+            "recovered_mxu_fraction": round(ovl / xfer, 4) if xfer
+            else 0.0,
+            "slices": a["slices"],
+            "ranks": len(a["ranks"]),
+        }
+    return {"tracks": len({k for k in xfers} | {k for k in reduces}),
+            "collectives": collectives}
+
+
 def render(report: dict, out=None) -> str:
     """Human rendering of an attribution report (perf_doctor's body)."""
     lines = [

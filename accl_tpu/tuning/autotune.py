@@ -48,7 +48,13 @@ TABLE_VERSION = 1
 #: payload crosses the wire block-scaled int8 or cast-fp16 — a win in
 #: a cell arms the driver's CompressionPolicy at install.
 ALGORITHMS = ("static", "flat", "tree", "ring", "hierarchical",
-              "compress_int8", "compress_fp16")
+              "compress_int8", "compress_fp16", "fused")
+
+#: collectives the r18 fused (pipelined compute/communication) lane
+#: reshapes — the descriptor opt-in routed by backends/tpu.py; a win in
+#: a cell means SelectionPolicy should serve ``fused`` for that size
+#: bucket under the same never-slower prune as every other lane
+FUSED_COLLECTIVES = frozenset(("allreduce", "reduce_scatter"))
 
 #: the measurable compression lanes and their wire dtypes (per-dtype
 #: tables: these lanes only cover float32 cells — cell keys already
@@ -190,7 +196,7 @@ def algorithms_for(world, dtype: str = "float32") -> tuple:
     have no compressed pair registered)."""
     comp = COMPRESSION_ALGS if dtype == "float32" else ()
     if backend_of(world) == "tpu":
-        return ("static", "flat", "ring", "hierarchical") + comp
+        return ("static", "flat", "ring", "hierarchical", "fused") + comp
     return ("static", "flat", "tree", "hierarchical") + comp
 
 
@@ -247,6 +253,11 @@ def lane_covers(backend: str, alg: str, coll: str,
         # a compressed wire is a genuinely different datapath than
         # static at every size; coverage is by collective only
         return coll in COMPRESS_COLLECTIVES
+    if alg == "fused":
+        # the chunked pipelined ring is a per-descriptor opt-in (no
+        # register resolves to it), so it is a different dispatch than
+        # static at every size; only the TPU backend routes it
+        return backend == "tpu" and coll in FUSED_COLLECTIVES
     covered = LANE_COLLECTIVES.get((backend, alg))
     if covered is not None and coll not in covered:
         return False
@@ -281,7 +292,9 @@ def apply_algorithm(world, alg: str) -> None:
                 a.set_tuning(int(TuningKey.RING_THRESHOLD_BYTES), _HUGE)
             elif alg == "ring":
                 a.set_tuning(int(TuningKey.RING_THRESHOLD_BYTES), 0)
-            else:  # static / hierarchical ride the env default
+            else:  # static / hierarchical / fused ride the env default
+                # (the fused lane is a per-CALL descriptor opt-in, not
+                # a register: _run_once passes fused=True instead)
                 a.set_tuning(
                     int(TuningKey.RING_THRESHOLD_BYTES),
                     int(os.environ.get("ACCL_RING_THRESHOLD",
@@ -308,6 +321,45 @@ def apply_algorithm(world, alg: str) -> None:
 # ---------------------------------------------------------------------------
 # measurement
 # ---------------------------------------------------------------------------
+
+def _overlap_marks() -> dict:
+    """Per-recorder flight-ring seq watermark, taken before a cell's
+    timed reps so the overlap column accounts ONLY that cell's calls."""
+    from ..observability import flight as _flight
+
+    return {id(r): (r, max((rec.seq for rec in r.records()),
+                           default=-1))
+            for r in _flight.recorders()}
+
+
+def _cell_overlap(marks: dict) -> Optional[float]:
+    """The measured ``attribution.overlap`` exposed-wire fraction of
+    the flight records landed since ``marks`` (one sweep cell), with
+    the trace collector's device stamp slices as compute windows when
+    ``ACCL_DEVICE_TRACE`` armed them.  None when nothing completed
+    (flight recorder off / single-rank view)."""
+    from ..observability import attribution as _attr
+    from ..observability import flight as _flight
+    from ..observability import trace as _trace
+
+    docs = []
+    for rec, mark in marks.values():
+        d = rec.dump()
+        d["records"] = [r for r in d["records"] if r["seq"] > mark]
+        docs.append(d)
+    if not docs:
+        return None
+    trace_doc = (_trace.collector().to_perfetto()
+                 if _trace.collector().device_records() else None)
+    try:
+        rep = _attr.overlap(_flight.merge_flight_dumps(docs),
+                            trace_doc=trace_doc)
+    except (ACCLError, ValueError, KeyError):
+        return None
+    wire = sum(c["wire_us"] for c in rep["collectives"].values())
+    exposed = sum(c["exposed_us"] for c in rep["collectives"].values())
+    return round(exposed / wire, 4) if wire > 0 else None
+
 
 def _run_once_hier(world, hier, coll: str, count: int, dtype,
                    root: int) -> float:
@@ -417,10 +469,15 @@ def measure(world, config: TuneConfig = TuneConfig(),
                             return _sweep._run_once(
                                 world, coll, count, dtype, config.root,
                                 compress=_compress_dtype_of(alg))
+                        if alg == "fused":
+                            return _sweep._run_once(world, coll, count,
+                                                    dtype, config.root,
+                                                    fused=True)
                         return _sweep._run_once(world, coll, count,
                                                 dtype, config.root)
 
                     run()  # untimed warmup (jit/compile/path setup)
+                    marks = _overlap_marks()
                     dur = min(run() for _ in range(config.repetitions))
                     algbw = nbytes / dur / 1e9 if dur > 0 else 0.0
                     rows.append({
@@ -432,6 +489,9 @@ def measure(world, config: TuneConfig = TuneConfig(),
                         "duration_us": round(dur * 1e6, 2),
                         "busbw_GBps": round(
                             algbw * _sweep._busbw_factor(coll, P), 4),
+                        # r18: measured exposed-wire fraction of this
+                        # cell's reps (attribution.overlap)
+                        "overlap": _cell_overlap(marks),
                     })
                     if log:
                         r = rows[-1]
@@ -467,6 +527,9 @@ def build_table(rows: list, world_meta: dict) -> SelectionTable:
             "static_busbw_GBps":
                 static["busbw_GBps"] if static else None,
             "bytes": best["bytes"],
+            # r18: the winner's measured exposed-wire fraction (None
+            # when the cell had no flight coverage)
+            "overlap": best.get("overlap"),
         }
     return SelectionTable(entries, world_meta)
 
@@ -575,6 +638,10 @@ def compare(world, table: SelectionTable,
                 return _sweep._run_once(world, coll, count, dtype,
                                         config.root,
                                         compress=_compress_dtype_of(lane))
+            if lane == "fused":
+                apply_algorithm(world, "static")
+                return _sweep._run_once(world, coll, count, dtype,
+                                        config.root, fused=True)
             apply_algorithm(world, lane)
             return _sweep._run_once(world, coll, count, dtype,
                                     config.root)

@@ -9,6 +9,11 @@ Teacher-forced parity with the training forward is the tested contract
 (tests/test_decode.py); this tour shows the user-facing surface.
 
     python examples/generate_text.py
+
+Set ACCL_FUSED=1 to route any tensor-parallel collectives in the
+forward/decode path through the r18 fused lane (no-op on this
+single-device demo, but the flag plumbs through `generate`/`prefill`
+the same way it does on a tp-sharded serving mesh).
 """
 import os
 import sys
@@ -33,6 +38,7 @@ from accl_tpu.models.transformer import loss_fn
 
 
 def main() -> None:
+    fused = os.environ.get("ACCL_FUSED", "0") not in ("", "0")
     cfg = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4,
                       n_kv_heads=2, d_head=16, d_ff=128,
                       mlp="swiglu", rope=True)
@@ -54,7 +60,7 @@ def main() -> None:
     print(f"trained {n_steps} steps")
 
     prompt = data[:2, :8]
-    out = generate(params, prompt, cfg, max_new=6)
+    out = generate(params, prompt, cfg, max_new=6, fused=fused)
     print("generated:", np.asarray(out).tolist())
 
     # the cache contract, demonstrated: teacher-forced decode logits

@@ -9,6 +9,10 @@ sequence axis all compiled into one SPMD program.
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/train_transformer_3d.py
+
+Set ACCL_FUSED=1 to route the tensor-parallel allreduces through the
+r18 fused lane (chunked collectives drained under the MXU — bitwise
+vs the default schedule; see docs/performance.md).
 """
 import os
 import sys
@@ -38,6 +42,7 @@ from accl_tpu.parallel.ring_attention import zigzag_indices
 
 B, T = 4, 64
 STEPS = int(os.environ.get("ACCL_EXAMPLE_STEPS", "5"))
+FUSED = os.environ.get("ACCL_FUSED", "0") not in ("", "0")
 
 
 def main():
@@ -51,7 +56,8 @@ def main():
                       sp_schedule="zigzag")
     params = init_params(np.random.default_rng(0), cfg)
 
-    step, (param_specs, tok_spec) = make_train_step(mesh, cfg, lr=1e-2)
+    step, (param_specs, tok_spec) = make_train_step(mesh, cfg, lr=1e-2,
+                                                    fused=FUSED)
     params = shard_params(params, mesh, cfg)
 
     # zigzag: feed tokens in the load-balanced causal layout (rank i
@@ -67,8 +73,9 @@ def main():
         params, loss = step(params, tokens)
         print(f"step {i}: loss {float(loss):.4f}")
 
+    lane = "fused (r18 chunked overlap)" if FUSED else "default"
     print(f"train_transformer_3d: {STEPS} steps on dp=2 x tp=2 x sp=2 "
-          f"({len(jax.devices())} devices): OK")
+          f"({len(jax.devices())} devices, {lane} tp collectives): OK")
 
 
 if __name__ == "__main__":

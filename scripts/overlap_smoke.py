@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""CI overlap smoke: the r18 fused compute/communication gate.
+
+Two rungs, both on the CPU/interpret rung (4 virtual devices), both
+under ``ACCL_DEVICE_TRACE=1``:
+
+1. **Device timeline** — run the chunked ring allreduce at C=1 (the
+   sequential 3-phase stamp clock) and C=4 (the overlapped clock),
+   schema-validate every per-chunk stamp row (rank/step ordering, ring
+   neighbor attribution, per-hop bytes, the exact clock for each
+   chunking), and assert ``attribution.device_overlap`` reports the
+   fused timeline's exposed-wire fraction strictly below the
+   sequential one (which must sit at 1.0).
+
+2. **Driver A/B** — one `bench.sweep.run_fused_overlap_sweep` cell
+   per wire lane (>= 64 KiB allreduce, fp32 + int8) through the real
+   TPU-backend gang dispatch: the fused arm's measured
+   ``attribution.overlap`` exposed-wire fraction must come back
+   strictly below the sequential arm's.
+
+Artifacts: the Perfetto doc with the device stamp tracks and a JSON
+report with the A/B rows + device_overlap accounting (uploaded by
+.github/workflows/build-and-test.yml perf-gate).
+
+Usage: python scripts/overlap_smoke.py [--ranks N] [--trace PATH]
+       [--report PATH]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_device_rung(ranks: int) -> dict:
+    """Ops-level C=1 vs C=4 chunked allreduce under the stamp plane;
+    returns the schema-validated device_overlap accounting."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    import accl_tpu.ops.fused as fused
+    import accl_tpu.ops.ring as ring
+    from accl_tpu.observability import attribution
+    from accl_tpu.observability import trace as obs_trace
+    from accl_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) >= ranks, (
+        f"device rung needs {ranks} devices (set XLA_FLAGS="
+        f"--xla_force_host_platform_device_count={ranks})")
+    ring._reset_device_trace_cache()
+    assert ring.device_trace_enabled(), "ACCL_DEVICE_TRACE not armed"
+    obs_trace.collector().clear()
+    mesh = make_mesh(dp=ranks)
+
+    def runner(chunks, collective):
+        def body(xb):
+            return fused.chunked_ring_all_reduce(
+                xb[0], "dp", chunks=chunks, collective=collective)[None]
+
+        try:
+            f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                          out_specs=P("dp", None), check_vma=False)
+        except TypeError:  # older shard_map spells the flag check_rep
+            f = shard_map(body, mesh=mesh, in_specs=P("dp", None),
+                          out_specs=P("dp", None), check_rep=False)
+        x = np.stack([np.arange(1024, dtype=np.float32) + r
+                      for r in range(ranks)])
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        out = np.asarray(jax.jit(f)(xs))
+        np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-5)
+
+    C = 4
+    runner(1, "seq_allreduce")
+    runner(C, "fused_allreduce")
+
+    # schema validation: every stamp row, per collective
+    fields = obs_trace.DEVICE_TRACE_FIELDS
+    rows_by = {}
+    for rec in obs_trace.collector().device_records():
+        rows_by.setdefault(rec["collective"], []).extend(
+            dict(zip(fields, r)) for r in rec["rows"])
+    assert set(rows_by) == {"seq_allreduce", "fused_allreduce"}, \
+        f"unexpected collectives: {sorted(rows_by)}"
+    for coll, rows in rows_by.items():
+        seen_ranks = set()
+        for row in rows:
+            seen_ranks.add(row["rank"])
+            assert row["tx_peer"] == (row["rank"] + 1) % ranks, row
+            assert row["rx_peer"] == (row["rank"] - 1) % ranks, row
+            assert row["tx_bytes"] > 0 and row["rx_bytes"] > 0, row
+            assert row["seq_send"] < row["seq_wait"] < row["seq_phase"]
+            if coll == "seq_allreduce":  # sequential 3-phase clock
+                assert row["seq_send"] == 3 * row["step"], row
+                assert row["seq_wait"] == row["seq_send"] + 1, row
+            else:  # overlapped clock: xfer(i+1) covers reduce(i)
+                assert row["seq_send"] == 2 * row["step"], row
+                assert row["seq_wait"] == row["seq_send"] + 2, row
+                assert row["seq_phase"] == row["seq_send"] + 4, row
+        assert seen_ranks == set(range(ranks)), (coll, seen_ranks)
+    # RS + AG phases: (P-1)*C slots each, per rank
+    assert len(rows_by["seq_allreduce"]) == ranks * 2 * (ranks - 1)
+    assert len(rows_by["fused_allreduce"]) == ranks * 2 * (ranks - 1) * C
+
+    dev = attribution.device_overlap(obs_trace.collector().to_perfetto())
+    seq = dev["collectives"]["seq_allreduce"]
+    fus = dev["collectives"]["fused_allreduce"]
+    assert abs(seq["exposed_fraction"] - 1.0) < 1e-6, seq
+    assert fus["exposed_fraction"] < seq["exposed_fraction"], (seq, fus)
+    print(f"[overlap-smoke] device timeline: sequential exposed "
+          f"{seq['exposed_fraction']:.3f}, fused exposed "
+          f"{fus['exposed_fraction']:.3f} (recovered-MXU "
+          f"{fus['recovered_mxu_fraction']:.1%})")
+    return dev
+
+
+def run_driver_rung(ranks: int) -> list:
+    """One fused-overlap A/B cell per wire lane through the TPU-backend
+    gang dispatch; asserts fused exposed < sequential exposed."""
+    from accl_tpu.backends.tpu import TpuWorld
+    from accl_tpu.bench.sweep import run_fused_overlap_sweep
+
+    with TpuWorld(ranks) as world:
+        rows = run_fused_overlap_sweep(
+            world, collectives=("allreduce",), count_pows=(14,),
+            repetitions=2,
+            log=lambda s: print(f"[overlap-smoke]{s}"))
+    cells = {}
+    for r in rows:
+        cells.setdefault((r["wire"], r["collective"], r["count"]),
+                         {})[r["mode"]] = r
+    assert cells, "A/B sweep produced no rows"
+    for key, modes in cells.items():
+        seq, fus = modes["sequential"], modes["fused"]
+        assert seq["exposed_wire_fraction"] is not None, seq
+        assert fus["exposed_wire_fraction"] is not None, fus
+        assert (fus["exposed_wire_fraction"]
+                < seq["exposed_wire_fraction"]), (key, seq, fus)
+        print(f"[overlap-smoke] driver {key}: sequential exposed "
+              f"{seq['exposed_wire_fraction']:.3f} -> fused "
+              f"{fus['exposed_wire_fraction']:.3f}")
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--trace", default="overlap_timeline.json")
+    ap.add_argument("--report", default="overlap_smoke_report.json")
+    args = ap.parse_args()
+
+    # arm the stamp plane + virtual devices BEFORE jax/accl import
+    os.environ["ACCL_DEVICE_TRACE"] = "1"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.ranks}").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    dev = run_device_rung(args.ranks)
+
+    from accl_tpu.observability import trace as obs_trace
+
+    obs_trace.collector().dump(args.trace)
+
+    ab_rows = run_driver_rung(args.ranks)
+
+    with open(args.report, "w") as f:
+        json.dump({"ranks": args.ranks, "device_overlap": dev,
+                   "driver_ab": ab_rows}, f, indent=1)
+    print(f"[overlap-smoke] OK — report {args.report}, "
+          f"timeline {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

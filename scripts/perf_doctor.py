@@ -290,6 +290,22 @@ def main() -> int:
                       f"({c['exposed_fraction'] * 100:.1f}% of span), "
                       f"recovered-compute "
                       f"{c['recovered_compute_fraction'] * 100:.1f}%")
+            # device overlap (r18): the stamp-clock timeline's own
+            # xfer-vs-reduce accounting — the recovered-MXU fraction
+            # the fused lanes exist to raise (1.0 recovered = every
+            # wire hop hidden under the matmul accumulator)
+            if trace_doc is not None:
+                dev = attribution.device_overlap(trace_doc)
+                report["device_overlap"] = dev
+                if dev["collectives"]:
+                    print(f"\ndevice overlap (r18, "
+                          f"{dev['tracks']} stamp track(s)):")
+                    for coll, c in sorted(dev["collectives"].items()):
+                        print(f"  {coll}: xfer {c['xfer_us']:.1f}us "
+                              f"over {c['ranks']} rank(s), exposed "
+                              f"{c['exposed_fraction'] * 100:.1f}%, "
+                              f"recovered-MXU "
+                              f"{c['recovered_mxu_fraction'] * 100:.1f}%")
             for c in attr["collectives"].values():
                 d = c["dominant_straggler"]
                 if d is not None and d["share"] >= 0.5:
@@ -319,6 +335,17 @@ def main() -> int:
                 report["link_matrix"] = links
                 schema_errors.extend(validate_link_section(links))
                 render_link_matrix(links, sys.stdout)
+                # r18: the recovered-MXU fraction belongs next to the
+                # link traffic it hides — how much of those bytes'
+                # wire time the device timeline shows covered by MXU
+                dev = report.get("device_overlap", {}).get(
+                    "collectives", {})
+                if dev:
+                    mean_rec = sum(c["recovered_mxu_fraction"]
+                                   for c in dev.values()) / len(dev)
+                    print(f"  recovered-MXU (device stamp clock): "
+                          f"mean {mean_rec * 100:.1f}% over "
+                          f"{len(dev)} collective(s)")
                 if links["findings"].get("imbalanced"):
                     findings += 1
             if args.baseline:

@@ -29,6 +29,10 @@ def main() -> int:
                     help="run the r17 compression-lane sweep "
                          "(bandwidth vs exactness per wire lane) "
                          "instead of the plain collective sweep")
+    ap.add_argument("--fused-overlap", action="store_true",
+                    help="run the r18 fused-overlap A/B lane (fused "
+                         "chunked collective under matmul vs the "
+                         "sequential schedule; TPU backend only)")
     args = ap.parse_args()
 
     if args.design == "tpu":
@@ -55,7 +59,23 @@ def main() -> int:
         if args.design == "emu-inproc" else initialize_world(design,
                                                              args.nranks)
     try:
-        if args.quantized:
+        if args.fused_overlap:
+            from accl_tpu.bench.sweep import run_fused_overlap_sweep
+
+            if args.design != "tpu":
+                print("--fused-overlap requires --design tpu (the "
+                      "fused lane is a TPU-backend dispatch lane)",
+                      file=sys.stderr)
+                return 2
+            run_fused_overlap_sweep(
+                world,
+                collectives=tuple(args.collectives)
+                if args.collectives else ("allreduce",
+                                          "reduce_scatter"),
+                count_pows=range(args.pows[0], args.pows[1] + 1),
+                repetitions=args.reps, writer=out,
+                log=lambda s: print(s, file=sys.stderr))
+        elif args.quantized:
             from accl_tpu.bench.sweep import run_compression_sweep
 
             run_compression_sweep(

@@ -1,0 +1,534 @@
+"""r18 fused compute/communication overlap: the chunked pipelined ring.
+
+Pins the four contracts of the fused lane:
+
+- **Exactness** — the fp32 chunked collectives are BITWISE the C=1
+  chain (same fold order as the Pallas ring), fused matmul-allreduce
+  is bitwise the unfused matmul+psum sequence, and the int8 wire stays
+  inside the r17 error bound with the quantize/dequantize fused into
+  the chunk loop.
+- **Opt-in dispatch** — with ACCL_FUSED unset every gang plan compiles
+  with the fused bit off (bit-identical to the pre-r18 dispatch); the
+  per-call ``fused=`` arg and the env default both arm it.
+- **Observability** — under ACCL_DEVICE_TRACE the C=1 rows carry the
+  sequential 3-phase stamp clock and C>1 rows the overlapped clock, so
+  ``attribution.device_overlap`` reports the fused timeline's exposed
+  fraction strictly below the sequential one.
+- **Lifecycle** — plan capture/replay of a fused call is bitwise
+  stable, and the abort fence fast-fails a fused call like any other.
+
+The tier-3 Pallas kernels need a jax whose interpreter implements
+remote DMA signals; on older jax those tests self-skip exactly like
+the pallas ring test files do.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax spells it experimental
+    from jax.experimental.shard_map import shard_map
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import accl_tpu.ops.fused as F
+import accl_tpu.ops.ring as ring
+from accl_tpu import ACCLError, ReduceFunction
+from accl_tpu.backends.emu import EmuWorld
+from accl_tpu.backends.tpu import TpuWorld
+from accl_tpu.constants import DataType
+from accl_tpu.observability import attribution
+from accl_tpu.observability import trace as obs_trace
+from accl_tpu.ops.quantized import DEFAULT_BLOCK
+
+NR = 4
+
+
+@pytest.fixture
+def devtrace(monkeypatch):
+    """Restore the device-trace gate, the fused-chunks cache, and the
+    collector around each test."""
+    yield monkeypatch
+    ring._reset_device_trace_cache()
+    F._reset_fused_chunks_cache()
+    obs_trace.collector().clear()
+
+
+def _mesh(n=NR, axis="dp"):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs a {n}-device mesh")
+    from accl_tpu.parallel import make_mesh
+
+    return make_mesh(**{axis: n})
+
+
+def _smap(mesh, fn, in_spec, out_spec):
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_spec,
+                         out_specs=out_spec, check_vma=False)
+    except TypeError:  # older shard_map spells the flag check_rep
+        return shard_map(fn, mesh=mesh, in_specs=in_spec,
+                         out_specs=out_spec, check_rep=False)
+
+
+def _sharded(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+
+
+def _run_chunked(mesh, x, fn):
+    xs = _sharded(mesh, x)
+    body = _smap(mesh, lambda xb: fn(xb[0])[None], P("dp", None),
+                 P("dp", None))
+    return np.asarray(jax.jit(body)(xs))
+
+
+# ---------------------------------------------------------------------------
+# exactness: fp32 bitwise, int8 within the r17 bound
+# ---------------------------------------------------------------------------
+def test_pick_chunks_divides():
+    assert F._pick_chunks(64, 4) == 4
+    assert F._pick_chunks(6, 4) == 3  # largest divisor <= request
+    assert F._pick_chunks(7, 4) == 1
+    assert F._pick_chunks(4, None) >= 1
+
+
+def test_chunked_allreduce_bitwise_vs_single_chain(devtrace, rng):
+    mesh = _mesh()
+    x = rng.standard_normal((NR, 256)).astype(np.float32)
+    out_c1 = _run_chunked(
+        mesh, x, lambda v: F.chunked_ring_all_reduce(v, "dp", chunks=1))
+    out_c4 = _run_chunked(
+        mesh, x, lambda v: F.chunked_ring_all_reduce(v, "dp", chunks=4))
+    # chunking NEVER changes the bits: each chunk folds the same
+    # (local + incoming) chain, only in C independent pipelines
+    np.testing.assert_array_equal(out_c1, out_c4)
+    np.testing.assert_allclose(out_c4[0], x.sum(axis=0), rtol=1e-5)
+
+
+def test_chunked_allreduce_pads_ragged_lengths(devtrace, rng):
+    mesh = _mesh()
+    x = rng.standard_normal((NR, 100)).astype(np.float32)  # not % P*C
+    out = _run_chunked(
+        mesh, x, lambda v: F.chunked_ring_all_reduce(v, "dp", chunks=4))
+    np.testing.assert_allclose(out[0], x.sum(axis=0), rtol=1e-5)
+    assert out.shape == x.shape
+
+
+def test_chunked_reduce_scatter_bitwise_and_guard(devtrace, rng):
+    mesh = _mesh()
+    x = rng.standard_normal((NR, NR * 64)).astype(np.float32)
+    out_c1 = _run_chunked(
+        mesh, x,
+        lambda v: F.chunked_ring_reduce_scatter(v, "dp", chunks=1))
+    out_c4 = _run_chunked(
+        mesh, x,
+        lambda v: F.chunked_ring_reduce_scatter(v, "dp", chunks=4))
+    np.testing.assert_array_equal(out_c1, out_c4)
+    ref = x.sum(axis=0).reshape(NR, 64)
+    for r in range(NR):
+        np.testing.assert_allclose(out_c4[r], ref[r], rtol=1e-5)
+    with pytest.raises(ValueError, match="divisible"):
+        _run_chunked(
+            mesh, rng.standard_normal((NR, NR * 64 + 1)).astype(
+                np.float32),
+            lambda v: F.chunked_ring_reduce_scatter(v, "dp"))
+
+
+def test_chunked_all_gather_matches_jnp(devtrace, rng):
+    mesh = _mesh()
+    x = rng.standard_normal((NR, 96)).astype(np.float32)
+    out = _run_chunked(
+        mesh, x, lambda v: F.chunked_ring_all_gather(v, "dp", chunks=3))
+    np.testing.assert_array_equal(out[0], x.reshape(-1))
+
+
+def test_chunked_allreduce_int8_ef_within_r17_bound(devtrace, rng):
+    """The fused int8 lane (per-hop requantize + error feedback inside
+    the chunk loop) keeps the r17 bound: P * amax / 254 * 2."""
+    mesh = _mesh()
+    x = rng.standard_normal((NR, 512)).astype(np.float32)
+    out = _run_chunked(
+        mesh, x, lambda v: F.chunked_ring_all_reduce(
+            v, "dp", chunks=4, wire=(DEFAULT_BLOCK, True)))
+    exact = x.sum(axis=0, dtype=np.float64)
+    bound = NR * np.abs(x).max() / 254 * 2
+    assert np.abs(out[0] - exact).max() <= bound
+
+
+def test_fused_matmul_allreduce_bitwise_vs_unfused(devtrace, rng):
+    """allreduce-into-matmul: the pipelined per-hop (dot_block + fold)
+    chain is bitwise the unfused matmul+psum sequence (same fp32
+    contraction per row block, same fold order as the C=1 chain)."""
+    mesh = _mesh()
+    K, N = 32, 48
+    x = rng.standard_normal((NR, 64, K)).astype(np.float32)
+    w = rng.standard_normal((NR, K, N)).astype(np.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None, None)))
+    ws = jax.device_put(w, NamedSharding(mesh, P("dp", None, None)))
+
+    def fused(xb, wb):
+        return F.fused_matmul_allreduce(xb[0], wb[0], axis="dp",
+                                        use_pallas=False, chunks=4)[None]
+
+    def seq(xb, wb):
+        from jax import lax
+
+        part = jnp.dot(xb[0], wb[0],
+                       preferred_element_type=jnp.float32)
+        return lax.psum(part, "dp")[None]
+
+    spec = (P("dp", None, None), P("dp", None, None))
+    out_f = np.asarray(jax.jit(_smap(mesh, fused, spec,
+                                     P("dp", None, None)))(xs, ws))
+    out_s = np.asarray(jax.jit(_smap(mesh, seq, spec,
+                                     P("dp", None, None)))(xs, ws))
+    # same fp32 contraction per row block; the ring fold sums in ring
+    # order vs psum's tree, so allclose (not bitwise) across the seam
+    np.testing.assert_allclose(out_f, out_s, rtol=1e-5, atol=1e-4)
+    ref = np.einsum("rmk,rkn->mn", x, w)
+    np.testing.assert_allclose(out_f[0], ref, rtol=1e-4, atol=1e-3)
+
+
+def test_fused_expert_ffn_matches_dispatch_combine(devtrace, rng):
+    """reduce_scatter-into-MoE-dispatch: capacity-chunked a2a -> ffn ->
+    a2a equals the expert_dispatch/expert_combine sequence bitwise."""
+    from accl_tpu.parallel.strategies import (expert_combine,
+                                              expert_dispatch)
+
+    mesh = _mesh(NR, "ep")
+    T, D = 32, 16
+    x = rng.standard_normal((NR, T, D)).astype(np.float32)
+    idxs = rng.integers(0, NR, size=(NR, T)).astype(np.int32)
+
+    def ffn(t):
+        return t * 2.0 + 1.0
+
+    def fused(xb, ib):
+        return F.fused_expert_ffn(xb[0], ib[0], ffn, axis="ep",
+                                  chunks=4)[None]
+
+    def seq(xb, ib):
+        inp, info = expert_dispatch(xb[0], ib[0], "ep")
+        return expert_combine(ffn(inp), info, "ep")[None]
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("ep", None, None)))
+    is_ = jax.device_put(idxs, NamedSharding(mesh, P("ep", None)))
+
+    def smap(fn):
+        try:
+            return shard_map(fn, mesh=mesh,
+                             in_specs=(P("ep", None, None),
+                                       P("ep", None)),
+                             out_specs=P("ep", None, None),
+                             check_vma=False)
+        except TypeError:
+            return shard_map(fn, mesh=mesh,
+                             in_specs=(P("ep", None, None),
+                                       P("ep", None)),
+                             out_specs=P("ep", None, None),
+                             check_rep=False)
+
+    out_f = np.asarray(jax.jit(smap(fused))(xs, is_))
+    out_s = np.asarray(jax.jit(smap(seq))(xs, is_))
+    np.testing.assert_array_equal(out_f, out_s)
+
+
+# ---------------------------------------------------------------------------
+# device-trace stamp clocks + device_overlap A/B
+# ---------------------------------------------------------------------------
+def _trace_allreduce(mesh, chunks, collective):
+    x = np.stack([np.arange(256, dtype=np.float32) + r
+                  for r in range(NR)])
+    _run_chunked(mesh, x, lambda v: F.chunked_ring_all_reduce(
+        v, "dp", chunks=chunks, collective=collective))
+
+
+def test_stamp_clock_c1_sequential_c4_overlapped(devtrace):
+    """C=1 has one chain (nothing pipelines against it), so its rows
+    carry the honest sequential 3-phase clock; C>1 rows carry the
+    overlapped clock where slot i+1's xfer covers slot i's reduce."""
+    devtrace.setenv("ACCL_DEVICE_TRACE", "1")
+    ring._reset_device_trace_cache()
+    obs_trace.collector().clear()
+    mesh = _mesh()
+    _trace_allreduce(mesh, 1, "seq_ar")
+    _trace_allreduce(mesh, 4, "fused_ar")
+    recs = obs_trace.collector().device_records()
+    by_coll = {}
+    for rec in recs:
+        by_coll.setdefault(rec["collective"], []).extend(rec["rows"])
+    assert set(by_coll) == {"seq_ar", "fused_ar"}
+    fields = obs_trace.DEVICE_TRACE_FIELDS
+    for raw in by_coll["seq_ar"]:
+        row = dict(zip(fields, raw))
+        assert row["seq_send"] == 3 * row["step"]
+        assert row["seq_wait"] == row["seq_send"] + 1
+        assert row["seq_phase"] == row["seq_send"] + 2
+        assert row["tx_peer"] == (row["rank"] + 1) % NR
+        assert row["rx_peer"] == (row["rank"] - 1) % NR
+        assert row["tx_bytes"] > 0
+    for raw in by_coll["fused_ar"]:
+        row = dict(zip(fields, raw))
+        assert row["seq_send"] == 2 * row["step"]
+        assert row["seq_wait"] == row["seq_send"] + 2
+        assert row["seq_phase"] == row["seq_send"] + 4
+    # RS + AG phases, (P-1)*C slots each
+    assert len(by_coll["fused_ar"]) == NR * 2 * (NR - 1) * 4
+    assert len(by_coll["seq_ar"]) == NR * 2 * (NR - 1)
+
+
+def test_device_overlap_fused_below_sequential(devtrace):
+    """attribution.device_overlap on the stamp timeline: the C=1 clock
+    reports full exposure (1.0), the pipelined clock reports ~1/slots
+    — the in-kernel half of the r18 gate criterion."""
+    devtrace.setenv("ACCL_DEVICE_TRACE", "1")
+    ring._reset_device_trace_cache()
+    obs_trace.collector().clear()
+    mesh = _mesh()
+    _trace_allreduce(mesh, 1, "seq_ar")
+    _trace_allreduce(mesh, 4, "fused_ar")
+    rep = attribution.device_overlap(obs_trace.collector().to_perfetto())
+    seq = rep["collectives"]["seq_ar"]
+    fus = rep["collectives"]["fused_ar"]
+    assert seq["exposed_fraction"] == pytest.approx(1.0)
+    assert fus["exposed_fraction"] < seq["exposed_fraction"]
+    assert fus["recovered_mxu_fraction"] > 0.5
+    assert seq["ranks"] == fus["ranks"] == NR
+
+
+def test_device_trace_off_emits_nothing(devtrace):
+    devtrace.delenv("ACCL_DEVICE_TRACE", raising=False)
+    ring._reset_device_trace_cache()
+    obs_trace.collector().clear()
+    mesh = _mesh()
+    _trace_allreduce(mesh, 4, "fused_ar")
+    assert obs_trace.collector().device_records() == []
+
+
+# ---------------------------------------------------------------------------
+# driver dispatch: opt-in, exactness, plan replay, abort fence
+# ---------------------------------------------------------------------------
+def _wdata(rank, count=256):
+    return (np.random.default_rng(7 + rank)
+            .standard_normal(count).astype(np.float32))
+
+
+def test_driver_fused_allreduce_matches_unfused():
+    count = 256
+    with TpuWorld(NR) as w:
+
+        def body(fused):
+            def run(accl, rank):
+                s = accl.create_buffer_like(_wdata(rank, count))
+                r = accl.create_buffer(count, np.float32)
+                accl.allreduce(s, r, count, ReduceFunction.SUM,
+                               fused=fused)
+                return r.host.copy()
+
+            return run
+
+        out_f = w.run(body(True))
+        out_u = w.run(body(False))
+        # every plan compiled for the fused calls carries the fused bit,
+        # and the unfused ones the r2 dispatch (fn_args[9])
+        flags = {p["fn_args"][9] for p in
+                 w.engine._gang_plans.values()}
+        assert flags == {True, False}
+    exact = sum(_wdata(r, count) for r in range(NR))
+    for r in range(NR):
+        np.testing.assert_allclose(out_f[r], exact, atol=1e-4)
+        np.testing.assert_allclose(out_f[r], out_u[r], atol=1e-4)
+
+
+def test_driver_fused_reduce_scatter_and_int8():
+    count = 256  # per-rank result length
+    with TpuWorld(NR) as w:
+
+        def run(accl, rank):
+            data = np.tile(_wdata(rank, count), NR)
+            s = accl.create_buffer_like(data)
+            r = accl.create_buffer(count, np.float32)
+            accl.reduce_scatter(s, r, count, ReduceFunction.SUM,
+                                fused=True)
+            q = accl.create_buffer(count * NR, np.float32)
+            a = accl.create_buffer_like(np.tile(_wdata(rank, count), NR))
+            accl.allreduce(a, q, count * NR, ReduceFunction.SUM,
+                           compress_dtype=DataType.int8, fused=True)
+            return r.host.copy(), q.host.copy()
+
+        outs = w.run(run)
+    exact = sum(_wdata(r, count) for r in range(NR))
+    tiled = np.tile(exact, NR)
+    amax = max(np.abs(_wdata(r, count)).max() for r in range(NR))
+    bound = NR * amax / 254 * 2
+    for r in range(NR):
+        rs, ar8 = outs[r]
+        np.testing.assert_allclose(rs, exact, atol=1e-4)
+        assert np.abs(ar8 - tiled).max() <= bound + 1e-4
+
+
+def test_accl_fused_env_default(monkeypatch):
+    """ACCL_FUSED=1 arms the driver default; unset leaves every gang
+    plan on the pre-r18 dispatch (the bit-identity contract)."""
+    monkeypatch.delenv("ACCL_FUSED", raising=False)
+    count = 64
+    with TpuWorld(2) as w:
+        assert all(a._fused_default is False for a in w.accls)
+
+        def run(accl, rank):
+            s = accl.create_buffer_like(_wdata(rank, count))
+            r = accl.create_buffer(count, np.float32)
+            accl.allreduce(s, r, count, ReduceFunction.SUM)
+            return r.host.copy()
+
+        w.run(run)
+        assert all(p["fn_args"][9] is False
+                   for p in w.engine._gang_plans.values())
+    monkeypatch.setenv("ACCL_FUSED", "1")
+    with TpuWorld(2) as w:
+        assert all(a._fused_default is True for a in w.accls)
+        w.run(run)
+        assert any(p["fn_args"][9] for p in
+                   w.engine._gang_plans.values())
+
+
+def test_selection_policy_arms_fused_descriptor():
+    """A table cell won by the ``fused`` lane arms the memoized call
+    descriptor on first consult: subsequent dispatch rides the fused
+    gang plan with no per-call flag from the caller."""
+    from accl_tpu.tuning.autotune import (SelectionPolicy,
+                                          SelectionTable, cell_key)
+
+    count = 256  # 1 KiB fp32 -> the <=1KiB bucket
+    tab = SelectionTable(
+        {cell_key("allreduce", "float32", "<=1KiB", NR): {
+            "algorithm": "fused", "busbw_GBps": 1.0,
+            "static_busbw_GBps": 0.5, "bytes": count * 4,
+            "overlap": 0.25}},
+        {"backend": "tpu", "nranks": NR, "dtype": "float32"})
+    with TpuWorld(NR) as w:
+        for a in w.accls:
+            a._tune_policy = SelectionPolicy(tab)
+
+        def run(accl, rank):
+            s = accl.create_buffer_like(_wdata(rank, count))
+            r = accl.create_buffer(count, np.float32)
+            accl.allreduce(s, r, count, ReduceFunction.SUM)
+            return r.host.copy()
+
+        outs = w.run(run)
+        assert any(p["fn_args"][9] for p in
+                   w.engine._gang_plans.values())
+    exact = sum(_wdata(r, count) for r in range(NR))
+    for r in range(NR):
+        np.testing.assert_allclose(outs[r], exact, atol=1e-4)
+
+
+def test_plan_capture_replay_fused_bitwise():
+    """A captured fused call replays bitwise-stable: N replays produce
+    exactly the bytes of N eager fused calls."""
+    count = 256
+    with TpuWorld(NR) as w:
+        store: dict = {}
+        plans: dict = {}
+
+        def cap(accl, rank):
+            s = accl.create_buffer_like(_wdata(rank, count))
+            s.sync_to_device()
+            r = accl.create_buffer(count, np.float32)
+            store[rank] = (s, r)
+            plans[rank] = accl.capture_plan(lambda a: a.allreduce(
+                s, r, count, ReduceFunction.SUM, from_fpga=True,
+                to_fpga=True, fused=True))
+
+        w.run(cap)
+
+        def rep(accl, rank):
+            outs = []
+            for _ in range(3):
+                plans[rank].replay()
+                s, r = store[rank]
+                r.sync_from_device()
+                outs.append(r.host.copy())
+            return outs
+
+        outs = w.run(rep)
+    exact = sum(_wdata(r, count) for r in range(NR))
+    for rank in range(NR):
+        first = outs[rank][0]
+        np.testing.assert_allclose(first, exact, atol=1e-4)
+        for rep_out in outs[rank][1:]:
+            np.testing.assert_array_equal(first, rep_out)
+
+
+def test_fused_call_abort_fence_raises():
+    """The abort fast-fail precedes dispatch: a fused call on a fenced
+    communicator raises COMM_ABORTED, never runs."""
+    count = 64
+    with EmuWorld(2) as w:
+
+        def run(accl, rank):
+            accl.abort(0)
+            s = accl.create_buffer_like(_wdata(rank, count))
+            r = accl.create_buffer(count, np.float32)
+            with pytest.raises(ACCLError, match="aborted"):
+                accl.allreduce(s, r, count, ReduceFunction.SUM,
+                               fused=True)
+
+        w.run(run)
+
+
+# ---------------------------------------------------------------------------
+# models: the fused flag is parity-neutral
+# ---------------------------------------------------------------------------
+def test_transformer_tp_forward_fused_bitwise(devtrace, rng):
+    from accl_tpu.models import transformer as tf
+
+    mesh = _mesh(2, "tp")
+    cfg = tf.ModelConfig(vocab=64, d_model=32, n_heads=2, d_head=8,
+                         n_layers=1, d_ff=64)
+    params = tf.init_params(np.random.default_rng(0), cfg)
+    tokens = rng.integers(0, cfg.vocab, size=(2, 8)).astype(np.int32)
+
+    def fwd(fused):
+        def body(p, t):
+            return tf.forward(p, t, cfg, tp_axis="tp", fused=fused)
+
+        specs = jax.tree.map(lambda _: P(), params)
+        try:
+            f = shard_map(body, mesh=mesh, in_specs=(specs, P()),
+                          out_specs=P(), check_vma=False)
+        except TypeError:
+            f = shard_map(body, mesh=mesh, in_specs=(specs, P()),
+                          out_specs=P(), check_rep=False)
+        return np.asarray(jax.jit(f)(params, tokens))
+
+    np.testing.assert_array_equal(fwd(False), fwd(True))
+
+
+# ---------------------------------------------------------------------------
+# tier 3: the hand-scheduled Pallas kernels (skip on jax without
+# remote-DMA interpret support, like the pallas ring tests)
+# ---------------------------------------------------------------------------
+def test_fused_matmul_allreduce_pallas_kernel(devtrace, rng):
+    mesh = _mesh()
+    K, N = 32, 128
+    x = rng.standard_normal((NR, 128, K)).astype(np.float32)
+    w = rng.standard_normal((NR, K, N)).astype(np.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None, None)))
+    ws = jax.device_put(w, NamedSharding(mesh, P("dp", None, None)))
+
+    def body(xb, wb):
+        return F.fused_matmul_allreduce_pallas(
+            xb[0], wb[0], axis="dp", interpret=True)[None]
+
+    spec = (P("dp", None, None), P("dp", None, None))
+    try:
+        out = np.asarray(jax.jit(_smap(mesh, body, spec,
+                                       P("dp", None, None)))(xs, ws))
+    except NotImplementedError as e:  # jax-skew: no remote DMA interp
+        pytest.skip(f"pallas interpreter lacks remote DMA: {e}")
+    ref = np.einsum("rmk,rkn->mn", x, w)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-3)
