@@ -178,6 +178,14 @@ class ACCL:
         #: carries fused=False and dispatch is bit-identical to r17.
         self._fused_default = os.environ.get(
             "ACCL_FUSED", "0") not in ("", "0")
+        #: transparent hierarchical dispatch (r19): memoized per-
+        #: (comm, axis-split) composers serving table cells won by the
+        #: "hierarchical" lane without the caller constructing one.
+        #: Probed only AFTER an armed policy returned "hierarchical"
+        #: for a call — no table (or no hier win) never touches it, so
+        #: dispatch stays bit-identical when nothing selects the lane.
+        self._hier_comms: dict = {}
+        self._in_hier = False
 
     # ------------------------------------------------------------------
     # bring-up (reference: accl.cpp:1082-1130 initialize)
@@ -678,6 +686,81 @@ class ACCL:
         # cached decisions — the next call re-resolves at current size
         if self._tune_policy is not None:
             self._tune_policy._memo.clear()
+        # transparent-dispatch composers (r19) memoize an axis split
+        # over a specific membership epoch; a fence retires them the
+        # same way it retires captured plans
+        self._drop_hier_comms()
+
+    def _drop_hier_comms(self) -> None:
+        """Retire the transparent-dispatch composer memo (r19): cached
+        scratch is freed; the burned sub-comm ids stay (create-order
+        discipline), and a later qualifying call re-mints sub-comms in
+        gang order on every rank alike."""
+        if not self._hier_comms:
+            return
+        for h in self._hier_comms.values():
+            if h:
+                try:
+                    h.close()
+                except ACCLError:
+                    pass
+        self._hier_comms.clear()
+
+    def _route_hier(self, call: CCLOCall, sync_in: list, sync_out: list,
+                    run_async: bool, desc: str):
+        """Serve one call through the composer the selection table
+        picked for its cell (r19 transparent hierarchical dispatch).
+        Returns the last staged call's completed Request, or None when
+        the call does not qualify — root-dependent, async, device-
+        resident, stream/compressed/fused, sub-communicator, capture
+        or sanitizer active — and must ride the flat path.  First
+        qualifying call per (comm, axis split) mints the composer:
+        lazy construction is create-order aligned because every rank
+        reaches the same first qualifying call in gang order."""
+        if (run_async or call.comm != GLOBAL_COMM
+                or not sync_in or not sync_out
+                or call.compression_flags != CompressionFlags.NO_COMPRESSION
+                or call.stream_flags != StreamFlags.NO_STREAM
+                or call.host_flags != HostFlags.NO_HOST
+                or call.fused
+                or self._plan_recorder is not None or _san.active()):
+            return None
+        op = Operation(call.scenario)
+        if op.name not in ("allreduce", "reduce_scatter", "allgather"):
+            return None
+        table = self._tune_policy.table
+        meta = table.world or {}
+        key = (call.comm, tuple(meta.get("shape") or ()),
+               tuple(meta.get("axis_order") or ()))
+        h = self._hier_comms.get(key)
+        if h is None:
+            from .tuning.autotune import fabric_of_table
+            from .tuning.compose import HierarchicalComm
+
+            fabric = fabric_of_table(table, self.size)
+            if fabric.trivial:
+                # nothing to compose across: remember the miss so the
+                # next call is one dict probe, and ride the flat path
+                self._hier_comms[key] = False
+                return None
+            h = HierarchicalComm(self, fabric)
+            self._hier_comms[key] = h
+        elif h is False:
+            return None
+        sendbuf, recvbuf = sync_in[0][0], sync_out[0][0]
+        self._in_hier = True
+        try:
+            if op is Operation.allreduce:
+                h.allreduce(sendbuf, recvbuf, call.count,
+                            ReduceFunction(call.function))
+            elif op is Operation.reduce_scatter:
+                h.reduce_scatter(sendbuf, recvbuf, call.count,
+                                 ReduceFunction(call.function))
+            else:
+                h.allgather(sendbuf, recvbuf, call.count)
+        finally:
+            self._in_hier = False
+        return self._last_request
 
     def _replay_auto(self, entry, desc: str) -> Optional[Request]:
         """Route one auto-captured call through its plan ring; returns
@@ -1468,6 +1551,19 @@ class ACCL:
             # signature rides the fused gang plan)
             if alg == "fused" and not call.fused:
                 call.fused = True
+            # transparent hierarchical dispatch (r19): a cell won by
+            # the composer routes through a memoized per-(comm,
+            # axis-split) HierarchicalComm — the caller never
+            # constructs one.  Only the plain sync host path on the
+            # global communicator qualifies; everything else falls
+            # through to the flat engine call.  The _in_hier guard
+            # keeps the composer's own staged sub-comm calls (which
+            # re-enter _execute) on the flat path.
+            elif alg == "hierarchical" and not self._in_hier:
+                routed = self._route_hier(call, sync_in, sync_out,
+                                          run_async, desc)
+                if routed is not None:
+                    return routed
         # plan auto-replay (ACCL_PLAN_AUTO, accl_tpu/plans.py): a call
         # whose gang agreed to arm a one-step ring replays through it —
         # no descriptor work, no gang assembly, no per-call request

@@ -1008,6 +1008,12 @@ class EmuWorld:
             [d.engine_stats for d in self.devices], name="accl-emu",
             link_sources=[(r, d.link_stats)
                           for r, d in enumerate(self.devices)])
+        # online tuner (r19): ACCL_TUNE_ONLINE=1 closes the telemetry
+        # -> tuner loop over this world (tuning/online.py); unset
+        # constructs nothing and dispatch stays bit-identical
+        from ..tuning import online as _online
+
+        self.online_tuner = _online.ensure_online_tuner_from_env(self)
         _live_worlds.add(self)  # interpreter-exit safety net
 
     def start_watchdog(self, **kwargs) -> "_health.Watchdog":
@@ -1142,6 +1148,16 @@ class EmuWorld:
 
     def close(self) -> None:
         self.watchdog.stop()
+        if getattr(self, "online_tuner", None) is not None:
+            from ..tuning import online as _online
+
+            # stop the loop before engines die — a mid-teardown A/B
+            # measurement would submit against a dying world
+            if _online.online_tuner() is self.online_tuner:
+                _online.stop_online_tuner()
+            else:
+                self.online_tuner.stop()
+            self.online_tuner = None
         if self.telemetry is not None:
             self.telemetry.stop()  # before shutdown: no poll of a dead world
             self.telemetry = None

@@ -2504,6 +2504,12 @@ class TpuWorld:
             [self.devices[0].engine_stats], name="accl-tpu",
             link_sources=[(r, d.link_stats)
                           for r, d in enumerate(self.devices)])
+        # online tuner (r19): same world-level arm as EmuWorld —
+        # ACCL_TUNE_ONLINE=1 starts the live retune loop, unset
+        # constructs nothing (bit-identical dispatch)
+        from ..tuning import online as _online
+
+        self.online_tuner = _online.ensure_online_tuner_from_env(self)
 
     def run(self, fn: Callable, *args) -> list:
         futures = [self._pool.submit(fn, self.accls[r], r, *args)
@@ -2524,6 +2530,14 @@ class TpuWorld:
                                       nranks=self.nranks, comm=comm)
 
     def close(self) -> None:
+        if getattr(self, "online_tuner", None) is not None:
+            from ..tuning import online as _online
+
+            if _online.online_tuner() is self.online_tuner:
+                _online.stop_online_tuner()
+            else:
+                self.online_tuner.stop()
+            self.online_tuner = None
         if self.telemetry is not None:
             self.telemetry.stop()
             self.telemetry = None

@@ -257,6 +257,12 @@ class FlightRecorder:
 FENCE_EVENTS = frozenset(("abort", "shrink", "grow", "reset_errors"))
 PLAN_CAPTURE_EVENT = "plan_capture"
 TEARDOWN_EVENT = "engine_teardown"
+#: r19 online tuner: one anchor per hot-swapped selection install (and
+#: per revert), so merge_flight_dumps can order retunes against the
+#: traffic they reshaped.  Same zero-duration mark_event discipline as
+#: the fences — an install IS a fence for captured plans.
+RETUNE_EVENT = "retune_install"
+RETUNE_REVERT_EVENT = "retune_revert"
 
 
 def mark_event(recorder: Optional["FlightRecorder"], name: str,

@@ -349,6 +349,14 @@ class MetricsExporter:
                     elif self.path.startswith("/flight"):
                         body = json.dumps(_flight.dump_all()).encode()
                         ctype = "application/json"
+                    elif self.path.startswith("/retunes"):
+                        # r19: the online tuner's bounded retune-
+                        # history ring (empty doc when no tuner ran)
+                        from ..tuning import online as _online
+
+                        body = json.dumps(
+                            _online.history_doc()).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404)
                         return

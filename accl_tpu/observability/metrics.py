@@ -156,6 +156,25 @@ METRIC_HELP = {
     "accl_sentinel_checks": "sentinel comparison sweeps executed",
     "accl_sentinel_findings": ("sentinel drift findings (p50/p99/"
                                "bandwidth past threshold vs baseline)"),
+    # ---- online tuner retune episodes (r19, tuning/online.py) ----
+    "accl_tuning_retunes_proposed": ("retune hypotheses opened from a "
+                                     "sentinel finding or fabric "
+                                     "re-score (one cell or one axis, "
+                                     "never a full sweep)"),
+    "accl_tuning_retunes_verified": ("retune hypotheses whose "
+                                     "challenger won the interleaved "
+                                     "best-of A/B against the "
+                                     "incumbent"),
+    "accl_tuning_retunes_installed": ("retune selections hot-swapped "
+                                      "into the live SelectionPolicy "
+                                      "and backend registers"),
+    "accl_tuning_retunes_rejected": ("retune hypotheses dropped: "
+                                     "challenger lost the A/B, "
+                                     "hysteresis margin unmet, or "
+                                     "cooldown suppressed the cell"),
+    "accl_tuning_retunes_reverted": ("installed retunes rolled back "
+                                     "after a post-install sentinel "
+                                     "regression on the same cell"),
     # ---- TPU per-engine registry bare names (TpuEngine.metrics — the
     # dispatch-lane counters ACCL.metrics() merges under engine/ keys;
     # HELP here keeps the per-engine registry itself exportable) ----

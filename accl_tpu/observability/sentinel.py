@@ -228,6 +228,12 @@ class Sentinel:
         self._thread: Optional[threading.Thread] = None
         #: last check's findings (tests + perf_doctor read this)
         self.findings: list = []
+        #: r19 online-tuner hook: callables invoked with each check's
+        #: FRESH findings list (never the repeats) — see subscribe()
+        self._subscribers: list = []
+        #: (collective, dtype, bucket, axis) -> drift ratio at last
+        #: delivery (the WORSEN_RATIO re-delivery reference)
+        self._delivered: dict = {}
 
     # -- the comparison (shared by live sentinel + offline doctor) ------
     def compare_snapshot(self, snapshot: dict) -> list:
@@ -275,16 +281,42 @@ class Sentinel:
                         kind="bandwidth")
         return findings
 
+    #: a persisting finding is RE-delivered to subscribers when its
+    #: drift ratio worsens past this factor of the last delivery — the
+    #: r19 online tuner's revert path depends on it (a bad install
+    #: makes an already-flagged cell WORSE; a merely-persisting finding
+    #: must not spam the control plane)
+    WORSEN_RATIO = 1.25
+
     def check(self) -> list:
         """One sweep: compare, publish counters + the slow verdict, log
-        each NEW finding through the structured logger."""
+        each NEW (or materially worsened) finding through the
+        structured logger."""
         self._registry.inc("sentinel/checks")
-        prev_keys = {(f["collective"], f["dtype"], f["size_bucket"],
-                      f["axis"]) for f in self.findings}
+
+        def _key(f):
+            return (f["collective"], f["dtype"], f["size_bucket"],
+                    f["axis"])
+
+        def _drift(f):
+            # bandwidth findings drift DOWN (live/baseline < 1); fold
+            # both kinds into a worsens-upward scale
+            return 1.0 / f["ratio"] if f["kind"] == "bandwidth" \
+                and f["ratio"] else f["ratio"]
+
+        live_keys = set()
         self.findings = self.compare_snapshot(self._registry.snapshot())
-        fresh = [f for f in self.findings
-                 if (f["collective"], f["dtype"], f["size_bucket"],
-                     f["axis"]) not in prev_keys]
+        fresh = []
+        for f in self.findings:
+            live_keys.add(_key(f))
+            last = self._delivered.get(_key(f))
+            if last is None or _drift(f) > last * self.WORSEN_RATIO:
+                fresh.append(f)
+                self._delivered[_key(f)] = _drift(f)
+        # a finding that cleared re-arms: if it comes back, deliver it
+        for k in list(self._delivered):
+            if k not in live_keys:
+                del self._delivered[k]
         if fresh:
             self._registry.inc("sentinel/findings", len(fresh))
             from ..utils.logging import get_logger
@@ -298,8 +330,35 @@ class Sentinel:
                     f["collective"], f["dtype"], f["size_bucket"],
                     f["axis"], f["ratio"], f["live"], f["baseline"],
                     f["threshold"], f["baseline_source"])
+        if fresh:
+            # r19: fan the fresh findings out to subscribers (the
+            # online tuner's hypothesis intake).  A subscriber fault
+            # must never take the sentinel loop down — the loop is the
+            # thing that would report it.
+            for fn in list(self._subscribers):
+                try:
+                    fn(list(fresh))
+                except Exception:
+                    from ..utils.logging import get_logger
+
+                    get_logger("accl_tpu.sentinel").warning(
+                        "sentinel subscriber %r raised; dropping this "
+                        "delivery", fn, exc_info=True)
         _health.note_slow(self._registry, bool(self.findings))
         return self.findings
+
+    def subscribe(self, fn) -> None:
+        """Register a callback for fresh findings (called from the
+        sentinel's check thread with a list of finding dicts).  The
+        online tuner subscribes here; idempotent per callable."""
+        if fn not in self._subscribers:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        try:
+            self._subscribers.remove(fn)
+        except ValueError:
+            pass
 
     # -- lifecycle ------------------------------------------------------
     def start(self, interval_s: float = 5.0) -> "Sentinel":

@@ -35,7 +35,8 @@ def _pad_to_multiple(flat, size: int):
 
 
 def sync_gradients(grads, axis: str = "dp", compress: Optional[str] = None,
-                   mean: bool = True):
+                   mean: bool = True, error_feedback: bool = False,
+                   stochastic: bool = False, seed: int = 0):
     """All-reduce a gradient pytree across the data-parallel axis.
 
     `compress="bf16"|"f16"` models the reference's on-the-wire fp16
@@ -43,7 +44,9 @@ def sync_gradients(grads, axis: str = "dp", compress: Optional[str] = None,
     link in half precision, accumulate in fp32.  `compress="int8"` goes
     one tier further than the reference's lane set: the leaf rides a
     quantized ring allreduce (int8 wire + per-block fp32 scales, 4:1 —
-    ops/quantized.py)."""
+    ops/quantized.py).  `error_feedback`/`stochastic`/`seed` forward to
+    the quantized ring's per-hop requantization error carry (EQuARX);
+    they only apply to the int8 lane."""
     size = _axis_size(axis)
 
     def sync_leaf(g):
@@ -52,7 +55,9 @@ def sync_gradients(grads, axis: str = "dp", compress: Optional[str] = None,
             from ..ops.quantized import quantized_all_reduce
 
             flat = _pad_to_multiple(g.astype(jnp.float32).reshape(-1), size)
-            out = quantized_all_reduce(flat, axis)
+            out = quantized_all_reduce(flat, axis,
+                                       error_feedback=error_feedback,
+                                       stochastic=stochastic, seed=seed)
             if mean:
                 out = out / size
             n = g.size

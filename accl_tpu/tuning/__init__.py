@@ -22,6 +22,13 @@ Three layers (HiCCL, arxiv 2408.05962; ACCL+ crossover points, arxiv
   threshold become the backend of the learned policy.  Knobs:
   ``ACCL_TUNE_TABLE=path`` arms it, ``ACCL_TUNE=0`` restores the static
   thresholds bit-for-bit.
+- :mod:`~accl_tpu.tuning.online` — the r19 live control plane:
+  :class:`OnlineTuner` subscribes to sentinel findings and link-matrix
+  re-scores, re-measures ONE cell (or re-demotes one axis) with the
+  interleaved best-of A/B, and hot-swaps the live policy only when the
+  challenger wins — never-slower, fenced like abort, every episode in
+  the exported retune-history ring.  ``ACCL_TUNE_ONLINE=1`` arms it;
+  unset is bit-identical to the static/table dispatch.
 """
 from .autotune import (  # noqa: F401
     SelectionPolicy,
@@ -31,4 +38,12 @@ from .autotune import (  # noqa: F401
     tune,
 )
 from .compose import HierarchicalComm  # noqa: F401
+from .online import (  # noqa: F401
+    OnlineTuner,
+    RetuneHistory,
+    ensure_online_tuner_from_env,
+    online_enabled,
+    online_tuner,
+    stop_online_tuner,
+)
 from .topology import Fabric  # noqa: F401

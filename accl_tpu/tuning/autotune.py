@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
@@ -36,6 +37,7 @@ import numpy as np
 from ..bench import sweep as _sweep
 from ..constants import ACCLError, ReduceFunction, TuningKey
 from ..observability import metrics as _metrics
+from ..utils.logging import get_logger
 from .compose import HierarchicalComm
 from .topology import Fabric
 
@@ -79,6 +81,26 @@ ENV_TUNE = "ACCL_TUNE"
 
 _HUGE = 0x7FFFFFFF
 
+#: r19 overlap objective: lanes within this busbw fraction of the
+#: cell's fastest are a TIE, resolved toward the lane that recovered
+#: the most MXU time (lowest r18 ``attribution.overlap`` exposed-wire
+#: fraction).  2% sits inside best-of-reps measurement noise, so the
+#: tie-break never overrules a real bandwidth win.
+OVERLAP_TIE_BAND = 0.02
+
+_BUCKET_UNITS = {"B": 1, "KiB": 1 << 10, "MiB": 1 << 20,
+                 "GiB": 1 << 30, "TiB": 1 << 40}
+_BUCKET_RE = re.compile(r"<=(\d+)(B|KiB|MiB|GiB|TiB)$")
+
+
+def bucket_bytes(bucket: str) -> int:
+    """Invert :func:`metrics.size_bucket`: the bucket label's
+    upper-bound payload in bytes — the representative size a targeted
+    online re-measure probes.  0 for the degenerate ``0B`` bucket (and
+    anything unparseable: the caller skips those cells)."""
+    m = _BUCKET_RE.match(bucket)
+    return int(m.group(1)) * _BUCKET_UNITS[m.group(2)] if m else 0
+
 
 @dataclass
 class TuneConfig:
@@ -112,11 +134,38 @@ class SelectionTable:
     def __init__(self, entries: dict, world: dict):
         self.entries = entries
         self.world = world
+        self._dtypes: Optional[frozenset] = None
+        self._fallback_logged: set = set()
+
+    def dtypes(self) -> frozenset:
+        """The dtypes this table has swept cells for (cached; any
+        entry mutation must clear ``_dtypes``)."""
+        if self._dtypes is None:
+            self._dtypes = frozenset(
+                k.split("|")[1] for k in self.entries)
+        return self._dtypes
 
     def lookup(self, coll: str, dtype: str, nbytes: int,
                nranks: int) -> Optional[dict]:
-        return self.entries.get(
-            cell_key(coll, dtype, _metrics.size_bucket(nbytes), nranks))
+        bucket = _metrics.size_bucket(nbytes)
+        entry = self.entries.get(cell_key(coll, dtype, bucket, nranks))
+        if entry is not None or dtype == "float32":
+            return entry
+        # per-dtype tables (r19): a dtype the sweep never covered is
+        # served the float32 row — the schedule crossovers are shaped
+        # by payload bytes, not element type — and logged once so an
+        # operator knows the selection is borrowed, not measured
+        if dtype in self.dtypes():
+            return None  # swept dtype, genuinely untuned cell
+        entry = self.entries.get(
+            cell_key(coll, "float32", bucket, nranks))
+        if entry is not None and dtype not in self._fallback_logged:
+            self._fallback_logged.add(dtype)
+            get_logger("accl_tpu.tuning").info(
+                "selection table has no %s cells; serving the float32 "
+                "row (sweep it: scripts/accl_tune.py --dtype %s)",
+                dtype, dtype)
+        return entry
 
     def to_doc(self) -> dict:
         return {
@@ -483,6 +532,9 @@ def measure(world, config: TuneConfig = TuneConfig(),
                     rows.append({
                         "algorithm": alg,
                         "collective": coll,
+                        # r19 per-dtype tables: rows carry their own
+                        # dtype so one table can merge multiple sweeps
+                        "dtype": config.dtype,
                         "count": count,
                         "bytes": nbytes,
                         "size_bucket": _metrics.size_bucket(nbytes),
@@ -507,18 +559,36 @@ def measure(world, config: TuneConfig = TuneConfig(),
     return rows
 
 
+def _tie_rank(r: dict) -> tuple:
+    """Ordering within a busbw tie band: most recovered MXU fraction
+    (1 - overlap) first, then raw busbw, then static (ties on a box
+    with no flight coverage keep the pre-r19 argmax winner)."""
+    ov = r.get("overlap")
+    recovered = (1.0 - ov) if ov is not None else -1.0
+    return (recovered, r["busbw_GBps"], r["algorithm"] == "static")
+
+
 def build_table(rows: list, world_meta: dict) -> SelectionTable:
     """Per-cell argmax busbw over the measured lanes.  ``static`` is
     always a candidate, so a tuned world is never knowingly worse than
-    the static thresholds on any measured cell."""
+    the static thresholds on any measured cell.  Lanes within
+    ``OVERLAP_TIE_BAND`` of the fastest are tie-broken toward the one
+    with the lowest measured exposed-wire fraction (r18
+    ``attribution.overlap`` folded into the objective): equal wire
+    speed, more MXU time recovered."""
     cells: dict = {}
     for r in rows:
-        key = cell_key(r["collective"], world_meta.get("dtype", "float32"),
+        key = cell_key(r["collective"],
+                       r.get("dtype")
+                       or world_meta.get("dtype", "float32"),
                        r["size_bucket"], world_meta["nranks"])
         cells.setdefault(key, []).append(r)
     entries = {}
     for key, cands in cells.items():
-        best = max(cands, key=lambda r: r["busbw_GBps"])
+        top = max(cands, key=lambda r: r["busbw_GBps"])
+        band = [r for r in cands if r["busbw_GBps"]
+                >= top["busbw_GBps"] * (1.0 - OVERLAP_TIE_BAND)]
+        best = max(band, key=_tie_rank) if len(band) > 1 else top
         static = next((r for r in cands if r["algorithm"] == "static"),
                       None)
         entries[key] = {
@@ -680,6 +750,7 @@ def compare(world, table: SelectionTable,
         ratio = round(tuned_bw / static_bw, 3) if static_bw else 0.0
         out.append({
             "collective": coll,
+            "dtype": dt,
             "size_bucket": bucket,
             "count": count,
             "bytes": nbytes,
@@ -697,6 +768,107 @@ def compare(world, table: SelectionTable,
         for h in hier:
             h.close()
     return out
+
+
+# ---------------------------------------------------------------------------
+# single-cell measurement (the online tuner's unit of work)
+# ---------------------------------------------------------------------------
+
+def run_cell_lane(world, alg: str, coll: str, count: int, dtype,
+                  root: int = 0, hier: Optional[list] = None) -> float:
+    """One timed rep of one lane on one cell — ``compare()``'s
+    run-lane contract at module level so the online tuner re-measures
+    exactly the way the offline verifier did."""
+    if alg == "hierarchical":
+        apply_algorithm(world, "static")
+        return _run_once_hier(world, hier, coll, count, dtype, root)
+    if alg in COMPRESSION_ALGS:
+        apply_algorithm(world, "static")
+        return _sweep._run_once(world, coll, count, dtype, root,
+                                compress=_compress_dtype_of(alg))
+    if alg == "fused":
+        apply_algorithm(world, "static")
+        return _sweep._run_once(world, coll, count, dtype, root,
+                                fused=True)
+    apply_algorithm(world, alg)
+    return _sweep._run_once(world, coll, count, dtype, root)
+
+
+def cell_candidates(world, coll: str, count: int,
+                    dtype_name: str = "float32", *,
+                    repetitions: int = 2, root: int = 0,
+                    hier: Optional[list] = None,
+                    exclude: tuple = ()) -> list:
+    """Quick best-of sweep of every covering lane on ONE cell — the
+    online tuner's challenger shortlist (a targeted hypothesis, never
+    a full sweep).  Returns ``[(algorithm, busbw_GBps)]`` fastest
+    first; registers are restored to static."""
+    P = world.nranks
+    dtype = _sweep._resolve_dtype(dtype_name)
+    nbytes = count * _sweep._payload_factor(coll, P) * dtype.itemsize
+    bwf = _sweep._busbw_factor(coll, P)
+    static_regs = world.accls[0].static_tuning()
+    backend = backend_of(world)
+    out = []
+    try:
+        for alg in algorithms_for(world, dtype_name):
+            if alg in exclude:
+                continue
+            if alg == "hierarchical" and hier is None:
+                continue
+            if not lane_covers(backend, alg, coll, nranks=P,
+                               nbytes=nbytes, static_regs=static_regs):
+                continue
+            run_cell_lane(world, alg, coll, count, dtype, root, hier)
+            dur = min(run_cell_lane(world, alg, coll, count, dtype,
+                                    root, hier)
+                      for _ in range(repetitions))
+            bw = round(nbytes / dur / 1e9 * bwf, 4) if dur > 0 else 0.0
+            out.append((alg, bw))
+    finally:
+        apply_algorithm(world, "static")
+    return sorted(out, key=lambda t: -t[1])
+
+
+def ab_cell(world, incumbent: str, challenger: str, coll: str,
+            count: int, dtype_name: str = "float32", *,
+            repetitions: int = 3, retries: int = 2, root: int = 0,
+            hier: Optional[list] = None) -> tuple:
+    """The r16 interleaved best-of A/B on ONE cell: warm both lanes,
+    interleave rep pairs in the same session so box drift hits both
+    alike, symmetric best-of across retry rounds (retrying cannot bias
+    the ratio toward either side).  Returns ``(incumbent_busbw,
+    challenger_busbw)`` in GB/s; registers end restored to static."""
+    P = world.nranks
+    dtype = _sweep._resolve_dtype(dtype_name)
+    nbytes = count * _sweep._payload_factor(coll, P) * dtype.itemsize
+    bwf = _sweep._busbw_factor(coll, P)
+
+    def to_bw(dur):
+        return round(nbytes / dur / 1e9 * bwf, 4) if dur > 0 else 0.0
+
+    def pair():
+        run_cell_lane(world, incumbent, coll, count, dtype, root, hier)
+        run_cell_lane(world, challenger, coll, count, dtype, root, hier)
+        di, dc = [], []
+        for _ in range(repetitions):
+            di.append(run_cell_lane(world, incumbent, coll, count,
+                                    dtype, root, hier))
+            dc.append(run_cell_lane(world, challenger, coll, count,
+                                    dtype, root, hier))
+        return to_bw(min(di)), to_bw(min(dc))
+
+    try:
+        inc_bw, ch_bw = pair()
+        attempts = retries
+        while ch_bw <= inc_bw and attempts > 0:
+            attempts -= 1
+            i2, c2 = pair()
+            inc_bw = max(inc_bw, i2)
+            ch_bw = max(ch_bw, c2)
+    finally:
+        apply_algorithm(world, "static")
+    return inc_bw, ch_bw
 
 
 # ---------------------------------------------------------------------------
@@ -830,6 +1002,38 @@ class SelectionPolicy:
             _metrics.default_registry().inc(f"tuning/selected/{alg}")
         self._memo[key] = alg
         return alg
+
+    def hot_swap(self, accl, key: str,
+                 entry: Optional[dict]) -> Optional[dict]:
+        """The online tuner's install primitive: replace (or drop,
+        ``entry=None``) ONE table cell, clear the dispatch memo, and
+        re-derive the backend registers from scratch.  Returns the
+        previous entry — the caller's revert token.  Registers are
+        rebuilt from the static values first because ``install`` only
+        writes thresholds it has wins for: a revert that removes the
+        last ring/flat win must fall back to static, not keep a stale
+        tuned threshold."""
+        prev = self.table.entries.get(key)
+        if entry is None:
+            self.table.entries.pop(key, None)
+        else:
+            self.table.entries[key] = dict(entry)
+        self.table._dtypes = None
+        self._memo.clear()
+        accl.apply_static_tuning()
+        had_compression = accl.compression_policy
+        self.install(accl)
+        if os.environ.get("ACCL_COMPRESS", "").strip():
+            # the env knob outranks table-derived compression at
+            # initialize; keep that precedence across an online swap
+            accl.set_compression(had_compression)
+        elif accl.compression_policy is had_compression and not any(
+                e.get("algorithm") in COMPRESSION_ALGS
+                for e in self.table.entries.values()):
+            # _install_compression leaves an armed policy standing
+            # when the swap removed the last compress win — disarm it
+            accl.set_compression(None)
+        return prev
 
 
 def policy_from_env() -> Optional[SelectionPolicy]:

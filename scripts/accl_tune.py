@@ -41,6 +41,11 @@ def main() -> int:
                     help="comma list of log2 element counts "
                          "(default 6,8,10,12,14,16)")
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--dtypes", default="",
+                    help="comma list of dtypes to sweep into ONE merged "
+                         "per-dtype table, e.g. float32,bfloat16,float16 "
+                         "(default: just --dtype; unswept dtypes are "
+                         "served the float32 row at dispatch)")
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--out", default="tune_table.json",
                     help="selection-table JSON path")
@@ -75,7 +80,9 @@ def main() -> int:
         kwargs["collectives"] = tuple(args.collectives.split(","))
     pows = (tuple(int(p) for p in args.pows.split(","))
             if args.pows else (6, 8, 10, 12, 14, 16))
-    cfg = TuneConfig(count_pows=pows, dtype=args.dtype,
+    dtypes = (tuple(d.strip() for d in args.dtypes.split(",") if d.strip())
+              if args.dtypes else (args.dtype,))
+    cfg = TuneConfig(count_pows=pows, dtype=dtypes[0],
                      repetitions=args.reps, shape=shape,
                      measured_demotion=not args.no_demotion, **kwargs)
 
@@ -104,13 +111,31 @@ def main() -> int:
     try:
         print(f"[accl_tune] tuning {args.ranks} ranks on "
               f"{args.backend} ({len(pows)} sizes x "
-              f"{len(cfg.collectives)} collectives)")
-        table = autotune.tune(world, cfg, log=print)
+              f"{len(cfg.collectives)} collectives x "
+              f"{len(dtypes)} dtypes)")
+        table = None
+        from dataclasses import replace
+        for d in dtypes:
+            cfg_d = replace(cfg, dtype=d)
+            if len(dtypes) > 1:
+                print(f"[accl_tune] dtype lane: {d}")
+            t = autotune.tune(world, cfg_d, log=print)
+            if table is None:
+                table = t
+            else:
+                # merged per-dtype table: one artifact, one cell per
+                # (collective, dtype, bucket) — dispatch falls back to
+                # the float32 row for dtypes never swept here
+                table.entries.update(t.entries)
+                table._dtypes = None
+        table.world["dtypes"] = list(dtypes)
         rows = []
         if args.record:
             print("[accl_tune] verifying tuned vs static (interleaved, "
                   "pruning unreproducible selections)")
-            rows = autotune.compare(world, table, cfg, log=print)
+            for d in dtypes:
+                rows.extend(autotune.compare(
+                    world, table, replace(cfg, dtype=d), log=print))
     finally:
         world.close()
 
@@ -122,7 +147,7 @@ def main() -> int:
         csv_path = f"{args.record}.csv"
         with open(csv_path, "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=[
-                "collective", "size_bucket", "count", "bytes",
+                "collective", "dtype", "size_bucket", "count", "bytes",
                 "algorithm", "static_busbw_GBps", "tuned_busbw_GBps",
                 "ratio"])
             w.writeheader()
@@ -134,7 +159,8 @@ def main() -> int:
             f.write(
                 f"# Tuned vs static sweep record\n\n"
                 f"- world: {args.ranks} ranks, {args.backend} backend, "
-                f"fabric {table.world.get('shape')}\n"
+                f"fabric {table.world.get('shape')}, dtypes "
+                f"{','.join(dtypes)}\n"
                 f"- table: {os.path.basename(args.out)} "
                 f"({len(table.entries)} cells, "
                 f"{tuned_cells} non-static selections after "
@@ -142,10 +168,12 @@ def main() -> int:
                 f"- wins >= 1.15x busbw vs static: {wins} cells\n"
                 f"- cells > 1.05x slower than static: {len(slow)} "
                 f"(gate: must be 0)\n\n"
-                f"| collective | bucket | algorithm | static GB/s | "
-                f"tuned GB/s | ratio |\n|---|---|---|---|---|---|\n")
+                f"| collective | dtype | bucket | algorithm | "
+                f"static GB/s | tuned GB/s | ratio |\n"
+                f"|---|---|---|---|---|---|---|\n")
             for r in rows:
-                f.write(f"| {r['collective']} | {r['size_bucket']} | "
+                f.write(f"| {r['collective']} | {r['dtype']} | "
+                        f"{r['size_bucket']} | "
                         f"{r['algorithm']} | {r['static_busbw_GBps']} "
                         f"| {r['tuned_busbw_GBps']} | {r['ratio']}x "
                         f"|\n")

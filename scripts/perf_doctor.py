@@ -26,7 +26,13 @@ files into the same three-part report a running world exposes through
   ``Fabric.from_link_matrix`` ingests for axis demotion;
 - **overlap accounting** (r15, needs --trace + --flight): wire-exposed
   vs compute-overlapped time per collective — the recovered-compute
-  precursor metric for device-initiated fusion (ROADMAP item 3).
+  precursor metric for device-initiated fusion (ROADMAP item 3);
+- **retune history** (r19, ``--retunes``): the online tuner's audit
+  ring (the ``/retunes`` exporter endpoint / retune_smoke artifact)
+  rendered as finding -> hypothesis -> A/B -> decision chains, with a
+  post-install cross-check against the sentinel section — an installed
+  cell the sentinel still flags (and the tuner has not auto-reverted)
+  is a finding.
 
 ``--ci`` is the perf-gate mode: the REPORT SCHEMA is hard-validated
 (a malformed dump or snapshot fails the job) but threshold findings
@@ -234,6 +240,122 @@ def render_link_matrix(section: dict, out) -> None:
               f"{'  (IMBALANCED)' if f['imbalanced'] else ''}\n")
 
 
+def load_retunes(path: str) -> dict:
+    from accl_tpu.tuning import online as _online
+
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) \
+            or doc.get("format") != _online.HISTORY_FORMAT:
+        raise ValueError(
+            f"{path} is not a retune history (format="
+            f"{doc.get('format') if isinstance(doc, dict) else doc!r}; "
+            f"want {_online.HISTORY_FORMAT!r} — the exporter's /retunes "
+            f"body or retune_smoke's artifact)")
+    return doc
+
+
+def validate_retune_section(doc: dict) -> list:
+    """--ci schema gate for the retune-history section: versioned
+    format, every episode a closed decision chain."""
+    from accl_tpu.tuning import online as _online
+
+    errors = []
+    if doc.get("version") != _online.HISTORY_VERSION:
+        errors.append(f"retunes: unsupported history version "
+                      f"{doc.get('version')!r}")
+    episodes = doc.get("episodes")
+    if not isinstance(episodes, list):
+        errors.append("retunes: 'episodes' is not a list")
+        return errors
+    for ep in episodes:
+        seq = ep.get("seq") if isinstance(ep, dict) else None
+        tag = f"retunes: episode {seq!r}"
+        if not isinstance(ep, dict) or not isinstance(seq, int):
+            errors.append(f"{tag}: not a sequenced episode dict")
+            continue
+        if ep.get("kind") not in ("cell", "axis"):
+            errors.append(f"{tag}: kind {ep.get('kind')!r}")
+        if ep.get("decision") not in _online.DECISIONS:
+            errors.append(f"{tag}: decision {ep.get('decision')!r} not "
+                          f"in {_online.DECISIONS}")
+        trigger = ep.get("trigger")
+        if not isinstance(trigger, dict) or "type" not in trigger:
+            errors.append(f"{tag}: trigger is not a typed dict")
+        if not isinstance(ep.get("opened_at"), (int, float)) \
+                or not isinstance(ep.get("closed_at"), (int, float)):
+            errors.append(f"{tag}: missing opened_at/closed_at stamps")
+        if ep.get("kind") == "cell" \
+                and ep.get("decision") in ("installed", "rejected",
+                                           "reverted") \
+                and not isinstance(ep.get("cell"), str):
+            errors.append(f"{tag}: cell decision without a cell key")
+    return errors
+
+
+def retune_cross_check(doc: dict, sentinel_findings: list) -> list:
+    """Installed cells the sentinel STILL flags: the tuner's own
+    post-install watch auto-reverts these when it sees the finding, so
+    one surviving in a report means the regression outlived the loop
+    (or the loop is stopped) — surface it as a finding."""
+    reverted = {ep.get("installed_episode")
+                for ep in doc.get("episodes", [])
+                if ep.get("decision") == "reverted"}
+    live_installs = {}
+    for ep in doc.get("episodes", []):
+        if ep.get("decision") == "installed" \
+                and ep.get("kind") == "cell" \
+                and ep.get("seq") not in reverted:
+            live_installs[ep["cell"]] = ep
+    out = []
+    for f in sentinel_findings:
+        key = "|".join(str(f.get(k, "")) for k in
+                       ("collective", "dtype", "size_bucket"))
+        for cell, ep in live_installs.items():
+            if cell.startswith(key + "|"):
+                out.append({
+                    "cell": cell, "episode": ep["seq"],
+                    "installed":
+                        (ep.get("installed") or {}).get("algorithm"),
+                    "sentinel_ratio": f.get("ratio"),
+                })
+    return out
+
+
+def render_retunes(doc: dict, cross: list, out) -> None:
+    episodes = doc.get("episodes", [])
+    out.write(f"\nretune history (r19): {len(episodes)} episode(s) "
+              f"kept of {doc.get('total', len(episodes))} "
+              f"({doc.get('dropped', 0)} dropped from the ring)\n")
+    for ep in episodes:
+        trig = ep.get("trigger", {})
+        if ep.get("kind") == "axis":
+            hyp = ep.get("hypothesis", {})
+            chain = (f"link_matrix re-score -> axis_order "
+                     f"{hyp.get('axis_order_from')} -> "
+                     f"{hyp.get('axis_order_to')}")
+        else:
+            parts = [f"sentinel {trig.get('kind', 'drift')} "
+                     f"{trig.get('ratio')}x on {ep.get('cell')}"]
+            hyp = ep.get("hypothesis")
+            if hyp:
+                parts.append(f"challenger {hyp.get('challenger')} vs "
+                             f"{hyp.get('incumbent')}")
+            ab = ep.get("ab")
+            if ab:
+                parts.append(f"A/B {ab.get('ratio')}x")
+            chain = " -> ".join(parts)
+        out.write(f"  #{ep.get('seq'):<3} [{ep.get('kind')}] {chain} "
+                  f"-> {str(ep.get('decision', '?')).upper()}"
+                  f"{': ' + ep['reason'] if ep.get('reason') else ''}\n")
+    for c in cross:
+        out.write(f"  CROSS-CHECK: installed cell {c['cell']} "
+                  f"(episode #{c['episode']}, {c['installed']}) is "
+                  f"still flagged by the sentinel at "
+                  f"{c['sentinel_ratio']}x and has NOT been "
+                  f"auto-reverted\n")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--metrics", default="",
@@ -248,6 +370,10 @@ def main() -> int:
                     help="committed baseline (sentinel JSON, callrate "
                          "record, registry snapshot, or sweep CSV); "
                          "repeatable — later files fill gaps")
+    ap.add_argument("--retunes", default="",
+                    help="retune-history JSON (the exporter's /retunes "
+                         "body / retune_smoke artifact) — rendered as "
+                         "decision chains + sentinel cross-check")
     ap.add_argument("--out", default="",
                     help="write the full JSON report here (CI artifact)")
     ap.add_argument("--ci", action="store_true",
@@ -259,8 +385,9 @@ def main() -> int:
     ap.add_argument("--timeline", action="store_true",
                     help="include the per-gang timeline in the report")
     args = ap.parse_args()
-    if not args.metrics and not args.flight:
-        ap.error("pass --metrics and/or --flight input files")
+    if not args.metrics and not args.flight and not args.retunes:
+        ap.error("pass --metrics, --flight, and/or --retunes input "
+                 "files")
 
     report: dict = {"version": 1}
     schema_errors: list = []
@@ -376,6 +503,19 @@ def main() -> int:
         except (OSError, ValueError, json.JSONDecodeError) as e:
             schema_errors.append(f"metrics/sentinel: "
                                  f"{type(e).__name__}: {e}")
+
+    # -- retune history (r19) ------------------------------------------
+    if args.retunes:
+        try:
+            doc = load_retunes(args.retunes)
+            schema_errors.extend(validate_retune_section(doc))
+            cross = retune_cross_check(
+                doc, report.get("sentinel", {}).get("findings", []))
+            report["retunes"] = {"history": doc, "cross_check": cross}
+            findings += len(cross)
+            render_retunes(doc, cross, sys.stdout)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            schema_errors.append(f"retunes: {type(e).__name__}: {e}")
 
     report["schema_errors"] = schema_errors
     report["findings_total"] = findings

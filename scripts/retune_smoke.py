@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""retune_smoke: CI drill for the r19 online-retune control plane.
+
+One command proves the live telemetry -> tuner loop end-to-end on a
+4-rank emu world, deterministically (no timer threads — the drill
+drives ``sentinel.check()`` and ``tuner.step()`` explicitly, so a
+failing run replays bit-for-bit from ``--seed``):
+
+1. healthy allreduce traffic; the registry snapshot becomes the
+   sentinel baseline (the committed-baseline stand-in);
+2. a SEEDED chaos plan (the ``ACCL_CHAOS`` grammar; default
+   ``slow_rank``) degrades one rank's egress MID-RUN — the next
+   ``sentinel.check()`` fires fresh findings into the subscribed
+   :class:`~accl_tpu.tuning.online.OnlineTuner`;
+3. the tuner turns one finding into one cell hypothesis, re-measures
+   with the interleaved best-of A/B, and closes an episode —
+   never-slower: only a verified winner installs, and the drill
+   asserts the post-decision p50 did not regress;
+4. artifacts (``retune_history.json`` — the exporter's ``/retunes``
+   body — plus the metrics snapshot and a summary) are round-tripped
+   through ``scripts/perf_doctor.py --ci --retunes`` in a subprocess:
+   the doctor must schema-validate and render the exact bytes a live
+   world would serve.
+
+Usage:
+  python scripts/retune_smoke.py --ranks 4 --seed 42 --out-dir .
+"""
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--count", type=int, default=4096,
+                    help="elements per allreduce (float32)")
+    ap.add_argument("--warm", type=int, default=12,
+                    help="healthy calls before the baseline snapshot")
+    ap.add_argument("--degraded", type=int, default=16,
+                    help="calls under chaos before the sentinel check")
+    ap.add_argument("--chaos", default="",
+                    help="ACCL_CHAOS-grammar plan injected mid-run "
+                         "(default: seed=<seed>,slow_rank=1:1000)")
+    ap.add_argument("--out-dir", default=".")
+    args = ap.parse_args()
+    chaos_spec = args.chaos or f"seed={args.seed},slow_rank=1:1000"
+
+    # same receive-budget widening as tests/conftest.py: a loaded CI
+    # core can stall a rank past the reference 1 s default
+    os.environ.setdefault("ACCL_DEFAULT_TIMEOUT", "30000000")
+    # single-axis fabric: this drill verifies the CONTROL PLANE
+    # (finding -> hypothesis -> A/B -> install), so the challenger
+    # shortlist stays on the register/compression lanes — the composed
+    # hierarchical lane under per-message egress-stall chaos is the
+    # offline composer drill's territory (its multi-stage traffic
+    # amplifies the stall past the engine wait budget on a loaded box)
+    os.environ.setdefault("ACCL_FABRIC", str(args.ranks))
+
+    import numpy as np
+
+    from accl_tpu.backends.emu import EmuWorld
+    from accl_tpu.bench import sweep as _sweep
+    from accl_tpu.observability import metrics as _metrics
+    from accl_tpu.observability.sentinel import Baseline, Sentinel
+    from accl_tpu.resilience.chaos import ChaosPlan
+    from accl_tpu.tuning.online import DECISIONS, OnlineTuner
+
+    dtype = np.dtype(np.float32)
+    registry = _metrics.default_registry()
+    world = EmuWorld(args.ranks, devmem_bytes=256 << 20,
+                     n_egr_rx_bufs=64, max_eager_size=16384,
+                     max_rendezvous_size=64 << 20)
+
+    def drive(n: int) -> float:
+        """n timed allreduces; returns the p50 call duration in us."""
+        durs = [_sweep._run_once(world, "allreduce", args.count, dtype, 0)
+                for _ in range(n)]
+        return statistics.median(durs) * 1e6
+
+    summary: dict = {"seed": args.seed, "chaos": chaos_spec,
+                     "count": args.count}
+    try:
+        # -- 1: healthy phase -> baseline -----------------------------
+        p50_warm = drive(args.warm)
+        summary["p50_warm_us"] = round(p50_warm, 1)
+        baseline = Baseline.from_snapshot(
+            registry.snapshot(), source=f"retune_smoke warm phase "
+                                        f"(seed {args.seed})")
+        assert baseline.entries, "warm traffic published no call metrics"
+        sentinel = Sentinel(baseline, registry, p50_ratio=1.5,
+                            p99_ratio=2.0, bw_ratio=0.6, min_calls=8)
+        tuner = OnlineTuner(world, hysteresis=1.05, repetitions=2)
+        tuner.attach_sentinel(sentinel)
+        print(f"retune_smoke: warm p50 {p50_warm:.0f}us over "
+              f"{args.warm} calls; baseline has "
+              f"{len(baseline.entries)} entr(ies)")
+
+        # -- 2: seeded chaos mid-run ----------------------------------
+        plan = ChaosPlan.parse(chaos_spec)
+        for r, d in enumerate(world.devices):
+            plan.apply(d, r)
+        p50_degraded = drive(args.degraded)
+        summary["p50_degraded_us"] = round(p50_degraded, 1)
+        print(f"retune_smoke: chaos [{chaos_spec}] -> degraded p50 "
+              f"{p50_degraded:.0f}us ({p50_degraded / p50_warm:.2f}x "
+              f"warm)")
+
+        findings = sentinel.check()
+        if not findings:
+            print("retune_smoke: FAIL — sentinel saw no drift after "
+                  f"the chaos phase (p50 {p50_degraded:.0f}us vs warm "
+                  f"{p50_warm:.0f}us)", file=sys.stderr)
+            return 1
+        print(f"retune_smoke: sentinel fired {len(findings)} "
+              f"finding(s); {tuner.pending()} queued to the tuner")
+
+        # -- 3: drain the control plane -------------------------------
+        episodes = []
+        while tuner.pending():
+            ep = tuner.step()
+            if ep is not None:
+                episodes.append(ep)
+        if not episodes:
+            print("retune_smoke: FAIL — findings queued but no episode "
+                  "closed", file=sys.stderr)
+            return 1
+        for ep in episodes:
+            assert ep["decision"] in DECISIONS, ep
+            print(f"retune_smoke: episode #{ep['seq']} "
+                  f"{ep.get('cell')}: {ep['decision']} "
+                  f"({ep.get('reason', '')})")
+        decisions = {ep["decision"] for ep in episodes}
+        if not decisions & {"installed", "rejected"}:
+            print(f"retune_smoke: FAIL — no episode reached a measured "
+                  f"decision (got {sorted(decisions)})", file=sys.stderr)
+            return 1
+
+        # never-slower, measured: whatever the decisions were, the live
+        # dispatch after the control plane ran must not be worse than
+        # the degraded state it was reacting to (generous slack:
+        # shared CI cores)
+        p50_post = drive(args.warm)
+        summary["p50_post_us"] = round(p50_post, 1)
+        summary["recovery_ratio"] = round(p50_degraded / p50_post, 3) \
+            if p50_post else 0.0
+        print(f"retune_smoke: post-decision p50 {p50_post:.0f}us "
+              f"({summary['recovery_ratio']}x recovery vs degraded)")
+        if p50_post > p50_degraded * 1.5:
+            print("retune_smoke: FAIL — dispatch after the retune is "
+                  f"{p50_post / p50_degraded:.2f}x SLOWER than the "
+                  f"degraded state (never-slower broken)",
+                  file=sys.stderr)
+            return 1
+
+        # retune counter families must have moved (schema'd telemetry)
+        counters = registry.snapshot()["counters"]
+        retunes = {k: v for k, v in counters.items()
+                   if k.startswith("tuning/retunes/")}
+        assert retunes.get("tuning/retunes/proposed", 0) >= 1, retunes
+        summary["retune_counters"] = retunes
+        print(f"retune_smoke: counters {retunes}")
+
+        # -- 4: artifacts + the perf_doctor round-trip ----------------
+        os.makedirs(args.out_dir, exist_ok=True)
+        hist_path = os.path.join(args.out_dir, "retune_history.json")
+        with open(hist_path, "w") as f:
+            json.dump(tuner.history.to_doc(), f, indent=1,
+                      sort_keys=True)
+        snap_path = os.path.join(args.out_dir, "retune_metrics.json")
+        with open(snap_path, "w") as f:
+            json.dump(registry.snapshot(), f, indent=1, sort_keys=True)
+        summary["episodes"] = len(episodes)
+        summary["decisions"] = sorted(decisions)
+        with open(os.path.join(args.out_dir,
+                               "retune_summary.json"), "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+    finally:
+        world.close()
+
+    report_path = os.path.join(args.out_dir, "retune_doctor_report.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "perf_doctor.py"),
+         "--retunes", hist_path, "--metrics", snap_path,
+         "--ci", "--out", report_path],
+        capture_output=True, text=True)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(f"retune_smoke: FAIL — perf_doctor --ci rejected the "
+              f"retune artifacts (rc={proc.returncode})",
+              file=sys.stderr)
+        return 1
+    with open(report_path) as f:
+        report = json.load(f)
+    assert "retunes" in report and not report["schema_errors"], report
+    print("retune_smoke: OK — artifact round-trip through "
+          "perf_doctor --ci validated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,55 @@ def main() -> int:
                     help="run the r18 fused-overlap A/B lane (fused "
                          "chunked collective under matmul vs the "
                          "sequential schedule; TPU backend only)")
+    ap.add_argument("--ef-convergence", action="store_true",
+                    help="run the r19 error-feedback convergence "
+                         "lane: train the flagship LM under dp with "
+                         "fp32 / int8 / int8+EF gradient sync on "
+                         "identical data and record the loss "
+                         "trajectories (jax-level; no accl world)")
+    ap.add_argument("--steps", type=int, default=40,
+                    help="SGD steps for --ef-convergence")
+    ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+
+    if args.ef_convergence:
+        import os
+
+        # virtual host devices for the dp mesh — must land before the
+        # first jax import anywhere in the process
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={args.nranks}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.path.insert(0, ".")
+        from accl_tpu.bench.ef_convergence import (run_ef_convergence,
+                                                   write_summary_md)
+
+        out = sys.stdout if args.out == "-" else open(args.out, "w")
+        try:
+            summary = run_ef_convergence(
+                out, steps=args.steps, dp=args.nranks, seed=args.seed,
+                log=lambda s: print(s, file=sys.stderr))
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        if args.out != "-":
+            md = args.out.rsplit(".", 1)[0] + ".md"
+            write_summary_md(md, summary,
+                             csv_name=args.out.rsplit("/", 1)[-1])
+            print(f"[ef] summary: {md}", file=sys.stderr)
+        from accl_tpu.bench.ef_convergence import TRACK_TOL
+
+        bad = {k: v for k, v in summary.items()
+               if k.endswith("_mean_abs_dev") and v > TRACK_TOL}
+        if bad:
+            print(f"[ef] FAIL: quantized lane(s) diverged from the "
+                  f"fp32 trajectory past {TRACK_TOL:g}: {bad}",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     if args.design == "tpu":
         import jax  # noqa: F401  (leave platform to the environment)

@@ -1,0 +1,351 @@
+"""Online tuner: the live telemetry -> tuner control plane (r19).
+
+Pins the ISSUE-17 acceptance surface: ``ACCL_TUNE_ONLINE`` unset
+constructs NOTHING (dispatch stays the r18 static/table behavior on
+both backends), the armed loop closes finding -> hypothesis -> A/B ->
+decision episodes against a live chaos-degraded world (never-slower by
+construction), the post-install watch rejects stale same-batch
+findings but auto-reverts a genuine post-install regression, per-cell
+cooldown stops thrash, the sentinel's WORSEN_RATIO re-delivery feeds
+the revert path without spamming persisting findings, the retune
+counter families are schema'd, and the bounded audit ring round-trips
+through the ``/retunes`` exporter endpoint.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from accl_tpu.backends.emu import EmuWorld
+from accl_tpu.backends.tpu import TpuWorld
+from accl_tpu.observability import health as obs_health
+from accl_tpu.observability import metrics as _metrics
+from accl_tpu.observability.sentinel import Baseline, Sentinel
+from accl_tpu.resilience.chaos import ChaosPlan
+from accl_tpu.tuning.autotune import SelectionTable, cell_key
+from accl_tpu.tuning.online import (
+    DECISIONS,
+    HISTORY_FORMAT,
+    HISTORY_VERSION,
+    OnlineTuner,
+    RetuneHistory,
+    history_doc,
+    online_enabled,
+    online_tuner,
+)
+
+
+def _finding(coll="allreduce", dtype="float32", bucket="<=16KiB",
+             axis="p50_us", ratio=2.0, kind="latency"):
+    return {"collective": coll, "dtype": dtype, "size_bucket": bucket,
+            "axis": axis, "ratio": ratio, "kind": kind,
+            "live": 100.0, "baseline": 50.0, "threshold": 1.5,
+            "baseline_source": "test"}
+
+
+# ---------------------------------------------------------------------------
+# the off switch: unset = nothing constructed, dispatch untouched
+# ---------------------------------------------------------------------------
+
+def test_online_enabled_parsing(monkeypatch):
+    for off in (None, "", "0", " 0 "):
+        if off is None:
+            monkeypatch.delenv("ACCL_TUNE_ONLINE", raising=False)
+        else:
+            monkeypatch.setenv("ACCL_TUNE_ONLINE", off)
+        assert not online_enabled()
+    monkeypatch.setenv("ACCL_TUNE_ONLINE", "1")
+    assert online_enabled()
+
+
+@pytest.mark.parametrize("world_cls", [EmuWorld, TpuWorld],
+                         ids=["emu", "tpu-interpret"])
+def test_unset_env_constructs_nothing(monkeypatch, world_cls):
+    """The bit-parity pin: without the env knob there is no tuner
+    object, no loop thread, and no policy injected — the world is the
+    r18 world."""
+    monkeypatch.delenv("ACCL_TUNE_ONLINE", raising=False)
+    with world_cls(2) as w:
+        assert w.online_tuner is None
+        assert online_tuner() is None
+        assert all(getattr(a, "_tune_policy", None) is None
+                   for a in w.accls)
+        assert not any(t.name == "accl-online-tuner"
+                       for t in threading.enumerate())
+    doc = history_doc()
+    assert doc == {"format": HISTORY_FORMAT, "version": HISTORY_VERSION,
+                   "episodes": [], "dropped": 0, "total": 0}
+
+
+def test_env_gate_arms_and_close_stops(monkeypatch):
+    monkeypatch.setenv("ACCL_TUNE_ONLINE", "1")
+    monkeypatch.setenv("ACCL_TUNE_ONLINE_INTERVAL_MS", "50")
+    w = EmuWorld(2)
+    try:
+        tuner = w.online_tuner
+        assert tuner is not None and online_tuner() is tuner
+        assert any(t.name == "accl-online-tuner" and t.daemon
+                   for t in threading.enumerate())
+        # every driver serves the ONE shared table through its policy
+        assert all(a._tune_policy.table is tuner.table
+                   for a in w.accls)
+    finally:
+        w.close()
+    assert online_tuner() is None
+    time.sleep(0.2)
+    assert not any(t.name == "accl-online-tuner"
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# audit ring + counters schema
+# ---------------------------------------------------------------------------
+
+def test_history_ring_bounded_with_stable_seq():
+    h = RetuneHistory(maxlen=3)
+    for i in range(5):
+        ep = h.append({"decision": "rejected", "i": i})
+        assert ep["seq"] == i + 1
+    doc = h.to_doc()
+    assert doc["format"] == HISTORY_FORMAT
+    assert doc["version"] == HISTORY_VERSION
+    assert [e["i"] for e in doc["episodes"]] == [2, 3, 4]
+    assert doc["dropped"] == 2 and doc["total"] == 5
+    # seq survives the drop: the audit trail names evicted episodes
+    assert [e["seq"] for e in doc["episodes"]] == [3, 4, 5]
+
+
+def test_retune_counter_families_have_help():
+    for fam in ("proposed", "verified", "installed", "rejected",
+                "reverted"):
+        assert f"accl_tuning_retunes_{fam}" in _metrics.METRIC_HELP
+
+
+def test_retunes_endpoint_serves_history(monkeypatch):
+    obs_health.stop_exporter()
+    monkeypatch.setenv("ACCL_METRICS_PORT", "0")
+    try:
+        exporter = obs_health.ensure_exporter_from_env()
+        assert exporter is not None
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{exporter.port}/retunes",
+                timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["format"] == HISTORY_FORMAT
+        assert doc["version"] == HISTORY_VERSION
+        assert isinstance(doc["episodes"], list)
+    finally:
+        obs_health.stop_exporter()
+
+
+# ---------------------------------------------------------------------------
+# sentinel re-delivery (the revert path's signal)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_worsen_ratio_redelivery():
+    """A persisting finding is delivered once; re-delivered only when
+    its drift worsens past WORSEN_RATIO; a cleared finding re-arms."""
+    reg = _metrics.MetricsRegistry()
+    s = Sentinel(Baseline({}, "test"), registry=reg, min_calls=1)
+    deliveries = []
+    s.subscribe(lambda fresh: deliveries.append(list(fresh)))
+    script = [
+        ([_finding(ratio=2.0)], 1),   # new -> delivered
+        ([_finding(ratio=2.2)], 1),   # 2.2 < 2.0*1.25 -> suppressed
+        ([_finding(ratio=2.6)], 2),   # worsened past 2.5 -> delivered
+        ([], 2),                      # cleared -> key re-arms
+        ([_finding(ratio=2.0)], 3),   # back -> delivered again
+        # bandwidth drifts DOWNWARD; the fold must still re-deliver
+        ([_finding(axis="busbw_GBps", ratio=0.5, kind="bandwidth")], 4),
+        ([_finding(axis="busbw_GBps", ratio=0.45, kind="bandwidth")], 4),
+        ([_finding(axis="busbw_GBps", ratio=0.3, kind="bandwidth")], 5),
+    ]
+    for findings, want in script:
+        s.compare_snapshot = lambda snap, f=findings: list(f)
+        s.check()
+        assert len(deliveries) == want, (findings, deliveries)
+
+
+def test_sentinel_subscriber_fault_never_kills_the_check():
+    reg = _metrics.MetricsRegistry()
+    s = Sentinel(Baseline({}, "test"), registry=reg, min_calls=1)
+    seen = []
+    s.subscribe(lambda fresh: (_ for _ in ()).throw(RuntimeError("boom")))
+    s.subscribe(lambda fresh: seen.extend(fresh))
+    s.compare_snapshot = lambda snap: [_finding()]
+    s.check()
+    assert len(seen) == 1
+
+
+# ---------------------------------------------------------------------------
+# episode state machine: cooldown / stale rejection / revert
+# ---------------------------------------------------------------------------
+
+def test_cooldown_and_stale_then_genuine_revert():
+    reg = _metrics.MetricsRegistry()
+    with EmuWorld(2) as w:
+        tuner = OnlineTuner(w, registry=reg, cooldown_s=60.0)
+        key = cell_key("allreduce", "float32", "<=16KiB", 2)
+
+        # (a) cell inside its cooldown window -> "cooldown" episode
+        tuner._cooldown[key] = time.monotonic() + 60.0
+        tuner.on_findings([_finding()])
+        ep = tuner.step()
+        assert ep["decision"] == "cooldown" and ep["cell"] == key
+        assert reg.snapshot()["counters"].get(
+            "tuning/retunes/rejected") == 1
+        tuner._cooldown.pop(key)
+
+        # (b) a finding queued BEFORE the install is the install
+        # trigger's same-batch sibling, never its fallout -> rejected
+        tuner.table.entries[key] = {"algorithm": "flat",
+                                    "busbw_GBps": 1.0, "online": True}
+        for a in w.accls:
+            a._tune_policy._memo.clear()
+        tuner.on_findings([_finding()])
+        tuner._watch[key] = {"prev": None,
+                             "installed_at": time.monotonic(),
+                             "episode_seq": 7}
+        ep = tuner.step()
+        assert ep["decision"] == "rejected"
+        assert "stale" in ep["reason"]
+        assert key in tuner._watch  # the watch survives a stale hit
+
+        # (c) a finding that arrives AFTER the install is the
+        # install's fallout -> auto-revert to the pre-install entry
+        tuner.on_findings([_finding()])
+        ep = tuner.step()
+        assert ep["decision"] == "reverted"
+        assert ep["reverted_to"] == "static"
+        assert ep["installed_episode"] == 7
+        assert key not in tuner._watch
+        assert key not in tuner.table.entries  # prev=None -> dropped
+        assert tuner._cooldown[key] > time.monotonic()  # hard cooldown
+        assert reg.snapshot()["counters"].get(
+            "tuning/retunes/reverted") == 1
+
+
+def test_tuner_adopts_armed_table_and_fabric_meta():
+    """A tuner over a world armed with a tuned table serves THAT table
+    (the incumbents) and composes over the table's recorded fabric."""
+    with EmuWorld(4) as w:
+        table = SelectionTable(
+            {cell_key("allreduce", "float32", "<=1KiB", 4):
+             {"algorithm": "flat", "busbw_GBps": 1.0}},
+            {"nranks": 4, "backend": "emu", "dtype": "float32",
+             "shape": [2, 2], "axis_order": [0, 1]})
+        from accl_tpu.tuning.autotune import SelectionPolicy
+        for a in w.accls:
+            a._tune_policy = SelectionPolicy(table)
+        tuner = OnlineTuner(w, registry=_metrics.MetricsRegistry())
+        assert tuner.table is table
+        assert tuple(tuner.fabric.shape) == (2, 2)
+        assert not tuner.fabric.trivial
+
+
+def test_dtype_fallback_serves_float32_row():
+    """Per-dtype tables (r19): an unswept dtype borrows the float32
+    row; a swept dtype's genuinely-untuned cell stays None."""
+    key32 = cell_key("allreduce", "float32", "<=16KiB", 4)
+    t = SelectionTable({key32: {"algorithm": "flat"}},
+                       {"nranks": 4, "backend": "emu"})
+    assert t.lookup("allreduce", "bfloat16", 16384, 4)["algorithm"] \
+        == "flat"
+    assert t.lookup("allreduce", "float32", 1 << 20, 4) is None
+    t.entries[cell_key("allreduce", "bfloat16", "<=1KiB", 4)] = {
+        "algorithm": "tree"}
+    t._dtypes = None
+    # bfloat16 is now a SWEPT dtype: no borrowing for its other cells
+    assert t.lookup("allreduce", "bfloat16", 16384, 4) is None
+
+
+# ---------------------------------------------------------------------------
+# the drill: chaos -> finding -> hypothesis -> A/B -> decision
+# ---------------------------------------------------------------------------
+
+def test_retune_drill_end_to_end(monkeypatch):
+    """The compressed scripts/retune_smoke.py drill: seeded chaos
+    degrades a live world, the sentinel's findings drive the tuner
+    through measured episodes, and the post-decision dispatch is
+    never-slower than the degraded state it reacted to."""
+    import statistics
+
+    from accl_tpu.bench import sweep as _sweep
+
+    # isolate the drill's call metrics from the rest of the suite
+    reg = _metrics.MetricsRegistry()
+    monkeypatch.setattr(_metrics, "_default", reg)
+    monkeypatch.setenv("ACCL_DEFAULT_TIMEOUT", "30000000")
+    # single-axis fabric: the drill verifies the control plane on the
+    # register/compression lanes (see scripts/retune_smoke.py)
+    monkeypatch.setenv("ACCL_FABRIC", "4")
+    dtype = np.dtype(np.float32)
+    count = 4096  # 16 KiB fp32: multiple eager segments per message
+
+    w = EmuWorld(4, devmem_bytes=256 << 20, n_egr_rx_bufs=64,
+                 max_eager_size=16384, max_rendezvous_size=64 << 20)
+    try:
+        def drive(n):
+            durs = [_sweep._run_once(w, "allreduce", count, dtype, 0)
+                    for _ in range(n)]
+            return statistics.median(durs) * 1e6
+
+        p50_warm = drive(8)
+        baseline = Baseline.from_snapshot(reg.snapshot(), source="warm")
+        sentinel = Sentinel(baseline, reg, p50_ratio=1.5, p99_ratio=2.0,
+                            bw_ratio=0.6, min_calls=6)
+        tuner = OnlineTuner(w, hysteresis=1.05, repetitions=2,
+                            registry=reg)
+        tuner.attach_sentinel(sentinel)
+
+        plan = ChaosPlan.parse("seed=42,slow_rank=1:1000")
+        for r, d in enumerate(w.devices):
+            plan.apply(d, r)
+        p50_degraded = drive(10)
+        assert sentinel.check(), \
+            f"no drift seen ({p50_warm:.0f} -> {p50_degraded:.0f}us)"
+        assert tuner.pending() > 0
+
+        episodes = []
+        while tuner.pending():
+            ep = tuner.step()
+            if ep is not None:
+                episodes.append(ep)
+        assert episodes
+        for ep in episodes:
+            assert ep["decision"] in DECISIONS
+            assert ep["trigger"]["type"] == "sentinel"
+            assert isinstance(ep["opened_at"], float)
+            assert isinstance(ep["closed_at"], float)
+            assert ep["cell"].startswith("allreduce|float32|")
+        decisions = {ep["decision"] for ep in episodes}
+        assert decisions & {"installed", "rejected"}, episodes
+
+        # never-slower: the dispatch the control plane left behind
+        # must not be worse than the degraded state it reacted to
+        p50_post = drive(8)
+        assert p50_post <= p50_degraded * 1.5, \
+            (p50_warm, p50_degraded, p50_post)
+
+        counters = reg.snapshot()["counters"]
+        assert counters.get("tuning/retunes/proposed", 0) >= 1
+        if "installed" in decisions:
+            assert counters.get("tuning/retunes/installed", 0) >= 1
+            assert counters.get("tuning/retunes/verified", 0) >= 1
+            # an install is fenced like abort: the flight ring carries
+            # the anchor on every rank
+            from accl_tpu.observability import flight as _flight
+            for a in w.accls:
+                kinds = [r.collective for r in
+                         a.flight_recorder.records()]
+                assert _flight.RETUNE_EVENT in kinds
+
+        doc = tuner.history.to_doc()
+        assert doc["format"] == HISTORY_FORMAT
+        assert doc["version"] == HISTORY_VERSION
+        assert len(doc["episodes"]) == len(episodes)
+    finally:
+        w.close()
