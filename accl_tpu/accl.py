@@ -313,10 +313,33 @@ class ACCL:
         if _flight.enabled():
             self.flight_recorder = _flight.register(
                 _flight.FlightRecorder(local_rank))
+            # RECEIVE_TIMEOUT forensics (r20): the instant the engine
+            # classifies a receive-timeout, the recorder snapshots the
+            # per-peer link rows (and, where the backend exposes it,
+            # the gang-assembly state) with wall-clock stamps into the
+            # flight dump — the standing sub-comm allgather wedge
+            # (ROADMAP item 5) ships an artifact, not a bare timeout
+            sources = {}
+            for attr, key in (("link_stats", "link_rows"),
+                              ("engine_stats", "engine_stats")):
+                fn = getattr(self._device, attr, None)
+                if callable(fn):
+                    sources[key] = fn
+            gang_fn = getattr(self._device, "gang_assembly_snapshot",
+                              None)
+            if gang_fn is None:
+                eng = getattr(self._device, "_engine", None)
+                gang_fn = getattr(eng, "gang_assembly_snapshot", None)
+            if callable(gang_fn):
+                sources["gang_assembly"] = gang_fn
+            if sources:
+                self.flight_recorder.set_forensics_sources(sources)
         _health.ensure_exporter_from_env()
         from .observability import sentinel as _sentinel
+        from .observability import slo as _slo
 
         _sentinel.ensure_sentinel_from_env()
+        _slo.ensure_slo_from_env()
 
         # 9. resilience bring-up: ACCL_SUPERVISE=1 arms the recovery
         #    supervisor (resilience/supervisor.py) on this rank — a
@@ -390,14 +413,21 @@ class ACCL:
                 f"no arithmetic config for dtype pair {pair} — supported "
                 f"pairs: {sorted(p for p in self._arith_ids)}") from None
 
-    def create_communicator(self, indices: Sequence[int]) -> int:
+    def create_communicator(self, indices: Sequence[int],
+                            tenant: Optional[str] = None) -> int:
         """Create a sub-communicator from global-rank indices; returns its
         id (reference: accl.cpp:971-978).
 
         Collective and order-sensitive: every member rank must create
         its sub-communicators in the same order so the ids align across
         the group — the same discipline the reference needs for its
-        exchange-memory communicator addresses (communicator.cpp:23)."""
+        exchange-memory communicator addresses (communicator.cpp:23).
+
+        ``tenant`` labels the communicator's traffic for the per-tenant
+        observability plane (r20): flight records, ``tenant/<name>``
+        metric families, trace tracks and ``link_matrix(tenant=...)``
+        slices all key off it.  Purely driver/telemetry-side — the
+        engine ABI is untouched."""
         size = self.comm.size
         bad = [i for i in indices if not 0 <= i < size]
         if bad:
@@ -408,7 +438,35 @@ class ACCL:
         sub = self.comm.split(indices, new_id)
         self._device.upload_communicator(sub)
         self._communicators.append(sub)
+        if tenant is not None:
+            self.set_tenant(new_id, tenant)
         return new_id
+
+    def set_tenant(self, comm_id: int, tenant: Optional[str]) -> None:
+        """Label (or with ``None`` unlabel) a communicator's traffic
+        with a tenant name for per-tenant telemetry.  Names are bounded
+        and shell-safe (``[A-Za-z0-9_.-]{1,64}``) because they become
+        metric label values and trace track names; the registry's
+        ACCL_METRICS_MAX_SERIES guard bounds how many distinct names
+        can mint series."""
+        comm = self.communicator(comm_id)
+        if tenant is not None:
+            import re as _re
+
+            if not isinstance(tenant, str) or \
+                    not _re.fullmatch(r"[A-Za-z0-9_.\-]{1,64}", tenant):
+                raise ACCLError(
+                    f"set_tenant: invalid tenant name {tenant!r} — "
+                    f"need 1-64 chars of [A-Za-z0-9_.-] (it becomes a "
+                    f"metric label and trace track name)")
+        comm.tenant = tenant
+
+    def tenant_comm_ids(self, tenant: str) -> list:
+        """Ids of this rank's communicators labeled ``tenant`` — the
+        slice key ``link_matrix(tenant=...)`` folds over (a tenant's
+        traffic is the union of its communicators' link rows)."""
+        return [c.id for c in self._communicators
+                if not c.is_placeholder and c.tenant == tenant]
 
     def reserve_communicator(self) -> int:
         """Burn one communicator id with an inert slot, so a sub-group
@@ -1710,17 +1768,23 @@ class ACCL:
         instance joins the same gang id every engine would assemble."""
         op, nranks, rank, dtype_name, nbytes = \
             self.resolve_call_signature(call)
+        # tenant label (r20): rides the issuing communicator; one
+        # attribute read (class-level None when unlabeled)
+        tenant = (self._communicators[call.comm].tenant
+                  if call.comm < len(self._communicators) else None)
         if self.flight_recorder is not None and _flight.enabled():
             req.flight = self.flight_recorder.new_record(
                 req.id, op.name, call.comm, call.tag, dtype_name,
-                call.count, nbytes, nranks, op in _GANG_OPS, t_submit)
+                call.count, nbytes, nranks, op in _GANG_OPS, t_submit,
+                tenant)
         if _metrics.enabled():
             req.metric = (_metrics.default_registry(), op.name, dtype_name,
-                          nbytes, nranks, t_submit)
+                          nbytes, nranks, t_submit, tenant)
         if _trace.enabled():
             span = _trace.new_span(op.name, desc, rank, call.count,
                                    dtype_name, nbytes, nranks)
             span.t_submit = t_submit
+            span.tenant = tenant
             if op in _GANG_OPS:
                 span.gang_id = _trace.collector().gang_id_for(
                     (int(op), call.comm, call.tag), rank)

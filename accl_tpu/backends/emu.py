@@ -1110,13 +1110,24 @@ class EmuWorld:
         (comm, peer) wire-counter rows."""
         return {r: d.link_stats() for r, d in enumerate(self.devices)}
 
-    def link_matrix(self, comm: int = 0) -> dict:
+    def link_matrix(self, comm: int = 0,
+                    tenant: Optional[str] = None) -> dict:
         """World-level P×P link traffic matrix over one communicator
         (observability/telemetry.link_matrix doc) — the measured
         per-link bandwidth/congestion input the topology-aware
-        selection work (ROADMAP item 2) consumes."""
+        selection work (ROADMAP item 2) consumes.  ``tenant`` (r20)
+        slices instead by tenant label: the union of every
+        communicator labeled that tenant across the world's drivers."""
         from ..observability import telemetry as _telemetry
 
+        if tenant is not None:
+            comms = set()
+            for a in self.accls:
+                comms.update(a.tenant_comm_ids(tenant))
+            doc = _telemetry.link_matrix(self.link_stats(),
+                                         nranks=self.nranks, comms=comms)
+            doc["tenant"] = tenant
+            return doc
         return _telemetry.link_matrix(self.link_stats(),
                                       nranks=self.nranks, comm=comm)
 

@@ -2521,11 +2521,22 @@ class TpuWorld:
         from the gang scheduler's wire twin."""
         return {r: d.link_stats() for r, d in enumerate(self.devices)}
 
-    def link_matrix(self, comm: int = 0) -> dict:
+    def link_matrix(self, comm: int = 0,
+                    tenant: Optional[str] = None) -> dict:
         """World-level P×P link traffic matrix (same schema as
-        EmuWorld.link_matrix — observability/telemetry.link_matrix)."""
+        EmuWorld.link_matrix — observability/telemetry.link_matrix).
+        ``tenant`` (r20) slices by tenant label instead: the union of
+        every communicator labeled that tenant across the drivers."""
         from ..observability import telemetry as _telemetry
 
+        if tenant is not None:
+            comms = set()
+            for a in self.accls:
+                comms.update(a.tenant_comm_ids(tenant))
+            doc = _telemetry.link_matrix(self.link_stats(),
+                                         nranks=self.nranks, comms=comms)
+            doc["tenant"] = tenant
+            return doc
         return _telemetry.link_matrix(self.link_stats(),
                                       nranks=self.nranks, comm=comm)
 

@@ -47,6 +47,13 @@ class Communicator:
     #: communicator answers False with zero per-instance cost
     is_placeholder = False
 
+    #: tenant/lane label for per-tenant observability (r20): class
+    #: attribute so unlabeled communicators answer None with zero
+    #: per-instance cost; set via ACCL.create_communicator(tenant=...)
+    #: or ACCL.set_tenant().  Not part of the wire ABI — the engine
+    #: never sees it, only the telemetry plane does.
+    tenant = None
+
     def __init__(self, ranks: Sequence[Rank], local_rank: int, comm_id: int = 0):
         if not 0 <= local_rank < len(ranks):
             raise ValueError(f"local_rank {local_rank} out of range for {len(ranks)} ranks")
@@ -105,12 +112,16 @@ class Communicator:
             raise ValueError("local rank must be part of the new communicator")
         new_ranks = [self._ranks[i] for i in indices]
         new_local = list(indices).index(self._local_rank)
-        return Communicator(new_ranks, new_local, comm_id)
+        sub = Communicator(new_ranks, new_local, comm_id)
+        if self.tenant is not None:
+            sub.tenant = self.tenant
+        return sub
 
     def dump(self) -> str:
         """Human-readable table dump
         (reference: accl.cpp:1445-1455 dump_communicator)."""
-        lines = [f"communicator {self._id}: size={self.size} local_rank={self._local_rank}"]
+        ten = f" tenant={self.tenant}" if self.tenant is not None else ""
+        lines = [f"communicator {self._id}: size={self.size} local_rank={self._local_rank}{ten}"]
         for i, r in enumerate(self._ranks):
             tag = " (local)" if i == self._local_rank else ""
             lines.append(

@@ -61,13 +61,20 @@ TERMINAL_STATE_NAMES = ("complete", "failed", "aborted")
 #: adds no import edge)
 _COMM_ABORTED_BIT = 1 << 27
 
+#: retcode bit for an engine receive-timeout (constants.ErrorCode.
+#: RECEIVE_TIMEOUT_ERROR) — the trigger for the r20 forensic capture
+#: (ROADMAP item 5's standing sub-comm allgather wedge ships a bare
+#: timeout today; the forensics attach the per-peer link rows and
+#: gang-assembly state the post-mortem needs)
+_RECEIVE_TIMEOUT_BIT = 1 << 11
+
 #: record fields every dump carries — the schema the CI hang smoke and
 #: accl_doctor validate against
 RECORD_SCHEMA_KEYS = (
     "seq", "req_id", "rank", "collective", "comm", "tag", "dtype",
     "count", "nbytes", "nranks", "lane", "state", "gang", "retcode",
     "age_us", "t_submit", "t_queue", "t_gang_ready", "t_dispatch",
-    "t_complete",
+    "t_complete", "tenant",
 )
 
 
@@ -78,13 +85,13 @@ class FlightRecord:
 
     __slots__ = ("seq", "req_id", "rank", "collective", "comm", "tag",
                  "dtype", "count", "nbytes", "nranks", "lane", "state",
-                 "gang", "retcode", "t_submit", "t_queue", "t_gang_ready",
-                 "t_dispatch", "t_complete", "_recorder")
+                 "gang", "retcode", "tenant", "t_submit", "t_queue",
+                 "t_gang_ready", "t_dispatch", "t_complete", "_recorder")
 
     def __init__(self, recorder: "FlightRecorder", seq: int, req_id: int,
                  collective: str, comm: int, tag: int, dtype: str,
                  count: int, nbytes: int, nranks: int, gang: bool,
-                 t_submit: int):
+                 t_submit: int, tenant: Optional[str] = None):
         self._recorder = recorder
         self.seq = seq
         self.req_id = req_id
@@ -97,6 +104,7 @@ class FlightRecord:
         self.nbytes = nbytes
         self.nranks = nranks
         self.gang = gang
+        self.tenant = tenant
         self.lane: Optional[str] = None
         self.state = S_SUBMITTED
         self.retcode = 0
@@ -145,6 +153,11 @@ class FlightRecord:
             self.state = S_ABORTED
         else:
             self.state = S_FAILED
+            if retcode & _RECEIVE_TIMEOUT_BIT:
+                # RECEIVE_TIMEOUT forensics (r20): capture the link
+                # rows + gang-assembly state at classification time —
+                # best-effort, never raising on the record path
+                self._recorder._note_timeout(self)
         self._recorder._note_finished(self)
 
     def summary(self, now: Optional[int] = None) -> str:
@@ -162,6 +175,7 @@ class FlightRecord:
             "nbytes": self.nbytes, "nranks": self.nranks,
             "lane": self.lane, "state": STATE_NAMES[self.state],
             "gang": self.gang, "retcode": self.retcode,
+            "tenant": self.tenant,
             "age_us": round(self.age_ns(now) / 1e3, 1),
             "t_submit": self.t_submit, "t_queue": self.t_queue,
             "t_gang_ready": self.t_gang_ready,
@@ -197,14 +211,22 @@ class FlightRecorder:
         #: monotonic ns of the most recent COMM_ABORTED finalization
         #: (the watchdog's "aborted" health signal)
         self.last_abort_ns = 0
+        #: zero-arg providers polled when a record classifies as
+        #: RECEIVE_TIMEOUT (set_forensics_sources) — e.g. the device's
+        #: link_stats / the engine's gang_assembly_snapshot
+        self._forensics_sources: dict = {}
+        #: captured forensic snapshots, newest last (bounded: a timeout
+        #: storm must not grow the dump without bound)
+        self._forensics: "deque" = deque(maxlen=8)
 
     # -- record path (always-on; keep it allocation + append only) -----
     def new_record(self, req_id: int, collective: str, comm: int,
                    tag: int, dtype: str, count: int, nbytes: int,
-                   nranks: int, gang: bool, t_submit: int) -> FlightRecord:
+                   nranks: int, gang: bool, t_submit: int,
+                   tenant: Optional[str] = None) -> FlightRecord:
         rec = FlightRecord(self, next(self._seq), req_id, collective,
                            comm, tag, dtype, count, nbytes, nranks, gang,
-                           t_submit)
+                           t_submit, tenant)
         self._records.append(rec)
         return rec
 
@@ -215,6 +237,42 @@ class FlightRecorder:
             self.last_error_ns = rec.t_complete
         if rec.state == S_ABORTED:
             self.last_abort_ns = rec.t_complete
+
+    # -- RECEIVE_TIMEOUT forensics (r20, ROADMAP item 5 wedge) ---------
+    def set_forensics_sources(self, sources: dict) -> None:
+        """Arm zero-arg provider callables (e.g. ``{"link_rows":
+        device.link_stats, "gang_assembly": engine.gang_assembly_
+        snapshot}``) polled the instant a record classifies as
+        RECEIVE_TIMEOUT.  The snapshot carries a WALL-CLOCK stamp
+        alongside the monotonic one — the ingredient the detsched
+        virtual clock hides, so a wedge under a virtualized schedule
+        still correlates with host logs."""
+        self._forensics_sources = dict(sources)
+
+    def _note_timeout(self, rec: FlightRecord) -> None:
+        if not self._forensics_sources:
+            return
+        import time as _time
+
+        snap = {
+            "seq": rec.seq,
+            "req_id": rec.req_id,
+            "collective": rec.collective,
+            "comm": rec.comm,
+            "tag": rec.tag,
+            "tenant": rec.tenant,
+            "retcode": rec.retcode,
+            "t_complete": rec.t_complete,
+            "wall_clock": _time.time(),
+            "wall_clock_iso": _time.strftime(
+                "%Y-%m-%dT%H:%M:%S%z", _time.localtime()),
+        }
+        for name, fn in self._forensics_sources.items():
+            try:
+                snap[name] = fn()
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                snap[name] = f"<capture failed: {e!r}>"
+        self._forensics.append(snap)
 
     # -- queries --------------------------------------------------------
     def records(self) -> list:
@@ -240,12 +298,18 @@ class FlightRecorder:
 
     def dump(self) -> dict:
         now = now_ns()
-        return {
+        doc = {
             "rank": self.rank,
             "capacity": self.capacity,
             "last_completed_seq": self.last_completed_seq,
             "records": [r.to_dict(now) for r in self.records()],
         }
+        if self._forensics:
+            # RECEIVE_TIMEOUT forensic snapshots (r20): link rows +
+            # gang-assembly state captured at classification time, with
+            # wall-clock stamps
+            doc["timeout_forensics"] = list(self._forensics)
+        return doc
 
 
 #: lifecycle event names (r13) published as zero-duration records so

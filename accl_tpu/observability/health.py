@@ -327,8 +327,9 @@ OPENMETRICS_CONTENT_TYPE = (
 
 
 class MetricsExporter:
-    """Serves /metrics (OpenMetrics), /healthz (JSON) and /flight
-    (merged flight dump) from a daemon thread."""
+    """Serves /metrics (OpenMetrics), /healthz (JSON), /flight
+    (merged flight dump), /retunes (online-tuner history) and /slo
+    (per-tenant SLO report) from a daemon thread."""
 
     def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
                  host: str = "127.0.0.1"):
@@ -356,6 +357,26 @@ class MetricsExporter:
 
                         body = json.dumps(
                             _online.history_doc()).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/slo"):
+                        # r20: the live per-tenant SLO report.  A
+                        # scrape IS an evaluation sweep (check() then
+                        # doc()) so a pull-only deployment — no
+                        # ACCL_SLO_INTERVAL_MS thread — still gets
+                        # fresh verdicts at its scrape cadence.  Empty
+                        # versioned doc when no tracker is armed.
+                        from . import slo as _slo
+
+                        tr = _slo.tracker()
+                        if tr is not None:
+                            tr.check()
+                            doc = tr.doc()
+                        else:
+                            doc = {"format": _slo.SLO_REPORT_FORMAT,
+                                   "version": _slo.SLO_REPORT_VERSION,
+                                   "checks": 0, "specs": [],
+                                   "tenants": {}, "findings_total": 0}
+                        body = json.dumps(doc).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

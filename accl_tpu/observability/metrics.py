@@ -21,6 +21,8 @@ import os
 import threading
 from typing import Optional
 
+from ..constants import env_int
+
 #: fixed histogram bucket upper bounds, microseconds (powers of 4 —
 #: 1 µs .. ~16.8 s, 13 buckets + overflow): coarse enough to stay
 #: allocation-free per observation, fine enough to separate the
@@ -152,10 +154,34 @@ METRIC_HELP = {
     "accl_collective_algbw_gbps": "algorithmic bandwidth (nccl-tests)",
     "accl_collective_busbw_gbps": ("bus bandwidth (nccl-tests "
                                    "correction factors)"),
+    # ---- registry self-protection (r20 cardinality guard) ----
+    "accl_metrics_dropped_series": (
+        "new metric series refused because the registry hit "
+        "ACCL_METRICS_MAX_SERIES — nonzero means a dynamic label "
+        "(tenant name, peer id) is minting unbounded families"),
+    # ---- per-tenant collective families (r20, observe_call tenant=) ----
+    "accl_tenant_collective_calls": ("collective calls completed per "
+                                     "(tenant, collective, dtype, "
+                                     "size_bucket)"),
+    "accl_tenant_collective_errors": ("per-tenant collective calls with "
+                                      "non-zero retcode"),
+    "accl_tenant_collective_bytes": "per-tenant per-rank payload bytes",
+    "accl_tenant_collective_latency_us": (
+        "per-tenant submit->complete latency histogram (power-of-4 µs "
+        "buckets) — the SLOTracker's estimator substrate"),
+    "accl_tenant_collective_algbw_gbps": (
+        "per-tenant algorithmic bandwidth (nccl-tests)"),
+    "accl_tenant_collective_busbw_gbps": (
+        "per-tenant bus bandwidth (nccl-tests correction factors)"),
     # ---- regression sentinel (r14, observability/sentinel.py) ----
     "accl_sentinel_checks": "sentinel comparison sweeps executed",
     "accl_sentinel_findings": ("sentinel drift findings (p50/p99/"
                                "bandwidth past threshold vs baseline)"),
+    # ---- per-tenant SLO tracker (r20, observability/slo.py) ----
+    "accl_slo_checks": "SLO tracker evaluation sweeps executed",
+    "accl_slo_findings": ("fresh SLO findings delivered (fast/slow "
+                          "burn-rate breaches, budget exhaustion, "
+                          "busbw floor breaches) per tenant objective"),
     # ---- online tuner retune episodes (r19, tuning/online.py) ----
     "accl_tuning_retunes_proposed": ("retune hypotheses opened from a "
                                      "sentinel finding or fabric "
@@ -215,6 +241,12 @@ METRIC_HELP_PREFIXES = {
     "accl_tuning_selected_": ("calls whose descriptor signature the "
                               "ACCL_TUNE_TABLE selection policy "
                               "resolved to this algorithm lane"),
+    # r20 per-tenant observability: verdict/budget gauges and any other
+    # tenant-scoped family minted by the SLO tracker
+    # (observability/slo.py) under tenant/<name>/...
+    "accl_tenant_": ("per-tenant observability family (SLO verdicts, "
+                     "budget-remaining, burn rates) under the "
+                     "tenant/<name>/ namespace"),
 }
 
 
@@ -276,22 +308,67 @@ class _CallStats:
         self.hist = [0] * (nbuckets + 1)  # + overflow
 
 
-class MetricsRegistry:
-    """Thread-safe counters + gauges + per-call-signature stats."""
+#: default hard bound on distinct series across every table in one
+#: registry (counters + gauges + value histograms + call signatures +
+#: tenant call signatures) — generous for real worlds, small enough
+#: that a tenant-name bug (unbounded labels) cannot OOM the exporter
+DEFAULT_MAX_SERIES = 4096
 
-    def __init__(self):
+#: the overflow family itself — exempt from the bound so the drop is
+#: always countable even at capacity
+_DROPPED_SERIES = "metrics/dropped_series"
+
+
+class MetricsRegistry:
+    """Thread-safe counters + gauges + per-call-signature stats.
+
+    New-series creation is bounded by ``ACCL_METRICS_MAX_SERIES``
+    (constants env contract: a malformed value raises a clear
+    :class:`~accl_tpu.constants.ACCLError` naming the knob).  Once the
+    bound is hit, observations that would mint a NEW series are dropped
+    and counted under ``metrics/dropped_series``; existing series keep
+    updating normally.
+    """
+
+    def __init__(self, max_series: Optional[int] = None):
         self._lock = threading.Lock()
         self._counters: dict = {}
         self._gauges: dict = {}
         self._calls: dict = {}
+        #: per-(tenant, collective, dtype, size_bucket) call stats —
+        #: the r20 tenant dimension (observe_call tenant=...)
+        self._tenant_calls: dict = {}
         #: named value histograms (power-of-4 µs buckets, same shape as
         #: the per-call latency histograms): recovery latency, join
         #: wait — anything that is a distribution but not a collective
         self._values: dict = {}
+        self._max_series = (
+            max_series if max_series is not None
+            else env_int("ACCL_METRICS_MAX_SERIES", DEFAULT_MAX_SERIES,
+                         minimum=16))
+
+    # -- cardinality guard (call under self._lock) ---------------------
+    def _admit_locked(self, table: dict, key) -> bool:
+        """True if `key` may be inserted into `table`: already present,
+        or the registry still has series headroom.  A refused insert is
+        counted under the (exempt) overflow family."""
+        if key in table:
+            return True
+        total = (len(self._counters) + len(self._gauges)
+                 + len(self._values) + len(self._calls)
+                 + len(self._tenant_calls))
+        if total < self._max_series:
+            return True
+        self._counters[_DROPPED_SERIES] = \
+            self._counters.get(_DROPPED_SERIES, 0) + 1
+        return False
 
     # -- counters / gauges --------------------------------------------
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
+            if name != _DROPPED_SERIES and \
+                    not self._admit_locked(self._counters, name):
+                return
             self._counters[name] = self._counters.get(name, 0) + value
 
     # -- named value histograms ---------------------------------------
@@ -301,6 +378,8 @@ class MetricsRegistry:
         with self._lock:
             st = self._values.get(name)
             if st is None:
+                if not self._admit_locked(self._values, name):
+                    return
                 st = self._values[name] = {
                     "count": 0, "sum_us": 0.0,
                     "hist": [0] * (len(LATENCY_BUCKETS_US) + 1)}
@@ -322,81 +401,122 @@ class MetricsRegistry:
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
+            if not self._admit_locked(self._gauges, name):
+                return
             self._gauges[name] = value
 
     # -- per-call stats ------------------------------------------------
+    @staticmethod
+    def _record_call_locked(st: "_CallStats", nbytes: int,
+                            duration_ns: float, nranks: int, ok: bool,
+                            engine_ns: float) -> None:
+        st.calls += 1
+        st.nranks = nranks
+        if not ok:
+            st.errors += 1
+            return
+        st.total_ns += duration_ns
+        st.min_ns = min(st.min_ns, duration_ns)
+        st.max_ns = max(st.max_ns, duration_ns)
+        st.total_bytes += nbytes
+        st.total_engine_ns += engine_ns
+        us = duration_ns / 1e3
+        for i, ub in enumerate(LATENCY_BUCKETS_US):
+            if us <= ub:
+                st.hist[i] += 1
+                break
+        else:
+            st.hist[-1] += 1
+
     def observe_call(self, collective: str, dtype: str, nbytes: int,
                      duration_ns: float, nranks: int = 1, ok: bool = True,
-                     engine_ns: float = 0.0) -> None:
+                     engine_ns: float = 0.0,
+                     tenant: Optional[str] = None) -> None:
         """Record one completed call: count, latency histogram bucket,
-        byte volume (bandwidth is derived at snapshot time)."""
+        byte volume (bandwidth is derived at snapshot time).  With
+        `tenant`, the same observation also lands in the per-tenant
+        table (its own latency histogram per signature — the SLO
+        tracker's estimator substrate)."""
         key = (collective, dtype, size_bucket(nbytes))
         with self._lock:
             st = self._calls.get(key)
             if st is None:
-                st = self._calls[key] = _CallStats(len(LATENCY_BUCKETS_US))
-            st.calls += 1
-            st.nranks = nranks
-            if not ok:
-                st.errors += 1
+                if not self._admit_locked(self._calls, key):
+                    st = None
+                else:
+                    st = self._calls[key] = \
+                        _CallStats(len(LATENCY_BUCKETS_US))
+            if st is not None:
+                self._record_call_locked(st, nbytes, duration_ns, nranks,
+                                         ok, engine_ns)
+            if tenant is None:
                 return
-            st.total_ns += duration_ns
-            st.min_ns = min(st.min_ns, duration_ns)
-            st.max_ns = max(st.max_ns, duration_ns)
-            st.total_bytes += nbytes
-            st.total_engine_ns += engine_ns
-            us = duration_ns / 1e3
-            for i, ub in enumerate(LATENCY_BUCKETS_US):
-                if us <= ub:
-                    st.hist[i] += 1
-                    break
-            else:
-                st.hist[-1] += 1
+            tkey = (tenant,) + key
+            tst = self._tenant_calls.get(tkey)
+            if tst is None:
+                if not self._admit_locked(self._tenant_calls, tkey):
+                    return
+                tst = self._tenant_calls[tkey] = \
+                    _CallStats(len(LATENCY_BUCKETS_US))
+            self._record_call_locked(tst, nbytes, duration_ns, nranks,
+                                     ok, engine_ns)
 
     # -- query ---------------------------------------------------------
+    @staticmethod
+    def _call_doc(coll: str, dtype: str, bucket: str,
+                  st: "_CallStats") -> dict:
+        good = st.calls - st.errors
+        avg_ns = st.total_ns / good if good else 0.0
+        algbw = st.total_bytes / st.total_ns if st.total_ns > 0 else 0.0
+        return {
+            "collective": coll,
+            "dtype": dtype,
+            "size_bucket": bucket,
+            "calls": st.calls,
+            "errors": st.errors,
+            "nranks": st.nranks,
+            "bytes": st.total_bytes,
+            "latency_us": {
+                "min": round(st.min_ns / 1e3, 2) if good else 0.0,
+                "avg": round(avg_ns / 1e3, 2),
+                "max": round(st.max_ns / 1e3, 2),
+                # exact sum: the OpenMetrics histogram _sum
+                # (avg*calls would re-round)
+                "total": round(st.total_ns / 1e3, 2),
+            },
+            "hist_us": {
+                **{f"le_{ub}": n for ub, n in
+                   zip(LATENCY_BUCKETS_US, st.hist)},
+                "inf": st.hist[-1],
+            },
+            # 6 decimals: a small-message call is a few µGB/s
+            # and must not round to a flat 0.0
+            "algbw_GBps": round(algbw, 6),
+            "busbw_GBps": round(
+                algbw * busbw_factor(coll, st.nranks), 6),
+        }
+
     def snapshot(self) -> dict:
         """Full registry state; bandwidths in GB/s (bytes/ns)."""
         with self._lock:
             calls = {}
             for (coll, dtype, bucket), st in self._calls.items():
-                good = st.calls - st.errors
-                avg_ns = st.total_ns / good if good else 0.0
-                algbw = (st.total_bytes / st.total_ns
-                         if st.total_ns > 0 else 0.0)
-                calls["|".join((coll, dtype, bucket))] = {
-                    "collective": coll,
-                    "dtype": dtype,
-                    "size_bucket": bucket,
-                    "calls": st.calls,
-                    "errors": st.errors,
-                    "nranks": st.nranks,
-                    "bytes": st.total_bytes,
-                    "latency_us": {
-                        "min": round(st.min_ns / 1e3, 2) if good else 0.0,
-                        "avg": round(avg_ns / 1e3, 2),
-                        "max": round(st.max_ns / 1e3, 2),
-                        # exact sum: the OpenMetrics histogram _sum
-                        # (avg*calls would re-round)
-                        "total": round(st.total_ns / 1e3, 2),
-                    },
-                    "hist_us": {
-                        **{f"le_{ub}": n for ub, n in
-                           zip(LATENCY_BUCKETS_US, st.hist)},
-                        "inf": st.hist[-1],
-                    },
-                    # 6 decimals: a small-message call is a few µGB/s
-                    # and must not round to a flat 0.0
-                    "algbw_GBps": round(algbw, 6),
-                    "busbw_GBps": round(
-                        algbw * busbw_factor(coll, st.nranks), 6),
-                }
+                calls["|".join((coll, dtype, bucket))] = \
+                    self._call_doc(coll, dtype, bucket, st)
+            tenant_calls = {}
+            for (tenant, coll, dtype, bucket), st in \
+                    self._tenant_calls.items():
+                doc = self._call_doc(coll, dtype, bucket, st)
+                doc["tenant"] = tenant
+                tenant_calls["|".join((tenant, coll, dtype, bucket))] = doc
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges),
                     "values": {k: {"count": v["count"],
                                    "sum_us": round(v["sum_us"], 2),
                                    "hist": list(v["hist"])}
                                for k, v in self._values.items()},
-                    "calls": calls}
+                    "calls": calls,
+                    "tenant_calls": tenant_calls}
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), indent=2, sort_keys=True)
@@ -467,11 +587,24 @@ class MetricsRegistry:
             describe(n)
             out.append(f"# TYPE {n} counter")
             out.append(f"{n}_total {snap['counters'][k]}")
+        tenant_health = {}
         for k in sorted(snap["gauges"]):
+            m = re.match(r"^tenant/([^/]+)/health$", k)
+            if m:
+                # per-tenant health rides the accl_health family as a
+                # labeled sample (the SLO tracker's verdict surface)
+                tenant_health[m.group(1)] = snap["gauges"][k]
+                continue
             n = name(k)
             describe(n)
             out.append(f"# TYPE {n} gauge")
             out.append(f"{n} {snap['gauges'][k]}")
+        if tenant_health:
+            describe("accl_health")
+            out.append("# TYPE accl_health gauge")
+            for t in sorted(tenant_health):
+                out.append(
+                    f'accl_health{{tenant="{esc(t)}"}} {tenant_health[t]}')
         for k in sorted(snap["values"]):
             n = name(k)
             v = snap["values"][k]
@@ -485,39 +618,44 @@ class MetricsRegistry:
             out.append(f'{n}_bucket{{le="+Inf"}} {cum}')
             out.append(f"{n}_sum {v['sum_us']}")
             out.append(f"{n}_count {v['count']}")
-        if snap["calls"]:
-            for fam, kind in (("accl_collective_calls", "counter"),
-                              ("accl_collective_errors", "counter"),
-                              ("accl_collective_bytes", "counter"),
-                              ("accl_collective_latency_us", "histogram"),
-                              ("accl_collective_algbw_gbps", "gauge"),
-                              ("accl_collective_busbw_gbps", "gauge")):
+        def emit_call_tables(table: dict, base: str) -> None:
+            if not table:
+                return
+            for fam, kind in ((f"{base}_calls", "counter"),
+                              (f"{base}_errors", "counter"),
+                              (f"{base}_bytes", "counter"),
+                              (f"{base}_latency_us", "histogram"),
+                              (f"{base}_algbw_gbps", "gauge"),
+                              (f"{base}_busbw_gbps", "gauge")):
                 describe(fam)
                 out.append(f"# TYPE {fam} {kind}")
-        for k in sorted(snap["calls"]):
-            c = snap["calls"][k]
-            lbl = (f'collective="{esc(c["collective"])}",'
-                   f'dtype="{esc(c["dtype"])}",'
-                   f'size_bucket="{esc(c["size_bucket"])}"')
-            out.append(f"accl_collective_calls_total{{{lbl}}} {c['calls']}")
-            out.append(
-                f"accl_collective_errors_total{{{lbl}}} {c['errors']}")
-            out.append(f"accl_collective_bytes_total{{{lbl}}} {c['bytes']}")
-            cum = 0
-            for ub in LATENCY_BUCKETS_US:
-                cum += c["hist_us"][f"le_{ub}"]
-                out.append("accl_collective_latency_us_bucket"
-                           f'{{{lbl},le="{ub}"}} {cum}')
-            cum += c["hist_us"]["inf"]
-            out.append("accl_collective_latency_us_bucket"
-                       f'{{{lbl},le="+Inf"}} {cum}')
-            out.append("accl_collective_latency_us_sum"
-                       f"{{{lbl}}} {c['latency_us']['total']}")
-            out.append(f"accl_collective_latency_us_count{{{lbl}}} {cum}")
-            out.append(
-                f"accl_collective_algbw_gbps{{{lbl}}} {c['algbw_GBps']}")
-            out.append(
-                f"accl_collective_busbw_gbps{{{lbl}}} {c['busbw_GBps']}")
+            for k in sorted(table):
+                c = table[k]
+                lbl = (f'collective="{esc(c["collective"])}",'
+                       f'dtype="{esc(c["dtype"])}",'
+                       f'size_bucket="{esc(c["size_bucket"])}"')
+                if "tenant" in c:
+                    lbl = f'tenant="{esc(c["tenant"])}",' + lbl
+                out.append(f"{base}_calls_total{{{lbl}}} {c['calls']}")
+                out.append(f"{base}_errors_total{{{lbl}}} {c['errors']}")
+                out.append(f"{base}_bytes_total{{{lbl}}} {c['bytes']}")
+                cum = 0
+                for ub in LATENCY_BUCKETS_US:
+                    cum += c["hist_us"][f"le_{ub}"]
+                    out.append(f"{base}_latency_us_bucket"
+                               f'{{{lbl},le="{ub}"}} {cum}')
+                cum += c["hist_us"]["inf"]
+                out.append(f"{base}_latency_us_bucket"
+                           f'{{{lbl},le="+Inf"}} {cum}')
+                out.append(f"{base}_latency_us_sum"
+                           f"{{{lbl}}} {c['latency_us']['total']}")
+                out.append(f"{base}_latency_us_count{{{lbl}}} {cum}")
+                out.append(f"{base}_algbw_gbps{{{lbl}}} {c['algbw_GBps']}")
+                out.append(f"{base}_busbw_gbps{{{lbl}}} {c['busbw_GBps']}")
+
+        emit_call_tables(snap["calls"], "accl_collective")
+        emit_call_tables(snap.get("tenant_calls", {}),
+                         "accl_tenant_collective")
         out.append("# EOF")
         return "\n".join(out) + "\n"
 
@@ -526,6 +664,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._calls.clear()
+            self._tenant_calls.clear()
             self._values.clear()
 
 

@@ -373,7 +373,8 @@ def decode_link_stats(values: Sequence[int]) -> List[dict]:
 
 
 def link_matrix(per_rank_rows: dict, nranks: Optional[int] = None,
-                comm: Optional[int] = 0) -> dict:
+                comm: Optional[int] = 0,
+                comms: Optional[Iterable[int]] = None) -> dict:
     """Fold per-rank link rows into the world-level P×P traffic matrix.
 
     ``per_rank_rows`` maps GLOBAL rank -> decoded link rows (the
@@ -381,7 +382,13 @@ def link_matrix(per_rank_rows: dict, nranks: Optional[int] = None,
     which communicator's rows to fold (default 0, the global comm,
     whose comm-local peer ranks ARE global ranks); ``comm=None`` folds
     every comm — callers owning sub-communicators must map peers to
-    global ranks themselves first.
+    global ranks themselves first.  ``comms`` (r20 tenant slicing)
+    overrides ``comm`` with an explicit SET of communicator ids to fold
+    — the per-tenant view: a tenant's traffic is the union of its
+    communicators' rows.  Peer indices in non-global comms are
+    comm-local; slice consumers treat rows/cols as comm-local
+    coordinates (world kill/join drills keep sub-comm membership
+    contiguous from rank 0, so the slice stays meaningful).
 
     Returns ``{"nranks": P, "fields": {field: P×P list-of-lists}}``
     with ``matrix[src][dst]`` = rank src's counter toward peer dst for
@@ -391,19 +398,27 @@ def link_matrix(per_rank_rows: dict, nranks: Optional[int] = None,
     side measured it)."""
     ranks = sorted(per_rank_rows)
     P = nranks if nranks is not None else (max(ranks) + 1 if ranks else 0)
+    comm_set = None if comms is None else {int(c) for c in comms}
     fields = {f: [[0] * P for _ in range(P)] for f in LINK_COUNTER_FIELDS}
     for src, rows in per_rank_rows.items():
         if src >= P:
             continue
         for row in rows:
-            if comm is not None and row.get("comm") != comm:
+            if comm_set is not None:
+                if row.get("comm") not in comm_set:
+                    continue
+            elif comm is not None and row.get("comm") != comm:
                 continue
             dst = int(row.get("peer", -1))
             if not 0 <= dst < P:
                 continue
             for f in LINK_COUNTER_FIELDS:
                 fields[f][src][dst] += int(row.get(f, 0))
-    return {"nranks": P, "comm": comm, "fields": fields}
+    doc = {"nranks": P, "comm": comm, "fields": fields}
+    if comm_set is not None:
+        doc["comm"] = None
+        doc["comms"] = sorted(comm_set)
+    return doc
 
 
 def slowest_link(matrix: dict,

@@ -69,10 +69,10 @@ class TraceSpan:
     whose native engine matches calls below the Python layer); export
     skips slices whose endpoints are missing."""
 
-    __slots__ = ("name", "desc", "rank", "gang_id", "lane", "count",
-                 "dtype", "nbytes", "nranks", "t_submit", "t_queue",
-                 "t_gang_ready", "t_dispatch", "t_device_begin",
-                 "t_device_end", "t_complete")
+    __slots__ = ("name", "desc", "rank", "gang_id", "lane", "tenant",
+                 "count", "dtype", "nbytes", "nranks", "t_submit",
+                 "t_queue", "t_gang_ready", "t_dispatch",
+                 "t_device_begin", "t_device_end", "t_complete")
 
     def __init__(self, name: str, desc: str = "", rank: int = -1,
                  count: int = 0, dtype: str = "", nbytes: int = 0,
@@ -82,6 +82,9 @@ class TraceSpan:
         self.rank = rank
         self.gang_id: Optional[int] = None
         self.lane: Optional[str] = None
+        #: tenant/lane label of the issuing communicator (r20) — spans
+        #: of a labeled tenant render on their own per-tenant call track
+        self.tenant: Optional[str] = None
         self.count = count
         self.dtype = dtype
         self.nbytes = nbytes
@@ -237,9 +240,12 @@ class TraceCollector:
             args = {"desc": s.desc, "count": s.count, "dtype": s.dtype,
                     "nbytes": s.nbytes, "nranks": s.nranks,
                     "gang_id": s.gang_id, "lane": s.lane,
+                    "tenant": s.tenant,
                     "timestamps_ns": s.timestamps()}
-            slice_ev(pid, "call", s.name + gid, s.t_submit, s.t_complete,
-                     args)
+            call_track = ("call" if s.tenant is None
+                          else f"call:{s.tenant}")
+            slice_ev(pid, call_track, s.name + gid, s.t_submit,
+                     s.t_complete, args)
             slice_ev(pid, "queue", s.name + gid, s.t_queue,
                      s.t_dispatch or s.t_complete,
                      {"gang_ready_ns": s.t_gang_ready})

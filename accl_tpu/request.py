@@ -88,8 +88,9 @@ class Request:
         #: observability (accl_tpu/observability): `trace` is this
         #: call's TraceSpan (None when tracing is off — the
         #: zero-allocation fast path), `metric` is the driver-attached
-        #: (registry, collective, dtype, nbytes, nranks, t_submit_ns)
-        #: tuple published at completion.  Both set by ACCL._execute.
+        #: (registry, collective, dtype, nbytes, nranks, t_submit_ns,
+        #: tenant) tuple published at completion.  Both set by
+        #: ACCL._execute.
         self.trace: Optional[object] = None
         self.metric: Optional[tuple] = None
         #: always-on flight-recorder record (observability/flight.py);
@@ -130,10 +131,11 @@ class Request:
             if self.flight is not None:
                 self.flight.finish(self.retcode, t_end)
             if self.metric is not None:
-                reg, coll, dtype, nbytes, nranks, t0 = self.metric
+                reg, coll, dtype, nbytes, nranks, t0, tenant = self.metric
                 reg.observe_call(coll, dtype, nbytes, t_end - t0, nranks,
                                  ok=self.retcode == 0,
-                                 engine_ns=self.duration_ns)
+                                 engine_ns=self.duration_ns,
+                                 tenant=tenant)
             span = self.trace
             if span is not None:
                 span.t_complete = t_end

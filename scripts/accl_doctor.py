@@ -124,6 +124,14 @@ def scrape_live(target: str, timeout_s: float = 10.0) -> dict:
                 f"the world running with ACCL_METRICS_PORT set?")
         out[path] = (body.decode() if path == "metrics"
                      else json.loads(body))
+    # /slo (r20) is NON-FATAL: a pre-r20 world has no such route, and
+    # this doctor must still produce its report against it
+    try:
+        with urllib.request.urlopen(f"{target}/slo",
+                                    timeout=timeout_s) as resp:
+            out["slo"] = json.loads(resp.read())
+    except (OSError, ValueError):
+        out["slo"] = None
     return out
 
 
@@ -172,6 +180,37 @@ def report_live(scraped: dict, out=sys.stdout) -> bool:
         w("engine telemetry: none exported (set "
           "ACCL_TELEMETRY_INTERVAL_MS>0 on the world to sample the "
           "native engine stats plane)\n")
+    # per-tenant SLO plane (r20): the /slo body when the scraped world
+    # has a tracker armed, plus the tenant/* metric families.  Same
+    # forward-compatibility stance as the engine block: a family this
+    # doctor build does not know renders as unrecognized, never fatal.
+    slo = scraped.get("slo")
+    if slo and slo.get("tenants"):
+        w(f"per-tenant SLO ({len(slo.get('specs', []))} spec(s), "
+          f"{slo.get('checks', 0)} check sweep(s)):\n")
+        for tenant in sorted(slo["tenants"]):
+            t = slo["tenants"][tenant]
+            w(f"  tenant {tenant}: "
+              f"{str(t.get('verdict', '?')).upper()} — budget "
+              f"remaining {t.get('budget_remaining', 1.0) * 100:.1f}%"
+              f" over {len(t.get('objectives', []))} objective(s)\n")
+    tenant_lines = [ln for ln in scraped["metrics"].splitlines()
+                    if ln and not ln.startswith("#")
+                    and (ln.startswith("accl_tenant_")
+                         or ln.startswith("accl_slo_")
+                         or ln.startswith("accl_health{tenant="))]
+    if tenant_lines:
+        w("per-tenant metric families:\n")
+        for ln in tenant_lines:
+            name = ln.split("{")[0].split(" ")[0]
+            family = name
+            for suffix in ("_total", "_bucket", "_sum", "_count"):
+                if family.endswith(suffix):
+                    family = family[: -len(suffix)]
+                    break
+            known = metric_help_for(family) or metric_help_for(name)
+            tag = "" if known else "  [unrecognized (newer world?)]"
+            w(f"  {ln}{tag}\n")
     w("\n")
     return report(scraped["flight"], out)
 

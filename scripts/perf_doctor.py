@@ -32,7 +32,19 @@ files into the same three-part report a running world exposes through
   rendered as finding -> hypothesis -> A/B -> decision chains, with a
   post-install cross-check against the sentinel section — an installed
   cell the sentinel still flags (and the tuner has not auto-reverted)
-  is a finding.
+  is a finding;
+- **per-tenant SLO report** (r20, ``--slo``): an ``accl-slo-report``
+  document (the ``/slo`` exporter body / slo_soak artifact) rendered
+  as budget-remaining + fast/slow burn rates per tenant objective,
+  with any embedded per-tenant link-matrix slices rendered against the
+  same fabric axes as the world matrix — a tenant whose verdict is not
+  ``ok`` is a finding.
+
+File-loaded sections go through ONE report-section registry
+(:data:`SECTIONS`: loader -> schema validator -> renderer), so
+``--ci`` schema validation covers every section uniformly — a section
+added without a validator is a bug the registry makes structurally
+impossible, not a silent gap.
 
 ``--ci`` is the perf-gate mode: the REPORT SCHEMA is hard-validated
 (a malformed dump or snapshot fails the job) but threshold findings
@@ -218,7 +230,9 @@ def render_link_matrix(section: dict, out) -> None:
     fabric, axis_fn = _world_fabric(P)
     f = section["findings"]
     spec = f", fabric {fabric.spec()}" if fabric is not None else ""
-    out.write(f"\nlink matrix ({P}x{P}, comm 0{spec}):\n")
+    scope = (f"tenant {matrix['tenant']}" if matrix.get("tenant")
+             else f"comm {matrix.get('comm') or 0}")
+    out.write(f"\nlink matrix ({P}x{P}, {scope}{spec}):\n")
     tx = matrix["fields"]["tx_bytes"]
     wait = matrix["fields"]["seek_wait_ns"]
     for s in range(P):
@@ -356,6 +370,148 @@ def render_retunes(doc: dict, cross: list, out) -> None:
                   f"auto-reverted\n")
 
 
+def _retunes_section(doc: dict, report: dict, out) -> int:
+    cross = retune_cross_check(
+        doc, report.get("sentinel", {}).get("findings", []))
+    report["retunes"] = {"history": doc, "cross_check": cross}
+    render_retunes(doc, cross, out)
+    return len(cross)
+
+
+def load_slo(path: str) -> dict:
+    from accl_tpu.observability import slo as _slo
+
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) \
+            or doc.get("format") != _slo.SLO_REPORT_FORMAT:
+        raise ValueError(
+            f"{path} is not an SLO report (format="
+            f"{doc.get('format') if isinstance(doc, dict) else doc!r}; "
+            f"want {_slo.SLO_REPORT_FORMAT!r} — the exporter's /slo "
+            f"body or slo_soak's artifact)")
+    return doc
+
+
+def validate_slo_section(doc: dict) -> list:
+    """--ci schema gate for the SLO report: versioned format, every
+    objective row complete with a known verdict and a sane budget,
+    every embedded per-tenant link-matrix slice square."""
+    from accl_tpu.observability import slo as _slo
+
+    errors = []
+    if doc.get("version") != _slo.SLO_REPORT_VERSION:
+        errors.append(f"slo: unsupported report version "
+                      f"{doc.get('version')!r}")
+    tenants = doc.get("tenants")
+    if not isinstance(tenants, dict):
+        errors.append("slo: 'tenants' is not a dict")
+        return errors
+    for tenant, t in tenants.items():
+        tag = f"slo: tenant {tenant!r}"
+        if not isinstance(t, dict):
+            errors.append(f"{tag}: not a dict")
+            continue
+        if t.get("verdict") not in _slo.VERDICT_NAMES:
+            errors.append(f"{tag}: verdict {t.get('verdict')!r} not in "
+                          f"{_slo.VERDICT_NAMES}")
+        br = t.get("budget_remaining")
+        if not isinstance(br, (int, float)) or not 0.0 <= br <= 1.0:
+            errors.append(f"{tag}: budget_remaining {br!r} not in "
+                          f"[0, 1]")
+        rows = t.get("objectives")
+        if not isinstance(rows, list):
+            errors.append(f"{tag}: 'objectives' is not a list")
+            continue
+        for row in rows:
+            missing = [k for k in _slo.OBJECTIVE_SCHEMA_KEYS
+                       if k not in row]
+            if missing:
+                errors.append(f"{tag}: objective row missing {missing}")
+                continue
+            if row["verdict"] not in _slo.VERDICT_NAMES:
+                errors.append(f"{tag}: objective {row['objective']} "
+                              f"verdict {row['verdict']!r}")
+    for tenant, m in (doc.get("link_matrices") or {}).items():
+        errors.extend(
+            f"slo[{tenant}]: {e}" for e in
+            validate_link_section({"matrix": m, "findings": {}}))
+    return errors
+
+
+def render_slo(doc: dict, out) -> int:
+    """Render the per-tenant report; returns the finding count (one
+    per not-ok tenant, plus imbalanced tenant link slices)."""
+    findings = 0
+    tenants = doc.get("tenants", {})
+    out.write(f"\nSLO report (r20): {len(doc.get('specs', []))} "
+              f"spec(s), {len(tenants)} tenant(s), "
+              f"{doc.get('checks', 0)} check sweep(s), windows "
+              f"fast={doc.get('fast_window')}/"
+              f"slow={doc.get('slow_window')} sweeps\n")
+    for tenant in sorted(tenants):
+        t = tenants[tenant]
+        verdict = t.get("verdict", "?")
+        if verdict != "ok":
+            findings += 1
+        out.write(f"  tenant {tenant}: {str(verdict).upper()}  "
+                  f"budget remaining "
+                  f"{t.get('budget_remaining', 1.0) * 100:.1f}%\n")
+        for row in t.get("objectives", []):
+            budget = (f"budget {row['budget_remaining'] * 100:.1f}%"
+                      if row.get("budget_remaining") is not None
+                      else "no budget (floor)")
+            out.write(
+                f"    {row['collective']}/{row['size_bucket']} "
+                f"{row['objective']:<12} target {row['target']:<10} "
+                f"burn fast {row['burn_fast']:>7.2f} / slow "
+                f"{row['burn_slow']:>7.2f}  {budget}  "
+                f"-> {row['verdict']}\n")
+    for tenant in sorted(doc.get("link_matrices", {}) or {}):
+        matrix = doc["link_matrices"][tenant]
+        section = {"matrix": matrix, "findings": link_findings(matrix)}
+        render_link_matrix(section, out)
+        if section["findings"].get("imbalanced"):
+            findings += 1
+    return findings
+
+
+def _slo_section(doc: dict, report: dict, out) -> int:
+    report["slo"] = doc
+    return render_slo(doc, out)
+
+
+#: the report-section registry (r20 satellite): every file-loaded
+#: section declares loader -> --ci schema validator -> renderer in one
+#: place, so schema validation is uniform across sections by
+#: construction.  The renderer returns the section's finding count;
+#: validators for sections assembled in-process (link_matrix) are
+#: registered too so main() resolves EVERY validator through here.
+SECTIONS = {
+    "retunes": {"load": load_retunes, "validate": validate_retune_section,
+                "render": _retunes_section},
+    "slo": {"load": load_slo, "validate": validate_slo_section,
+            "render": _slo_section},
+    "link_matrix": {"load": None, "validate": validate_link_section,
+                    "render": None},
+}
+
+
+def run_section(name: str, path: str, report: dict,
+                schema_errors: list, out) -> int:
+    """Load + validate + render one registered file-backed section;
+    loader/validator failures become schema errors (fatal under --ci),
+    never tracebacks."""
+    sec = SECTIONS[name]
+    try:
+        doc = sec["load"](path)
+        schema_errors.extend(sec["validate"](doc))
+        return sec["render"](doc, report, out)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        schema_errors.append(f"{name}: {type(e).__name__}: {e}")
+        return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--metrics", default="",
@@ -374,6 +530,11 @@ def main() -> int:
                     help="retune-history JSON (the exporter's /retunes "
                          "body / retune_smoke artifact) — rendered as "
                          "decision chains + sentinel cross-check")
+    ap.add_argument("--slo", default="",
+                    help="SLO report JSON (the exporter's /slo body / "
+                         "slo_soak artifact) — rendered as per-tenant "
+                         "budget-remaining + burn rates, with embedded "
+                         "per-tenant link-matrix slices")
     ap.add_argument("--out", default="",
                     help="write the full JSON report here (CI artifact)")
     ap.add_argument("--ci", action="store_true",
@@ -385,9 +546,10 @@ def main() -> int:
     ap.add_argument("--timeline", action="store_true",
                     help="include the per-gang timeline in the report")
     args = ap.parse_args()
-    if not args.metrics and not args.flight and not args.retunes:
-        ap.error("pass --metrics, --flight, and/or --retunes input "
-                 "files")
+    if not args.metrics and not args.flight and not args.retunes \
+            and not args.slo:
+        ap.error("pass --metrics, --flight, --retunes and/or --slo "
+                 "input files")
 
     report: dict = {"version": 1}
     schema_errors: list = []
@@ -460,7 +622,8 @@ def main() -> int:
             links = link_matrix_section(snap)
             if links:
                 report["link_matrix"] = links
-                schema_errors.extend(validate_link_section(links))
+                schema_errors.extend(
+                    SECTIONS["link_matrix"]["validate"](links))
                 render_link_matrix(links, sys.stdout)
                 # r18: the recovered-MXU fraction belongs next to the
                 # link traffic it hides — how much of those bytes'
@@ -504,18 +667,11 @@ def main() -> int:
             schema_errors.append(f"metrics/sentinel: "
                                  f"{type(e).__name__}: {e}")
 
-    # -- retune history (r19) ------------------------------------------
-    if args.retunes:
-        try:
-            doc = load_retunes(args.retunes)
-            schema_errors.extend(validate_retune_section(doc))
-            cross = retune_cross_check(
-                doc, report.get("sentinel", {}).get("findings", []))
-            report["retunes"] = {"history": doc, "cross_check": cross}
-            findings += len(cross)
-            render_retunes(doc, cross, sys.stdout)
-        except (OSError, ValueError, json.JSONDecodeError) as e:
-            schema_errors.append(f"retunes: {type(e).__name__}: {e}")
+    # -- registry-driven file sections: retunes (r19), slo (r20) -------
+    for name, path in (("retunes", args.retunes), ("slo", args.slo)):
+        if path:
+            findings += run_section(name, path, report, schema_errors,
+                                    sys.stdout)
 
     report["schema_errors"] = schema_errors
     report["findings_total"] = findings

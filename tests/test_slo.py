@@ -1,0 +1,573 @@
+"""Per-tenant SLO observatory (r20): burn-rate math pins for the
+SLOTracker (fast-burn fires before slow-burn, cleared keys re-arm,
+verdict precedence per tenant), the metrics-registry cardinality guard,
+tenant-labeled OpenMetrics families, per-tenant link-matrix slices on
+emu AND tpu-interpret, RECEIVE_TIMEOUT flight forensics, and the
+perf_doctor --slo / exporter /slo round trips.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from accl_tpu import ReduceFunction
+from accl_tpu.constants import ACCLError
+from accl_tpu.observability import health as obs_health
+from accl_tpu.observability import metrics as obs_metrics
+from accl_tpu.observability import sentinel as obs_sentinel
+from accl_tpu.observability import slo as obs_slo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(tenant="decode", collective="allreduce", **axes):
+    """A normalized spec dict in load_specs' output shape."""
+    s = {"tenant": tenant, "collective": collective, "size_bucket": "*",
+         "availability": axes.pop("availability", 0.99)}
+    s.update(axes)
+    return s
+
+
+def _tracker(specs, reg, **kw):
+    kw.setdefault("fast_window", 2)
+    kw.setdefault("slow_window", 8)
+    kw.setdefault("fast_burn", 8.0)
+    kw.setdefault("slow_burn", 2.0)
+    kw.setdefault("min_calls", 4)
+    return obs_slo.SLOTracker(specs, registry=reg, **kw)
+
+
+def _sweep(reg, us, n=10, ok=True, tenant="decode", coll="allreduce",
+           nbytes=4096):
+    for _ in range(n):
+        reg.observe_call(coll, "float32", nbytes, us * 1e3, 4, ok=ok,
+                         tenant=tenant)
+
+
+def _row(tracker, objective="p50_us", tenant="decode"):
+    rows = [o for o in tracker.objectives
+            if o["objective"] == objective and o["tenant"] == tenant]
+    assert len(rows) == 1, tracker.objectives
+    return rows[0]
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math pins: the multi-window discipline on a synthetic stream
+# ---------------------------------------------------------------------------
+def test_fast_burn_fires_before_slow_burn_then_budget_exhausts():
+    """p50 objective (budget 0.5, clamped thresholds fast=1.8 slow=1.0):
+    a latency cliff pages via the FAST window two sweeps in, while the
+    slow window is still below threshold; the cumulative budget then
+    drains monotonically to exhaustion."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(p50_us=256.0)], reg)
+    deliveries = []
+    tr.subscribe(lambda fs: deliveries.append(fs))
+
+    for _ in range(6):                    # healthy: 100us < 256us ceiling
+        _sweep(reg, 100)
+        tr.check()
+        assert _row(tr)["verdict"] == "ok"
+        assert _row(tr)["budget_remaining"] == 1.0
+
+    remaining = []
+    verdicts = []
+    for _ in range(6):                    # cliff: 1000us > ceiling
+        _sweep(reg, 1000)
+        tr.check()
+        row = _row(tr)
+        verdicts.append(row["verdict"])
+        remaining.append(row["budget_remaining"])
+
+    # sweep 1 of the cliff: fast window is half healthy — no page yet
+    assert verdicts[0] == "ok"
+    # sweep 2: fast window all-bad -> burn 2.0 >= clamped 1.8 pages,
+    # while the slow burn is still under ITS threshold (fast fired first)
+    assert verdicts[1] == "fast_burn"
+    # slow catches up later; budget exhausts by cliff sweep 6
+    assert verdicts[-1] == "exhausted"
+    assert remaining[-1] == 0.0
+    assert remaining == sorted(remaining, reverse=True)  # monotonic drain
+    assert remaining[0] == pytest.approx(0.7143, abs=1e-3)
+
+    # delivery gating: one page at the fast_burn flip, one re-delivery
+    # when the verdict worsened to exhausted — repeats suppressed
+    assert len(deliveries) == 2
+    assert all(f["kind"] == "slo" for batch in deliveries for f in batch)
+    assert deliveries[0][0]["verdict"] == "fast_burn"
+    assert deliveries[1][0]["verdict"] == "exhausted"
+    snap = reg.snapshot()
+    assert snap["counters"]["slo/checks"] == 12
+    assert snap["counters"]["slo/findings"] == 2
+    assert snap["gauges"]["tenant/decode/health"] == obs_slo.V_EXHAUSTED
+    assert snap["gauges"]["tenant/decode/slo_budget_remaining"] == 0.0
+
+
+def test_slow_burn_threshold_crosses_after_fast():
+    """The slow window's burn crosses its (clamped) threshold only once
+    half its sweeps are bad — sweeps after the fast page."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(p50_us=256.0)], reg)
+    for _ in range(6):
+        _sweep(reg, 100)
+        tr.check()
+    burns_slow = []
+    for _ in range(4):
+        _sweep(reg, 1000)
+        tr.check()
+        burns_slow.append(_row(tr)["burn_slow"])
+    # 2 bad of 8 sweeps -> bad_frac 0.25 -> burn 0.5 < 1.0 threshold
+    assert burns_slow[1] == pytest.approx(0.5, abs=1e-6)
+    assert burns_slow[1] < 1.0
+    # 4 bad of 8 -> burn exactly at the clamped slow threshold
+    assert burns_slow[3] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_cleared_keys_rearm_and_redeliver():
+    """A finding that clears (healthy sweeps drain both windows) drops
+    from the delivered table, so the NEXT violation pages again instead
+    of being worsening-gated against the stale severity."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(p50_us=256.0)], reg)
+    deliveries = []
+    tr.subscribe(lambda fs: deliveries.append(fs))
+    fkey = ("decode", "allreduce", "*", "p50_us")
+
+    for _ in range(6):
+        _sweep(reg, 100)
+        tr.check()
+    for _ in range(2):                    # first violation: one page
+        _sweep(reg, 1000)
+        tr.check()
+    assert len(deliveries) == 1
+    assert fkey in tr._delivered
+
+    for _ in range(8):                    # recovery drains both windows
+        _sweep(reg, 100)
+        tr.check()
+    assert _row(tr)["verdict"] == "ok"
+    assert fkey not in tr._delivered      # cleared key re-armed
+
+    for _ in range(2):                    # second violation: pages AGAIN
+        _sweep(reg, 1000)
+        tr.check()
+    assert len(deliveries) == 2
+    assert deliveries[1][0]["verdict"] == "fast_burn"
+
+
+def test_verdict_precedence_and_per_tenant_isolation():
+    """Two tenants: one driven to exhaustion, one healthy — verdicts,
+    gauges, and the labeled accl_health samples stay per-tenant; a
+    spec'd tenant with no traffic still reports ok."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(tenant="a", collective="*", p50_us=4.0),
+                   _spec(tenant="b", collective="*", p50_us=256.0),
+                   _spec(tenant="ghost", collective="*", p50_us=256.0)],
+                  reg)
+    for _ in range(3):
+        _sweep(reg, 1000, tenant="a")     # every call violates 4us
+        _sweep(reg, 100, tenant="b")
+        tr.check()
+    doc = tr.doc()
+    assert doc["tenants"]["a"]["verdict"] == "exhausted"
+    assert doc["tenants"]["a"]["budget_remaining"] == 0.0
+    assert doc["tenants"]["b"]["verdict"] == "ok"
+    assert doc["tenants"]["b"]["budget_remaining"] == 1.0
+    assert doc["tenants"]["ghost"]["verdict"] == "ok"   # no traffic
+    assert doc["tenants"]["ghost"]["objectives"] == []
+    snap = reg.snapshot()
+    assert snap["gauges"]["tenant/a/health"] == obs_slo.V_EXHAUSTED
+    assert snap["gauges"]["tenant/b/health"] == obs_slo.V_OK
+
+    # every objective row carries the full --ci schema
+    for t in doc["tenants"].values():
+        for row in t["objectives"]:
+            for k in obs_slo.OBJECTIVE_SCHEMA_KEYS:
+                assert k in row, (k, row)
+
+    body = reg.to_openmetrics()
+    assert obs_metrics.validate_openmetrics(body) == []
+    assert re.search(r'^accl_health\{tenant="a"\} 3(\.0)?$', body, re.M)
+    assert re.search(r'^accl_health\{tenant="b"\} 0(\.0)?$', body, re.M)
+    # the per-tenant health gauge rides accl_health, never its own family
+    assert "accl_tenant_a_health" not in body
+
+
+def test_availability_objective_burns_on_failures_not_latency():
+    """ok=False calls never enter the latency histogram (the latency
+    SLI is over successful calls) — they burn the AVAILABILITY budget
+    instead, which track_errors declares."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(availability=0.75, p50_us=256.0,
+                         track_errors=True)], reg)
+    for _ in range(4):
+        _sweep(reg, 100)
+        tr.check()
+    # failures with enormous durations: latency axis must stay blind
+    _sweep(reg, 1_000_000, ok=False)
+    tr.check()
+    lat = _row(tr, "p50_us")
+    assert lat["bad_fast"] == 0 and lat["verdict"] == "ok"
+    avail = _row(tr, "availability")
+    assert avail["bad_fast"] == 10          # the errors, counted
+    assert avail["budget_remaining"] < 1.0  # and burning the budget
+    _sweep(reg, 1_000_000, ok=False)
+    tr.check()
+    assert _row(tr, "availability")["verdict"] == "exhausted"
+    assert _row(tr, "p50_us")["verdict"] == "ok"
+
+
+def test_busbw_floor_objective():
+    """busbw is a floor, not a ceiling: under floor/2 pages fast, under
+    floor bleeds slow, above it is ok — no cumulative budget."""
+    reg = obs_metrics.MetricsRegistry()
+    # synthetic stream: 1 MiB in 100us -> ~10 GB/s algbw
+    tr = _tracker([_spec(busbw_GBps=1000.0),
+                   _spec(tenant="fine", busbw_GBps=0.001)], reg)
+    for _ in range(2):
+        _sweep(reg, 100, nbytes=1 << 20)
+        _sweep(reg, 100, nbytes=1 << 20, tenant="fine")
+        tr.check()
+    row = _row(tr, "busbw_GBps")
+    assert row["verdict"] == "fast_burn"     # way under floor/2
+    assert row["budget_remaining"] is None   # floors carry no budget
+    assert _row(tr, "busbw_GBps", "fine")["verdict"] == "ok"
+
+
+def test_idle_tenant_burn_decays():
+    """A tenant that stops sending still has its windows advance
+    (idle_sweep), so a past violation decays instead of pinning the
+    verdict forever."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(p50_us=256.0)], reg)
+    for _ in range(6):
+        _sweep(reg, 100)
+        tr.check()
+    for _ in range(2):
+        _sweep(reg, 1000)
+        tr.check()
+    assert _row(tr)["verdict"] == "fast_burn"
+    for _ in range(8):                      # silence: no observe_call
+        tr.check()
+    assert _row(tr)["verdict"] == "ok"
+    assert _row(tr)["calls_fast"] == 0
+
+
+def test_sentinel_subscribers_receive_slo_findings(monkeypatch):
+    """One control plane: a live sentinel's subscribers get SLO pages
+    too, without subscribing to the tracker themselves."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(p50_us=4.0)], reg)
+    got = []
+    monkeypatch.setattr(
+        obs_sentinel, "_sentinel",
+        types.SimpleNamespace(_subscribers=[lambda fs: got.append(fs)]))
+    _sweep(reg, 1000)
+    tr.check()
+    assert got and got[0][0]["kind"] == "slo"
+    assert got[0][0]["tenant"] == "decode"
+
+
+# ---------------------------------------------------------------------------
+# spec loading + env-driven lifecycle
+# ---------------------------------------------------------------------------
+def _write_spec(tmp_path, doc, name="slo.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _valid_spec_doc():
+    return {"format": obs_slo.SLO_SPEC_FORMAT,
+            "version": obs_slo.SLO_SPEC_VERSION,
+            "slos": [{"tenant": "decode", "collective": "allreduce",
+                      "p50_us": 256.0},
+                     {"tenant": "prefill", "availability": 0.9,
+                      "track_errors": True}]}
+
+
+def test_load_specs_round_trip_and_defaults(tmp_path):
+    specs = obs_slo.load_specs(_write_spec(tmp_path, _valid_spec_doc()))
+    assert specs[0]["size_bucket"] == "*"        # default wildcard
+    assert specs[0]["availability"] == 0.99      # default availability
+    assert specs[1]["collective"] == "*"
+    assert specs[1]["track_errors"] is True
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda d: d.update(format="nope"), "not an accl-slo-spec"),
+    (lambda d: d.update(version=99), "version"),
+    (lambda d: d.update(slos=[]), "non-empty"),
+    (lambda d: d["slos"][0].pop("tenant"), "tenant"),
+    (lambda d: d["slos"][0].update(availability=1.5), r"\(0, 1\)"),
+    (lambda d: d["slos"][0].update(p50_us=-1.0), "must be > 0"),
+    (lambda d: d["slos"][0].update({"p50_us": None}) or
+     d["slos"][0].pop("p50_us"), "no objective"),
+])
+def test_load_specs_validation_errors(tmp_path, mutate, match):
+    doc = _valid_spec_doc()
+    doc["slos"] = doc["slos"][:1]
+    mutate(doc)
+    with pytest.raises(ValueError, match=match):
+        obs_slo.load_specs(_write_spec(tmp_path, doc))
+
+
+def test_ensure_slo_from_env(tmp_path, monkeypatch):
+    obs_slo.stop_slo()
+    try:
+        monkeypatch.delenv("ACCL_SLO", raising=False)
+        assert obs_slo.ensure_slo_from_env() is None   # unset = off
+        monkeypatch.setenv("ACCL_SLO", "0")
+        assert obs_slo.ensure_slo_from_env() is None   # explicit off
+        # a bad spec disables with a warning — never raises at bring-up
+        monkeypatch.setenv("ACCL_SLO", str(tmp_path / "missing.json"))
+        assert obs_slo.ensure_slo_from_env() is None
+        bad = dict(_valid_spec_doc(), format="nope")
+        monkeypatch.setenv("ACCL_SLO", _write_spec(tmp_path, bad, "b.json"))
+        assert obs_slo.ensure_slo_from_env() is None
+        # a good spec arms the singleton, idempotently
+        reg = obs_metrics.MetricsRegistry()
+        monkeypatch.setenv("ACCL_SLO",
+                           _write_spec(tmp_path, _valid_spec_doc()))
+        tr = obs_slo.ensure_slo_from_env(reg)
+        assert tr is not None and obs_slo.tracker() is tr
+        assert obs_slo.ensure_slo_from_env(reg) is tr
+        assert tr._thread is None       # ACCL_SLO_INTERVAL_MS=0: no timer
+    finally:
+        obs_slo.stop_slo()
+    assert obs_slo.tracker() is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: the registry's label-cardinality bound
+# ---------------------------------------------------------------------------
+def test_metrics_cardinality_guard_counts_drops():
+    reg = obs_metrics.MetricsRegistry(max_series=8)
+    for i in range(30):
+        reg.inc(f"series/{i}")
+    snap = reg.snapshot()
+    admitted = [k for k in snap["counters"] if k.startswith("series/")]
+    assert len(admitted) == 8
+    assert snap["counters"]["metrics/dropped_series"] == 22
+    # existing series keep updating at capacity
+    reg.inc("series/0", 5)
+    assert reg.snapshot()["counters"]["series/0"] == 6
+    # the guard bounds tenant series minting too (hostile label flood)
+    for i in range(20):
+        reg.observe_call("allreduce", "float32", 64, 1e3, 2,
+                         tenant=f"t{i}")
+    snap = reg.snapshot()
+    assert len(snap["tenant_calls"]) == 0   # registry already full
+    assert snap["counters"]["metrics/dropped_series"] > 22
+
+
+def test_metrics_max_series_env_knob(monkeypatch):
+    monkeypatch.setenv("ACCL_METRICS_MAX_SERIES", "16")
+    reg = obs_metrics.MetricsRegistry()
+    for i in range(40):
+        reg.inc(f"series/{i}")
+    assert sum(1 for k in reg.snapshot()["counters"]
+               if k.startswith("series/")) == 16
+    monkeypatch.setenv("ACCL_METRICS_MAX_SERIES", "banana")
+    with pytest.raises(ACCLError, match="ACCL_METRICS_MAX_SERIES"):
+        obs_metrics.MetricsRegistry()
+
+
+def test_tenant_families_validate_as_openmetrics():
+    reg = obs_metrics.MetricsRegistry()
+    _sweep(reg, 100, tenant="decode")
+    tr = _tracker([_spec(p50_us=256.0)], reg)
+    tr.check()
+    body = reg.to_openmetrics()
+    assert obs_metrics.validate_openmetrics(body) == []
+    assert ('accl_tenant_collective_calls_total{tenant="decode",'
+            'collective="allreduce"') in body
+    assert "accl_tenant_decode_slo_budget_remaining" in body
+    assert "accl_slo_checks_total" in body
+
+
+# ---------------------------------------------------------------------------
+# per-tenant link-matrix slices (emu + tpu-interpret)
+# ---------------------------------------------------------------------------
+def _tenant_traffic_body(nranks=4, count=64, iters=3):
+    def body(accl, rank):
+        d = accl.create_communicator(list(range(nranks)),
+                                     tenant="decode")
+        accl.create_communicator(list(range(nranks)),
+                                 tenant="prefill")
+        assert accl.tenant_comm_ids("decode") == [d]
+        send = accl.create_buffer_like(
+            np.arange(count, dtype=np.float32) + rank)
+        recv = accl.create_buffer(count, np.float32)
+        for _ in range(iters):
+            accl.allreduce(send, recv, count, ReduceFunction.SUM,
+                           comm_id=d, from_fpga=True, to_fpga=True)
+    return body
+
+
+def _assert_tenant_slices(world):
+    md = world.link_matrix(tenant="decode")
+    mp = world.link_matrix(tenant="prefill")
+    m0 = world.link_matrix()                 # comm 0: saw no traffic
+    assert md["tenant"] == "decode"
+    total = sum(sum(row) for row in md["fields"]["tx_bytes"])
+    assert total > 0, "decode slice must carry the comm's traffic"
+    assert sum(sum(row) for row in mp["fields"]["tx_bytes"]) == 0
+    assert sum(sum(row) for row in m0["fields"]["tx_bytes"]) == 0
+    # the sub-comm spans ranks in identity order: ring traffic lands on
+    # right-neighbor links exactly like the comm-0 matrices do
+    tx = md["fields"]["tx_msgs"]
+    P = md["nranks"]
+    assert any(tx[r][(r + 1) % P] > 0 for r in range(P))
+
+
+def test_tenant_link_matrix_slice_emu():
+    from accl_tpu.backends.emu import EmuWorld
+
+    world = EmuWorld(4)
+    try:
+        world.run(_tenant_traffic_body())
+        _assert_tenant_slices(world)
+    finally:
+        world.close()
+
+
+def test_tenant_link_matrix_slice_tpu_interpret():
+    from accl_tpu.backends.tpu import TpuWorld
+
+    with TpuWorld(4) as world:
+        world.run(_tenant_traffic_body(count=32, iters=2))
+        _assert_tenant_slices(world)
+
+
+# ---------------------------------------------------------------------------
+# satellite: RECEIVE_TIMEOUT forensics in the flight dump
+# ---------------------------------------------------------------------------
+def test_flight_timeout_forensics_snapshot():
+    from accl_tpu.observability import flight as obs_flight
+
+    rec = obs_flight.FlightRecorder(rank=0, capacity=32)
+    rec.set_forensics_sources({
+        "link_rows": lambda: [{"comm": 3, "peer": 1, "tx_msgs": 7}],
+        "gang_assembly": lambda: (_ for _ in ()).throw(
+            RuntimeError("engine gone")),
+    })
+    r = rec.new_record(7, "allreduce", 3, 0, "float32", 64, 256, 2,
+                       True, 1_000, tenant="decode")
+    r.finish(obs_flight._RECEIVE_TIMEOUT_BIT, 2_000)
+    dump = rec.dump()
+    assert dump["records"][0]["tenant"] == "decode"
+    assert len(dump["timeout_forensics"]) == 1
+    snap = dump["timeout_forensics"][0]
+    assert snap["tenant"] == "decode" and snap["collective"] == "allreduce"
+    assert snap["link_rows"] == [{"comm": 3, "peer": 1, "tx_msgs": 7}]
+    # a dying provider degrades to a note, never breaks the dump
+    assert snap["gang_assembly"].startswith("<capture failed")
+    # wall-clock stamps alongside the monotonic one (detsched antidote)
+    assert snap["wall_clock"] > 0
+    assert re.match(r"\d{4}-\d{2}-\d{2}T", snap["wall_clock_iso"])
+
+    # non-timeout failures do NOT snapshot
+    r2 = rec.new_record(8, "allgather", 0, 0, "float32", 64, 256, 2,
+                        True, 3_000)
+    r2.finish(1, 4_000)
+    assert len(rec.dump()["timeout_forensics"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# exporter /slo endpoint + perf_doctor --slo round trips
+# ---------------------------------------------------------------------------
+def test_exporter_slo_endpoint(tmp_path, monkeypatch):
+    import urllib.request
+
+    obs_slo.stop_slo()
+    obs_health.stop_exporter()
+    reg = obs_metrics.MetricsRegistry()
+    monkeypatch.setenv("ACCL_SLO", _write_spec(tmp_path, _valid_spec_doc()))
+    try:
+        tr = obs_slo.ensure_slo_from_env(reg)
+        assert tr is not None
+        _sweep(reg, 100, tenant="decode")
+        exp = obs_health.start_exporter(port=0, registry=reg)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/slo", timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["format"] == obs_slo.SLO_REPORT_FORMAT
+        assert doc["version"] == obs_slo.SLO_REPORT_VERSION
+        assert doc["checks"] >= 1          # the scrape drove a sweep
+        assert doc["tenants"]["decode"]["verdict"] == "ok"
+    finally:
+        obs_health.stop_exporter()
+        obs_slo.stop_slo()
+
+    # with no tracker armed the endpoint serves the empty document
+    try:
+        exp = obs_health.start_exporter(port=0, registry=reg)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/slo", timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["tenants"] == {} and doc["checks"] == 0
+    finally:
+        obs_health.stop_exporter()
+
+
+def _mk_report():
+    """A real tracker report with one violating and one healthy
+    tenant (what slo_soak writes / the exporter serves)."""
+    reg = obs_metrics.MetricsRegistry()
+    tr = _tracker([_spec(p50_us=256.0),
+                   _spec(tenant="prefill", collective="*",
+                         p99_us=16384.0)], reg)
+    for _ in range(4):
+        _sweep(reg, 100)
+        _sweep(reg, 100, tenant="prefill", coll="allgather")
+        tr.check()
+    for _ in range(2):
+        _sweep(reg, 1000)
+        tr.check()
+    return tr.doc()
+
+
+def test_perf_doctor_slo_ci_round_trip(tmp_path):
+    report_path = tmp_path / "slo_report.json"
+    report_path.write_text(json.dumps(_mk_report()))
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/perf_doctor.py"),
+         "--slo", str(report_path), "--ci", "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["schema_errors"] == []
+    assert report["slo"]["tenants"]["decode"]["verdict"] == "fast_burn"
+    assert "tenant decode" in proc.stdout
+    assert "tenant prefill" in proc.stdout
+    assert "burn fast" in proc.stdout
+
+
+def test_perf_doctor_slo_ci_rejects_schema_drift(tmp_path):
+    doc = _mk_report()
+    doc["tenants"]["decode"]["verdict"] = "bogus"
+    doc["tenants"]["prefill"]["budget_remaining"] = 7.5
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/perf_doctor.py"),
+         "--slo", str(bad), "--ci"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "verdict" in proc.stdout + proc.stderr
+    # and a non-report file is a schema error, not a traceback
+    notreport = tmp_path / "x.json"
+    notreport.write_text("{}")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/perf_doctor.py"),
+         "--slo", str(notreport), "--ci"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "Traceback" not in proc.stderr
