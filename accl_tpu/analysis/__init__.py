@@ -35,6 +35,8 @@ _EXPORTS = {
     "check_fence_staleness": "checks",
     "check_teardown_completions": "checks",
     "check_lock_order": "checks",
+    "check_stuck_progress": "checks",
+    "check_subcomm_interleave": "checks",
     "CaptureSession": "sanitizer",
 }
 

@@ -13,6 +13,11 @@ dispatch:
   with :func:`~accl_tpu.observability.flight.merge_flight_dumps`.
 - ``desync-missing-call`` — a member issues fewer gang calls than its
   peers: the trailing collectives can never complete.
+- ``subcomm-interleave-hazard`` — ranks shared by two overlapping
+  sub-communicators issue their collectives in divergent comm order:
+  blocking calls deadlock outright, and even async/chunked ones pin
+  the shared rx pool against each other (the 8-rank sub-comm
+  allgather wedge class — see docs/static_analysis.md).
 - ``deadlock-cycle`` / ``p2p-unmatched`` / ``gang-missing-member`` —
   a send/recv matching simulation with a wait-for graph: blocking
   rendezvous sends, blocking recvs and gang barriers advance only when
@@ -33,6 +38,7 @@ from ..observability.flight import (
     FENCE_EVENTS,
     PLAN_CAPTURE_EVENT,
     TEARDOWN_EVENT,
+    TERMINAL_STATE_NAMES,
     first_divergence,
 )
 from .findings import ERROR, WARNING, Finding, sort_findings
@@ -130,6 +136,105 @@ def check_order_and_params(programs: dict) -> list:
                      f"{sorted(behind)} return early (conditional "
                      "collective?); every member must issue the call",
                 comm=comm, ranks=sorted(behind), index=min(depths.values())))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# cross-communicator issue order on overlapping sub-communicators
+# ---------------------------------------------------------------------------
+def check_subcomm_interleave(programs: dict) -> list:
+    """Ranks on overlapping communicators must enter them in one
+    agreed global order.
+
+    A gang collective in flight holds engine resources (rx-pool
+    buffers, lane credits) until every member arrives, so "rank r
+    enters comm x before comm y" is an acquisition edge x -> y.  A
+    cycle in the cross-rank comm-order graph is the multi-communicator
+    ABBA: with blocking calls it deadlocks outright, and with
+    async/chunked calls each side's first collective pins the shared
+    rx pool against the peer's — the hazard class behind the 8-rank
+    concurrent sub-comm allgather wedge (RECEIVE_TIMEOUT with the
+    expected segment parked in staging).  Two ranks entering a shared
+    comm pair in opposite orders is the 2-cycle; a 2D grid whose rows
+    and columns alternate which axis goes first closes longer cycles
+    through comms that share only one rank each.  Per-comm order
+    agreement is ``desync-order``'s job; this checker compares order
+    ACROSS communicators.
+    """
+    findings: list = []
+    # rank -> comm -> index of the rank's first gang call on that comm
+    # (position within the rank's gang-call stream: first-issue order,
+    # so a trailing world barrier does not fabricate a back edge)
+    first_pos: dict = {}
+    for r, prog in sorted(programs.items()):
+        pos: dict = {}
+        k = 0
+        for call in prog.calls:
+            if not call.is_gang:
+                continue
+            pos.setdefault(call.comm, k)
+            k += 1
+        first_pos[r] = pos
+    # acquisition edges with one witness rank per edge; a rank only
+    # contributes edges between comms it is a member of (it issued on
+    # both), so every edge crosses an overlap by construction
+    edge_why: dict = {}  # (x, y) -> (rank, gang-pos of x, gang-pos of y)
+    for r in sorted(first_pos):
+        pos = first_pos[r]
+        cs = sorted(pos, key=lambda c: pos[c])
+        for i, x in enumerate(cs):
+            for y in cs[i + 1:]:
+                edge_why.setdefault((x, y), (r, pos[x], pos[y]))
+
+    # 2-cycles first: pairwise divergence on a shared comm pair is the
+    # common bug and deserves one precise finding per pair
+    flagged: set = set()
+    for (x, y), (ra, ax, ay) in sorted(edge_why.items()):
+        if x >= y or (y, x) not in edge_why:
+            continue
+        rb, by, bx = edge_why[(y, x)]
+        flagged.update(((x, y), (y, x)))
+        findings.append(Finding(
+            ERROR, "subcomm-interleave-hazard",
+            f"overlapping comms {x} and {y} are entered in divergent "
+            f"order: rank {ra} issues comm {x} first (gang call #{ax}, "
+            f"then comm {y} at #{ay}), rank {rb} issues comm {y} first "
+            f"(gang call #{by}, then comm {x} at #{bx}) — blocking "
+            f"calls deadlock, async ones pin the shared rx pool "
+            f"against each other (the sub-comm allgather wedge class)",
+            hint="issue collectives on overlapping sub-communicators "
+                 "in one global order on every rank (e.g. sort by comm "
+                 "id, or row-comms before col-comms everywhere)",
+            comm=x, ranks=sorted({ra, rb})))
+    if findings:
+        return findings  # longer cycles through a flagged pair cascade
+
+    # no pairwise divergence: look for a longer cycle (grid shapes
+    # whose comm pairs share only one rank each)
+    edges: dict = {}
+    for (x, y) in edge_why:
+        edges.setdefault(x, []).append(y)
+        edges.setdefault(y, [])
+    cycle = _find_cycle(edges)
+    if cycle:
+        chain = "; ".join(
+            "comm {} before comm {} (rank {}, gang calls #{} -> #{})"
+            .format(x, cycle[(k + 1) % len(cycle)],
+                    *edge_why[(x, cycle[(k + 1) % len(cycle)])])
+            for k, x in enumerate(cycle))
+        findings.append(Finding(
+            ERROR, "subcomm-interleave-hazard",
+            f"communicators {sorted(cycle)} form an acquisition cycle "
+            f"across ranks: {chain} — no global comm order exists, so "
+            f"the gang windows can interlock (deadlock when blocking, "
+            f"rx-pool pinning when chunked/async)",
+            hint="pick one global order for overlapping "
+                 "sub-communicators (e.g. all row comms before all col "
+                 "comms on every rank) so the acquisition graph is "
+                 "acyclic",
+            comm=min(cycle),
+            ranks=sorted({edge_why[(x, cycle[(k + 1) % len(cycle)])][0]
+                          for k, x in enumerate(cycle)})))
     return findings
 
 
@@ -564,6 +669,7 @@ def check_programs(programs: dict,
         return []
     findings: list = []
     findings += check_order_and_params(programs)
+    findings += check_subcomm_interleave(programs)
     findings += check_membership(programs)
     findings += check_buffer_hazards(programs)
     findings += check_leaked_requests(programs)
@@ -595,6 +701,9 @@ def check_programs(programs: dict,
 #   communicators (gang collectives held concurrently = locks) in
 #   opposite orders: the cross-rank ABBA pattern that deadlocks
 #   hierarchical/multi-comm schedules.
+# - ``stuck-progress`` — a record parked in a non-terminal state:
+#   a submitted call that never finalized (liveness; ERROR when the
+#   rank's dump shows engine teardown happened around it).
 # ---------------------------------------------------------------------------
 def _flight_per_rank(merged) -> dict:
     """rank -> seq-ordered record dicts.  Accepts a merged dump doc
@@ -729,14 +838,60 @@ def check_lock_order(merged) -> list:
     return findings
 
 
+def check_stuck_progress(merged) -> list:
+    """Liveness over dumps: every submitted call must finalize.
+
+    A record parked in a non-terminal state (submitted/queued/
+    gang_ready/dispatched/recovering — anything outside
+    ``TERMINAL_STATE_NAMES``) never published a completion.  In a
+    post-mortem dump whose rank carries an ``engine_teardown`` anchor
+    that is an ERROR: the world tore down around a call that never
+    finalized (the detsched liveness invariant, as a dump check —
+    teardown-finalized calls carry COMM_ABORTED and retire
+    ``aborted``, so they do NOT trip this).  Without a teardown anchor
+    the dump may be a mid-run snapshot, so the finding downgrades to a
+    WARNING carrying the in-flight age.
+    """
+    findings: list = []
+    for rank, recs in _flight_per_rank(merged).items():
+        has_teardown = any(
+            rec.get("collective") == TEARDOWN_EVENT for rec in recs)
+        for rec in recs:
+            if rec.get("collective") == TEARDOWN_EVENT:
+                continue
+            state = rec.get("state")
+            if state in TERMINAL_STATE_NAMES:
+                continue
+            age = rec.get("age_us", 0)
+            findings.append(Finding(
+                ERROR if has_teardown else WARNING, "stuck-progress",
+                f"rank {rank}: {rec.get('collective')} (seq "
+                f"{rec['seq']}, comm {rec.get('comm', -1)}) never "
+                f"finalized — parked in state {state!r} "
+                + (f"through engine teardown"
+                   if has_teardown else f"for {age} us at dump time")
+                + " — a submitted call must retire complete, failed "
+                  "or aborted",
+                hint="a dispatched-but-never-completed recv whose "
+                     "peer made progress is the cross-comm rx-pool "
+                     "pinning signature (staged segment, expired "
+                     "budget); replay the schedule under "
+                     "scripts/model_check.py and check "
+                     "engine_wedged_timeouts in the link forensics",
+                comm=rec.get("comm", -1), ranks=[rank],
+                index=rec["seq"]))
+    return findings
+
+
 def check_flight_lifecycle(merged) -> list:
     """The post-mortem lifecycle suite over merged flight dumps:
-    fence-stale replays, completions after teardown, and cross-rank
-    lock-order inversions.  Accepts what :func:`~accl_tpu.
-    observability.flight.merge_flight_dumps` produces (dict or path)
-    or a single-rank dump."""
+    fence-stale replays, completions after teardown, cross-rank
+    lock-order inversions, and stuck-progress liveness.  Accepts what
+    :func:`~accl_tpu.observability.flight.merge_flight_dumps` produces
+    (dict or path) or a single-rank dump."""
     findings: list = []
     findings += check_fence_staleness(merged)
     findings += check_teardown_completions(merged)
     findings += check_lock_order(merged)
+    findings += check_stuck_progress(merged)
     return sort_findings(findings)

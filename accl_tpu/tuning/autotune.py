@@ -552,7 +552,7 @@ def measure(world, config: TuneConfig = TuneConfig(),
                             f"{r['busbw_GBps']:>8.3f} GB/s")
     finally:
         apply_algorithm(world, "static")
-        if own_hier:
+        if own_hier and hier is not None:
             for h in hier:
                 h.close()  # drop cached scratch; sub-comms stay (ids
                 # are burned either way — the create-order discipline)
@@ -764,7 +764,7 @@ def compare(world, table: SelectionTable,
                 f"{static_bw:8.3f} tuned {tuned_bw:8.3f} GB/s "
                 f"({ratio}x)")
     apply_algorithm(world, "static")
-    if own_hier:
+    if own_hier and hier is not None:
         for h in hier:
             h.close()
     return out

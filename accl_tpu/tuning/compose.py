@@ -82,8 +82,9 @@ class HierarchicalComm:
         #: the inner axis (allreduce/bcast swap stage comms)
         self.swapped = self.fabric.within_axis() != self._inner_axis
         rank = accl.rank
-        self._inner_group, self._inner_comm = None, -1
-        self._outer_group, self._outer_comm = None, -1
+        inner_group: Optional[list] = None
+        outer_group: Optional[list] = None
+        inner_comm = outer_comm = -1
         # deterministic global order: inner groups first, then outer —
         # every rank iterates the same list and burns the ids of the
         # groups it is not in, so group G gets ONE world-wide comm id
@@ -91,20 +92,24 @@ class HierarchicalComm:
         outer_groups = self.fabric.groups_complement(self._inner_axis)
         for group in inner_groups:
             if rank in group:
-                self._inner_group = group
-                self._inner_comm = accl.create_communicator(group)
+                inner_group = group
+                inner_comm = accl.create_communicator(group)
             else:
                 accl.reserve_communicator()
         for group in outer_groups:
             if rank in group:
-                self._outer_group = group
-                self._outer_comm = accl.create_communicator(group)
+                outer_group = group
+                outer_comm = accl.create_communicator(group)
             else:
                 accl.reserve_communicator()
-        if self._inner_group is None or self._outer_group is None:
+        if inner_group is None or outer_group is None:
             raise ACCLError(
                 f"HierarchicalComm: rank {rank} is in no fabric group "
                 f"(fabric {self.fabric.spec()})")
+        self._inner_group: list = inner_group
+        self._outer_group: list = outer_group
+        self._inner_comm: int = inner_comm
+        self._outer_comm: int = outer_comm
 
     # ------------------------------------------------------------------
     # helpers

@@ -61,7 +61,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Any, Optional
 
 from ..constants import ACCLError, env_float, env_int
 from ..observability import flight as _flight
@@ -169,7 +169,7 @@ class OnlineTuner:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._measure_lock = threading.Lock()
-        self._sentinel = None
+        self._sentinel: Any = None
         # one policy per driver, all serving ONE shared table — the
         # armed ACCL_TUNE_TABLE policy when present (adopting its
         # entries as the incumbents), a fresh empty table otherwise
@@ -192,18 +192,19 @@ class OnlineTuner:
         # same env/probe resolution offline tune() uses (ACCL_FABRIC
         # included — Fabric() alone would silently factorize)
         meta = self.table.world or {}
-        self.fabric = None
+        fabric: Optional[Fabric] = None
         if meta.get("shape"):
             try:
-                self.fabric = Fabric(
+                fabric = Fabric(
                     world.nranks, shape=meta.get("shape"),
                     axis_order=tuple(meta["axis_order"])
                     if meta.get("axis_order") else None)
             except (ACCLError, KeyError):
-                self.fabric = None
-        if self.fabric is None:
-            self.fabric = Fabric.for_world(
+                fabric = None
+        if fabric is None:
+            fabric = Fabric.for_world(
                 world.nranks, probe=backend_of(world) == "tpu")
+        self.fabric: Fabric = fabric
 
     # ------------------------------------------------------------------
     # intake

@@ -88,6 +88,9 @@ class Fabric:
             raise ACCLError(
                 f"Fabric: axis_order {self.axis_order} is not a "
                 f"permutation of the {len(shape)} axes")
+        #: axis name -> measured blocked-time score; populated only by
+        #: :meth:`from_link_matrix` (empty on unmeasured fabrics)
+        self.axis_scores: dict = {}
 
     # ------------------------------------------------------------------
     # constructors

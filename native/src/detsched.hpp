@@ -67,9 +67,11 @@ enum class OpKind : uint8_t {
 };
 
 struct Decision {
-  uint8_t nen = 0;        // enabled-thread count at this point
+  uint8_t nen = 0;        // total option count: enabled threads + injections
   uint8_t chosen = 0;     // index chosen into the sorted enabled list
-  bool branchable = false;  // alternatives worth exploring (conflict + bounds)
+  uint8_t inj_from = 0;   // options >= inj_from are timeout injections
+  bool branchable = false;  // thread alternatives worth exploring
+  bool inj_branch = false;  // injection alternatives worth exploring
 };
 
 struct RunResult {
@@ -80,6 +82,9 @@ struct RunResult {
   std::vector<Decision> decisions;  // full decision metadata
   uint64_t steps = 0;
   bool free_ran = false;  // budget/deadlock escape hatch fired (see below)
+  uint64_t injections = 0;       // timeout injections taken this run
+  uint64_t pressure_events = 0;  // resource-pressure arming events observed
+  uint64_t live_leak = 0;        // submitted calls never finalized (liveness)
 };
 
 class Sched {
@@ -92,6 +97,13 @@ class Sched {
   // ---- hook-side queries (hot; called from every wrapper) ----
   bool on() const { return active_.load(std::memory_order_relaxed) && slot() >= 0; }
   bool run_active() const { return active_.load(std::memory_order_relaxed); }
+  // True once the escape hatch fired: the run is tearing down on real
+  // primitives, so time-based code (receive budgets) must read the REAL
+  // clock again — the virtual clock is frozen and would never expire
+  // them, wedging the very teardown the hatch exists to guarantee.
+  bool free_running() const {
+    return free_run_flag_.load(std::memory_order_relaxed);
+  }
 
   // ---- virtual clock ----
   uint64_t now_ns() {
@@ -150,6 +162,20 @@ class Sched {
       g.unlock();
       return free_run_cv_wait(lk, timeout_ns);
     }
+    // Hurried thread (a timeout injection granted it charges): its timed
+    // waits expire IMMEDIATELY, advancing the virtual clock by the full
+    // slice while every peer stays parked — one injection decision burns
+    // a whole sliced receive budget "atomically", which is exactly the
+    // "budget expires while conflicting ops are still pending" ordering
+    // quiescence can never produce (it only advances time when NOTHING
+    // is runnable).  No scheduling point: the burn must not let peers
+    // interleave, or the injected expiry degenerates into quiescence.
+    if (me >= 0 && th_[me].hurry > 0 && timeout_ns != kInf) {
+      --th_[me].hurry;
+      vnow_ += timeout_ns;
+      wake_expired_locked();
+      return false;  // timeout; the user mutex stays held
+    }
     yield_locked(g, me, OpKind::CvWait, cv);
     if (free_run_) {
       g.unlock();
@@ -164,6 +190,9 @@ class Sched {
     th_[me].deadline = timeout_ns == kInf ? kInf : vnow_ + timeout_ns;
     th_[me].notified = false;
     th_[me].cv_seq = cv_seq_++;
+    // a timed park arms the injection window: the very next decision may
+    // offer "this waiter's budget slice expires now" as an alternative
+    if (timeout_ns != kInf) inj_window_ = true;
     schedule_locked(g, me);
     bool notified = th_[me].notified;
     th_[me].deadline = kInf;
@@ -321,6 +350,42 @@ class Sched {
     }
   }
 
+  // ---- resource-pressure modeling ----
+  // Called (via det_note_pressure) when a modeled resource saturates —
+  // e.g. the rx pool staging an ingress because no buffer is IDLE.
+  // Arms the timeout-injection window: exhaustion is the precondition
+  // for the interesting timeout class (a budget expiring because pinned
+  // resources, not a slow peer, starve the match), so the explorer gets
+  // an injection alternative at exactly the decision where it matters.
+  void note_pressure() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (free_run_) return;
+    ++pressure_events_;
+    inj_window_ = true;
+  }
+
+  // Injections taken so far this run — drills consult this to decide
+  // which invariants still hold (an injected timeout legalizes
+  // RECEIVE_TIMEOUT retcodes that would be findings on a clean run).
+  uint64_t timeout_injections() {
+    std::lock_guard<std::mutex> g(mu_);
+    return injections_;
+  }
+
+  // ---- liveness tokens ----
+  // One token per submitted engine call; the finalize paths return it.
+  // Tokens still outstanding when the drill returns (without the free-
+  // run escape hatch muddying the schedule) are calls that never
+  // finalized under this fair schedule — the stuck-progress finding.
+  void live_begin() {
+    std::lock_guard<std::mutex> g(mu_);
+    ++live_tokens_;
+  }
+  void live_end() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (live_tokens_ > 0) --live_tokens_;
+  }
+
   // ---- run control (explorer side; call from ONE driver thread) ----
   RunResult run(const std::vector<uint8_t>& prefix, uint64_t seed,
                 uint64_t max_steps, const std::function<void()>& drill) {
@@ -343,6 +408,11 @@ class Sched {
       seed_ = seed ? seed : 1;
       max_steps_ = max_steps;
       free_run_ = false;
+      free_run_flag_.store(false, std::memory_order_relaxed);
+      injections_ = 0;
+      pressure_events_ = 0;
+      inj_window_ = false;
+      live_tokens_ = 0;
       result_ = RunResult{};
       active_.store(true);
     }
@@ -351,9 +421,21 @@ class Sched {
     {
       std::lock_guard<std::mutex> g(mu_);
       active_.store(false);
+      // liveness: every submitted call must have finalized by drill
+      // return.  Suppressed when the escape hatch fired (the schedule
+      // already failed) or an earlier finding owns the run.
+      if (live_tokens_ != 0 && !free_run_ && !result_.failed) {
+        result_.failed = true;
+        result_.what =
+            "liveness: submitted call(s) never finalized (stuck-progress)";
+        result_.fail_step = step_;
+      }
       out = result_;
       out.free_ran = free_run_;
       out.steps = step_;
+      out.injections = injections_;
+      out.pressure_events = pressure_events_;
+      out.live_leak = live_tokens_;
       slot_ref() = -1;
     }
     cv_.notify_all();  // release anything the escape hatch left parked
@@ -363,6 +445,15 @@ class Sched {
   // exploration knobs (see Explorer)
   int preempt_bound = 3;
   uint64_t branch_depth = 4096;  // decisions beyond this: default policy only
+  // Timeout injections allowed per run.  0 (the default) disables the
+  // mechanism entirely: decision spaces, prefix consumption, and traces
+  // are bit-identical to the pre-injection checker, so artifacts
+  // recorded without --ibound replay unchanged.
+  int inject_bound = 0;
+  // Charges an injection grants its victim: enough immediate-expiry
+  // slices to burn a whole engine receive budget (1 s default budget /
+  // 50 ms steady slices = 20) with headroom for the fast-phase slices.
+  int hurry_charges = 64;
 
  private:
   enum class St : uint8_t {
@@ -384,6 +475,7 @@ class Sched {
     uint64_t deadline = kInf;
     uint64_t cv_seq = 0;
     int join_slot = -1;
+    int hurry = 0;  // immediate-expiry charges from a timeout injection
   };
 
   static int& slot_ref() {
@@ -391,6 +483,21 @@ class Sched {
     return s;
   }
   static int slot() { return slot_ref(); }
+
+  // Wake every parked thread whose deadline the virtual clock has
+  // passed (quiescence and injected burns share these semantics: a cv
+  // deadline passing is a timeout, never a notify).
+  void wake_expired_locked() {
+    for (int i = 0; i < nth_; ++i)
+      if (th_[i].used && th_[i].deadline <= vnow_ &&
+          (th_[i].st == St::BlockedSleep || th_[i].st == St::BlockedCv)) {
+        bool was_cv = th_[i].st == St::BlockedCv;
+        th_[i].notified = false;
+        th_[i].st = St::Ready;
+        th_[i].pending = was_cv ? OpKind::Lock : OpKind::None;
+        th_[i].obj = nullptr;
+      }
+  }
 
   void wake_mutex_waiters_locked(std::mutex* m) {
     for (int i = 0; i < nth_; ++i)
@@ -452,6 +559,25 @@ class Sched {
       int nen = 0;
       for (int i = 0; i < nth_; ++i)
         if (th_[i].used && th_[i].st == St::Ready) en[nen++] = i;
+      // Timeout-injection candidates: parked TIMED waiters, offered as
+      // extra decision alternatives [nen, nen+ninj) while the window is
+      // armed (a timed park or a resource-pressure event just happened)
+      // and the per-run injection budget has room.  Choosing one means
+      // "that waiter's budget slice expires NOW, with these enabled
+      // threads' conflicting ops still pending".  The window is one-shot
+      // per arming event so the branching factor stays tied to the
+      // interesting program points instead of every decision.
+      int inj[kMaxThreads];
+      int ninj = 0;
+      bool window = inj_window_;
+      inj_window_ = false;
+      if (nen > 0 && window && inject_bound > 0 &&
+          injections_ < uint64_t(inject_bound)) {
+        for (int i = 0; i < nth_; ++i)
+          if (th_[i].used && th_[i].st == St::BlockedCv &&
+              th_[i].deadline != kInf)
+            inj[ninj++] = i;
+      }
       if (nen > 0) {
         if (++step_ > max_steps_) {
           if (!result_.failed) {
@@ -462,18 +588,21 @@ class Sched {
           enter_free_run_locked();
           return;
         }
+        int ntot = nen + ninj;
         int choice = 0;
         bool from_prefix = prefix_pos_ < prefix_.size();
         if (from_prefix) {
           // consumed at EVERY decision (also forced nen==1 ones) so a
           // prefix copied from a recorded trace stays index-aligned
-          choice = prefix_[prefix_pos_++] % nen;
-        } else if (nen == 1) {
+          choice = prefix_[prefix_pos_++] % ntot;
+        } else if (nen == 1 && ninj == 0) {
           choice = 0;
         } else {
           // default policy: keep the current thread running when it is
           // still enabled (short traces), else a seeded pick — varied
-          // but fully reproducible from (prefix, seed)
+          // but fully reproducible from (prefix, seed).  Injections are
+          // never taken by default: only an explorer-expanded (or
+          // replayed) prefix byte reaches the [nen, ntot) range.
           choice = -1;
           for (int k = 0; k < nen; ++k)
             if (en[k] == cur_) choice = k;
@@ -481,11 +610,15 @@ class Sched {
             choice = int(mix(seed_ ^ (step_ * 0x9E3779B97F4A7C15ull)) % nen);
         }
         // preemption accounting: picking another thread while the
-        // current one is still runnable is a preemption
-        bool cur_enabled = false;
-        for (int k = 0; k < nen; ++k)
-          if (en[k] == cur_) cur_enabled = true;
-        if (cur_enabled && en[choice] != cur_) ++preempts_;
+        // current one is still runnable is a preemption.  An injection
+        // is NOT one: no runner is displaced, the enabled set simply
+        // grows before the re-pick.
+        if (choice < nen) {
+          bool cur_enabled = false;
+          for (int k = 0; k < nen; ++k)
+            if (en[k] == cur_) cur_enabled = true;
+          if (cur_enabled && en[choice] != cur_) ++preempts_;
+        }
         // branchable: >= 2 enabled, a real conflict among pending ops,
         // inside the branch window, preemption budget left
         bool conf = false;
@@ -493,13 +626,33 @@ class Sched {
           for (int b = a + 1; b < nen && !conf; ++b)
             if (conflict(th_[en[a]], th_[en[b]])) conf = true;
         Decision d;
-        d.nen = uint8_t(nen);
+        d.nen = uint8_t(ntot);
         d.chosen = uint8_t(choice);
+        d.inj_from = uint8_t(nen);
         d.branchable = nen > 1 && conf &&
                        result_.decisions.size() < branch_depth &&
                        preempts_ < uint64_t(preempt_bound);
+        d.inj_branch = ninj > 0 && result_.decisions.size() < branch_depth;
         result_.decisions.push_back(d);
         result_.choices.push_back(uint8_t(choice));
+        if (choice >= nen) {
+          // timeout injection: jump the virtual clock to the victim's
+          // deadline even though threads are runnable — the wall-clock
+          // ordering quiescence hides — wake it as timed out, and grant
+          // hurry charges so its subsequent budget slices burn through
+          // without peers interleaving.
+          int vi = inj[choice - nen];
+          ++injections_;
+          if (th_[vi].deadline > vnow_) vnow_ = th_[vi].deadline;
+          th_[vi].hurry = hurry_charges;
+          wake_expired_locked();  // wakes vi + anything the jump passed
+          if (debug_)
+            std::fprintf(stderr,
+                         "[ds] step=%llu INJECT slot %d (vnow -> %llu)\n",
+                         (unsigned long long)step_, vi,
+                         (unsigned long long)vnow_);
+          continue;  // re-pick with the woken waiter(s) enabled
+        }
         cur_ = en[choice];
         th_[cur_].st = St::Running;
         if (debug_) {
@@ -539,15 +692,7 @@ class Sched {
         return;
       }
       vnow_ = dl;
-      for (int i = 0; i < nth_; ++i)
-        if (th_[i].used && th_[i].deadline <= vnow_ &&
-            (th_[i].st == St::BlockedSleep || th_[i].st == St::BlockedCv)) {
-          bool was_cv = th_[i].st == St::BlockedCv;
-          th_[i].notified = false;  // cv deadline: a timeout, not a wake
-          th_[i].st = St::Ready;
-          th_[i].pending = was_cv ? OpKind::Lock : OpKind::None;
-          th_[i].obj = nullptr;
-        }
+      wake_expired_locked();  // cv deadline passing: a timeout, not a wake
     }
   }
 
@@ -556,7 +701,12 @@ class Sched {
   // (engine receive budgets unstick anything genuinely wedged) so the
   // harness can tear down and report instead of hanging.
   void enter_free_run_locked() {
+    if (debug_)
+      std::fprintf(stderr, "[ds] FREE-RUN step=%llu what=%s\n",
+                   (unsigned long long)step_,
+                   result_.failed ? result_.what.c_str() : "(none)");
     free_run_ = true;
+    free_run_flag_.store(true, std::memory_order_relaxed);
     for (int i = 0; i < nth_; ++i)
       if (th_[i].used && th_[i].st != St::Done) th_[i].st = St::Running;
     cv_.notify_all();
@@ -568,6 +718,12 @@ class Sched {
     // lock — holding it here would wedge the very thread that has to
     // flip it, hanging the harness instead of reporting the finding)
     (void)ns;
+    if (debug_) {
+      static thread_local uint64_t spins = 0;
+      if (++spins % 5000 == 0)
+        std::fprintf(stderr, "[ds] free-run spin slot=%d spins=%llu\n",
+                     slot(), (unsigned long long)spins);
+    }
     lk.unlock();
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
     lk.lock();
@@ -586,7 +742,8 @@ class Sched {
   std::mutex mu_;
   std::condition_variable cv_;
   std::atomic<bool> active_{false};
-  bool free_run_ = false;
+  bool free_run_ = false;               // guarded by mu_
+  std::atomic<bool> free_run_flag_{false};  // lock-free mirror for hooks
   Th th_[kMaxThreads];
   int nth_ = 0;
   int cur_ = -1;
@@ -595,6 +752,9 @@ class Sched {
   std::vector<uint8_t> prefix_;
   size_t prefix_pos_ = 0;
   uint64_t seed_ = 1, max_steps_ = 200000;
+  uint64_t injections_ = 0, pressure_events_ = 0;
+  bool inj_window_ = false;
+  int64_t live_tokens_ = 0;
   RunResult result_;
   bool debug_ = std::getenv("ACCL_DS_DEBUG") != nullptr;
 };
@@ -603,6 +763,7 @@ class Sched {
 inline bool on() { return Sched::inst().on(); }
 inline bool run_active() { return Sched::inst().run_active(); }
 inline uint64_t now_ns() { return Sched::inst().now_ns(); }
+inline bool free_running() { return Sched::inst().free_running(); }
 inline void lock_hooked(std::mutex* m) { Sched::inst().lock_hooked(m); }
 inline void unlock_hooked(std::mutex* m) { Sched::inst().unlock_hooked(m); }
 inline bool cv_block(const void* cv, std::unique_lock<std::mutex>& lk,
@@ -617,6 +778,12 @@ inline void yield_hooked() { Sched::inst().yield_hooked(); }
 inline void expect(bool cond, const char* what) {
   Sched::inst().expect(cond, what);
 }
+inline void note_pressure() { Sched::inst().note_pressure(); }
+inline uint64_t timeout_injections() {
+  return Sched::inst().timeout_injections();
+}
+inline void live_begin() { Sched::inst().live_begin(); }
+inline void live_end() { Sched::inst().live_end(); }
 
 // ---------------------------------------------------------------------------
 // Explorer: stateless bounded exploration over choice prefixes.
@@ -629,6 +796,12 @@ struct ExploreOpts {
   uint64_t branch_depth = 4096;
   bool stop_on_first = true;
   double budget_s = 0;  // 0 = unbounded
+  int inject_bound = 0;  // timeout injections per run (0 = disabled)
+  // Trace-guided exploration: replay this observed choice prefix
+  // bit-for-bit, then explore the SUFFIX only — the r13 --replay hex
+  // idiom turned into a DFS seed, so a captured artifact from a live
+  // wedge repro focuses the budget on the neighborhood that matters.
+  std::vector<uint8_t> seed_prefix;
 };
 
 struct ExploreStats {
@@ -638,6 +811,8 @@ struct ExploreStats {
   RunResult first_failure;      // valid when findings > 0
   std::vector<uint8_t> first_failure_prefix;  // minimal failing prefix
   uint64_t seed = 1;
+  uint64_t injected_runs = 0;    // runs where >= 1 timeout was injected
+  uint64_t pressure_events = 0;  // resource-pressure arming events, summed
 };
 
 inline uint64_t trace_hash(const std::vector<uint8_t>& v) {
@@ -677,6 +852,7 @@ inline ExploreStats explore(const std::function<void()>& drill,
   Sched& S = Sched::inst();
   S.preempt_bound = opts.preempt_bound;
   S.branch_depth = opts.branch_depth;
+  S.inject_bound = opts.inject_bound;
   ExploreStats st;
   st.seed = opts.seed;
   std::set<uint64_t> seen;
@@ -688,7 +864,10 @@ inline ExploreStats explore(const std::function<void()>& drill,
     size_t expand_from;
   };
   std::vector<Item> stack;
-  stack.push_back({{}, 0});
+  // trace-guided: the seed prefix is replayed verbatim; only decisions
+  // past it are expanded (expand_from counts DECISIONS, and prefix
+  // bytes map 1:1 onto decisions, so its length is the right floor)
+  stack.push_back({opts.seed_prefix, opts.seed_prefix.size()});
   auto t0 = std::chrono::steady_clock::now();
   while (!stack.empty() && st.runs < opts.max_runs) {
     if (opts.budget_s > 0) {
@@ -701,6 +880,8 @@ inline ExploreStats explore(const std::function<void()>& drill,
     stack.pop_back();
     RunResult r = S.run(it.prefix, opts.seed, opts.max_steps, drill);
     ++st.runs;
+    if (r.injections > 0) ++st.injected_runs;
+    st.pressure_events += r.pressure_events;
     if (seen.insert(trace_hash(r.choices)).second) ++st.unique_traces;
     if (r.failed) {
       ++st.findings;
@@ -712,12 +893,15 @@ inline ExploreStats explore(const std::function<void()>& drill,
       if (opts.stop_on_first) break;
       continue;  // do not expand a failing schedule further
     }
-    // expand alternatives at branchable decision points
+    // expand alternatives at branchable decision points: thread choices
+    // below inj_from under the conflict rule, timeout injections at or
+    // above it under the injection rule
     for (size_t i = it.expand_from; i < r.decisions.size(); ++i) {
       const Decision& d = r.decisions[i];
-      if (!d.branchable) continue;
+      if (!d.branchable && !d.inj_branch) continue;
       for (uint8_t alt = 0; alt < d.nen; ++alt) {
         if (alt == d.chosen) continue;
+        if (alt < d.inj_from ? !d.branchable : !d.inj_branch) continue;
         std::vector<uint8_t> p(r.choices.begin(),
                                r.choices.begin() + long(i));
         p.push_back(alt);

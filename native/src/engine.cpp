@@ -85,6 +85,7 @@ void Engine::shutdown() {
       if (!r.done) {
         r.retcode = COMM_ABORTED | RANK_FAILED;
         r.done = true;
+        det_live_end();
       }
     }
   }
@@ -265,6 +266,7 @@ uint64_t Engine::start_call(const uint32_t* w15) {
     MutexLock g(results_mu_);
     results_[c.id] = CallResult{};
   }
+  det_live_begin();  // liveness token, returned when the call finalizes
   cmd_q_.push(c);
   // a submission racing shutdown(): the finalize sweep may already
   // have run, leaving this call pending forever (its waiter would burn
@@ -275,6 +277,7 @@ uint64_t Engine::start_call(const uint32_t* w15) {
     if (!r.done) {
       r.retcode = COMM_ABORTED | RANK_FAILED;
       r.done = true;
+      det_live_end();
     }
   }
   return c.id;
@@ -741,10 +744,23 @@ void Engine::stage_egress(uint32_t session, Message&& msg) {
   }
   {
     UniqueLock g(egress_mu_);
-    egress_cv_.wait(g, [&]() ACCL_REQUIRES(egress_mu_) {
-      return egress_q_.size() < pipeline_depth_.load() || !egress_running_;
-    });
+    // BOUNDED backpressure: ingress handlers send too (NACK, pong,
+    // retransmit, rendezvous control) and ingress runs in the SENDER's
+    // egress thread, so with every queue at depth the engines form a
+    // backpressure cycle through each other — egress thread A parked in
+    // B's window, B's in C's, nobody draining.  Waiting forever turns
+    // that transient into a distributed deadlock (and wedges shutdown,
+    // which joins the loop thread before it stops this writer).  After
+    // a receive budget with no slot, overflow the window instead: the
+    // deque is unbounded storage, depth is a pacing knob, and a counted
+    // overflow beats a silent standstill.
+    bool slot = cv_wait_for_pred(
+        egress_cv_, g, timeout_budget(), [&]() ACCL_REQUIRES(egress_mu_) {
+          return egress_q_.size() < pipeline_depth_.load() ||
+                 !egress_running_ || !running_.load();
+        });
     if (!egress_running_) return;
+    if (!slot) egress_overflows_.fetch_add(1);
     egress_q_.emplace_back(session, std::move(msg));
     uint64_t d = egress_q_.size(), h = egress_hwm_.load();
     while (d > h && !egress_hwm_.compare_exchange_weak(h, d)) {
@@ -1591,14 +1607,19 @@ void Engine::loop() {
         r.retcode = ab;
         r.duration_ns = 0.0;
         r.done = true;
+        det_live_end();
         continue;
       }
     }
 
     auto t0 = steady_clock::now();
+    // the retry budget ticks on the det-aware clock (virtual under the
+    // model checker) while duration telemetry stays on the real one
     if (c.first_try_ns == 0)
-      c.first_try_ns =
-          uint64_t(duration_cast<nanoseconds>(t0.time_since_epoch()).count());
+      c.first_try_ns = uint64_t(
+          duration_cast<nanoseconds>(det_clock_now().time_since_epoch())
+              .count() +
+          1);
     uint32_t step_before = c.current_step;
     sticky_err_ = 0;
     bool retry = false;
@@ -1611,21 +1632,25 @@ void Engine::loop() {
       r.retcode = ret;
       r.duration_ns = double(dt);
       r.done = true;
+      det_live_end();
     } catch (NotReadyEx&) {
       retry = true;
     }
     if (retry) {
       // the budget is PER RECEIVE, like the blocking eager seek: any
-      // step progress restarts the clock
+      // step progress restarts the clock (+1 keeps the stamp distinct
+      // from the 0 = "never tried" sentinel on the virtual clock,
+      // whose epoch starts at 0)
       if (c.current_step != step_before)
         c.first_try_ns = uint64_t(
-            duration_cast<nanoseconds>(steady_clock::now().time_since_epoch())
-                .count());
+            duration_cast<nanoseconds>(det_clock_now().time_since_epoch())
+                .count() +
+            1);
       // expire stalled calls against the receive budget (see CallDesc
       // .first_try_ns): a peer that never arrives must surface as the
       // engine's own RECEIVE_TIMEOUT_ERROR, not as a host-side hang
       auto waited = duration_cast<nanoseconds>(
-                        steady_clock::now().time_since_epoch())
+                        det_clock_now().time_since_epoch())
                         .count() -
                     int64_t(c.first_try_ns);
       if (waited > timeout_budget().count()) {
@@ -1635,6 +1660,7 @@ void Engine::loop() {
         r.retcode = sticky_err_ | RECEIVE_TIMEOUT_ERROR;
         r.duration_ns = double(waited);
         r.done = true;
+        det_live_end();
       } else {
         retry_q_.push_back(c);
         // cooperative pacing: the firmware round-robins between the
@@ -2309,7 +2335,8 @@ void Engine::send_eager(CallDesc& c, uint32_t dst, uint32_t tag, uint64_t addr,
 // classifies exactly like today, on the same clock.
 std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
                                                    uint32_t tag,
-                                                   int* evicted_out) {
+                                                   int* evicted_out,
+                                                   Message* staged_out) {
   CommTable& t = *comm_ptr(c.comm());
   seeks_.fetch_add(1);
   link_count(c.comm(), src, &LinkCounters::seeks);
@@ -2328,8 +2355,12 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
                                  .count()));
     }
   } seek_stamp{this, c.comm(), src};
+  // budget measured on the det-aware clock: virtual time under the
+  // model checker (so explored schedules can actually reach expiry —
+  // the wall-clock ingredient the virtual clock used to hide), the
+  // real steady clock in production builds
   auto budget = timeout_budget();
-  auto deadline = steady_clock::now() + budget;
+  auto deadline = det_clock_now() + budget;
   uint32_t retry_max = retrans_enabled() ? retry_max_.load() : 0;
   uint32_t attempts = 0;  // fast-phase NACK rounds consumed
   uint32_t chunks = 0;    // steady-state 50 ms slices elapsed
@@ -2348,8 +2379,39 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
       return std::nullopt;
     }
     uint32_t expect = t.inbound_seq[src];
-    auto now = steady_clock::now();
+    auto now = det_clock_now();
     if (now >= deadline) {
+#if !defined(ACCL_FAULT_SUBCOMM_WEDGE)
+      // last-gasp rescue: the segment may have been staged during the
+      // FINAL slice (after this iteration's seek already missed), so a
+      // timeout must re-probe staging before it classifies — otherwise
+      // a message that did arrive is reported as a slow peer.  Taken
+      // regardless of pool idleness: the budget is gone, in-order
+      // delivery via the normal drain is no longer an option.
+      if (staged_out) {
+        auto sm = rx_.take_staged(c.comm(), src, tag, expect);
+        if (sm) {
+          staged_takes_.fetch_add(1);
+          *staged_out = std::move(*sm);
+          RxNotification n;
+          n.index = UINT32_MAX;  // sentinel: payload rides *staged_out
+          n.bytes = uint32_t(staged_out->payload.size());
+          n.tag = staged_out->hdr.tag;
+          n.src = staged_out->hdr.src;
+          n.seqn = staged_out->hdr.seqn;
+          n.comm = staged_out->hdr.comm_id;
+          n.compressed = staged_out->hdr.compressed;
+          return n;
+        }
+      }
+#endif
+      // classifying a timeout while the expected segment sits in the
+      // staging queue is NOT a slow peer — the data arrived and the
+      // pool never surfaced it (cross-comm pinning).  Counted in every
+      // build: the detsched drill invariant reads this to tell a
+      // genuine wedge from a legitimately-injected slow-peer timeout.
+      if (rx_.has_staged_match(c.comm(), src, tag, expect))
+        wedged_timeouts_.fetch_add(1);
       // a genuine matching failure (timeout after the recovery budget),
       // not an abort/shutdown wake — the seek-miss telemetry observable
       seek_misses_.fetch_add(1);
@@ -2373,6 +2435,34 @@ std::optional<RxNotification> Engine::seek_recover(CallDesc& c, uint32_t src,
     }
     auto note = rx_.seek(c.comm(), src, tag, expect, slice);
     if (note) return note;
+#if !defined(ACCL_FAULT_SUBCOMM_WEDGE)
+    // Staged-segment rescue (the 8-rank sub-comm allgather wedge fix):
+    // when every buffer is RESERVED, the expected segment may be parked
+    // in the staging queue with nothing left to drain it — the comm
+    // pinning the pool will not release() until ITS peer progresses,
+    // which can transitively wait on this very receiver (a cross-comm
+    // dependency cycle through the shared pool).  Instead of burning
+    // the rest of the budget into a RECEIVE_TIMEOUT, consume the
+    // payload straight from staging.  Only under pressure: with an idle
+    // buffer present the normal deposit->notify path is at most one
+    // release() away and must keep its in-order semantics.
+    if (staged_out && !rx_.has_idle()) {
+      auto sm = rx_.take_staged(c.comm(), src, tag, expect);
+      if (sm) {
+        staged_takes_.fetch_add(1);
+        *staged_out = std::move(*sm);
+        RxNotification n;
+        n.index = UINT32_MAX;  // sentinel: payload rides *staged_out
+        n.bytes = uint32_t(staged_out->payload.size());
+        n.tag = staged_out->hdr.tag;
+        n.src = staged_out->hdr.src;
+        n.seqn = staged_out->hdr.seqn;
+        n.comm = staged_out->hdr.comm_id;
+        n.compressed = staged_out->hdr.compressed;
+        return n;
+      }
+    }
+#endif
     // Solicit a retransmission: the fast phase NACKs after every miss
     // (µs-scale recovery for a drop that already happened); afterwards
     // a steady-state NACK every ~200 ms covers a segment dropped LATER
@@ -2416,7 +2506,8 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
     first = false;
     uint64_t chunk = std::min(seg_elems, elems - off);
     int evicted_in_recovery = 0;
-    auto note = seek_recover(c, src, tag, &evicted_in_recovery);
+    Message staged_msg;  // payload home for a staging-queue rescue
+    auto note = seek_recover(c, src, tag, &evicted_in_recovery, &staged_msg);
     if (!note) {
       // abort-wake: seek_recover already stamped the abort bits; this
       // call is fenced, not timed out — no fault classification
@@ -2479,7 +2570,10 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
       return;
     }
     t.inbound_seq[src]++;
-    const uint8_t* data = rx_.data(note->index);
+    // a staged rescue carries its payload in staged_msg, not the pool
+    const uint8_t* data = note->index == UINT32_MAX
+                              ? staged_msg.payload.data()
+                              : rx_.data(note->index);
     // interpret the arriving bytes via OUR OWN flag algebra — the
     // reference eth header carries no compressed marker; each end derives
     // the wire representation from its arithcfg + ETH flag, which is what
@@ -2524,7 +2618,7 @@ void Engine::recv_eager(CallDesc& c, uint32_t src, uint32_t tag, uint64_t addr,
         break;
       }
     }
-    rx_.release(note->index);
+    if (note->index != UINT32_MAX) rx_.release(note->index);
     // a duplicated segment's stale copy (seqn <= the one just consumed)
     // can never match a future seek; drop it now instead of letting it
     // pin a pool buffer until some later timeout runs eviction

@@ -680,12 +680,29 @@ class Engine {
   // `evicted_out` counts suspicious same-route entries evicted during
   // recovery (they classify a final failure as PACK_SEQ, like the
   // entries themselves would have).
+  // `staged_out` (when non-null) may receive a message rescued straight
+  // from the rx pool's staging queue; the returned notification then
+  // carries index == UINT32_MAX and the payload rides *staged_out.
   std::optional<RxNotification> seek_recover(CallDesc& c, uint32_t src,
-                                             uint32_t tag, int* evicted_out);
+                                             uint32_t tag, int* evicted_out,
+                                             Message* staged_out = nullptr);
   // telemetry: recovered-seek entries vs final misses (timeout /
   // lossy-hole classification — NOT abort/shutdown wakes, which are
   // fencing, not matching failures).  miss/seek is the seek-miss rate.
   std::atomic<uint64_t> seeks_{0}, seek_misses_{0};
+  // sub-comm wedge observables: timeouts classified while the expected
+  // segment sat in staging (the cross-comm pool-pinning failure — must
+  // stay 0 on a healthy engine) and staged-rescue consumptions (the fix
+  // firing).  Counted in BOTH normal and ACCL_FAULT_SUBCOMM_WEDGE
+  // builds so the detsched drill invariant reads the same signal.
+  std::atomic<uint64_t> wedged_timeouts_{0}, staged_takes_{0};
+
+ public:
+  uint64_t wedged_timeouts() const { return wedged_timeouts_.load(); }
+  uint64_t staged_takes() const { return staged_takes_.load(); }
+  uint64_t egress_overflows() const { return egress_overflows_.load(); }
+
+ private:
 
   // ---- abort + epoch fencing (resilience layer 2) ----
   static constexpr uint32_t kMaxComms = 64;  // comms_.reserve(64) twin
@@ -786,6 +803,11 @@ class Engine {
   // telemetry: egress staging high-water (depth is read live under
   // egress_mu_ by engine_stats); written at stage time under the lock
   std::atomic<uint64_t> egress_hwm_{0};
+  // backpressure-cycle escape valve: stagings that overflowed the
+  // pipeline window after a full receive budget with no slot (see
+  // stage_egress — ingress-context senders can cycle through each
+  // other's windows; a counted overflow beats a distributed deadlock)
+  std::atomic<uint64_t> egress_overflows_{0};
   std::atomic<uint32_t> pipeline_depth_{3};
   bool egress_running_ ACCL_GUARDED_BY(egress_mu_) = true;
   Thread egress_thread_;

@@ -67,6 +67,11 @@ class RxPool {
       uint64_t s = staging_.size(), h = staged_hwm_.load();
       while (s > h && !staged_hwm_.compare_exchange_weak(h, s)) {
       }
+      // pool exhausted: this deposit parked in staging, which only
+      // release() drains — the precondition for cross-comm pinning.
+      // Tell the model checker so exhaustion-induced timeout orderings
+      // become explorable state (no-op outside detsched runs).
+      det_note_pressure();
     }
   }
 
@@ -208,6 +213,41 @@ class RxPool {
     MutexLock g(m_);
     for (auto s : status_)
       if (s == Status::IDLE) return true;
+    return false;
+  }
+
+  // Pull a STAGED message matching (comm, src, tag|TAG_ANY, seqn)
+  // straight out of the overflow queue, bypassing the buffer table.
+  // The sub-comm wedge rescue: under cross-comm pool pinning the
+  // expected segment can sit in staging FOREVER — release() is the only
+  // drain, and the comm whose segments pin every buffer will not
+  // release until ITS peer progresses, which may in turn wait on this
+  // receiver (a cross-comm dependency cycle through the pool).  A
+  // receiver about to burn its budget takes the payload directly.
+  std::optional<Message> take_staged(uint32_t comm, uint32_t src,
+                                     uint32_t tag, uint32_t seqn) {
+    MutexLock g(m_);
+    for (auto it = staging_.begin(); it != staging_.end(); ++it) {
+      if (it->hdr.comm_id == comm && it->hdr.src == src &&
+          it->hdr.seqn == seqn && (tag == TAG_ANY || it->hdr.tag == tag)) {
+        Message msg = std::move(*it);
+        staging_.erase(it);
+        return msg;
+      }
+    }
+    return std::nullopt;
+  }
+
+  // Non-destructive probe: is a staged message matching the seek
+  // present?  A timeout classified while this is true is the wedge
+  // observable — the data arrived, the pool just never surfaced it.
+  bool has_staged_match(uint32_t comm, uint32_t src, uint32_t tag,
+                        uint32_t seqn) const {
+    MutexLock g(m_);
+    for (const auto& msg : staging_)
+      if (msg.hdr.comm_id == comm && msg.hdr.src == src &&
+          msg.hdr.seqn == seqn && (tag == TAG_ANY || msg.hdr.tag == tag))
+        return true;
     return false;
   }
 

@@ -26,6 +26,7 @@
 #endif
 
 #include <atomic>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <map>
@@ -39,6 +40,18 @@ namespace accl {
 namespace drills {
 
 // ---- tiny world builder -------------------------------------------------
+// Drill rx pools are deliberately SMALL (default 4 x 256 B) so real
+// multi-segment payloads exhaust them inside explored schedules —
+// resource pressure is modeled state, not an accident of sizing.
+// Overridable per invocation for exhaustion-gradient experiments.
+inline uint32_t env_u32(const char* key, uint32_t dflt) {
+  const char* v = std::getenv(key);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  unsigned long x = std::strtoul(v, &end, 10);
+  return (end && *end == '\0' && x > 0) ? uint32_t(x) : dflt;
+}
+
 struct DetWorld {
   std::shared_ptr<InprocHub> hub;
   std::vector<std::unique_ptr<Engine>> eng;
@@ -49,7 +62,8 @@ struct DetWorld {
       eng.push_back(std::make_unique<Engine>(
           uint32_t(r), devmem, std::make_unique<InprocTransport>(hub, r)));
     for (int r = 0; r < nranks; ++r) {
-      eng[size_t(r)]->cfg_rx_buffers(4, 256);
+      eng[size_t(r)]->cfg_rx_buffers(env_u32("ACCL_DETSCHED_RX_BUFS", 4),
+                                     env_u32("ACCL_DETSCHED_RX_BUFSZ", 256));
       setup_comm(r, nranks);
       setup_arith(r);
     }
@@ -363,7 +377,13 @@ inline void subcomm_allgather_impl(int P) {
         uint64_t id = e.start_call(d.data());
         uint32_t ret = w.wait_call(r, id, "sub-comm allgather never "
                                           "completed");
-        det::expect(ret == 0,
+        // On a schedule with timeout injections a non-zero retcode can
+        // be legitimate: an injected expiry IS a slow peer, and a
+        // RECEIVE_TIMEOUT (or the cascade it triggers) is the correct
+        // classification.  The wedge invariant lives below: a timeout
+        // classified while the expected segment sat STAGED is never
+        // legitimate, injected or not.
+        det::expect(ret == 0 || det::timeout_injections() > 0,
                     phase == 0 ? "row allgather classified an error "
                                  "(the sub-comm wedge)"
                                : "column allgather classified an "
@@ -374,12 +394,48 @@ inline void subcomm_allgather_impl(int P) {
     }));
   }
   for (auto& t : ranks) t.join();
+  // THE wedge invariant, schedule-independent: no rank may ever have
+  // classified RECEIVE_TIMEOUT while the segment it was seeking sat in
+  // the rx staging queue (cross-comm pool pinning — data arrived, the
+  // pool never surfaced it).  The ACCL_FAULT_SUBCOMM_WEDGE build
+  // reverts the staged-rescue fix and the explorer must REDISCOVER
+  // this via a timeout injection under pool pressure.
+  uint64_t wedged = 0;
+  for (auto& e : w.eng) wedged += e->wedged_timeouts();
+  det::expect(wedged == 0,
+              "sub-comm wedge: RECEIVE_TIMEOUT classified while the "
+              "expected segment sat staged (cross-comm rx-pool pinning)");
 }
 
 inline void drill_subcomm_allgather() { subcomm_allgather_impl(4); }
 // the full ROADMAP repro scale (heavier per schedule — run with an
 // explicit budget, not in the default --ci sweep)
 inline void drill_subcomm_allgather8() { subcomm_allgather_impl(8); }
+
+// ---- sensitivity drill: a submitted call that never finalizes -----------
+// Exercises the liveness invariant directly: two workers each take a
+// live token (one per "submitted call"); one finalizes, the other
+// returns without handing its token back — the modeled stuck call.  On
+// EVERY schedule the run must end with the stuck-progress finding
+// (run it with --expect-finding).  The engine drills prove the
+// negative: all five finalize paths return their token, so clean runs
+// report zero leaks.
+inline void drill_liveness_leak() {
+  std::atomic<int> done{0};
+  Thread good([&] {
+    det::live_begin();
+    det_sleep_for(std::chrono::microseconds(50));
+    det::live_end();
+    done.fetch_add(1);
+  });
+  Thread stuck([&] {
+    det::live_begin();  // never returned
+    done.fetch_add(1);
+  });
+  good.join();
+  stuck.join();
+  det::expect(done.load() == 2, "liveness workers never ran");
+}
 
 inline const std::map<std::string, std::function<void()>>& registry() {
   static const auto* m = new std::map<std::string, std::function<void()>>{
@@ -390,6 +446,7 @@ inline const std::map<std::string, std::function<void()>>& registry() {
       {"detach_race", drill_detach_race},
       {"subcomm_allgather", drill_subcomm_allgather},
       {"subcomm_allgather8", drill_subcomm_allgather8},
+      {"liveness_leak", drill_liveness_leak},
   };
   return *m;
 }

@@ -10,6 +10,11 @@
 //   --schedule HEX          run exactly one schedule (artifact replay)
 //   --seed S                default-policy seed (part of the artifact)
 //   --pbound K              preemption bound (default 3)
+//   --ibound K              timeout injections per run (default 0 = off;
+//                           part of the artifact — replay needs the same
+//                           value or the decision spaces misalign)
+//   --explore-from HEX      trace-guided: replay this observed prefix
+//                           bit-for-bit, explore only the suffix
 //   --max-steps N           per-run scheduling-step budget
 //   --budget-s S            wall-clock budget for the exploration
 //   --expect-finding        exit 0 iff a finding WAS discovered
@@ -83,6 +88,10 @@ int main(int argc, char** argv) {
       opts.seed = std::strtoull(next(), nullptr, 10);
     } else if (a == "--pbound") {
       opts.preempt_bound = std::atoi(next());
+    } else if (a == "--ibound") {
+      opts.inject_bound = std::atoi(next());
+    } else if (a == "--explore-from") {
+      opts.seed_prefix = hex_decode(next());
     } else if (a == "--max-steps") {
       opts.max_steps = std::strtoull(next(), nullptr, 10);
     } else if (a == "--budget-s") {
@@ -109,14 +118,20 @@ int main(int argc, char** argv) {
   const auto& fn = it->second;
 
   if (do_replay) {
+    Sched::inst().preempt_bound = opts.preempt_bound;
+    Sched::inst().branch_depth = opts.branch_depth;
+    Sched::inst().inject_bound = opts.inject_bound;
     RunResult r =
         Sched::inst().run(hex_decode(schedule_hex), opts.seed, opts.max_steps, fn);
     std::printf(
         "{\"drill\":\"%s\",\"mode\":\"replay\",\"failed\":%s,"
-        "\"what\":\"%s\",\"steps\":%llu,\"seed\":%llu}\n",
+        "\"what\":\"%s\",\"steps\":%llu,\"seed\":%llu,\"ibound\":%d,"
+        "\"injections\":%llu,\"pressure_events\":%llu}\n",
         drill.c_str(), r.failed ? "true" : "false",
         json_escape(r.what).c_str(), (unsigned long long)r.steps,
-        (unsigned long long)opts.seed);
+        (unsigned long long)opts.seed, opts.inject_bound,
+        (unsigned long long)r.injections,
+        (unsigned long long)r.pressure_events);
     bool as_expected = expect_finding ? r.failed : !r.failed;
     return as_expected ? 0 : 1;
   }
@@ -128,14 +143,17 @@ int main(int argc, char** argv) {
       "{\"drill\":\"%s\",\"mode\":\"explore\",\"runs\":%llu,"
       "\"unique_traces\":%llu,\"findings\":%llu,\"what\":\"%s\","
       "\"fail_step\":%llu,\"prefix_hex\":\"%s\",\"trace_hex\":\"%s\","
-      "\"seed\":%llu,\"pbound\":%d,\"max_steps\":%llu}\n",
+      "\"seed\":%llu,\"pbound\":%d,\"ibound\":%d,\"injected_runs\":%llu,"
+      "\"pressure_events\":%llu,\"max_steps\":%llu}\n",
       drill.c_str(), (unsigned long long)st.runs,
       (unsigned long long)st.unique_traces, (unsigned long long)st.findings,
       json_escape(st.first_failure.what).c_str(),
       (unsigned long long)st.first_failure.fail_step,
       hex_encode(st.first_failure_prefix).c_str(),
       hex_encode(st.first_failure.choices).c_str(),
-      (unsigned long long)st.seed, opts.preempt_bound,
+      (unsigned long long)st.seed, opts.preempt_bound, opts.inject_bound,
+      (unsigned long long)st.injected_runs,
+      (unsigned long long)st.pressure_events,
       (unsigned long long)opts.max_steps);
   bool as_expected = expect_finding ? st.findings > 0 : st.findings == 0;
   return as_expected ? 0 : 1;

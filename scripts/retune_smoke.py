@@ -59,10 +59,16 @@ def main() -> int:
     os.environ.setdefault("ACCL_DEFAULT_TIMEOUT", "30000000")
     # single-axis fabric: this drill verifies the CONTROL PLANE
     # (finding -> hypothesis -> A/B -> install), so the challenger
-    # shortlist stays on the register/compression lanes — the composed
-    # hierarchical lane under per-message egress-stall chaos is the
-    # offline composer drill's territory (its multi-stage traffic
-    # amplifies the stall past the engine wait budget on a loaded box)
+    # shortlist stays on the register/compression lanes.  Retested in
+    # r21 after the sub-comm rx-pool-pinning wedge fix: the composed
+    # hierarchical lane under per-message slow_rank chaos still
+    # deadlocks, and with a DIFFERENT signature — the interleaved A/B
+    # arms' sub-comm flights sit in `dispatched` until the engine wait
+    # budget expires (a cross-phase stall between the two composed
+    # structures, not a RECEIVE_TIMEOUT with the segment staged), so
+    # the r21 fix does not cover it.  ROADMAP item 4 residue; a
+    # detsched drill pairing two interleaved HierarchicalComm
+    # instances is the next finder.
     os.environ.setdefault("ACCL_FABRIC", str(args.ranks))
 
     import numpy as np

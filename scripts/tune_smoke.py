@@ -62,8 +62,14 @@ def main() -> int:
     from accl_tpu.observability import metrics as _metrics
     from accl_tpu.tuning import TuneConfig, autotune
 
+    # allgather rejoined the sweep in r21: the 8-rank concurrent
+    # sub-comm wedge that kept it out of the r16 corpus (hierarchical
+    # allgather's row/col sub-comm traffic hit intermittent
+    # RECEIVE_TIMEOUTs) was root-caused to cross-comm rx-pool pinning
+    # and fixed in the engine — model_check.py's subcomm_allgather
+    # drills hold the invariant in CI now
     cfg = TuneConfig(
-        collectives=("allreduce", "bcast", "gather", "reduce"),
+        collectives=("allreduce", "allgather", "bcast", "gather", "reduce"),
         count_pows=(8, 12, 14), repetitions=2, shape=(2, 2),
         measured_demotion=False)
 

@@ -350,6 +350,69 @@ def test_deinit_warns_about_pending_async(caplog):
 
 
 # ---------------------------------------------------------------------------
+# cross-communicator interleave order
+# ---------------------------------------------------------------------------
+def _grid_program(row_first_ranks):
+    """2x2 grid: row comm id 1, col comm id 2 on every rank; ranks in
+    ``row_first_ranks`` enter row-then-col, the rest col-then-row."""
+    def fn(a, rank):
+        row, col = divmod(rank, 2)
+        rc = a.create_communicator([row * 2, row * 2 + 1])
+        cc = a.create_communicator([col, col + 2])
+        s = a.create_buffer(64, np.float32)
+        ro = a.create_buffer(128, np.float32)
+        co = a.create_buffer(128, np.float32)
+        order = [(rc, ro), (cc, co)]
+        if rank not in row_first_ranks:
+            order.reverse()
+        reqs = [a.allgather(s, out, 64, comm_id=cid, run_async=True)
+                for cid, out in order]
+        for req in reqs:
+            req.wait()
+            req.check()
+    return fn
+
+
+def test_subcomm_interleave_divergent_pair_flagged():
+    findings = lint(_grid_program(row_first_ranks={0, 1}), nranks=4)
+    assert [f.code for f in findings] == ["subcomm-interleave-hazard"]
+    f = findings[0]
+    assert f.severity == ERROR
+    assert f.ranks == [0, 2]  # one witness per direction
+    assert "divergent order" in f.message
+
+
+def test_subcomm_interleave_agreed_order_clean():
+    # same grid, every rank row-then-col: one global order, no hazard
+    assert lint(_grid_program(row_first_ranks={0, 1, 2, 3}),
+                nranks=4) == []
+
+
+def test_subcomm_interleave_long_cycle_flagged():
+    # no pair is entered both ways, but the per-rank orders close a
+    # 3-cycle in the comm-order graph: 1<2 (rank 0), 2<3 (rank 1),
+    # 3<1 (rank 2) — no global order exists
+    def fn(a, rank):
+        members = [0, 1, 2]
+        cids = [a.create_communicator(members) for _ in range(3)]
+        s = a.create_buffer(64, np.float32)
+        outs = [a.create_buffer(64 * 3, np.float32) for _ in range(3)]
+        pair = (rank, (rank + 1) % 3)
+        reqs = []
+        for k in pair:
+            reqs.append(a.allgather(s, outs[k], 64, comm_id=cids[k],
+                                    run_async=True))
+        for req in reqs:
+            req.wait()
+            req.check()
+
+    findings = lint(fn, nranks=3)
+    assert "subcomm-interleave-hazard" in codes(findings)
+    cyc = [f for f in findings if f.code == "subcomm-interleave-hazard"]
+    assert len(cyc) == 1 and "acquisition cycle" in cyc[0].message
+
+
+# ---------------------------------------------------------------------------
 # CLI round-trips over the committed fixtures
 # ---------------------------------------------------------------------------
 def run_cli(*args):
@@ -387,6 +450,12 @@ def test_cli_param_mismatch_fixture_flagged():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "param-mismatch" in proc.stdout
     assert "count=256" in proc.stdout and "count=128" in proc.stdout
+
+
+def test_cli_subcomm_interleave_fixture_flagged():
+    proc = run_cli(os.path.join(FIXTURES, "subcomm_interleave_fixture.py"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "subcomm-interleave-hazard" in proc.stdout
 
 
 def test_cli_strict_promotes_warnings(tmp_path):
@@ -720,6 +789,52 @@ def test_lock_order_sequential_acquisition_clean():
                t_complete=25)]
     merged = {"ranks": [_dump(0, r0), _dump(1, r1)]}
     assert check_lock_order(merged) == []
+
+
+def test_stuck_progress_through_teardown_is_error():
+    from accl_tpu.analysis.checks import check_stuck_progress
+
+    recs = [
+        _rec(0, 0, "allreduce", gang=True, t_complete=20),
+        # a dispatched recv that never finalized, with the world torn
+        # down around it: the liveness violation (the sub-comm wedge's
+        # dump signature)
+        _rec(0, 1, "recv", state="dispatched", comm=2, t_submit=30),
+        _rec(0, 2, "engine_teardown", comm=-1, t_submit=100,
+             t_complete=100, lane="lifecycle"),
+    ]
+    findings = check_stuck_progress(_dump(0, recs))
+    assert [f.code for f in findings] == ["stuck-progress"]
+    f = findings[0]
+    assert f.severity == ERROR and f.index == 1 and f.comm == 2
+
+
+def test_stuck_progress_midrun_snapshot_is_warning():
+    from accl_tpu.analysis.checks import check_stuck_progress
+
+    # no teardown anchor: the dump may be a live snapshot, so the
+    # in-flight record downgrades to a warning
+    recs = [_rec(0, 0, "allgather", gang=True, state="queued")]
+    findings = check_stuck_progress(_dump(0, recs))
+    assert [(f.code, f.severity) for f in findings] == \
+        [("stuck-progress", WARNING)]
+
+
+def test_stuck_progress_terminal_states_clean():
+    from accl_tpu.analysis.checks import check_stuck_progress
+
+    # complete, failed and ABORTED (teardown's finalize sweep) all
+    # count as finalized — liveness holds
+    recs = [
+        _rec(0, 0, "allreduce", gang=True, t_complete=20),
+        _rec(0, 1, "recv", state="failed", retcode=1 << 11,
+             t_complete=30),
+        _rec(0, 2, "send", state="aborted", retcode=1 << 27,
+             t_complete=40),
+        _rec(0, 3, "engine_teardown", comm=-1, t_submit=100,
+             t_complete=100, lane="lifecycle"),
+    ]
+    assert check_stuck_progress(_dump(0, recs)) == []
 
 
 def test_lifecycle_suite_end_to_end_on_real_world(tmp_path):

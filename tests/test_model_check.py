@@ -12,7 +12,14 @@ contract end to end:
   the artifact alone (the same hex+seed contract as fuzz_wire.py);
 * the artifact round-trip: explore -> artifact -> --replay reproduces
   the identical finding, and the same schedule on the FIXED build runs
-  clean.
+  clean;
+* r19 — the timeout- and resource-aware upgrade: injection branching
+  is deterministic and off-by-default-identical (``--ibound 0``),
+  rx-pool occupancy is explored state (tightening the pool surfaces
+  more pressure decision points), trace-guided exploration refinds a
+  captured failure on schedule one, and the liveness invariant (every
+  submitted call finalizes) fires on a seeded leak and stays quiet on
+  clean engine drills.
 
 Builds are driven through the native Makefile once per session; the
 whole module self-skips when no C++ toolchain is available.
@@ -48,9 +55,10 @@ def harness():
     return BIN
 
 
-def run_json(binary, *args, timeout=180):
+def run_json(binary, *args, timeout=180, env=None):
     proc = subprocess.run(
-        [binary, *args], capture_output=True, text=True, timeout=timeout
+        [binary, *args], capture_output=True, text=True, timeout=timeout,
+        env=env,
     )
     line = proc.stdout.strip().splitlines()[-1]
     out = json.loads(line)
@@ -145,3 +153,97 @@ def test_model_check_cli_artifact_roundtrip(harness, tmp_path):
     )
     assert replay.returncode == 0, replay.stdout + replay.stderr
     assert "reproduced" in replay.stdout
+
+
+# ---- r19: timeout- and resource-aware exploration ------------------------
+
+
+def test_timeout_branch_determinism(harness):
+    # same (drill, seed, ibound) -> identical sweep including the
+    # injection schedule; and ibound=0 keeps the legacy explorer
+    # bit-identical (no injections ever, so pre-r19 artifacts replay)
+    a = run_json(harness, "--drill", "subcomm_allgather", "--explore",
+                 "60", "--seed", "7", "--ibound", "1")
+    b = run_json(harness, "--drill", "subcomm_allgather", "--explore",
+                 "60", "--seed", "7", "--ibound", "1")
+    keys = ("runs", "unique_traces", "findings", "injected_runs",
+            "pressure_events")
+    assert [a[k] for k in keys] == [b[k] for k in keys]
+    assert a["findings"] == 0
+    assert a["injected_runs"] > 0  # the injector really branched
+    legacy = run_json(harness, "--drill", "subcomm_allgather", "--explore",
+                      "60", "--seed", "7", "--ibound", "0")
+    assert legacy["findings"] == 0
+    assert legacy["injected_runs"] == 0
+
+
+def test_resource_bound_exploration(harness):
+    # rx-pool occupancy is modeled state: halving the pool must surface
+    # MORE exhaustion decision points (pressure events arm the timeout
+    # injector exactly where pinning can starve a match), and the fixed
+    # engine must stay clean under the extra injected expiries
+    wide = run_json(harness, "--drill", "subcomm_allgather", "--explore",
+                    "40", "--seed", "3", "--ibound", "1")
+    tight_env = dict(os.environ, ACCL_DETSCHED_RX_BUFS="2")
+    tight = run_json(harness, "--drill", "subcomm_allgather", "--explore",
+                     "40", "--seed", "3", "--ibound", "1", env=tight_env)
+    assert wide["pressure_events"] > 0
+    assert tight["pressure_events"] > wide["pressure_events"]
+    assert tight["findings"] == 0
+    assert tight["exit_code"] == 0
+
+
+def test_trace_guided_exploration_roundtrip(harness):
+    # seed the DFS from a captured failing trace: the fault build
+    # refinds the race on schedule ONE instead of searching; the fixed
+    # build explores the same guided prefix clean (the fix, not
+    # schedule luck, holds the invariant)
+    found = run_json(BIN_FAULT, "--drill", "detach_race", "--explore",
+                     "500", "--seed", "3", "--expect-finding")
+    trace = found["trace_hex"]
+    assert trace
+    guided = run_json(BIN_FAULT, "--drill", "detach_race", "--explore",
+                      "50", "--seed", "3", "--explore-from", trace,
+                      "--expect-finding")
+    assert guided["runs"] == 1
+    assert guided["findings"] == 1
+    assert guided["what"] == found["what"]
+    fixed = run_json(harness, "--drill", "detach_race", "--explore", "50",
+                     "--seed", "3", "--explore-from", trace)
+    assert fixed["findings"] == 0
+    assert fixed["exit_code"] == 0
+
+
+def test_liveness_positive_and_negative(harness):
+    # positive: the seeded leak drill (a live token never handed back)
+    # ends with the stuck-progress finding on its very first schedule;
+    # negative: a clean engine drill with blocked-then-finalized calls
+    # returns every token through the finalize paths
+    leak = run_json(harness, "--drill", "liveness_leak", "--explore",
+                    "50", "--seed", "3", "--expect-finding")
+    assert leak["exit_code"] == 0
+    assert leak["findings"] >= 1
+    assert "stuck-progress" in leak["what"]
+    clean = run_json(harness, "--drill", "shutdown_vs_waiters",
+                     "--explore", "150", "--seed", "3")
+    assert clean["findings"] == 0
+    assert clean["exit_code"] == 0
+
+
+def test_unknown_drill_lists_registry(harness):
+    # the harness refuses with exit 2 and points at --list; the
+    # orchestrator does the listing itself so a typoed --drill/--replay
+    # name shows the caller what IS runnable
+    proc = subprocess.run(
+        [harness, "--drill", "no_such_drill", "--explore", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "unknown drill" in proc.stderr
+    mc = subprocess.run(
+        [sys.executable, MODEL_CHECK, "--drill", "no_such_drill",
+         "--no-build"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert mc.returncode == 2
+    assert "subcomm_allgather8" in mc.stdout + mc.stderr
